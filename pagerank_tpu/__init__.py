@@ -1,0 +1,33 @@
+"""pagerank_tpu — a TPU-native PageRank framework.
+
+A ground-up re-design of the capabilities of
+`mayursharma/PageRank-using-Apache-Spark` (reference: `Sparky.java`) for
+TPU hardware: JAX/XLA for the compute path, `shard_map` over a device
+mesh + `psum` over ICI for the distributed substrate that the reference
+inherits from Apache Spark (RDD shuffles, broadcasts, driver sync).
+
+Layer map (mirrors SURVEY.md §1):
+  L0 cluster runtime/comms -> jax.sharding.Mesh + XLA collectives (parallel/)
+  L1 ingestion             -> host-side loaders (ingest/)
+  L2 graph construction    -> CSC/COO arrays + masks (graph.py)
+  L3 iterative solver      -> jitted power iteration (models/, engines/, ops/)
+  L4 output/persistence    -> per-iteration snapshots (utils/snapshot.py)
+"""
+
+from pagerank_tpu.graph import Graph, build_graph
+from pagerank_tpu.utils.config import PageRankConfig
+from pagerank_tpu.engine import PageRankEngine, make_engine
+from pagerank_tpu.engines.cpu import ReferenceCpuEngine
+from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Graph",
+    "build_graph",
+    "PageRankConfig",
+    "PageRankEngine",
+    "make_engine",
+    "ReferenceCpuEngine",
+    "JaxTpuEngine",
+]

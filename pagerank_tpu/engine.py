@@ -1,0 +1,103 @@
+"""`PageRankEngine` — the thin API layer the reference lacks (SURVEY.md §1:
+"no API layer, no CLI"; the BASELINE.json north star asks for a
+`PageRankEngine` interface with a CPU-oracle impl and a JAX/TPU impl).
+
+An engine owns the L3 iterative-solver state. The driver loop here plays
+the role of the reference's `for (iter = 0; iter < 10; iter++)` block
+(Sparky.java:187-238): step, log (`:188`), snapshot (`:237`), repeat.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from pagerank_tpu.graph import Graph
+from pagerank_tpu.utils.config import PageRankConfig
+
+
+class PageRankEngine(abc.ABC):
+    """Base class for PageRank execution engines."""
+
+    name: str = "abstract"
+
+    def __init__(self, config: Optional[PageRankConfig] = None):
+        self.config = (config or PageRankConfig()).validate()
+        self.graph: Optional[Graph] = None
+        self.iteration = 0
+
+    @abc.abstractmethod
+    def build(self, graph: Graph) -> "PageRankEngine":
+        """Prepare solver state (device placement, sharding, r0)."""
+
+    @abc.abstractmethod
+    def step(self) -> Dict[str, float]:
+        """Run one power iteration; returns per-iteration info
+        (at least ``dangling_mass``; ``l1_delta`` when cheap)."""
+
+    @abc.abstractmethod
+    def ranks(self) -> np.ndarray:
+        """Current rank vector as a host numpy array."""
+
+    def set_ranks(self, r: np.ndarray, iteration: int = 0) -> None:
+        """Overwrite solver state — used by checkpoint resume."""
+        raise NotImplementedError
+
+    def run(
+        self,
+        num_iters: Optional[int] = None,
+        on_iteration: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    ) -> np.ndarray:
+        """Drive ``num_iters`` iterations (default: config.num_iters).
+
+        ``on_iteration(i, info)`` fires after each step — the hook point
+        for metrics logging and per-iteration snapshots (the reference's
+        println + saveAsTextFile, Sparky.java:188,237).
+        """
+        if self.graph is None:
+            raise RuntimeError("call build(graph) before run()")
+        total = self.config.num_iters if num_iters is None else num_iters
+        tol = self.config.tol
+        while self.iteration < total:
+            info = self.step()
+            i = self.iteration
+            self.iteration += 1
+            if on_iteration is not None:
+                on_iteration(i, info)
+            if tol is not None:
+                delta = info.get("l1_delta")
+                if delta is None:
+                    raise RuntimeError(
+                        f"engine {self.name} does not report l1_delta; cannot use tol"
+                    )
+                if float(delta) <= tol:
+                    break
+        return self.ranks()
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_engine(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.name = name
+        return cls
+
+    return deco
+
+
+def make_engine(name: str, config: Optional[PageRankConfig] = None) -> PageRankEngine:
+    """Engine factory: "cpu" (reference-semantics numpy/scipy oracle) or
+    "jax" (TPU-native; aliases "tpu", "jax_tpu")."""
+    # Import for registration side effects.
+    import pagerank_tpu.engines.cpu  # noqa: F401
+    import pagerank_tpu.engines.jax_engine  # noqa: F401
+
+    alias = {"tpu": "jax", "jax_tpu": "jax", "reference": "cpu", "oracle": "cpu"}
+    key = alias.get(name, name)
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown engine {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[key](config)

@@ -1,0 +1,70 @@
+"""ReferenceCpuEngine — float64 numpy/scipy oracle with exact reference
+semantics.
+
+This engine stands in for the Spark-RDD engine (no JVM/Spark in this
+environment): it computes, in exact vectorized form, what
+`Sparky.java:187-238` computes in local[*] mode:
+
+  contribs  = Aᵀ_norm r          # join+flatMap+reduceByKey, Sparky.java:192-229
+  m         = Σ_{dangling} r     # danglingContrib loop,      Sparky.java:219-222
+  sum       = contribs + z ⊙ r   # subtractByKey retention,   Sparky.java:224-225
+  r'        = 0.15 + 0.85 (sum + m/N)                       # Sparky.java:233
+
+It is the acceptance oracle for every other engine (L1 ≤ 1e-6 gate,
+BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from pagerank_tpu import graph as graph_lib
+from pagerank_tpu.engine import PageRankEngine, register_engine
+from pagerank_tpu.graph import Graph
+from pagerank_tpu.models import pagerank as pr_model
+
+
+@register_engine("cpu")
+class ReferenceCpuEngine(PageRankEngine):
+    """Single-host float64 oracle (scipy.sparse SpMV)."""
+
+    def build(self, graph: Graph) -> "ReferenceCpuEngine":
+        self.graph = graph
+        self._at = graph_lib.to_csr_transpose(graph)  # Aᵀ_norm, CSR
+        # Reference mode uses the post-repair dangUrls (uncrawled targets);
+        # textbook mode uses the standard definition (out_degree == 0).
+        mass_mask = (
+            graph.dangling_mask
+            if self.config.semantics == "reference"
+            else graph.out_degree == 0
+        )
+        self._dangling = mass_mask.astype(np.float64)
+        self._zero_in = graph.zero_in_mask.astype(np.float64)
+        self._r = pr_model.initial_rank(
+            graph.n, self.config.semantics, np.float64, np
+        )
+        self.iteration = 0
+        return self
+
+    def step(self) -> Dict[str, float]:
+        cfg = self.config
+        r = self._r
+        contrib = self._at @ r
+        m = float(self._dangling @ r)
+        r_new = pr_model.apply_update(
+            contrib, r, self._zero_in, m, self.graph.n, cfg.damping, cfg.semantics, np
+        )
+        delta = float(np.abs(r_new - r).sum())
+        self._r = r_new
+        return {"dangling_mass": m, "l1_delta": delta}
+
+    def ranks(self) -> np.ndarray:
+        return np.asarray(self._r)
+
+    def set_ranks(self, r: np.ndarray, iteration: int = 0) -> None:
+        if r.shape != (self.graph.n,):
+            raise ValueError(f"rank shape {r.shape} != ({self.graph.n},)")
+        self._r = np.asarray(r, dtype=np.float64)
+        self.iteration = iteration

@@ -1,0 +1,187 @@
+"""Host-side graph construction (L2).
+
+Replaces the reference's RDD graph build (`Sparky.java:78-184`):
+  - edge dedup + adjacency build (`.distinct().groupByKey()`, Sparky.java:124)
+  - vertex-universe completion: sources ∪ targets ∪ crawled-but-linkless
+    pages (Sparky.java:137-161)
+  - dangling set: `dangUrls` additions (Sparky.java:114-118,147-150) minus
+    the repair pass (:172-184). Because `JavaPairRDD.lookup` returns the
+    *list of values* for a key, a crawled linkless page's lookup yields a
+    non-null Iterable([null]) and the repair pass REMOVES it; only
+    uncrawled targets (stored value literally null, :149) survive. The
+    post-repair dangling-mass set is therefore exactly the *uncrawled
+    targets* — vertices that never appear as a crawl source. For pure
+    edge-list inputs every source has out-degree > 0, so this coincides
+    with out_degree == 0 (the default mask); crawl ingestion passes an
+    explicit ~crawled mask instead.
+  - the "missing-key retention" mask z = (in_degree == 0) needed by the
+    reference's `subtractByKey` quirk (Sparky.java:224-225)
+
+The device-facing representation is a deduplicated COO edge list sorted
+by destination (CSC order) so the per-iteration scatter-add is a sorted
+segment-sum, plus per-edge contribution weights w[e] = 1/out_degree[src[e]].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class Graph:
+    """A directed graph in destination-sorted COO form.
+
+    Attributes:
+      n: number of vertices (the reference's ``totalUrlCount``,
+         Sparky.java:162).
+      src, dst: int32 [num_edges] deduplicated edges, sorted by (dst, src).
+      out_degree: int32 [n] — number of *unique* targets per source
+         (dedup before out-degree, Sparky.java:124; self-loops kept).
+      in_degree: int32 [n].
+      dangling_mask: bool [n] — the reference's ``dangUrls`` after its
+         repair pass (Sparky.java:172-184): uncrawled targets. Defaults
+         to out_degree == 0 (exact for edge-list inputs); crawl
+         ingestion overrides it with ~crawled.
+      zero_in_mask: bool [n] — in_degree == 0 (vertices that receive no
+         contributions; the reference re-feeds them their old rank via
+         ``subtractByKey``, Sparky.java:224-225).
+      edge_weight: float64 [num_edges] — 1 / out_degree[src[e]].
+      vertex_names: optional id->name table when built from string keys.
+    """
+
+    n: int
+    src: np.ndarray
+    dst: np.ndarray
+    out_degree: np.ndarray
+    in_degree: np.ndarray
+    dangling_mask: np.ndarray
+    zero_in_mask: np.ndarray
+    edge_weight: np.ndarray
+    vertex_names: Optional[Sequence[str]] = field(default=None, repr=False)
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    def fingerprint(self) -> str:
+        """Stable hash of the graph structure, used to validate that a
+        checkpoint being resumed matches the graph (utils/snapshot.py)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.int64(self.n).tobytes())
+        h.update(self.src.tobytes())
+        h.update(self.dst.tobytes())
+        return h.hexdigest()[:16]
+
+
+def build_graph(
+    src: np.ndarray,
+    dst: np.ndarray,
+    n: Optional[int] = None,
+    extra_vertices: Optional[np.ndarray] = None,
+    dedup: bool = True,
+    dangling_mask: Optional[np.ndarray] = None,
+    vertex_names: Optional[Sequence[str]] = None,
+) -> Graph:
+    """Build a :class:`Graph` from raw (src, dst) edge arrays.
+
+    Mirrors the reference's graph-construction semantics:
+      - duplicate (src, dst) edges collapse before out-degree is counted
+        (``.distinct()``, Sparky.java:124);
+      - the vertex universe is sources ∪ targets ∪ ``extra_vertices``
+        (crawled pages with no anchor links — the reference's dangling
+        sentinel rows, Sparky.java:114-118 — and linked-to-but-uncrawled
+        targets, Sparky.java:137-161);
+      - self-loops are *not* filtered (SURVEY.md §2a.5).
+
+    Args:
+      src, dst: integer edge arrays of equal length.
+      n: vertex count; inferred as max id + 1 when omitted.
+      extra_vertices: ids of vertices with no edges that must still exist.
+      dedup: collapse duplicate edges (reference behavior). Disable only
+        for pre-deduplicated inputs.
+      dangling_mask: explicit dangling-mass membership (the post-repair
+        ``dangUrls``). Default: out_degree == 0, which equals the
+        reference semantics for edge-list inputs; crawl ingestion passes
+        ~crawled because the repair pass un-dangles every crawled page
+        (see module docstring).
+    """
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    if src.shape != dst.shape:
+        raise ValueError(f"src/dst length mismatch: {src.shape} vs {dst.shape}")
+
+    if n is None:
+        n = 0
+        for arr in (src, dst, extra_vertices):
+            if arr is not None and len(arr) > 0:
+                n = max(n, int(np.max(arr)) + 1)
+    n = int(n)
+    if n == 0:
+        raise ValueError("empty graph: no vertices")
+
+    if len(src) > 0 and (src.min() < 0 or src.max() >= n or dst.min() < 0 or dst.max() >= n):
+        raise ValueError("edge endpoint out of range [0, n)")
+
+    # Dedup + sort by (dst, src) in one pass via a packed 64-bit key.
+    # dst-major ordering makes the per-iteration scatter a *sorted*
+    # segment-sum (fast path on TPU).
+    if len(src) > 0:
+        key = dst * np.int64(n) + src
+        if dedup:
+            key = np.unique(key)  # unique() also sorts
+        else:
+            key = np.sort(key, kind="stable")
+        dst_s = (key // n).astype(np.int32)
+        src_s = (key % n).astype(np.int32)
+    else:
+        src_s = np.zeros(0, dtype=np.int32)
+        dst_s = np.zeros(0, dtype=np.int32)
+
+    out_degree = np.bincount(src_s, minlength=n).astype(np.int32)
+    in_degree = np.bincount(dst_s, minlength=n).astype(np.int32)
+
+    if dangling_mask is None:
+        dangling_mask = out_degree == 0
+    else:
+        dangling_mask = np.ascontiguousarray(dangling_mask, dtype=bool)
+        if dangling_mask.shape != (n,):
+            raise ValueError(f"dangling_mask shape {dangling_mask.shape} != ({n},)")
+        if np.any(dangling_mask & (out_degree > 0)):
+            raise ValueError("dangling_mask marks a vertex that has out-edges")
+    zero_in_mask = in_degree == 0
+
+    with np.errstate(divide="ignore"):
+        inv_out = np.where(out_degree > 0, 1.0 / out_degree.astype(np.float64), 0.0)
+    edge_weight = inv_out[src_s]
+
+    return Graph(
+        n=n,
+        src=src_s,
+        dst=dst_s,
+        out_degree=out_degree,
+        in_degree=in_degree,
+        dangling_mask=dangling_mask,
+        zero_in_mask=zero_in_mask,
+        edge_weight=edge_weight,
+        vertex_names=vertex_names,
+    )
+
+
+def to_csr_transpose(graph: Graph):
+    """The row-normalized adjacency, transposed, as ``scipy.sparse.csr_matrix``.
+
+    ``A_T[d, s] = 1/out_degree[s]`` for each edge s->d, so the reference's
+    contribution scatter + reduceByKey (Sparky.java:192-229) is exactly
+    ``A_T @ r``. Used by the CPU oracle engine.
+    """
+    from scipy import sparse
+
+    return sparse.csr_matrix(
+        (graph.edge_weight, (graph.dst, graph.src)),
+        shape=(graph.n, graph.n),
+    )

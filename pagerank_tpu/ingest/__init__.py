@@ -1,0 +1,17 @@
+from pagerank_tpu.ingest.ids import IdMap, records_to_graph
+from pagerank_tpu.ingest.edgelist import (
+    load_edgelist,
+    load_binary_edges,
+    save_binary_edges,
+)
+from pagerank_tpu.ingest.crawljson import parse_metadata_record, load_crawl_file
+
+__all__ = [
+    "IdMap",
+    "records_to_graph",
+    "load_edgelist",
+    "load_binary_edges",
+    "save_binary_edges",
+    "parse_metadata_record",
+    "load_crawl_file",
+]

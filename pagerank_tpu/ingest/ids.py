@@ -1,0 +1,84 @@
+"""String-vertex id assignment (C8 in SURVEY.md §2).
+
+The reference collects all source urls to the driver and broadcasts a
+HashSet for membership tests (Sparky.java:127-135). The TPU-native
+equivalent is a host-side url -> int32 id dictionary built once during
+ingestion; devices only ever see integer ids.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from pagerank_tpu.graph import Graph, build_graph
+
+
+class IdMap:
+    """Insertion-ordered string -> int32 id assignment."""
+
+    def __init__(self):
+        self._ids = {}
+        self._names: List[str] = []
+
+    def get_or_add(self, name: str) -> int:
+        i = self._ids.get(name)
+        if i is None:
+            i = len(self._names)
+            self._ids[name] = i
+            self._names.append(name)
+        return i
+
+    def get(self, name: str) -> Optional[int]:
+        return self._ids.get(name)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._ids
+
+    @property
+    def names(self) -> List[str]:
+        return self._names
+
+
+def records_to_graph(
+    records: Iterable[Tuple[str, List[str]]],
+) -> Tuple[Graph, IdMap]:
+    """Build a :class:`Graph` from (url, anchor-targets) crawl records.
+
+    A record with no targets contributes a vertex with no out-edges — the
+    reference's dangling sentinel (Sparky.java:114-118). Linked-to but
+    never-crawled targets become vertices too (Sparky.java:137-161); that
+    falls out of id assignment covering both endpoints.
+
+    Dangling-mass membership follows the post-repair ``dangUrls``
+    (Sparky.java:172-184): *uncrawled targets only*. A crawled page with
+    no anchor links contributes nothing and is NOT in the dangling mass —
+    its lookup value is a non-null Iterable([null]), so the repair pass
+    removes it (see graph.py module docstring).
+    """
+    ids = IdMap()
+    src: List[int] = []
+    dst: List[int] = []
+    crawled: List[int] = []
+    for url, targets in records:
+        u = ids.get_or_add(url)
+        crawled.append(u)
+        for t in targets:
+            src.append(u)
+            dst.append(ids.get_or_add(t))
+    n = len(ids)
+    crawled_mask = np.zeros(n, dtype=bool)
+    if crawled:
+        crawled_mask[np.asarray(crawled)] = True
+    graph = build_graph(
+        np.asarray(src, dtype=np.int64),
+        np.asarray(dst, dtype=np.int64),
+        n=n,
+        dangling_mask=~crawled_mask,
+        vertex_names=ids.names,
+    )
+    return graph, ids

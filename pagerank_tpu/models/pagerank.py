@@ -1,0 +1,60 @@
+"""The PageRank update rules — pure math, backend-agnostic.
+
+Two semantics modes (SURVEY.md §2a):
+
+**reference** — exactly what `Sparky.java`'s local-mode run computes:
+    r0 = 1                                          (Sparky.java:168)
+    r' = 0.15 + d * (Aᵀ_norm r  +  z ⊙ r  +  (mᵀ r)/N · 1)   (:229-235)
+  where
+    Aᵀ_norm[t, s] = 1/out_degree[s] per unique edge s→t (:124,:192-216),
+    z = (in_degree == 0)  — vertices that receive no contributions keep
+        their *old rank* as their contribution sum, via
+        ``ranks.subtractByKey(contribs)`` + union (:224-225),
+    m = (out_degree == 0) — dangling mass spread uniformly,
+        ``danglingContrib / totalUrlCount`` (:219-222, :233).
+  Ranks sum ≈ N ("N-scaled" formulation — 0.15, not (1-d)/N).
+
+**textbook** — standard normalized PageRank:
+    r0 = 1/N
+    r' = (1-d)/N + d * (Aᵀ_norm r + (mᵀ r)/N · 1)
+
+Both are expressed over a *contribution sum* computed by the backend
+(segment-sum over edges on device, scipy SpMV on host), so the same
+update applies to every engine.
+"""
+
+from __future__ import annotations
+
+
+def apply_update(contrib_sum, r_old, zero_in_mask, dangling_mass, n, damping, semantics, xp):
+    """Combine the per-vertex contribution sum into the next rank vector.
+
+    Args:
+      contrib_sum: [n] (or [n, k] for personalized batches) — Aᵀ_norm r.
+      r_old: previous rank vector, same shape.
+      zero_in_mask: [n] float mask, 1.0 where in_degree == 0.
+      dangling_mass: scalar (or [k]) — Σ_dangling r_old.
+      n: vertex count.
+      damping: d in (0,1).
+      semantics: "reference" | "textbook".
+      xp: array namespace (numpy or jax.numpy).
+    """
+    if semantics == "reference":
+        s = contrib_sum + _bcast(zero_in_mask, r_old) * r_old
+        return (1.0 - damping) + damping * (s + dangling_mass / n)
+    elif semantics == "textbook":
+        return (1.0 - damping) / n + damping * (contrib_sum + dangling_mass / n)
+    raise ValueError(f"unknown semantics: {semantics!r}")
+
+
+def initial_rank(n, semantics, dtype, xp, batch: int | None = None):
+    """r0 = 1.0 per vertex in reference mode (Sparky.java:165-170);
+    1/N in textbook mode. ``batch`` adds a trailing axis for PPR."""
+    shape = (n,) if batch is None else (n, batch)
+    v = 1.0 if semantics == "reference" else 1.0 / n
+    return xp.full(shape, v, dtype=dtype)
+
+
+def _bcast(mask, like):
+    # Broadcast a [n] mask against [n] or [n, k] rank arrays.
+    return mask if like.ndim == 1 else mask[:, None]

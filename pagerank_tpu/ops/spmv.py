@@ -1,0 +1,58 @@
+"""The contribution-scatter SpMV (L3 hot op).
+
+This single op replaces the reference's entire per-iteration shuffle
+pipeline — `allUrls.join(ranks)` → flatMap(rank/out_degree per target) →
+`reduceByKey(Sum)` (Sparky.java:192-216, 229; 3 shuffles / O(E)
+emissions) — with a gather + multiply + sorted segment-sum over a
+destination-sorted COO edge shard:
+
+    contrib[t] = Σ_{edges s→t} r[s] / out_degree[s]
+
+Edges arrive sorted by dst (graph.py packs keys dst-major), so
+``indices_are_sorted=True`` takes XLA's fast segment-sum path on TPU.
+Dangling sources have no edges, so they emit nothing — exactly the
+reference's null-sentinel behavior (Sparky.java:198-206, SURVEY.md §2a.6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def edge_contrib_segment_sum(r, src, dst, w, n, accum_dtype=None):
+    """contrib = Aᵀ_norm r over one COO edge shard.
+
+    Args:
+      r: [n] (or [n, k] batched) rank vector, replicated.
+      src, dst: int32 [e] edge endpoints, sorted by dst. Padding edges
+        must carry w == 0 (their contribution vanishes).
+      w: [e] per-edge weight 1/out_degree[src].
+      n: number of vertices (static).
+      accum_dtype: dtype for the gather-multiply-accumulate; defaults to
+        r.dtype. Use a wider type to protect the 1e-6 L1 budget on
+        heavy-tailed in-degree distributions (SURVEY.md §7).
+
+    Returns:
+      [n] (or [n, k]) partial contribution sums in accum_dtype.
+    """
+    acc = accum_dtype or r.dtype
+    wa = w.astype(acc)
+    if r.ndim == 2:
+        vals = r[src].astype(acc) * wa[:, None]
+    else:
+        vals = r[src].astype(acc) * wa
+    return jax.ops.segment_sum(
+        vals, dst, num_segments=n, indices_are_sorted=True
+    )
+
+
+def dangling_mass(r, dangling, accum_dtype=None):
+    """m = Σ_{out_degree==0} r — the reference's ``danglingContrib`` loop
+    (one distributed lookup per dangling URL per iteration,
+    Sparky.java:219-222) collapsed to a single on-device reduction."""
+    acc = accum_dtype or r.dtype
+    d = dangling.astype(acc)
+    if r.ndim == 2:
+        return d @ r.astype(acc)
+    return jnp.vdot(d, r.astype(acc))

@@ -1,0 +1,45 @@
+"""Device mesh construction (L0) — the stand-in for the reference's
+SparkConf/JavaSparkContext cluster bring-up (Sparky.java:40-41).
+
+The framework's single parallel axis is the *edge dimension* (SURVEY.md
+§2 P1/P5): a 1-D mesh whose devices each own a contiguous block of the
+destination-sorted edge list. Rank vectors are replicated; per-iteration
+communication is one `psum` of dense partials over ICI (intra-slice) or
+DCN (multi-host).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    axis_name: str = "data",
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D mesh over the first ``num_devices`` visible devices (all by
+    default)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devs)} visible"
+            )
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def edge_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for edge arrays: split along the (only) mesh axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for rank vectors / masks / scalars: fully replicated —
+    the analogue of Spark broadcast variables (Sparky.java:135,162)."""
+    return NamedSharding(mesh, P())

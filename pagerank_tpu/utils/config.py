@@ -1,0 +1,66 @@
+"""Configuration (SURVEY.md §5: the reference has none — everything is
+hardcoded: iterations `Sparky.java:187`, damping `:233`, input paths
+`:44-58`, output bucket `:237`. Here all of it is a dataclass + CLI flags).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# Semantics modes (SURVEY.md §2a): "reference" reproduces the Spark
+# program's local-mode behavior bit-for-bit in exact arithmetic;
+# "textbook" is the standard normalized PageRank.
+SEMANTICS_REFERENCE = "reference"
+SEMANTICS_TEXTBOOK = "textbook"
+
+
+@dataclass
+class PageRankConfig:
+    """All knobs for a PageRank run.
+
+    Defaults reproduce the reference workload shape: 10 iterations
+    (Sparky.java:187), damping 0.85 (:233), reference semantics
+    (N-scaled ranks initialized to 1.0, :168).
+    """
+
+    num_iters: int = 10
+    damping: float = 0.85
+    semantics: str = SEMANTICS_REFERENCE
+
+    # Numerics. dtype holds the rank vector; accum_dtype is used for the
+    # contribution segment-sum and dangling-mass reduction (the central
+    # precision/speed tradeoff on TPU — SURVEY.md §7 hard parts).
+    dtype: str = "float32"
+    accum_dtype: str = "float32"
+
+    # Early stop: if set, stop when L1(r' - r) <= tol. The reference has
+    # no convergence check (Sparky.java:187); None reproduces that.
+    tol: Optional[float] = None
+
+    # Parallelism: number of mesh devices (None = all visible devices).
+    num_devices: Optional[int] = None
+    mesh_axis: str = "data"
+
+    # Snapshots (the reference writes the full rank vector to S3 after
+    # *every* iteration, Sparky.java:237). snapshot_every=0 disables.
+    snapshot_dir: Optional[str] = None
+    snapshot_every: int = 1
+    resume: bool = False
+
+    # Observability.
+    log_every: int = 1
+    profile_dir: Optional[str] = None
+
+    def validate(self) -> "PageRankConfig":
+        if self.semantics not in (SEMANTICS_REFERENCE, SEMANTICS_TEXTBOOK):
+            raise ValueError(f"unknown semantics mode: {self.semantics!r}")
+        if not (0.0 < self.damping < 1.0):
+            raise ValueError(f"damping must be in (0,1), got {self.damping}")
+        if self.num_iters < 0:
+            raise ValueError("num_iters must be >= 0")
+        return self
+
+    def replace(self, **kw) -> "PageRankConfig":
+        return dataclasses.replace(self, **kw)

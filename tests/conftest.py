@@ -1,0 +1,19 @@
+"""Test config: fake 8 CPU devices so the sharded path runs without a TPU
+pod (SURVEY.md §4 "Distributed without a cluster"), and enable x64 so the
+float64 oracle/accumulation paths are real doubles."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A site plugin may have pinned jax_platforms programmatically (config
+# beats env); re-pin to CPU before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

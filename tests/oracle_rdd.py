@@ -1,0 +1,105 @@
+"""A pure-Python, dict-based transliteration of the reference's RDD
+pipeline (`Sparky.java:78-238`), quirks included — the golden oracle the
+vectorized engines are diffed against (SURVEY.md §4).
+
+This deliberately mimics the *structure* of the Spark program (flatMap →
+distinct → groupByKey → join → subtractByKey → reduceByKey), not good
+Python, so each line can be matched to a Sparky.java line.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+Record = Tuple[str, List[str]]  # (url, anchor targets from one crawl record)
+
+
+def sparky_pagerank(
+    records: Iterable[Record],
+    num_iters: int = 10,
+    damping: float = 0.85,
+):
+    """Run the reference pipeline on (url, targets) records.
+
+    A record with an empty target list is a crawled page with no anchor
+    links — it emits the (url, null) sentinel and joins dangUrls
+    (Sparky.java:114-118).
+
+    Returns (ranks, history, all_urls, dangling) where history[i] is the
+    rank dict the reference would write to S3 after iteration i
+    (Sparky.java:237).
+    """
+    # flatMapToPair with dangling sentinel (Sparky.java:78-123)
+    edges = set()
+    dang = set()
+    for url, targets in records:
+        if targets:  # isDangling=false iff >=1 anchor link (Sparky.java:103-106)
+            for t in targets:
+                edges.add((url, t))  # .distinct() dedups (Sparky.java:124)
+        else:
+            edges.add((url, None))
+            dang.add(url)
+
+    # groupByKey (Sparky.java:124)
+    adj: Dict[str, List[Optional[str]]] = {}
+    for s, t in sorted(edges, key=lambda e: (e[0], e[1] is None, e[1] or "")):
+        adj.setdefault(s, []).append(t)
+
+    # keys().collect() + broadcast (Sparky.java:127-135)
+    keyset = set(adj)
+
+    # graph completion: uncrawled targets -> (target, null), distinct
+    # (Sparky.java:137-159); union (Sparky.java:161)
+    completion = set()
+    for s, ts in adj.items():
+        for t in ts:
+            if t is not None and t not in keyset:
+                dang.add(t)
+                completion.add(t)
+    all_urls: Dict[str, Optional[List[Optional[str]]]] = dict(adj)
+    for t in completion:
+        all_urls[t] = None
+
+    n = len(all_urls)  # totalUrlCount (Sparky.java:162)
+    ranks = {u: 1.0 for u in all_urls}  # init to 1.0 (Sparky.java:165-170)
+
+    # dangling repair pass (Sparky.java:172-184). lookup(s) returns the
+    # *list of values* for key s, so for any crawled page get(0) is its
+    # (non-null) grouped Iterable — even when that Iterable is [null].
+    # The size==1 && get(0)==null test therefore only matches uncrawled
+    # targets, whose stored value is literally null (Sparky.java:149):
+    # the repair removes EVERY crawled page from dangUrls.
+    not_dangling = set()
+    for s in dang:
+        lookup = [all_urls[s]]  # List<Iterable<String>> with one element
+        if not (len(lookup) == 1 and lookup[0] is None):
+            not_dangling.add(s)
+    dang -= not_dangling
+
+    history = []
+    for _ in range(num_iters):
+        # contribution scatter (Sparky.java:192-216)
+        contribs: Dict[str, List[float]] = {}
+        for u, a in all_urls.items():  # join(ranks).values()
+            if a is not None:
+                url_count = len(a) - sum(1 for x in a if x is None)
+                if url_count > 0:
+                    page_rank = ranks[u] / url_count
+                    for t in a:
+                        if t is not None:
+                            contribs.setdefault(t, []).append(page_rank)
+        # dangling mass via per-url lookup (Sparky.java:219-222)
+        dangling_contrib = sum(ranks[u] for u in dang)
+        # subtractByKey + union: missing keys keep old rank (Sparky.java:224-225)
+        for u in ranks:
+            if u not in contribs:
+                contribs[u] = [ranks[u]]
+        # reduceByKey(Sum) + update (Sparky.java:229-235; the reference
+        # hardcodes 0.15/0.85 — parameterized here as (1-d)/d so the
+        # constant stays consistent with the engines at any damping)
+        ranks = {
+            u: (1.0 - damping) + damping * (sum(c) + dangling_contrib / n)
+            for u, c in contribs.items()
+        }
+        history.append(dict(ranks))
+    return ranks, history, all_urls, dang

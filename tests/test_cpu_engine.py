@@ -1,0 +1,135 @@
+"""Golden tests (SURVEY.md §4): the vectorized float64 CPU oracle must
+reproduce the RDD transliteration of `Sparky.java` iterate-by-iterate —
+per-iteration snapshots diffed, not just the final vector — and
+hand-computed values on the 4-node/6-edge toy graph (BASELINE config 1).
+"""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import PageRankConfig, ReferenceCpuEngine
+from pagerank_tpu.ingest import records_to_graph
+from tests.oracle_rdd import sparky_pagerank
+
+# BASELINE.json config 1: 4 nodes / 6 edges, damping 0.85, 10 iters.
+TOY_RECORDS = [
+    ("a", ["b", "c"]),
+    ("b", ["c", "a"]),
+    ("c", ["a", "d"]),
+    ("d", []),  # crawled page with no anchor links -> dangling
+]
+
+
+def run_engine_history(records, num_iters=10, **cfg_kw):
+    graph, ids = records_to_graph(records)
+    cfg = PageRankConfig(num_iters=num_iters, **cfg_kw)
+    eng = ReferenceCpuEngine(cfg).build(graph)
+    history = []
+    eng.run(on_iteration=lambda i, info: history.append(eng.ranks().copy()))
+    return graph, ids, history
+
+
+def assert_matches_transliteration(records, num_iters=10):
+    _, sparky_hist, all_urls, _ = sparky_pagerank(records, num_iters)
+    graph, ids, hist = run_engine_history(records, num_iters)
+    assert graph.n == len(all_urls)
+    assert len(hist) == len(sparky_hist) == num_iters
+    for it, (mine, ref) in enumerate(zip(hist, sparky_hist)):
+        for url, rank in ref.items():
+            vid = ids.get(url)
+            assert vid is not None, url
+            assert mine[vid] == pytest.approx(rank, abs=1e-12), (it, url)
+
+
+def test_toy_matches_transliteration_per_iteration():
+    assert_matches_transliteration(TOY_RECORDS)
+
+
+def test_toy_hand_computed_first_iteration():
+    _, ids, hist = run_engine_history(TOY_RECORDS, num_iters=1)
+    r1 = hist[0]
+    # r0=1 each; N=4; no zero-in-degree vertices. "d" is CRAWLED (record
+    # ("d", [])) so the repair pass removes it from dangUrls
+    # (Sparky.java:172-184, lookup() returns a non-null Iterable([null]))
+    # => dangling mass m = 0. d emits nothing (urlCount decremented to 0).
+    # a: 0.15+0.85*(0.5+0.5); b: 0.15+0.85*0.5; c: same as a; d: same as b.
+    assert r1[ids.get("a")] == pytest.approx(1.0)
+    assert r1[ids.get("b")] == pytest.approx(0.575)
+    assert r1[ids.get("c")] == pytest.approx(1.0)
+    assert r1[ids.get("d")] == pytest.approx(0.575)
+
+
+def test_uncrawled_target_carries_dangling_mass():
+    # "x" is an uncrawled target: the only kind of vertex that survives
+    # the repair pass in dangUrls. With records a->x, b->a, its mass must
+    # show up in every vertex's update.
+    records = [("a", ["x"]), ("b", ["a"])]
+    _, ids, hist = run_engine_history(records, num_iters=1)
+    r1 = hist[0]
+    # r0=1 each, N=3, m = r0[x] = 1, m/N = 1/3. in: a<-b, x<-a; b none.
+    # a: 0.15+0.85*(1 + 1/3); x: same; b (zero-in, keeps old rank):
+    # 0.15+0.85*(1 + 1/3).
+    expect = 0.15 + 0.85 * (1 + 1 / 3)
+    for u in ("a", "x", "b"):
+        assert r1[ids.get(u)] == pytest.approx(expect)
+    assert_matches_transliteration(records, num_iters=10)
+
+
+def test_uncrawled_target_and_zero_in_degree():
+    # "x" is linked-to but never crawled (graph completion,
+    # Sparky.java:137-161); "lonely" has no in-links, so the
+    # subtractByKey retention quirk (§2a.1) applies to it every iter.
+    records = [
+        ("a", ["b", "x"]),
+        ("b", ["a"]),
+        ("lonely", ["a", "b"]),
+    ]
+    assert_matches_transliteration(records, num_iters=10)
+
+
+def test_duplicate_records_and_repair_pass():
+    # "a" is marked dangling by one record but has outlinks in another —
+    # the reference's repair pass (Sparky.java:172-184) un-dangles it.
+    records = [
+        ("a", []),
+        ("a", ["b"]),
+        ("b", ["a", "a"]),  # duplicate edges collapse (§2a.5)
+    ]
+    assert_matches_transliteration(records, num_iters=10)
+
+
+def test_self_loop():
+    records = [("a", ["a", "b"]), ("b", [])]
+    assert_matches_transliteration(records, num_iters=10)
+
+
+def test_randomized_graphs_match_transliteration():
+    rng = np.random.default_rng(42)
+    urls = [f"u{i}" for i in range(25)]
+    extra = [f"x{i}" for i in range(6)]  # sometimes-uncrawled targets
+    for trial in range(8):
+        records = []
+        for u in urls:
+            for _ in range(int(rng.integers(0, 3))):  # 0-2 records per url
+                k = int(rng.integers(0, 5))
+                pool = urls + extra
+                targets = [pool[int(rng.integers(0, len(pool)))] for _ in range(k)]
+                records.append((u, targets))
+        if not records:
+            records = [("u0", [])]
+        assert_matches_transliteration(records, num_iters=6)
+
+
+def test_textbook_mode_conserves_probability_mass():
+    cfg = PageRankConfig(num_iters=25, semantics="textbook")
+    eng = ReferenceCpuEngine(cfg).build(records_to_graph(TOY_RECORDS)[0])
+    r = eng.run()
+    assert r.sum() == pytest.approx(1.0, abs=1e-12)
+    assert np.all(r > 0)
+
+
+def test_tol_early_stop():
+    cfg = PageRankConfig(num_iters=500, tol=1e-10)
+    eng = ReferenceCpuEngine(cfg).build(records_to_graph(TOY_RECORDS)[0])
+    eng.run()
+    assert eng.iteration < 500  # converged and stopped early

@@ -1,0 +1,91 @@
+"""Graph-construction unit tests (SURVEY.md §4 "Unit": CSR build,
+out-degrees, dangling mask, uncrawled-target rows)."""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu.graph import build_graph, to_csr_transpose
+
+
+def test_basic_build():
+    # 0->1, 0->2, 1->2, 2->0; 3 exists only as a target of 1->3.
+    src = np.array([0, 0, 1, 2, 1])
+    dst = np.array([1, 2, 2, 0, 3])
+    g = build_graph(src, dst)
+    assert g.n == 4
+    assert g.num_edges == 5
+    np.testing.assert_array_equal(g.out_degree, [2, 2, 1, 0])
+    np.testing.assert_array_equal(g.in_degree, [1, 1, 2, 1])
+    np.testing.assert_array_equal(g.dangling_mask, [False, False, False, True])
+    np.testing.assert_array_equal(g.zero_in_mask, [False, False, False, False])
+    # dst-sorted
+    assert np.all(np.diff(g.dst) >= 0)
+
+
+def test_duplicate_edges_collapse_before_out_degree():
+    # Quirk §2a.5: .distinct() before groupByKey — out-degree counts
+    # unique targets (Sparky.java:124).
+    src = np.array([0, 0, 0, 1])
+    dst = np.array([1, 1, 1, 0])
+    g = build_graph(src, dst)
+    assert g.num_edges == 2
+    np.testing.assert_array_equal(g.out_degree, [1, 1])
+    np.testing.assert_allclose(g.edge_weight, [1.0, 1.0])
+
+
+def test_self_loops_kept():
+    # Quirk §2a.5: self-loops are not filtered.
+    g = build_graph(np.array([0, 0]), np.array([0, 1]))
+    assert g.num_edges == 2
+    assert g.out_degree[0] == 2
+    assert not g.dangling_mask[0]
+
+
+def test_extra_vertices_and_zero_in():
+    # A crawled page with no anchor links exists with no edges at all
+    # (dangling sentinel, Sparky.java:114-118): vertex 5 here.
+    g = build_graph(np.array([0]), np.array([1]), n=6)
+    assert g.n == 6
+    np.testing.assert_array_equal(
+        g.dangling_mask, [False, True, True, True, True, True]
+    )
+    np.testing.assert_array_equal(
+        g.zero_in_mask, [True, False, True, True, True, True]
+    )
+
+
+def test_edge_weight_is_inv_unique_outdegree():
+    src = np.array([0, 0, 0])
+    dst = np.array([1, 2, 3])
+    g = build_graph(src, dst)
+    np.testing.assert_allclose(g.edge_weight, 1.0 / 3.0)
+
+
+def test_csr_transpose_matches_manual_spmv():
+    rng = np.random.default_rng(0)
+    n, e = 50, 400
+    g = build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+    at = to_csr_transpose(g)
+    r = rng.random(n)
+    expected = np.zeros(n)
+    for s, d, w in zip(g.src, g.dst, g.edge_weight):
+        expected[d] += w * r[s]
+    np.testing.assert_allclose(at @ r, expected, rtol=1e-12)
+
+
+def test_out_of_range_edge_raises():
+    with pytest.raises(ValueError):
+        build_graph(np.array([0]), np.array([5]), n=3)
+
+
+def test_empty_graph_raises():
+    with pytest.raises(ValueError):
+        build_graph(np.array([], dtype=np.int64), np.array([], dtype=np.int64))
+
+
+def test_fingerprint_stable_and_structure_sensitive():
+    g1 = build_graph(np.array([0, 1]), np.array([1, 0]))
+    g2 = build_graph(np.array([0, 1]), np.array([1, 0]))
+    g3 = build_graph(np.array([0, 1]), np.array([1, 1]))
+    assert g1.fingerprint() == g2.fingerprint()
+    assert g1.fingerprint() != g3.fingerprint()

@@ -1,0 +1,132 @@
+"""Ingestion tests (SURVEY.md §4 "Unit": JSON anchor filter, quote
+stripping, dedup; loader round-trips)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pagerank_tpu.ingest import (
+    IdMap,
+    load_binary_edges,
+    load_edgelist,
+    parse_metadata_record,
+    records_to_graph,
+    save_binary_edges,
+)
+from pagerank_tpu.ingest.crawljson import iter_crawl_records
+
+
+def meta(links):
+    return json.dumps({"content": {"links": links}})
+
+
+def test_anchor_filter_only_type_a():
+    # Only type=="a" links count (Sparky.java:103); "img"/others dropped.
+    m = meta(
+        [
+            {"href": "http://x/1", "type": "a"},
+            {"href": "http://x/2", "type": "img"},
+            {"href": "http://x/3", "type": "a"},
+        ]
+    )
+    url, targets = parse_metadata_record("http://src", m)
+    assert targets == ["http://x/1", "http://x/3"]
+
+
+def test_non_string_type_never_matches():
+    m = meta([{"href": "h", "type": 1}, {"href": "h2", "type": None}])
+    _, targets = parse_metadata_record("u", m)
+    assert targets == []
+
+
+def test_quote_stripping_operates_on_gson_rendering():
+    # replace("\"","") runs on the *quoted* Gson rendering
+    # (Sparky.java:105): surrounding quotes vanish, and an embedded quote
+    # was escaped to \" so stripping leaves its backslash behind.
+    m = meta([{"href": 'a"b"c', "type": "a"}])
+    _, targets = parse_metadata_record("u", m)
+    assert targets == ["a\\b\\c"]
+    m2 = meta([{"href": "plain", "type": "a"}])
+    assert parse_metadata_record("u", m2)[1] == ["plain"]
+
+
+def test_no_anchor_links_is_dangling():
+    # Pages with no anchor links (or no content/links at all) are
+    # dangling (Sparky.java:91-94,114-118).
+    for m in [meta([]), meta([{"href": "h", "type": "img"}]),
+              json.dumps({"content": {}}), json.dumps({}),
+              json.dumps({"content": None})]:
+        _, targets = parse_metadata_record("u", m)
+        assert targets == []
+
+
+def test_missing_href_strict_raises_lenient_skips():
+    m = meta([{"type": "a"}, {"href": "ok", "type": "a"}])
+    with pytest.raises(KeyError):
+        parse_metadata_record("u", m, strict=True)
+    _, targets = parse_metadata_record("u", m, strict=False)
+    assert targets == ["ok"]
+
+
+def test_malformed_json_strict_raises():
+    with pytest.raises(json.JSONDecodeError):
+        parse_metadata_record("u", "{not json", strict=True)
+    assert parse_metadata_record("u", "{not json", strict=False) == ("u", [])
+
+
+def test_records_to_graph_uncrawled_targets():
+    graph, ids = records_to_graph([("a", ["b", "c"]), ("b", ["a"])])
+    # c is linked-to but never crawled: exists, dangling (Sparky.java:137-161)
+    assert graph.n == 3
+    c = ids.get("c")
+    assert graph.dangling_mask[c]
+    assert graph.out_degree[c] == 0
+
+
+def test_crawled_linkless_page_is_not_dangling():
+    # The repair pass (Sparky.java:172-184) removes every *crawled* page
+    # from dangUrls — lookup() wraps values in a list, so a crawled
+    # linkless page's get(0) is the non-null Iterable([null]). Only
+    # uncrawled targets carry dangling mass.
+    graph, ids = records_to_graph([("a", ["b"]), ("b", [])])
+    b = ids.get("b")
+    assert graph.out_degree[b] == 0
+    assert not graph.dangling_mask[b]  # crawled => repaired out of dangUrls
+
+
+def test_idmap_roundtrip():
+    ids = IdMap()
+    assert ids.get_or_add("x") == 0
+    assert ids.get_or_add("y") == 1
+    assert ids.get_or_add("x") == 0
+    assert "y" in ids and ids.get("z") is None
+    assert ids.names == ["x", "y"]
+
+
+def test_edgelist_text_loader(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment line\n0 1\n1 2\n2 0\n\n# end\n3 1\n")
+    src, dst = load_edgelist(str(p))
+    np.testing.assert_array_equal(src, [0, 1, 2, 3])
+    np.testing.assert_array_equal(dst, [1, 2, 0, 1])
+
+
+def test_binary_roundtrip(tmp_path):
+    p = str(tmp_path / "edges.npz")
+    save_binary_edges(p, np.array([0, 1]), np.array([1, 2]), n=5)
+    src, dst, n = load_binary_edges(p)
+    np.testing.assert_array_equal(src, [0, 1])
+    np.testing.assert_array_equal(dst, [1, 2])
+    assert n == 5
+
+
+def test_crawl_tsv_file(tmp_path):
+    p = tmp_path / "crawl.tsv"
+    rows = [
+        "http://a\t" + meta([{"href": "http://b", "type": "a"}]),
+        "http://b\t" + meta([]),
+    ]
+    p.write_text("\n".join(rows) + "\n")
+    recs = list(iter_crawl_records(str(p)))
+    assert recs == [("http://a", ["http://b"]), ("http://b", [])]

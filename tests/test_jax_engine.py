@@ -1,0 +1,86 @@
+"""JaxTpuEngine vs the float64 CPU oracle (SURVEY.md §4: single
+dense-vs-sparse update-step equivalence + the L1 acceptance gate)."""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import (
+    JaxTpuEngine,
+    PageRankConfig,
+    ReferenceCpuEngine,
+    build_graph,
+)
+from pagerank_tpu.ingest import records_to_graph
+from tests.test_cpu_engine import TOY_RECORDS
+
+
+def random_graph(rng, n=200, e=1500):
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+def test_toy_matches_oracle_f64_exact():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    cfg = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+    r_jax = JaxTpuEngine(cfg.replace(num_devices=1)).build(graph).run()
+    r_cpu = ReferenceCpuEngine(cfg).build(graph).run()
+    np.testing.assert_allclose(r_jax, r_cpu, rtol=0, atol=1e-13)
+
+
+@pytest.mark.parametrize("semantics", ["reference", "textbook"])
+def test_random_graph_matches_oracle(semantics):
+    rng = np.random.default_rng(7)
+    graph = random_graph(rng)
+    cfg = PageRankConfig(
+        num_iters=15, semantics=semantics, dtype="float64", accum_dtype="float64"
+    )
+    r_jax = JaxTpuEngine(cfg.replace(num_devices=1)).build(graph).run()
+    r_cpu = ReferenceCpuEngine(cfg).build(graph).run()
+    np.testing.assert_allclose(r_jax, r_cpu, rtol=0, atol=1e-12)
+
+
+def test_float32_within_tolerance_of_f64_oracle():
+    rng = np.random.default_rng(3)
+    graph = random_graph(rng, n=500, e=4000)
+    cfg = PageRankConfig(num_iters=20)
+    r_jax = JaxTpuEngine(cfg).build(graph).run()  # f32, all fake devices
+    r_cpu = ReferenceCpuEngine(cfg).build(graph).run()
+    # N-scaled ranks are O(1); elementwise f32 tolerance.
+    np.testing.assert_allclose(r_jax, r_cpu, rtol=0, atol=5e-4)
+    assert np.abs(r_jax - r_cpu).sum() / graph.n < 1e-4
+
+
+def test_step_reports_dangling_mass_and_delta():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    eng = JaxTpuEngine(PageRankConfig(dtype="float64", accum_dtype="float64")).build(graph)
+    info = eng.step()
+    # "d" is crawled, so the repair pass empties dangUrls on this graph.
+    assert info["dangling_mass"] == pytest.approx(0.0)
+    assert info["l1_delta"] > 0
+
+    # An uncrawled target does carry mass.
+    g2, _ = records_to_graph([("a", ["x"]), ("b", ["a"])])
+    e2 = JaxTpuEngine(PageRankConfig(dtype="float64", accum_dtype="float64")).build(g2)
+    assert e2.step()["dangling_mass"] == pytest.approx(1.0)  # r0[x] = 1
+
+
+def test_run_fast_equals_stepwise():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    cfg = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+    r1 = JaxTpuEngine(cfg).build(graph).run()
+    r2 = JaxTpuEngine(cfg).build(graph).run_fast()
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_set_ranks_resume_midway():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    cfg = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+    full = JaxTpuEngine(cfg).build(graph).run()
+
+    first = JaxTpuEngine(cfg).build(graph)
+    first.run(num_iters=4)
+    snap = first.ranks()
+
+    resumed = JaxTpuEngine(cfg).build(graph)
+    resumed.set_ranks(snap, iteration=4)
+    r = resumed.run()
+    np.testing.assert_allclose(r, full, rtol=0, atol=1e-13)
