@@ -1,0 +1,100 @@
+"""Benchmark: PageRank power-iteration throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": "edges_per_sec_per_chip", "value": N, "unit": "edges/s/chip",
+   "vs_baseline": R}
+
+vs_baseline is measured throughput over the north-star implied rate: the
+BASELINE.md headline (50 iters on Twitter-2010's 1.47B edges in <60 s on
+a v4-8) requires 1.47e9*50/60/8 ≈ 1.53e8 edges/s/chip. The reference
+itself publishes no numbers (BASELINE.md), so that target is the bar.
+
+Workload: R-MAT (power-law, Graph500 params) — the SNAP/Common Crawl
+graphs aren't fetchable in this zero-egress environment; R-MAT reproduces
+the degree skew that makes the workload hard.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_EDGES_PER_SEC_PER_CHIP = 1.47e9 * 50 / 60 / 8
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=int, default=22, help="R-MAT scale (2^scale vertices)")
+    p.add_argument("--edge-factor", type=int, default=16)
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--accuracy-check", action="store_true",
+                   help="also diff a small graph against the f64 CPU oracle")
+    args = p.parse_args(argv)
+
+    from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    t0 = time.perf_counter()
+    src, dst = rmat_edges(args.scale, args.edge_factor, seed=0)
+    graph = build_graph(src, dst, n=1 << args.scale)
+    t_build = time.perf_counter() - t0
+    print(
+        f"graph: scale {args.scale}: {graph.n:,} vertices, "
+        f"{graph.num_edges:,} edges (build {t_build:.1f}s)",
+        file=sys.stderr,
+    )
+
+    cfg = PageRankConfig(num_iters=args.iters, dtype=args.dtype, accum_dtype=args.dtype)
+    engine = JaxTpuEngine(cfg).build(graph)
+    chips = engine.mesh.devices.size
+
+    for _ in range(args.warmup):
+        engine._device_step()
+    engine.fence()  # block_until_ready is not honest on tunneled backends
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        engine._device_step()
+    engine.fence()
+    dt = time.perf_counter() - t0
+
+    eps_chip = graph.num_edges * args.iters / dt / chips
+    print(
+        f"{args.iters} iters in {dt:.3f}s on {chips} chip(s): "
+        f"{dt / args.iters * 1e3:.2f} ms/iter, {eps_chip:.4g} edges/s/chip",
+        file=sys.stderr,
+    )
+
+    if args.accuracy_check:
+        from pagerank_tpu import ReferenceCpuEngine
+
+        s2, d2 = rmat_edges(16, 16, seed=3)
+        g2 = build_graph(s2, d2, n=1 << 16)
+        c2 = PageRankConfig(num_iters=20, dtype=args.dtype, accum_dtype=args.dtype)
+        r_tpu = JaxTpuEngine(c2).build(g2).run_fast()
+        r_cpu = ReferenceCpuEngine(c2).build(g2).run()
+        l1 = float(np.abs(r_tpu - r_cpu).sum())
+        print(
+            f"accuracy: L1 vs f64 oracle {l1:.3e} "
+            f"({l1 / g2.n:.3e}/vertex, scale-16, 20 iters)",
+            file=sys.stderr,
+        )
+
+    print(
+        json.dumps(
+            {
+                "metric": "edges_per_sec_per_chip",
+                "value": eps_chip,
+                "unit": "edges/s/chip",
+                "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

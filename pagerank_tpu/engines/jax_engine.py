@@ -4,21 +4,32 @@ The reference's per-iteration dataflow (Sparky.java:187-238) — 3 shuffles,
 |dangUrls|+1 driver round-trips, one S3 write — collapses into ONE jitted
 step per iteration:
 
-  - edge shards (dst-sorted COO) live sharded across a 1-D device mesh;
+  - edge data lives sharded across a 1-D device mesh;
   - the rank vector is replicated (a Spark "broadcast" that never leaves
     device, Sparky.java:135);
-  - each device computes a dense contribution partial with a sorted
-    segment-sum, then one `jax.lax.psum` over ICI merges partials —
-    the only cross-device communication per iteration;
+  - each device computes a dense contribution partial, then one
+    `jax.lax.psum` over ICI merges partials — the only cross-device
+    communication per iteration;
   - dangling mass, zero-in-degree retention, and the teleport term are
     fused elementwise arithmetic (XLA fuses them into the epilogue);
   - the rank buffer is donated, so device memory is O(1) in iterations
     (the reference instead re-caches every iteration with no unpersist,
     Sparky.java:216,235 — SURVEY.md §3.3).
 
+Two SpMV kernels (config.kernel):
+  - "ell": blocked-ELL slots + row segment-sum + width-8 row-gather
+    (ops/ell.py, ops/spmv.py:ell_contrib) — the TPU-fast path. Vertices
+    are relabeled by in-degree internally; ranks() translates back.
+  - "coo": dst-sorted COO + per-edge sorted segment-sum — simple
+    portable baseline.
+
 Zero host round-trips per iteration unless the caller asks for per-iter
 logging/snapshots; the L1 delta and dangling mass come back as device
 scalars fetched lazily.
+
+NOTE on timing: on some remote-tunnel backends `jax.block_until_ready`
+returns before execution finishes; fences here use a scalar device_get,
+which is always honest.
 """
 
 from __future__ import annotations
@@ -35,9 +46,19 @@ from jax import shard_map
 from pagerank_tpu.engine import PageRankEngine, register_engine
 from pagerank_tpu.graph import Graph
 from pagerank_tpu.models import pagerank as pr_model
+from pagerank_tpu.ops import ell as ell_lib
 from pagerank_tpu.ops import spmv
 from pagerank_tpu.parallel import mesh as mesh_lib
 from pagerank_tpu.parallel import partition
+
+
+def _pad_rows(a: np.ndarray, multiple: int, fill):
+    rows = a.shape[0]
+    target = -(-max(rows, 1) // multiple) * multiple
+    if target == rows:
+        return a
+    pad_shape = (target - rows,) + a.shape[1:]
+    return np.concatenate([a, np.full(pad_shape, fill, dtype=a.dtype)])
 
 
 @register_engine("jax")
@@ -48,6 +69,7 @@ class JaxTpuEngine(PageRankEngine):
         super().__init__(config)
         self._devices = devices
         self._mesh = None
+        self._pack: Optional[ell_lib.EllPack] = None
 
     # -- build ------------------------------------------------------------
 
@@ -59,18 +81,21 @@ class JaxTpuEngine(PageRankEngine):
         )
         axis = cfg.mesh_axis
         ndev = self._mesh.devices.size
+        mesh = self._mesh
 
         dtype = jnp.dtype(cfg.dtype)
         self._dtype = dtype
-        self._accum_dtype = jnp.dtype(cfg.accum_dtype)
+        accum = jnp.dtype(cfg.accum_dtype)
+        self._accum_dtype = accum
 
-        shards = partition.partition_edges(graph, ndev, weight_dtype=dtype)
-        e_shard = mesh_lib.edge_sharding(self._mesh)
+        kernel = cfg.kernel if cfg.kernel != "auto" else "ell"
+        self._kernel = kernel
+
+        n = graph.n
         rep = mesh_lib.replicated(self._mesh)
+        e_shard = mesh_lib.edge_sharding(self._mesh)
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
 
-        self._src = jax.device_put(shards.src, e_shard)
-        self._dst = jax.device_put(shards.dst, e_shard)
-        self._w = jax.device_put(shards.weight, e_shard)
         # Reference mode: post-repair dangUrls (uncrawled targets).
         # Textbook mode: standard dangling definition (out_degree == 0).
         mass_mask = (
@@ -78,41 +103,101 @@ class JaxTpuEngine(PageRankEngine):
             if cfg.semantics == "reference"
             else graph.out_degree == 0
         )
-        self._dangling = jax.device_put(mass_mask.astype(dtype), rep)
-        self._zero_in = jax.device_put(graph.zero_in_mask.astype(dtype), rep)
-        self._r = jax.device_put(
-            pr_model.initial_rank(graph.n, cfg.semantics, dtype, jnp), rep
+        zero_in = graph.zero_in_mask
+
+        if kernel == "ell":
+            pack = ell_lib.ell_pack(graph)
+            self._pack = pack
+            n_state = pack.n_padded  # device rank vector length (padded)
+            pad = n_state - n
+            # Relabel + pad masks; padding lanes are all-zero.
+            mass_mask = np.concatenate([mass_mask[pack.perm], np.zeros(pad, bool)])
+            zero_in = np.concatenate([zero_in[pack.perm], np.zeros(pad, bool)])
+            valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+
+            # Chunk the gather so its (slots, 8) intermediate stays ~100MB
+            # regardless of graph size; pad rows so chunks divide evenly.
+            rows_per_dev = -(-max(1, pack.num_rows) // ndev)
+            chunk_rows = min(32768, rows_per_dev)
+            pad_multiple = ndev * chunk_rows
+            src_slots = _pad_rows(pack.src, pad_multiple, 0)
+            w_slots = _pad_rows(pack.weight, pad_multiple, 0).astype(dtype)
+            row_block = _pad_rows(
+                pack.row_block, pad_multiple, max(0, pack.num_blocks - 1)
+            )
+            num_blocks = pack.num_blocks
+
+            self._src = jax.device_put(src_slots, shard2d)
+            self._w = jax.device_put(w_slots, shard2d)
+            self._row_block = jax.device_put(row_block, e_shard)
+
+            def sharded_contrib(r, src, w, row_block):
+                part = spmv.ell_contrib(
+                    r, src, w, row_block, num_blocks, accum_dtype=accum,
+                    chunk_rows=chunk_rows,
+                )
+                return jax.lax.psum(part, axis)
+
+            contrib_fn = shard_map(
+                sharded_contrib,
+                mesh=mesh,
+                in_specs=(P(), P(axis, None), P(axis, None), P(axis)),
+                out_specs=P(),
+            )
+            contrib_args = (self._src, self._w, self._row_block)
+        else:
+            self._pack = None
+            n_state = n
+            shards = partition.partition_edges(graph, ndev, weight_dtype=dtype)
+            self._src = jax.device_put(shards.src, e_shard)
+            self._dst = jax.device_put(shards.dst, e_shard)
+            self._w = jax.device_put(shards.weight, e_shard)
+
+            def sharded_contrib(r, src, dst, w):
+                part = spmv.edge_contrib_segment_sum(r, src, dst, w, n, accum)
+                return jax.lax.psum(part, axis)
+
+            contrib_fn = shard_map(
+                sharded_contrib,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(axis), P(axis)),
+                out_specs=P(),
+            )
+            contrib_args = (self._src, self._dst, self._w)
+            valid = np.ones(n, bool)  # no padding in coo state
+
+        self._n_state = n_state
+        self._dangling = jax.device_put(
+            np.asarray(mass_mask, bool).astype(dtype), rep
         )
+        self._zero_in = jax.device_put(
+            np.asarray(zero_in, bool).astype(dtype), rep
+        )
+        self._valid = jax.device_put(valid.astype(dtype), rep)
+
+        # Initial value uses the TRUE n (1/n in textbook mode), laid out
+        # over the padded state vector with zeros in padding lanes.
+        r0_value = 1.0 if cfg.semantics == "reference" else 1.0 / n
+        r0 = np.full(n_state, r0_value, dtype=dtype) * valid
+        self._r = jax.device_put(jnp.asarray(r0.astype(dtype)), rep)
         self.iteration = 0
 
-        n = graph.n
         damping = cfg.damping
         semantics = cfg.semantics
-        accum = self._accum_dtype
-        mesh = self._mesh
-
-        def sharded_contrib(r, src, dst, w):
-            part = spmv.edge_contrib_segment_sum(r, src, dst, w, n, accum)
-            return jax.lax.psum(part, axis)
-
-        contrib_fn = shard_map(
-            sharded_contrib,
-            mesh=mesh,
-            in_specs=(P(), P(axis), P(axis), P(axis)),
-            out_specs=P(),
-        )
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def step_fn(r, src, dst, w, dangling, zero_in):
-            contrib = contrib_fn(r, src, dst, w)
+        def step_fn(r, dangling, zero_in, valid_m, *c_args):
+            contrib = contrib_fn(r, *c_args)[: r.shape[0]]
             m = spmv.dangling_mass(r, dangling, accum)
             r_new = pr_model.apply_update(
                 contrib, r.astype(accum), zero_in.astype(accum), m, n,
                 damping, semantics, jnp,
-            ).astype(r.dtype)
+            )
+            r_new = (r_new * valid_m.astype(accum)).astype(r.dtype)
             delta = jnp.sum(jnp.abs(r_new.astype(accum) - r.astype(accum)))
             return r_new, delta, m
 
+        self._contrib_args = contrib_args
         self._step_fn = step_fn
         return self
 
@@ -121,7 +206,8 @@ class JaxTpuEngine(PageRankEngine):
     def _device_step(self):
         """One iteration; returns (delta, mass) as device scalars."""
         self._r, delta, m = self._step_fn(
-            self._r, self._src, self._dst, self._w, self._dangling, self._zero_in
+            self._r, self._dangling, self._zero_in, self._valid,
+            *self._contrib_args,
         )
         return delta, m
 
@@ -130,24 +216,38 @@ class JaxTpuEngine(PageRankEngine):
         return {"l1_delta": float(delta), "dangling_mass": float(m)}
 
     def run_fast(self, num_iters: Optional[int] = None) -> np.ndarray:
-        """Benchmark loop: no per-iteration host sync at all. Device
-        scalars are discarded; one block_until_ready at the end."""
+        """Benchmark loop: no per-iteration host sync; one honest scalar
+        fence at the end."""
         total = self.config.num_iters if num_iters is None else num_iters
+        delta = None
         while self.iteration < total:
-            self._device_step()
+            delta, _ = self._device_step()
             self.iteration += 1
-        jax.block_until_ready(self._r)
+        if delta is not None:
+            jax.device_get(delta)  # honest fence (see module docstring)
         return self.ranks()
 
+    def fence(self) -> None:
+        """Block until all queued steps actually finished on device."""
+        jax.device_get(jnp.sum(self._r))
+
     def ranks(self) -> np.ndarray:
-        return np.asarray(jax.device_get(self._r))
+        r = np.asarray(jax.device_get(self._r))[: self.graph.n]
+        if self._pack is not None:
+            out = np.empty(self.graph.n, dtype=r.dtype)
+            out[self._pack.perm] = r
+            return out
+        return r
 
     def set_ranks(self, r: np.ndarray, iteration: int = 0) -> None:
         if r.shape != (self.graph.n,):
             raise ValueError(f"rank shape {r.shape} != ({self.graph.n},)")
-        self._r = jax.device_put(
-            np.asarray(r, dtype=self._dtype), mesh_lib.replicated(self._mesh)
-        )
+        r = np.asarray(r, dtype=self._dtype)
+        if self._pack is not None:
+            rr = np.zeros(self._n_state, dtype=self._dtype)
+            rr[: self.graph.n] = r[self._pack.perm]
+            r = rr
+        self._r = jax.device_put(r, mesh_lib.replicated(self._mesh))
         self.iteration = iteration
 
     @property

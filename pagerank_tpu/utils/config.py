@@ -35,6 +35,11 @@ class PageRankConfig:
     dtype: str = "float32"
     accum_dtype: str = "float32"
 
+    # SpMV kernel: "ell" = blocked-ELL + row segment-sum (TPU-fast,
+    # ops/ell.py), "coo" = dst-sorted COO + per-edge segment-sum
+    # (simple; also the portable baseline), "auto" = ell.
+    kernel: str = "auto"
+
     # Early stop: if set, stop when L1(r' - r) <= tol. The reference has
     # no convergence check (Sparky.java:187); None reproduces that.
     tol: Optional[float] = None
@@ -60,6 +65,8 @@ class PageRankConfig:
             raise ValueError(f"damping must be in (0,1), got {self.damping}")
         if self.num_iters < 0:
             raise ValueError("num_iters must be >= 0")
+        if self.kernel not in ("auto", "ell", "coo"):
+            raise ValueError(f"unknown kernel: {self.kernel!r}")
         return self
 
     def replace(self, **kw) -> "PageRankConfig":

@@ -1,0 +1,83 @@
+"""Structured per-iteration metrics (SURVEY.md §5: the reference's entire
+observability is one println per iteration, Sparky.java:188).
+
+Logs iter, L1 delta, dangling mass, wall-clock, iters/sec and
+edges/sec/chip — the BASELINE.json metrics — to stderr and optionally a
+JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Dict, Optional, TextIO
+
+
+class MetricsLogger:
+    """Per-iteration logger; use as the engine's ``on_iteration`` hook."""
+
+    def __init__(
+        self,
+        num_edges: int,
+        num_chips: int = 1,
+        log_every: int = 1,
+        jsonl_path: Optional[str] = None,
+        stream: Optional[TextIO] = None,
+    ):
+        self.num_edges = num_edges
+        self.num_chips = max(1, num_chips)
+        self.log_every = log_every
+        self.stream = stream if stream is not None else sys.stderr
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._t_last = time.perf_counter()
+        self.history = []
+
+    def __call__(self, iteration: int, info: Dict[str, float]) -> None:
+        now = time.perf_counter()
+        dt = now - self._t_last
+        self._t_last = now
+        rec = {
+            "iter": iteration,
+            "seconds": dt,
+            "iters_per_sec": (1.0 / dt) if dt > 0 else float("inf"),
+            "edges_per_sec_per_chip": self.num_edges / dt / self.num_chips
+            if dt > 0
+            else float("inf"),
+        }
+        for k in ("l1_delta", "dangling_mass"):
+            if k in info:
+                rec[k] = float(info[k])
+        self.history.append(rec)
+        if self._jsonl:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if self.log_every and iteration % self.log_every == 0:
+            parts = [f"iter {iteration}", f"{dt * 1e3:.1f} ms"]
+            if "l1_delta" in rec:
+                parts.append(f"l1_delta {rec['l1_delta']:.3e}")
+            if "dangling_mass" in rec:
+                parts.append(f"mass {rec['dangling_mass']:.6g}")
+            parts.append(f"{rec['edges_per_sec_per_chip']:.3g} edges/s/chip")
+            print("  ".join(parts), file=self.stream)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.history:
+            return {}
+        # Skip iteration 0 (compile) when there are enough samples.
+        hist = self.history[1:] if len(self.history) > 1 else self.history
+        total = sum(h["seconds"] for h in hist)
+        iters = len(hist)
+        return {
+            "iters": len(self.history),
+            "mean_iter_seconds": total / iters,
+            "iters_per_sec": iters / total if total > 0 else float("inf"),
+            "edges_per_sec_per_chip": self.num_edges * iters / total / self.num_chips
+            if total > 0
+            else float("inf"),
+        }
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None

@@ -1,0 +1,88 @@
+"""Checkpoint / resume (SURVEY.md §5).
+
+The reference's de-facto checkpoint is ``saveAsTextFile`` of the full
+rank vector after every iteration (Sparky.java:237) with no resume logic.
+Here snapshots are first-class: (ranks, iteration, graph fingerprint,
+semantics) per file, a ``latest()`` scan, and ``resume_engine`` that
+validates the fingerprint before restoring — restart-from-latest is the
+failure-recovery story (kill-and-resume is tested in
+tests/test_snapshot.py).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_PAT = re.compile(r"^ranks_iter(\d+)\.npz$")
+
+
+class Snapshotter:
+    """Writes ``ranks_iter{i}.npz`` files into ``directory``."""
+
+    def __init__(self, directory: str, graph_fingerprint: str, semantics: str):
+        self.directory = directory
+        self.fingerprint = graph_fingerprint
+        self.semantics = semantics
+        os.makedirs(directory, exist_ok=True)
+
+    def path(self, iteration: int) -> str:
+        return os.path.join(self.directory, f"ranks_iter{iteration}.npz")
+
+    def save(self, iteration: int, ranks: np.ndarray) -> str:
+        p = self.path(iteration)
+        tmp = p + ".tmp.npz"
+        np.savez(
+            tmp,
+            ranks=ranks,
+            iteration=np.int64(iteration),
+            fingerprint=np.bytes_(self.fingerprint.encode()),
+            semantics=np.bytes_(self.semantics.encode()),
+        )
+        os.replace(tmp, p)  # atomic: a killed run never leaves a torn file
+        return p
+
+    def latest(self) -> Optional[int]:
+        best = None
+        try:
+            entries = os.listdir(self.directory)
+        except FileNotFoundError:
+            return None
+        for name in entries:
+            m = _PAT.match(name)
+            if m:
+                i = int(m.group(1))
+                best = i if best is None else max(best, i)
+        return best
+
+    def load(self, iteration: int) -> Tuple[np.ndarray, Dict[str, str]]:
+        with np.load(self.path(iteration)) as z:
+            meta = {
+                "fingerprint": bytes(z["fingerprint"]).decode(),
+                "semantics": bytes(z["semantics"]).decode(),
+                "iteration": int(z["iteration"]),
+            }
+            return z["ranks"].copy(), meta
+
+def resume_engine(engine, snap: Snapshotter) -> int:
+    """Restore the latest snapshot into ``engine``; returns the iteration
+    resumed from (0 if none found). Refuses a snapshot taken on a
+    different graph or semantics mode."""
+    it = snap.latest()
+    if it is None:
+        return 0
+    ranks, meta = snap.load(it)
+    if meta["fingerprint"] != snap.fingerprint:
+        raise ValueError(
+            f"snapshot graph fingerprint {meta['fingerprint']} != current "
+            f"{snap.fingerprint}; refusing to resume"
+        )
+    if meta["semantics"] != snap.semantics:
+        raise ValueError(
+            f"snapshot semantics {meta['semantics']!r} != current {snap.semantics!r}"
+        )
+    engine.set_ranks(ranks, iteration=meta["iteration"])
+    return meta["iteration"]

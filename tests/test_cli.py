@@ -1,0 +1,106 @@
+"""CLI end-to-end tests (config/flag subsystem, SURVEY.md §5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import PageRankConfig, ReferenceCpuEngine, build_graph
+from pagerank_tpu.cli import main
+from pagerank_tpu.ingest import save_binary_edges
+
+
+@pytest.fixture
+def edges_file(tmp_path):
+    rng = np.random.default_rng(0)
+    src, dst = rng.integers(0, 40, 200), rng.integers(0, 40, 200)
+    p = tmp_path / "edges.txt"
+    lines = ["# test graph"] + [f"{s} {d}" for s, d in zip(src, dst)]
+    p.write_text("\n".join(lines) + "\n")
+    return str(p), src, dst
+
+
+def read_ranks_tsv(path, n):
+    out = np.zeros(n)
+    with open(path) as f:
+        for line in f:
+            k, v = line.split("\t")
+            out[int(k)] = float(v)
+    return out
+
+
+def test_cli_edgelist_matches_oracle(tmp_path, edges_file):
+    path, src, dst = edges_file
+    out = str(tmp_path / "ranks.tsv")
+    rc = main(
+        ["--input", path, "--iters", "10", "--engine", "jax", "--out", out,
+         "--dtype", "float64", "--log-every", "0"]
+    )
+    assert rc == 0
+    g = build_graph(src, dst)
+    expected = ReferenceCpuEngine(PageRankConfig(num_iters=10)).build(g).run()
+    got = read_ranks_tsv(out, g.n)
+    np.testing.assert_allclose(got, expected, rtol=0, atol=1e-9)
+
+
+def test_cli_npz_and_jsonl_metrics(tmp_path, edges_file):
+    _, src, dst = edges_file
+    npz = str(tmp_path / "edges.npz")
+    save_binary_edges(npz, src, dst, n=40)
+    jsonl = str(tmp_path / "metrics.jsonl")
+    rc = main(["--input", npz, "--iters", "5", "--engine", "cpu",
+               "--jsonl", jsonl, "--log-every", "0"])
+    assert rc == 0
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert len(recs) == 5
+    assert recs[0]["iter"] == 0 and "l1_delta" in recs[0]
+
+
+def test_cli_crawl_autodetect(tmp_path):
+    p = tmp_path / "crawl.tsv"
+    meta = json.dumps({"content": {"links": [{"href": "http://b", "type": "a"}]}})
+    p.write_text(f"http://a\t{meta}\nhttp://b\t{json.dumps({})}\n")
+    out = str(tmp_path / "ranks.tsv")
+    rc = main(["--input", str(p), "--iters", "3", "--engine", "cpu",
+               "--out", out, "--log-every", "0"])
+    assert rc == 0
+    text = open(out).read()
+    assert "http://a\t" in text and "http://b\t" in text
+
+
+def test_cli_snapshot_resume(tmp_path, edges_file):
+    path, src, dst = edges_file
+    ck = str(tmp_path / "ckpt")
+    out1 = str(tmp_path / "r1.tsv")
+    main(["--input", path, "--iters", "4", "--engine", "cpu",
+          "--snapshot-dir", ck, "--log-every", "0"])
+    main(["--input", path, "--iters", "10", "--engine", "cpu",
+          "--snapshot-dir", ck, "--resume", "--out", out1, "--log-every", "0"])
+    g = build_graph(src, dst)
+    expected = ReferenceCpuEngine(PageRankConfig(num_iters=10)).build(g).run()
+    got = read_ranks_tsv(out1, g.n)
+    np.testing.assert_allclose(got, expected, rtol=0, atol=1e-12)
+
+
+def test_cli_synthetic(tmp_path):
+    rc = main(["--synthetic", "rmat:8", "--iters", "2", "--engine", "cpu",
+               "--log-every", "0"])
+    assert rc == 0
+
+
+def test_cli_tol_early_stop(edges_file, capsys):
+    path, _, _ = edges_file
+    rc = main(["--input", path, "--iters", "500", "--engine", "cpu",
+               "--tol", "1e-9", "--log-every", "0"])
+    assert rc == 0
+
+
+def test_cli_textbook_semantics(tmp_path, edges_file):
+    path, src, dst = edges_file
+    out = str(tmp_path / "ranks.tsv")
+    rc = main(["--input", path, "--iters", "20", "--semantics", "textbook",
+               "--engine", "cpu", "--out", out, "--log-every", "0"])
+    assert rc == 0
+    g = build_graph(src, dst)
+    got = read_ranks_tsv(out, g.n)
+    assert got.sum() == pytest.approx(1.0, abs=1e-9)

@@ -1,0 +1,106 @@
+"""Blocked-ELL packing + kernel tests (ops/ell.py, ops/spmv.py:ell_contrib)."""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, ReferenceCpuEngine, build_graph
+from pagerank_tpu.graph import to_csr_transpose
+from pagerank_tpu.ops import ell as ell_lib
+
+
+def random_graph(seed=0, n=300, e=2500):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+def test_pack_roundtrip_spmv_matches_csr():
+    g = random_graph()
+    pack = ell_lib.ell_pack(g)
+    rng = np.random.default_rng(1)
+    z = rng.random(g.n)
+    # relabeled input/output
+    y_rel = ell_lib.ell_spmv_reference(pack, z[pack.perm])
+    y = np.empty(g.n)
+    y[pack.perm] = y_rel
+    expected = to_csr_transpose(g) @ z
+    np.testing.assert_allclose(y, expected, rtol=1e-12)
+
+
+def test_pack_invariants():
+    g = random_graph(seed=3, n=500, e=4000)
+    pack = ell_lib.ell_pack(g)
+    assert pack.n == g.n
+    assert pack.n_padded % 128 == 0
+    # row_block ascending
+    assert np.all(np.diff(pack.row_block) >= 0)
+    # slot weights: real slots hold 1/out_degree, padding zero; total
+    # count of nonzero slots == edge count
+    assert (pack.weight > 0).sum() == g.num_edges
+    # in-degree-descending relabel => block depths are non-increasing-ish:
+    # first block's depth is the global max in-degree
+    if pack.num_rows:
+        first_rows = int((pack.row_block == 0).sum())
+        assert first_rows == int(g.in_degree.max())
+    # perm/inv_perm inverse of each other
+    np.testing.assert_array_equal(pack.perm[pack.inv_perm], np.arange(g.n))
+
+
+def test_pack_padding_reasonable_on_powerlaw():
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    src, dst = rmat_edges(16, 16, seed=0)
+    g = build_graph(src, dst, n=1 << 16)
+    pack = ell_lib.ell_pack(g)
+    # degree-sorted relabeling keeps ELL padding modest on power-law
+    # graphs (measured: 2.2x at scale 14 shrinking to 1.27x at scale 20;
+    # the ratio falls as blocks get denser).
+    assert pack.padding_ratio < 2.0, pack.padding_ratio
+
+
+def test_empty_graph_pack():
+    g = build_graph(np.array([], np.int64), np.array([], np.int64), n=10)
+    pack = ell_lib.ell_pack(g)
+    assert pack.num_rows == 0
+    y = ell_lib.ell_spmv_reference(pack, np.ones(10))
+    np.testing.assert_array_equal(y, 0)
+
+
+@pytest.mark.parametrize("ndev", [1, 8])
+def test_ell_engine_matches_oracle(ndev):
+    g = random_graph(seed=7)
+    cfg = PageRankConfig(
+        num_iters=12, dtype="float64", accum_dtype="float64",
+        kernel="ell", num_devices=ndev,
+    )
+    r_ell = JaxTpuEngine(cfg).build(g).run()
+    r_cpu = ReferenceCpuEngine(cfg).build(g).run()
+    np.testing.assert_allclose(r_ell, r_cpu, rtol=0, atol=1e-12)
+
+
+def test_ell_equals_coo_engine():
+    g = random_graph(seed=9, n=700, e=6000)
+    base = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+    r_ell = JaxTpuEngine(base.replace(kernel="ell")).build(g).run()
+    r_coo = JaxTpuEngine(base.replace(kernel="coo")).build(g).run()
+    np.testing.assert_allclose(r_ell, r_coo, rtol=0, atol=1e-12)
+
+
+def test_ell_set_ranks_roundtrip():
+    g = random_graph(seed=11)
+    cfg = PageRankConfig(num_iters=3, kernel="ell", dtype="float64",
+                         accum_dtype="float64")
+    eng = JaxTpuEngine(cfg).build(g)
+    rng = np.random.default_rng(0)
+    r = rng.random(g.n)
+    eng.set_ranks(r, iteration=5)
+    np.testing.assert_allclose(eng.ranks(), r, rtol=0, atol=0)
+    assert eng.iteration == 5
+
+
+def test_ell_non_multiple_of_128_vertices():
+    g = random_graph(seed=13, n=200, e=900)  # 200 -> padded 256
+    cfg = PageRankConfig(num_iters=8, kernel="ell", dtype="float64",
+                         accum_dtype="float64")
+    r = JaxTpuEngine(cfg).build(g).run()
+    r_cpu = ReferenceCpuEngine(cfg).build(g).run()
+    np.testing.assert_allclose(r, r_cpu, rtol=0, atol=1e-12)

@@ -1,0 +1,79 @@
+"""Checkpoint/resume + failure recovery (SURVEY.md §5: fault injection =
+kill-and-resume; resume must refuse mismatched graphs)."""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, ReferenceCpuEngine, build_graph
+from pagerank_tpu.utils.snapshot import Snapshotter, resume_engine
+
+
+def toy_graph(seed=0, n=50, e=300):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+CFG = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+
+
+def test_save_load_roundtrip(tmp_path):
+    g = toy_graph()
+    s = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    r = np.arange(5, dtype=np.float64)
+    s.save(3, r)
+    ranks, meta = s.load(3)
+    np.testing.assert_array_equal(ranks, r)
+    assert meta["iteration"] == 3
+    assert meta["fingerprint"] == g.fingerprint()
+    assert s.latest() == 3
+    s.save(7, r)
+    assert s.latest() == 7
+
+
+def test_kill_and_resume_matches_uninterrupted_run(tmp_path):
+    """Fault injection: run 10 iters straight vs run 4, 'crash', resume
+    from snapshot, finish — identical final ranks."""
+    g = toy_graph()
+    full = JaxTpuEngine(CFG).build(g).run()
+
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    eng1 = JaxTpuEngine(CFG).build(g)
+    eng1.run(
+        num_iters=4,
+        on_iteration=lambda i, info: snap.save(i + 1, eng1.ranks()),
+    )
+    del eng1  # "crash"
+
+    eng2 = JaxTpuEngine(CFG).build(g)
+    it = resume_engine(eng2, snap)
+    assert it == 4
+    r = eng2.run()
+    np.testing.assert_allclose(r, full, rtol=0, atol=1e-13)
+
+
+def test_resume_with_no_snapshot_is_noop(tmp_path):
+    g = toy_graph()
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    eng = ReferenceCpuEngine(CFG).build(g)
+    assert resume_engine(eng, snap) == 0
+    assert eng.iteration == 0
+
+
+def test_resume_refuses_wrong_graph(tmp_path):
+    g1, g2 = toy_graph(0), toy_graph(1)
+    s1 = Snapshotter(str(tmp_path), g1.fingerprint(), "reference")
+    s1.save(5, np.ones(g1.n))
+    eng = ReferenceCpuEngine(CFG).build(g2)
+    s2 = Snapshotter(str(tmp_path), g2.fingerprint(), "reference")
+    with pytest.raises(ValueError, match="fingerprint"):
+        resume_engine(eng, s2)
+
+
+def test_resume_refuses_wrong_semantics(tmp_path):
+    g = toy_graph()
+    s1 = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    s1.save(5, np.ones(g.n))
+    eng = ReferenceCpuEngine(CFG.replace(semantics="textbook")).build(g)
+    s2 = Snapshotter(str(tmp_path), g.fingerprint(), "textbook")
+    with pytest.raises(ValueError, match="semantics"):
+        resume_engine(eng, s2)

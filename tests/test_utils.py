@@ -1,0 +1,42 @@
+"""Synth generator + metrics tests."""
+
+import numpy as np
+
+from pagerank_tpu.utils.metrics import MetricsLogger
+from pagerank_tpu.utils.synth import rmat_edges, uniform_edges
+
+
+def test_rmat_shapes_and_range():
+    src, dst = rmat_edges(10, edge_factor=8, seed=1)
+    assert src.shape == dst.shape == (8 << 10,)
+    assert src.min() >= 0 and src.max() < 1 << 10
+    assert dst.min() >= 0 and dst.max() < 1 << 10
+
+
+def test_rmat_is_deterministic_and_skewed():
+    s1, d1 = rmat_edges(12, seed=7)
+    s2, d2 = rmat_edges(12, seed=7)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(d1, d2)
+    # Power-law-ish: max out-degree far above the mean (16).
+    deg = np.bincount(s1, minlength=1 << 12)
+    assert deg.max() > 10 * deg.mean()
+
+
+def test_uniform_edges():
+    src, dst = uniform_edges(100, 1000, seed=0)
+    assert src.shape == (1000,)
+    deg = np.bincount(src, minlength=100)
+    assert deg.max() < 5 * deg.mean()  # no heavy tail
+
+
+def test_metrics_logger_summary(tmp_path):
+    jsonl = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(num_edges=1000, num_chips=2, log_every=0, jsonl_path=jsonl)
+    for i in range(3):
+        m(i, {"l1_delta": 0.5 / (i + 1), "dangling_mass": 1.0})
+    s = m.summary()
+    m.close()
+    assert s["iters"] == 3
+    assert s["edges_per_sec_per_chip"] > 0
+    assert len(open(jsonl).readlines()) == 3
