@@ -1,0 +1,221 @@
+// fast_ingest — native host-side ingestion for pagerank_tpu.
+//
+// The reference inherits its ingestion machinery from Hadoop/Spark (JVM,
+// Sparky.java:61); this library is the build's native-runtime equivalent
+// for the host side of L1/L2: memory-mapped multithreaded edge-list
+// parsing and a 64-bit LSD radix sort-dedup that produces the dst-major
+// edge order the device kernels require (SURVEY.md §7: host ingestion of
+// 1.47B edges must not dwarf the device budget; text parsing in Python
+// would).
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this environment).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libfast_ingest.so \
+//            fast_ingest.cpp -lpthread
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Edge-list text parsing: whitespace-separated integer pairs, '#' comments.
+// ---------------------------------------------------------------------------
+
+struct ParseResult {
+  int64_t* src;
+  int64_t* dst;
+  int64_t count;
+  int64_t error;  // 0 ok; 1 open/map failure; 2 malformed (odd token count)
+};
+
+static void parse_span(const char* p, const char* end, std::vector<int64_t>* out) {
+  // Parses full lines in [p, end); caller aligns spans to line boundaries.
+  while (p < end) {
+    // skip whitespace/newlines
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')) p++;
+    if (p >= end) break;
+    if (*p == '#') {  // comment to end of line
+      while (p < end && *p != '\n') p++;
+      continue;
+    }
+    bool neg = false;
+    if (*p == '-') { neg = true; p++; }
+    int64_t v = 0;
+    while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+    out->push_back(neg ? -v : v);
+  }
+}
+
+ParseResult parse_edgelist(const char* path, int32_t num_threads) {
+  ParseResult r{nullptr, nullptr, 0, 0};
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) { r.error = 1; return r; }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    close(fd);
+    if (st.st_size == 0) { r.count = 0; return r; }
+    r.error = 1; return r;
+  }
+  size_t size = static_cast<size_t>(st.st_size);
+  char* data = static_cast<char*>(mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0));
+  close(fd);
+  if (data == MAP_FAILED) { r.error = 1; return r; }
+
+  int nt = num_threads > 0 ? num_threads : (int)std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  std::vector<std::vector<int64_t>> parts(nt);
+  std::vector<std::thread> threads;
+  size_t chunk = size / nt + 1;
+  std::vector<const char*> bounds(nt + 1);
+  bounds[0] = data;
+  for (int t = 1; t < nt; t++) {
+    const char* b = data + std::min(size, t * chunk);
+    // advance to next newline so each span holds whole lines
+    while (b < data + size && *b != '\n') b++;
+    if (b < data + size) b++;
+    bounds[t] = b;
+  }
+  bounds[nt] = data + size;
+  for (int t = 0; t < nt; t++) {
+    threads.emplace_back(parse_span, bounds[t], bounds[t + 1], &parts[t]);
+  }
+  for (auto& th : threads) th.join();
+  munmap(data, size);
+
+  int64_t total = 0;
+  for (auto& p : parts) total += (int64_t)p.size();
+  if (total % 2 != 0) { r.error = 2; return r; }
+  int64_t e = total / 2;
+  r.src = static_cast<int64_t*>(malloc(sizeof(int64_t) * (e ? e : 1)));
+  r.dst = static_cast<int64_t*>(malloc(sizeof(int64_t) * (e ? e : 1)));
+  int64_t k = 0;
+  // Token stream is strictly ordered across spans (spans are disjoint,
+  // line-aligned, in file order), alternating src dst src dst...
+  int64_t parity = 0;
+  for (auto& p : parts) {
+    for (int64_t v : p) {
+      if (parity == 0) r.src[k] = v; else r.dst[k++] = v;
+      parity ^= 1;
+    }
+  }
+  r.count = e;
+  return r;
+}
+
+void free_edges(int64_t* src, int64_t* dst) {
+  free(src);
+  free(dst);
+}
+
+// ---------------------------------------------------------------------------
+// Radix sort-dedup: key = dst * n + src (dst-major order), 8-bit LSD.
+// Outputs int32 src/dst plus out/in degrees. Returns deduped edge count.
+// ---------------------------------------------------------------------------
+
+static void lsd_radix_sort_parallel(uint64_t*& a, uint64_t*& b, int64_t e,
+                                    uint64_t maxkey, int nt) {
+  // 16-bit digits => at most 4 passes for 64-bit keys; stable LSD with
+  // per-thread histograms so the scatter runs fully parallel.
+  constexpr int RADIX = 1 << 16;
+  constexpr uint64_t MASK = RADIX - 1;
+  int passes = 1;
+  while (passes < 4 && (maxkey >> (16 * passes)) != 0) passes++;
+  int64_t chunk = (e + nt - 1) / nt;
+  std::vector<std::vector<int64_t>> hist(nt, std::vector<int64_t>(RADIX));
+  for (int p = 0; p < passes; p++) {
+    int shift = 16 * p;
+    {
+      std::vector<std::thread> ths;
+      for (int t = 0; t < nt; t++) {
+        ths.emplace_back([&, t] {
+          auto& h = hist[t];
+          std::fill(h.begin(), h.end(), 0);
+          int64_t lo = t * chunk, hi = std::min(e, lo + chunk);
+          for (int64_t i = lo; i < hi; i++) h[(a[i] >> shift) & MASK]++;
+        });
+      }
+      for (auto& th : ths) th.join();
+    }
+    // exclusive prefix over (digit-major, thread-minor) keeps stability
+    int64_t pos = 0;
+    for (int d = 0; d < RADIX; d++) {
+      for (int t = 0; t < nt; t++) {
+        int64_t c = hist[t][d];
+        hist[t][d] = pos;
+        pos += c;
+      }
+    }
+    {
+      std::vector<std::thread> ths;
+      for (int t = 0; t < nt; t++) {
+        ths.emplace_back([&, t] {
+          auto& h = hist[t];
+          int64_t lo = t * chunk, hi = std::min(e, lo + chunk);
+          for (int64_t i = lo; i < hi; i++) b[h[(a[i] >> shift) & MASK]++] = a[i];
+        });
+      }
+      for (auto& th : ths) th.join();
+    }
+    std::swap(a, b);
+  }
+}
+
+int64_t sort_dedup_degrees(const int64_t* src, const int64_t* dst, int64_t e,
+                           int64_t n, int32_t* out_src, int32_t* out_dst,
+                           int32_t* out_degree, int32_t* in_degree) {
+  if (e == 0) {
+    memset(out_degree, 0, sizeof(int32_t) * n);
+    memset(in_degree, 0, sizeof(int32_t) * n);
+    return 0;
+  }
+  int nt = (int)std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  if (nt > 32) nt = 32;
+  std::vector<uint64_t> keys(e), tmp(e);
+  {
+    int64_t chunk = (e + nt - 1) / nt;
+    std::vector<std::thread> ths;
+    for (int t = 0; t < nt; t++) {
+      ths.emplace_back([&, t] {
+        int64_t lo = t * chunk, hi = std::min(e, lo + chunk);
+        for (int64_t i = lo; i < hi; i++) {
+          keys[i] = (uint64_t)dst[i] * (uint64_t)n + (uint64_t)src[i];
+        }
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+  uint64_t maxkey = (uint64_t)(n - 1) * (uint64_t)n + (uint64_t)(n - 1);
+  uint64_t* a = keys.data();
+  uint64_t* b = tmp.data();
+  lsd_radix_sort_parallel(a, b, e, maxkey, nt);
+  // dedup + decode + degrees
+  memset(out_degree, 0, sizeof(int32_t) * n);
+  memset(in_degree, 0, sizeof(int32_t) * n);
+  int64_t k = 0;
+  uint64_t prev = ~a[0];  // != a[0]
+  for (int64_t i = 0; i < e; i++) {
+    if (a[i] == prev) continue;
+    prev = a[i];
+    int32_t d = (int32_t)(a[i] / (uint64_t)n);
+    int32_t s = (int32_t)(a[i] % (uint64_t)n);
+    out_src[k] = s;
+    out_dst[k] = d;
+    out_degree[s]++;
+    in_degree[d]++;
+    k++;
+  }
+  return k;
+}
+
+}  // extern "C"

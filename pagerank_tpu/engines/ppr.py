@@ -1,0 +1,173 @@
+"""Batched Personalized PageRank engines (SpMM power iteration).
+
+Device path: source batch is processed in column chunks; each chunk is a
+[n, kc] rank matrix replicated across the mesh, edges sharded, and the
+per-iteration communication is one psum of the dense [n, kc] partials —
+the same pattern as the rank-vector solver, with k-fold arithmetic
+intensity. Results are returned as per-source top-k (a full [num_sources,
+n] matrix would not fit host memory at scale).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pagerank_tpu.graph import Graph
+from pagerank_tpu.models import ppr as ppr_model
+from pagerank_tpu.utils.config import PageRankConfig
+
+
+@dataclass
+class PprResult:
+    sources: np.ndarray  # [s] source vertex ids
+    topk_ids: np.ndarray  # [s, k] highest-rank vertex ids per source
+    topk_scores: np.ndarray  # [s, k]
+
+    def rank_of(self, source_index: int):
+        return self.topk_ids[source_index], self.topk_scores[source_index]
+
+
+def ppr_cpu(
+    graph: Graph,
+    sources: np.ndarray,
+    num_iters: int = 20,
+    damping: float = 0.85,
+    dangling_to: str = ppr_model.DANGLING_TO_SOURCE,
+) -> np.ndarray:
+    """Float64 oracle: full [n, s] PPR matrix (small graphs only)."""
+    from pagerank_tpu.graph import to_csr_transpose
+
+    at = to_csr_transpose(graph)
+    n, s = graph.n, len(sources)
+    p = np.zeros((n, s))
+    p[sources, np.arange(s)] = 1.0
+    d = (graph.out_degree == 0).astype(np.float64)
+    r = p.copy()
+    for _ in range(num_iters):
+        contrib = at @ r
+        mass = d @ r
+        r = ppr_model.apply_ppr_update(
+            contrib, p, mass, n, damping, dangling_to, np
+        )
+    return r
+
+
+class PprJaxEngine:
+    """Chunked batched PPR on the device mesh."""
+
+    def __init__(self, config: Optional[PageRankConfig] = None,
+                 dangling_to: str = ppr_model.DANGLING_TO_SOURCE,
+                 devices=None):
+        self.config = (config or PageRankConfig()).validate()
+        self.dangling_to = dangling_to
+        self._devices = devices
+        self.graph: Optional[Graph] = None
+
+    def build(self, graph: Graph) -> "PprJaxEngine":
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from pagerank_tpu.ops import spmv
+        from pagerank_tpu.parallel import mesh as mesh_lib
+        from pagerank_tpu.parallel import partition
+
+        cfg = self.config
+        self.graph = graph
+        self._mesh = mesh_lib.make_mesh(
+            cfg.num_devices, cfg.mesh_axis, devices=self._devices
+        )
+        axis = cfg.mesh_axis
+        ndev = self._mesh.devices.size
+        dtype = jnp.dtype(cfg.dtype)
+        accum = jnp.dtype(cfg.accum_dtype)
+        n = graph.n
+
+        shards = partition.partition_edges(graph, ndev, weight_dtype=dtype)
+        e_shard = mesh_lib.edge_sharding(self._mesh)
+        rep = mesh_lib.replicated(self._mesh)
+        self._src = jax.device_put(shards.src, e_shard)
+        self._dst = jax.device_put(shards.dst, e_shard)
+        self._w = jax.device_put(shards.weight, e_shard)
+        self._dangling = jax.device_put(
+            (graph.out_degree == 0).astype(dtype), rep
+        )
+
+        damping = cfg.damping
+        dangling_to = self.dangling_to
+
+        def sharded_contrib(r, src, dst, w):
+            part = spmv.edge_contrib_segment_sum(r, src, dst, w, n, accum)
+            return jax.lax.psum(part, axis)
+
+        contrib_fn = shard_map(
+            sharded_contrib,
+            mesh=self._mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis)),
+            out_specs=P(),
+        )
+
+        @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+        def run_chunk(r, p_onehot, num_iters, src, dst, w, dangling):
+            def body(_, r):
+                contrib = contrib_fn(r, src, dst, w).astype(accum)
+                mass = dangling.astype(accum) @ r.astype(accum)
+                return ppr_model.apply_ppr_update(
+                    contrib, p_onehot.astype(accum), mass, n, damping,
+                    dangling_to, jnp,
+                ).astype(r.dtype)
+
+            return jax.lax.fori_loop(0, num_iters, body, r)
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def topk_fn(r, k):
+            scores, ids = jax.lax.top_k(r.T, k)  # per column
+            return ids, scores
+
+        self._run_chunk = run_chunk
+        self._topk = topk_fn
+        self._jnp = jnp
+        self._jax = jax
+        self._dtype = dtype
+        return self
+
+    def run(
+        self,
+        sources: np.ndarray,
+        num_iters: Optional[int] = None,
+        topk: int = 100,
+        chunk: int = 64,
+    ) -> PprResult:
+        if self.graph is None:
+            raise RuntimeError("call build(graph) before run()")
+        jax, jnp = self._jax, self._jnp
+        cfg = self.config
+        iters = cfg.num_iters if num_iters is None else num_iters
+        n = self.graph.n
+        sources = np.asarray(sources, dtype=np.int64)
+        topk = min(topk, n)
+
+        ids_out = np.zeros((len(sources), topk), np.int32)
+        scores_out = np.zeros((len(sources), topk), self._dtype)
+        from pagerank_tpu.parallel.mesh import replicated
+
+        rep = replicated(self._mesh)
+        for lo in range(0, len(sources), chunk):
+            batch = sources[lo : lo + chunk]
+            p = np.zeros((n, len(batch)), dtype=self._dtype)
+            p[batch, np.arange(len(batch))] = 1.0
+            p_dev = jax.device_put(jnp.asarray(p), rep)
+            r = self._run_chunk(
+                p_dev.copy(), p_dev, iters,
+                self._src, self._dst, self._w, self._dangling,
+            )
+            ids, scores = self._topk(r, topk)
+            ids_out[lo : lo + len(batch)] = np.asarray(jax.device_get(ids))
+            scores_out[lo : lo + len(batch)] = np.asarray(jax.device_get(scores))
+        return PprResult(sources=sources, topk_ids=ids_out, topk_scores=scores_out)

@@ -86,6 +86,7 @@ def build_graph(
     dedup: bool = True,
     dangling_mask: Optional[np.ndarray] = None,
     vertex_names: Optional[Sequence[str]] = None,
+    use_native_sort: bool = False,
 ) -> Graph:
     """Build a :class:`Graph` from raw (src, dst) edge arrays.
 
@@ -109,6 +110,9 @@ def build_graph(
         reference semantics for edge-list inputs; crawl ingestion passes
         ~crawled because the repair pass un-dangles every crawled page
         (see module docstring).
+      use_native_sort: route dedup+sort through the C++ radix sorter
+        (native/fast_ingest.cpp). Opt-in: it beats np.unique only on
+        multi-core hosts (this image is single-core, where numpy wins).
     """
     src = np.ascontiguousarray(src, dtype=np.int64)
     dst = np.ascontiguousarray(dst, dtype=np.int64)
@@ -129,21 +133,32 @@ def build_graph(
 
     # Dedup + sort by (dst, src) in one pass via a packed 64-bit key.
     # dst-major ordering makes the per-iteration scatter a *sorted*
-    # segment-sum (fast path on TPU).
+    # segment-sum (fast path on TPU). Large inputs take the native C++
+    # radix-sort path (native/fast_ingest.cpp) when available.
+    out_degree = in_degree = None
     if len(src) > 0:
-        key = dst * np.int64(n) + src
-        if dedup:
-            key = np.unique(key)  # unique() also sorts
+        native_out = None
+        if dedup and use_native_sort:
+            from pagerank_tpu.ingest import native as native_lib
+
+            native_out = native_lib.sort_dedup_degrees_native(src, dst, n)
+        if native_out is not None:
+            src_s, dst_s, out_degree, in_degree = native_out
         else:
-            key = np.sort(key, kind="stable")
-        dst_s = (key // n).astype(np.int32)
-        src_s = (key % n).astype(np.int32)
+            key = dst * np.int64(n) + src
+            if dedup:
+                key = np.unique(key)  # unique() also sorts
+            else:
+                key = np.sort(key, kind="stable")
+            dst_s = (key // n).astype(np.int32)
+            src_s = (key % n).astype(np.int32)
     else:
         src_s = np.zeros(0, dtype=np.int32)
         dst_s = np.zeros(0, dtype=np.int32)
 
-    out_degree = np.bincount(src_s, minlength=n).astype(np.int32)
-    in_degree = np.bincount(dst_s, minlength=n).astype(np.int32)
+    if out_degree is None:
+        out_degree = np.bincount(src_s, minlength=n).astype(np.int32)
+        in_degree = np.bincount(dst_s, minlength=n).astype(np.int32)
 
     if dangling_mask is None:
         dangling_mask = out_degree == 0

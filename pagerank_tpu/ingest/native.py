@@ -1,0 +1,126 @@
+"""ctypes bindings for the native ingestion library (native/fast_ingest.cpp).
+
+Compiled on demand with g++ (no pybind11 in this environment; C ABI +
+ctypes instead). Every entry point has a numpy fallback, so the package
+works without a toolchain — the native path exists because host-side
+ingestion of billion-edge graphs must not dwarf the device budget
+(SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "fast_ingest.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "..", "..", "native", "libfast_ingest.so")
+
+
+class _ParseResult(ctypes.Structure):
+    _fields_ = [
+        ("src", ctypes.POINTER(ctypes.c_int64)),
+        ("dst", ctypes.POINTER(ctypes.c_int64)),
+        ("count", ctypes.c_int64),
+        ("error", ctypes.c_int64),
+    ]
+
+
+def _build() -> Optional[str]:
+    src = os.path.abspath(_SRC)
+    so = os.path.abspath(_SO)
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", so, src, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return so
+    except Exception:
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB, _LIB_FAILED
+    with _LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        so = _build()
+        if so is None:
+            _LIB_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.parse_edgelist.restype = _ParseResult
+            lib.parse_edgelist.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+            lib.free_edges.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.sort_dedup_degrees.restype = ctypes.c_int64
+            lib.sort_dedup_degrees.argtypes = [
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ]
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def parse_edgelist_native(path: str, num_threads: int = 0):
+    """mmap + multithreaded text edge-list parse. Returns (src, dst) int64
+    arrays, or None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    res = lib.parse_edgelist(path.encode(), num_threads)
+    if res.error == 1:
+        raise FileNotFoundError(path)
+    if res.error == 2:
+        lib.free_edges(res.src, res.dst)
+        raise ValueError(f"{path}: odd token count; not a src/dst list")
+    e = res.count
+    if e == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    src = np.ctypeslib.as_array(res.src, shape=(e,)).copy()
+    dst = np.ctypeslib.as_array(res.dst, shape=(e,)).copy()
+    lib.free_edges(res.src, res.dst)
+    return src, dst
+
+
+def sort_dedup_degrees_native(
+    src: np.ndarray, dst: np.ndarray, n: int
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """dst-major radix sort + dedup + degree count. Returns (src32, dst32,
+    out_degree, in_degree) or None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    e = src.shape[0]
+    out_src = np.empty(max(e, 1), np.int32)
+    out_dst = np.empty(max(e, 1), np.int32)
+    out_deg = np.empty(n, np.int32)
+    in_deg = np.empty(n, np.int32)
+    k = lib.sort_dedup_degrees(src, dst, e, n, out_src, out_dst, out_deg, in_deg)
+    return out_src[:k].copy(), out_dst[:k].copy(), out_deg, in_deg

@@ -96,10 +96,14 @@ def ell_pack(graph: Graph) -> EllPack:
     block = new_dst // LANES  # per-edge dst block
     lane = (new_dst % LANES).astype(np.int64)
 
-    # Rows per block = max depth + 1 within the block (0 if block empty).
+    # Rows per block = max in-degree within the block. After the
+    # descending in-degree relabel, in-degrees are non-increasing, so the
+    # block max is simply the block's FIRST vertex's in-degree — no
+    # scatter-max needed (np.maximum.at is pathologically slow at scale).
     num_blocks = n_padded // LANES
-    block_rows = np.zeros(num_blocks, dtype=np.int64)
-    np.maximum.at(block_rows, block, depth + 1)
+    indeg_rel = np.zeros(n_padded, dtype=np.int64)
+    indeg_rel[:n] = graph.in_degree[perm]
+    block_rows = indeg_rel[0::LANES].copy()
 
     row_offset = np.concatenate([[0], np.cumsum(block_rows)])
     rows_total = int(row_offset[-1])

@@ -1,0 +1,60 @@
+"""Multi-host initialization (L0 over DCN).
+
+The reference scales out via Spark's cluster manager + netty shuffle
+(inherited, SURVEY.md §5 "Distributed communication backend"). The
+TPU-native equivalent: `jax.distributed.initialize` brings up the
+multi-host runtime; after that, the SAME solver code runs unchanged —
+the 1-D edge mesh simply spans all hosts' devices, psum partials ride
+ICI within a slice and DCN across slices. No shuffle machinery exists to
+port: the graph is statically partitioned once (parallel/partition.py).
+
+Single-host (or single-chip) runs skip initialization entirely.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def maybe_initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed when multi-host context is present.
+
+    Resolution order: explicit args > PAGERANK_TPU_* env vars > cloud
+    TPU auto-detection (jax.distributed.initialize() with no args reads
+    the TPU metadata server). Returns True if initialization ran.
+    """
+    import jax
+
+    coordinator = coordinator_address or os.environ.get("PAGERANK_TPU_COORDINATOR")
+    nproc = num_processes if num_processes is not None else _env_int("PAGERANK_TPU_NUM_PROCESSES")
+    pid = process_id if process_id is not None else _env_int("PAGERANK_TPU_PROCESS_ID")
+
+    if coordinator is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=nproc,
+            process_id=pid,
+        )
+        return True
+    if os.environ.get("TPU_WORKER_HOSTNAMES") and _env_int("TPU_WORKER_ID") is not None \
+            and os.environ.get("PAGERANK_TPU_AUTO_DISTRIBUTED") == "1":
+        jax.distributed.initialize()
+        return True
+    return False
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else None
+
+
+def process_info():
+    """(process_index, process_count) — (0, 1) when not distributed."""
+    import jax
+
+    return jax.process_index(), jax.process_count()

@@ -38,10 +38,11 @@ def rmat_edges(
     for _ in range(scale):
         src <<= 1
         dst <<= 1
-        r_bit = rng.random(n_edges)
-        c_bit = rng.random(n_edges)
-        src_bit = r_bit >= ab
-        dst_bit = np.where(src_bit, c_bit >= c_frac, c_bit >= a_frac)
+        r_bit = rng.random(n_edges, dtype=np.float32)
+        c_bit = rng.random(n_edges, dtype=np.float32)
+        src_bit = r_bit >= np.float32(ab)
+        threshold = np.where(src_bit, np.float32(c_frac), np.float32(a_frac))
+        dst_bit = c_bit >= threshold
         src |= src_bit
         dst |= dst_bit
     # Permute vertex labels so high-degree vertices aren't clustered at 0.
