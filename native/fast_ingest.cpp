@@ -35,10 +35,11 @@ struct ParseResult {
   int64_t* src;
   int64_t* dst;
   int64_t count;
-  int64_t error;  // 0 ok; 1 open/map failure; 2 malformed (odd token count)
+  int64_t error;  // 0 ok; 1 open/map failure; 2 odd token count; 3 bad token
 };
 
-static void parse_span(const char* p, const char* end, std::vector<int64_t>* out) {
+static void parse_span(const char* p, const char* end, std::vector<int64_t>* out,
+                       std::atomic<int>* bad) {
   // Parses full lines in [p, end); caller aligns spans to line boundaries.
   while (p < end) {
     // skip whitespace/newlines
@@ -51,7 +52,15 @@ static void parse_span(const char* p, const char* end, std::vector<int64_t>* out
     bool neg = false;
     if (*p == '-') { neg = true; p++; }
     int64_t v = 0;
+    const char* digits_start = p;
     while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+    if (p == digits_start) {
+      // Token with no digits (e.g. a stray word): flag and skip it —
+      // never stall. The caller surfaces error=3 as a ValueError.
+      bad->store(1, std::memory_order_relaxed);
+      while (p < end && *p != ' ' && *p != '\t' && *p != '\r' && *p != '\n') p++;
+      continue;
+    }
     out->push_back(neg ? -v : v);
   }
 }
@@ -86,12 +95,14 @@ ParseResult parse_edgelist(const char* path, int32_t num_threads) {
     bounds[t] = b;
   }
   bounds[nt] = data + size;
+  std::atomic<int> bad{0};
   for (int t = 0; t < nt; t++) {
-    threads.emplace_back(parse_span, bounds[t], bounds[t + 1], &parts[t]);
+    threads.emplace_back(parse_span, bounds[t], bounds[t + 1], &parts[t], &bad);
   }
   for (auto& th : threads) th.join();
   munmap(data, size);
 
+  if (bad.load()) { r.error = 3; return r; }
   int64_t total = 0;
   for (auto& p : parts) total += (int64_t)p.size();
   if (total % 2 != 0) { r.error = 2; return r; }

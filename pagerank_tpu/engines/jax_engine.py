@@ -52,13 +52,13 @@ from pagerank_tpu.parallel import mesh as mesh_lib
 from pagerank_tpu.parallel import partition
 
 
-def _pad_rows(a: np.ndarray, multiple: int, fill):
+def _pad_rows(a, multiple: int, fill, xp=np):
     rows = a.shape[0]
     target = -(-max(rows, 1) // multiple) * multiple
     if target == rows:
         return a
     pad_shape = (target - rows,) + a.shape[1:]
-    return np.concatenate([a, np.full(pad_shape, fill, dtype=a.dtype)])
+    return xp.concatenate([a, xp.full(pad_shape, fill, dtype=a.dtype)])
 
 
 @register_engine("jax")
@@ -70,23 +70,58 @@ class JaxTpuEngine(PageRankEngine):
         self._devices = devices
         self._mesh = None
         self._pack: Optional[ell_lib.EllPack] = None
+        self._perm: Optional[np.ndarray] = None  # relabeled -> original
 
     # -- build ------------------------------------------------------------
+
+    def _begin_build(self):
+        cfg = self.config
+        self._mesh = mesh_lib.make_mesh(
+            cfg.num_devices, cfg.mesh_axis, devices=self._devices
+        )
+        self._dtype = jnp.dtype(cfg.dtype)
+        self._accum_dtype = jnp.dtype(cfg.accum_dtype)
+
+    def build_device(self, dg) -> "JaxTpuEngine":
+        """Build from an on-device blocked-ELL graph
+        (ops/device_build.DeviceEllGraph) — no bulk host->device
+        transfer; see device_build's module docstring."""
+        from pagerank_tpu.ops.device_build import DeviceEllGraph
+
+        assert isinstance(dg, DeviceEllGraph)
+        cfg = self.config
+        self.graph = dg
+        self._begin_build()
+        if (cfg.kernel if cfg.kernel != "auto" else "ell") != "ell":
+            raise ValueError("build_device supports the ell kernel only")
+
+        n, pad = dg.n, dg.n_padded - dg.n
+        # Masks arrive in ORIGINAL id space; permute to relabeled space
+        # and pad (on device — these are [n] bool arrays).
+        mass = dg.dangling_mask[dg.perm]
+        zin = dg.zero_in_mask[dg.perm]
+        zpad = jnp.zeros(pad, bool)
+        self._perm = np.asarray(jax.device_get(dg.perm))
+        self._setup_ell(
+            dg.src, dg.weight, dg.row_block,
+            jnp.concatenate([mass, zpad]),
+            jnp.concatenate([zin, zpad]),
+            jnp.concatenate([jnp.ones(n, bool), zpad]),
+            n=n, n_state=dg.n_padded, num_blocks=dg.num_blocks,
+            num_rows=dg.num_rows,
+        )
+        return self
 
     def build(self, graph: Graph) -> "JaxTpuEngine":
         cfg = self.config
         self.graph = graph
-        self._mesh = mesh_lib.make_mesh(
-            cfg.num_devices, cfg.mesh_axis, devices=self._devices
-        )
+        self._begin_build()
         axis = cfg.mesh_axis
         ndev = self._mesh.devices.size
         mesh = self._mesh
 
-        dtype = jnp.dtype(cfg.dtype)
-        self._dtype = dtype
-        accum = jnp.dtype(cfg.accum_dtype)
-        self._accum_dtype = accum
+        dtype = self._dtype
+        accum = self._accum_dtype
 
         kernel = cfg.kernel if cfg.kernel != "auto" else "ell"
         self._kernel = kernel
@@ -94,7 +129,6 @@ class JaxTpuEngine(PageRankEngine):
         n = graph.n
         rep = mesh_lib.replicated(self._mesh)
         e_shard = mesh_lib.edge_sharding(self._mesh)
-        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
 
         # Reference mode: post-repair dangUrls (uncrawled targets).
         # Textbook mode: standard dangling definition (out_degree == 0).
@@ -108,45 +142,23 @@ class JaxTpuEngine(PageRankEngine):
         if kernel == "ell":
             pack = ell_lib.ell_pack(graph)
             self._pack = pack
+            self._perm = pack.perm
             n_state = pack.n_padded  # device rank vector length (padded)
             pad = n_state - n
             # Relabel + pad masks; padding lanes are all-zero.
             mass_mask = np.concatenate([mass_mask[pack.perm], np.zeros(pad, bool)])
             zero_in = np.concatenate([zero_in[pack.perm], np.zeros(pad, bool)])
             valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
-
-            # Chunk the gather so its (slots, 8) intermediate stays ~100MB
-            # regardless of graph size; pad rows so chunks divide evenly.
-            rows_per_dev = -(-max(1, pack.num_rows) // ndev)
-            chunk_rows = min(32768, rows_per_dev)
-            pad_multiple = ndev * chunk_rows
-            src_slots = _pad_rows(pack.src, pad_multiple, 0)
-            w_slots = _pad_rows(pack.weight, pad_multiple, 0).astype(dtype)
-            row_block = _pad_rows(
-                pack.row_block, pad_multiple, max(0, pack.num_blocks - 1)
+            self._setup_ell(
+                pack.src, pack.weight, pack.row_block,
+                mass_mask, zero_in, valid,
+                n=n, n_state=n_state, num_blocks=pack.num_blocks,
+                num_rows=pack.num_rows,
             )
-            num_blocks = pack.num_blocks
-
-            self._src = jax.device_put(src_slots, shard2d)
-            self._w = jax.device_put(w_slots, shard2d)
-            self._row_block = jax.device_put(row_block, e_shard)
-
-            def sharded_contrib(r, src, w, row_block):
-                part = spmv.ell_contrib(
-                    r, src, w, row_block, num_blocks, accum_dtype=accum,
-                    chunk_rows=chunk_rows,
-                )
-                return jax.lax.psum(part, axis)
-
-            contrib_fn = shard_map(
-                sharded_contrib,
-                mesh=mesh,
-                in_specs=(P(), P(axis, None), P(axis, None), P(axis)),
-                out_specs=P(),
-            )
-            contrib_args = (self._src, self._w, self._row_block)
+            return self
         else:
             self._pack = None
+            self._perm = None
             n_state = n
             shards = partition.partition_edges(graph, ndev, weight_dtype=dtype)
             self._src = jax.device_put(shards.src, e_shard)
@@ -165,21 +177,84 @@ class JaxTpuEngine(PageRankEngine):
             )
             contrib_args = (self._src, self._dst, self._w)
             valid = np.ones(n, bool)  # no padding in coo state
+            self._finalize(
+                contrib_fn, contrib_args, mass_mask, zero_in, valid, n, n_state
+            )
+            return self
 
+    def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
+                   valid, *, n, n_state, num_blocks, num_rows):
+        """Common ELL-path setup from slot arrays (host numpy or device
+        jnp) — pads rows to the per-device chunk multiple, places arrays
+        over the mesh, builds the sharded contribution fn."""
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        ndev = mesh.devices.size
+        dtype = self._dtype
+        accum = self._accum_dtype
+        self._kernel = "ell"
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+        e_shard = mesh_lib.edge_sharding(mesh)
+
+        # Chunk the gather so its (slots, 8) intermediate stays ~100MB
+        # regardless of graph size; pad rows so chunks divide evenly.
+        rows_per_dev = -(-max(1, num_rows) // ndev)
+        chunk_rows = min(32768, rows_per_dev)
+        pad_multiple = ndev * chunk_rows
+        xp = np if isinstance(src_slots, np.ndarray) else jnp
+        src_slots = _pad_rows(src_slots, pad_multiple, 0, xp)
+        if w_slots.dtype != dtype:  # convert before padding: smaller copy
+            w_slots = w_slots.astype(dtype)
+        w_slots = _pad_rows(w_slots, pad_multiple, 0, xp)
+        row_block = _pad_rows(row_block, pad_multiple, max(0, num_blocks - 1), xp)
+
+        self._src = jax.device_put(src_slots, shard2d)
+        self._w = jax.device_put(w_slots, shard2d)
+        self._row_block = jax.device_put(row_block, e_shard)
+
+        def sharded_contrib(r, src, w, row_block):
+            part = spmv.ell_contrib(
+                r, src, w, row_block, num_blocks, accum_dtype=accum,
+                chunk_rows=chunk_rows,
+            )
+            return jax.lax.psum(part, axis)
+
+        contrib_fn = shard_map(
+            sharded_contrib,
+            mesh=mesh,
+            in_specs=(P(), P(axis, None), P(axis, None), P(axis)),
+            out_specs=P(),
+        )
+        self._finalize(
+            contrib_fn, (self._src, self._w, self._row_block),
+            mass_mask, zero_in, valid, n, n_state,
+        )
+
+    def _finalize(self, contrib_fn, contrib_args, mass_mask, zero_in, valid,
+                  n, n_state):
+        """Masks + r0 placement and the fused jitted step."""
+        cfg = self.config
+        dtype = self._dtype
+        accum = self._accum_dtype
+        rep = mesh_lib.replicated(self._mesh)
+
+        xp = np if isinstance(mass_mask, np.ndarray) else jnp
         self._n_state = n_state
         self._dangling = jax.device_put(
-            np.asarray(mass_mask, bool).astype(dtype), rep
+            xp.asarray(mass_mask, bool).astype(dtype), rep
         )
         self._zero_in = jax.device_put(
-            np.asarray(zero_in, bool).astype(dtype), rep
+            xp.asarray(zero_in, bool).astype(dtype), rep
         )
+        valid = xp.asarray(valid, bool)
         self._valid = jax.device_put(valid.astype(dtype), rep)
 
         # Initial value uses the TRUE n (1/n in textbook mode), laid out
         # over the padded state vector with zeros in padding lanes.
         r0_value = 1.0 if cfg.semantics == "reference" else 1.0 / n
-        r0 = np.full(n_state, r0_value, dtype=dtype) * valid
-        self._r = jax.device_put(jnp.asarray(r0.astype(dtype)), rep)
+        r0 = xp.full(n_state, r0_value, dtype=dtype) * valid
+        self._r = jax.device_put(jnp.asarray(r0, dtype=dtype), rep)
         self.iteration = 0
 
         damping = cfg.damping
@@ -199,7 +274,6 @@ class JaxTpuEngine(PageRankEngine):
 
         self._contrib_args = contrib_args
         self._step_fn = step_fn
-        return self
 
     # -- iteration --------------------------------------------------------
 
@@ -233,9 +307,9 @@ class JaxTpuEngine(PageRankEngine):
 
     def ranks(self) -> np.ndarray:
         r = np.asarray(jax.device_get(self._r))[: self.graph.n]
-        if self._pack is not None:
+        if self._perm is not None:
             out = np.empty(self.graph.n, dtype=r.dtype)
-            out[self._pack.perm] = r
+            out[self._perm] = r
             return out
         return r
 
@@ -243,9 +317,9 @@ class JaxTpuEngine(PageRankEngine):
         if r.shape != (self.graph.n,):
             raise ValueError(f"rank shape {r.shape} != ({self.graph.n},)")
         r = np.asarray(r, dtype=self._dtype)
-        if self._pack is not None:
+        if self._perm is not None:
             rr = np.zeros(self._n_state, dtype=self._dtype)
-            rr[: self.graph.n] = r[self._pack.perm]
+            rr[: self.graph.n] = r[self._perm]
             r = rr
         self._r = jax.device_put(r, mesh_lib.replicated(self._mesh))
         self.iteration = iteration

@@ -98,6 +98,9 @@ def parse_edgelist_native(path: str, num_threads: int = 0):
     if res.error == 2:
         lib.free_edges(res.src, res.dst)
         raise ValueError(f"{path}: odd token count; not a src/dst list")
+    if res.error == 3:
+        lib.free_edges(res.src, res.dst)
+        raise ValueError(f"{path}: non-integer token; not a src/dst list")
     e = res.count
     if e == 0:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)

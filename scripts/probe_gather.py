@@ -1,0 +1,167 @@
+"""Micro-benchmark: candidate formulations of the SpMV gather on the live
+TPU, to pick the kernel the engine should default to.
+
+The hot op is contrib = Aᵀ_norm r — per ELL slot: z[src[row, lane]] * w.
+The gather of z at arbitrary src indices is the whole game (the multiply
+and row segment-sum are streaming). Variants probed:
+
+  take1d       : z[src]                       — plain 1-D take
+  onehot8      : z.reshape(-1, 8)[src>>3] ⊙ one_hot(src&7)   (current)
+  onehot16     : width-16 variant
+  onehot32     : width-32 variant
+  onehot128mxu : z.reshape(-1,128)[src>>7] one-hot contracted on the MXU
+  pallas_*     : Pallas in-kernel gather forms (support probe + timing)
+
+Run: python scripts/probe_gather.py [--rows 65536] [--n 1048576]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.device_get(jnp.sum(out if not isinstance(out, tuple) else out[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.device_get(jnp.sum(out if not isinstance(out, tuple) else out[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=1 << 16)  # rows of 128 slots
+    p.add_argument("--n", type=int, default=1 << 20)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    rows, n = args.rows, args.n
+    dtype = jnp.dtype(args.dtype)
+    slots = rows * 128
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, n, (rows, 128)).astype(np.int32)
+    w = rng.random((rows, 128), np.float32).astype(dtype)
+    z = rng.random(n, np.float32).astype(dtype)
+
+    src_d = jax.device_put(src)
+    w_d = jax.device_put(w)
+    z_d = jax.device_put(z)
+
+    results = {}
+
+    @jax.jit
+    def take1d(z, s, w):
+        return z[s] * w
+
+    results["take1d"] = timeit(take1d, z_d, src_d, w_d, iters=args.iters)
+
+    def make_onehot(width):
+        shift = width.bit_length() - 1
+        mask = width - 1
+
+        @jax.jit
+        def f(z, s, w):
+            zw = z.reshape(-1, width)
+            rows_g = zw[s >> shift]
+            sel = jax.nn.one_hot(s & mask, width, dtype=z.dtype)
+            return (rows_g * sel).sum(-1) * w
+
+        return f
+
+    for width in (8, 16, 32):
+        results[f"onehot{width}"] = timeit(
+            make_onehot(width), z_d, src_d, w_d, iters=args.iters
+        )
+
+    # MXU form: per slot, one_hot(128) dot the gathered 128-row.
+    @jax.jit
+    def onehot128mxu(z, s, w):
+        zw = z.reshape(-1, 128)
+        rows_g = zw[s >> 7]  # (rows, 128, 128)
+        sel = jax.nn.one_hot(s & 127, 128, dtype=z.dtype)
+        return jnp.einsum("rlk,rlk->rl", rows_g, sel) * w
+
+    try:
+        results["onehot128mxu"] = timeit(
+            onehot128mxu, z_d, src_d, w_d, iters=max(2, args.iters // 4)
+        )
+    except Exception as e:  # may OOM at big rows
+        results["onehot128mxu"] = f"FAIL {type(e).__name__}"
+
+    # Pallas in-kernel forms.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    CHUNK = 512
+
+    def probe_pallas(name, kernel_body):
+        try:
+            f = pl.pallas_call(
+                kernel_body,
+                out_shape=jax.ShapeDtypeStruct((rows, 128), dtype),
+                grid=(rows // CHUNK,),
+                in_specs=[
+                    pl.BlockSpec((n // 1, ), lambda i: (0,), memory_space=pltpu.VMEM)
+                    if False
+                    else pl.BlockSpec(memory_space=pltpu.VMEM),  # z whole
+                    pl.BlockSpec((CHUNK, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                    pl.BlockSpec((CHUNK, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec(
+                    (CHUNK, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+                ),
+            )
+            jf = jax.jit(f)
+            out = jf(z_d, src_d, w_d)
+            jax.device_get(jnp.sum(out))
+            results[name] = timeit(jf, z_d, src_d, w_d, iters=args.iters)
+        except Exception as e:
+            msg = str(e).splitlines()[0][:120] if str(e) else type(e).__name__
+            results[name] = f"FAIL {type(e).__name__}: {msg}"
+
+    def k_take(z_ref, s_ref, w_ref, o_ref):
+        o_ref[:] = z_ref[...][s_ref[...]] * w_ref[...]
+
+    probe_pallas("pallas_take1d", k_take)
+
+    def k_onehot8(z_ref, s_ref, w_ref, o_ref):
+        zw = z_ref[...].reshape(-1, 8)
+        s = s_ref[...]
+        rows_g = zw[s >> 3]
+        sel = jax.nn.one_hot(s & 7, 8, dtype=zw.dtype)
+        o_ref[:] = (rows_g * sel).sum(-1) * w_ref[...]
+
+    probe_pallas("pallas_onehot8", k_onehot8)
+
+    def k_taa(z_ref, s_ref, w_ref, o_ref):
+        # take_along_axis within 128 lanes after a row gather
+        zw = z_ref[...].reshape(-1, 128)
+        s = s_ref[...]
+        rows_g = zw[s >> 7]  # (CHUNK,128,128) gather - likely unsupported
+        o_ref[:] = jnp.take_along_axis(
+            rows_g, (s & 127)[..., None], axis=-1
+        )[..., 0] * w_ref[...]
+
+    probe_pallas("pallas_rowgather_taa", k_taa)
+
+    gb = slots * (4 + dtype.itemsize * 2) / 1e9  # src + w + out bytes
+    print(f"\nrows={rows} slots={slots:,} n={n:,} dtype={args.dtype}")
+    for k, v in results.items():
+        if isinstance(v, float):
+            print(f"  {k:24s} {v * 1e3:8.3f} ms  {slots / v / 1e9:7.3f} Gslot/s  {gb / v:6.1f} GB/s(stream)")
+        else:
+            print(f"  {k:24s} {v}")
+
+
+if __name__ == "__main__":
+    main()

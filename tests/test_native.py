@@ -38,6 +38,15 @@ def test_parse_odd_tokens(tmp_path):
         native.parse_edgelist_native(str(p))
 
 
+def test_parse_non_integer_token_raises(tmp_path):
+    # Regression: tokens with no digits used to spin the parser forever
+    # (the digit loop never advanced past e.g. 'x').
+    p = tmp_path / "bad_tok.txt"
+    p.write_text("0 1\nx y\n")
+    with pytest.raises(ValueError):
+        native.parse_edgelist_native(str(p))
+
+
 def test_parse_empty(tmp_path):
     p = tmp_path / "empty.txt"
     p.write_text("# nothing\n")
