@@ -43,6 +43,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
+from pagerank_tpu import graph as graph_mod
 from pagerank_tpu.engine import PageRankEngine, register_engine
 from pagerank_tpu.graph import Graph
 from pagerank_tpu.models import pagerank as pr_model
@@ -102,14 +103,23 @@ class JaxTpuEngine(PageRankEngine):
         zin = dg.zero_in_mask[dg.perm]
         zpad = jnp.zeros(pad, bool)
         self._perm = np.asarray(jax.device_get(dg.perm))
+        inv = graph_mod.inv_out_degree(dg.out_degree, jnp, dtype=self._dtype)
+        inv_out_rel = jnp.concatenate(
+            [inv[dg.perm], jnp.zeros(pad, self._dtype)]
+        )
         self._setup_ell(
             dg.src, dg.weight, dg.row_block,
             jnp.concatenate([mass, zpad]),
             jnp.concatenate([zin, zpad]),
             jnp.concatenate([jnp.ones(n, bool), zpad]),
             n=n, n_state=dg.n_padded, num_blocks=dg.num_blocks,
-            num_rows=dg.num_rows,
+            num_rows=dg.num_rows, inv_out_rel=inv_out_rel,
         )
+        # The slot arrays are donated to the engine: _setup_ell derives
+        # its sentinel-ized copies, and keeping the originals referenced
+        # from dg would pin a second full-size set of [rows, 128] arrays
+        # in HBM for the engine's lifetime.
+        dg.src = dg.weight = dg.row_block = None
         return self
 
     def build(self, graph: Graph) -> "JaxTpuEngine":
@@ -149,11 +159,13 @@ class JaxTpuEngine(PageRankEngine):
             mass_mask = np.concatenate([mass_mask[pack.perm], np.zeros(pad, bool)])
             zero_in = np.concatenate([zero_in[pack.perm], np.zeros(pad, bool)])
             valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+            inv = graph_mod.inv_out_degree(graph.out_degree)
+            inv_out_rel = np.concatenate([inv[pack.perm], np.zeros(pad)])
             self._setup_ell(
                 pack.src, pack.weight, pack.row_block,
                 mass_mask, zero_in, valid,
                 n=n, n_state=n_state, num_blocks=pack.num_blocks,
-                num_rows=pack.num_rows,
+                num_rows=pack.num_rows, inv_out_rel=inv_out_rel,
             )
             return self
         else:
@@ -182,17 +194,28 @@ class JaxTpuEngine(PageRankEngine):
             )
             return self
 
+    GATHER_WIDTH = 8
+
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
-                   valid, *, n, n_state, num_blocks, num_rows):
+                   valid, *, n, n_state, num_blocks, num_rows, inv_out_rel):
         """Common ELL-path setup from slot arrays (host numpy or device
         jnp) — pads rows to the per-device chunk multiple, places arrays
-        over the mesh, builds the sharded contribution fn."""
+        over the mesh, builds the sharded contribution fn.
+
+        The per-slot weights are NOT placed on device: the solver
+        pre-scales the rank vector by ``inv_out_rel`` each iteration
+        (ops/spmv.py:ell_contrib docstring), so ``w_slots`` is consumed
+        here only to locate inert slots (weight 0: ELL padding, duplicate
+        edges), which are re-pointed at the zero sentinel ``n_state``.
+        Half the slot bytes stream from HBM per iteration as a result.
+        """
         cfg = self.config
         mesh = self._mesh
         axis = cfg.mesh_axis
         ndev = mesh.devices.size
         dtype = self._dtype
         accum = self._accum_dtype
+        gw = self.GATHER_WIDTH
         self._kernel = "ell"
         shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
         e_shard = mesh_lib.edge_sharding(mesh)
@@ -203,36 +226,51 @@ class JaxTpuEngine(PageRankEngine):
         chunk_rows = min(32768, rows_per_dev)
         pad_multiple = ndev * chunk_rows
         xp = np if isinstance(src_slots, np.ndarray) else jnp
-        src_slots = _pad_rows(src_slots, pad_multiple, 0, xp)
-        if w_slots.dtype != dtype:  # convert before padding: smaller copy
-            w_slots = w_slots.astype(dtype)
-        w_slots = _pad_rows(w_slots, pad_multiple, 0, xp)
+        # Inert slots (weight 0) -> sentinel index n_state; real slots
+        # keep their source id. Row padding (added below) is all-inert.
+        src_slots = xp.where(w_slots != 0, src_slots, np.int32(n_state))
+        src_slots = _pad_rows(src_slots, pad_multiple, np.int32(n_state), xp)
         row_block = _pad_rows(row_block, pad_multiple, max(0, num_blocks - 1), xp)
 
         self._src = jax.device_put(src_slots, shard2d)
-        self._w = jax.device_put(w_slots, shard2d)
         self._row_block = jax.device_put(row_block, e_shard)
+        # 1/out_degree in RELABELED space, zero-padded to n_state. Kept
+        # (and the prescale multiply performed) in accum_dtype when that
+        # is wider than the rank dtype, so per-edge products carry accum
+        # precision into the segment-sum exactly as the per-slot-weight
+        # form did.
+        z_dtype = accum if jnp.dtype(accum).itemsize > jnp.dtype(dtype).itemsize else dtype
+        inv_out_rel = xp.asarray(inv_out_rel)
+        if inv_out_rel.dtype != z_dtype:
+            inv_out_rel = inv_out_rel.astype(z_dtype)
+        self._inv_out = jax.device_put(inv_out_rel, mesh_lib.replicated(mesh))
 
-        def sharded_contrib(r, src, w, row_block):
+        def sharded_contrib(z_ext, src, row_block):
             part = spmv.ell_contrib(
-                r, src, w, row_block, num_blocks, accum_dtype=accum,
-                chunk_rows=chunk_rows,
+                z_ext, src, row_block, num_blocks, accum_dtype=accum,
+                gather_width=gw, chunk_rows=chunk_rows,
             )
             return jax.lax.psum(part, axis)
 
         contrib_fn = shard_map(
             sharded_contrib,
             mesh=mesh,
-            in_specs=(P(), P(axis, None), P(axis, None), P(axis)),
+            in_specs=(P(), P(axis, None), P(axis)),
             out_specs=P(),
         )
+        inv_out = self._inv_out
+
+        def prescale(r):
+            z = r.astype(inv_out.dtype) * inv_out
+            return jnp.concatenate([z, jnp.zeros(gw, dtype=z.dtype)])
+
         self._finalize(
-            contrib_fn, (self._src, self._w, self._row_block),
-            mass_mask, zero_in, valid, n, n_state,
+            contrib_fn, (self._src, self._row_block),
+            mass_mask, zero_in, valid, n, n_state, prescale=prescale,
         )
 
     def _finalize(self, contrib_fn, contrib_args, mass_mask, zero_in, valid,
-                  n, n_state):
+                  n, n_state, prescale=None):
         """Masks + r0 placement and the fused jitted step."""
         cfg = self.config
         dtype = self._dtype
@@ -262,7 +300,8 @@ class JaxTpuEngine(PageRankEngine):
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def step_fn(r, dangling, zero_in, valid_m, *c_args):
-            contrib = contrib_fn(r, *c_args)[: r.shape[0]]
+            z = r if prescale is None else prescale(r)
+            contrib = contrib_fn(z, *c_args)[: r.shape[0]]
             m = spmv.dangling_mass(r, dangling, accum)
             r_new = pr_model.apply_update(
                 contrib, r.astype(accum), zero_in.astype(accum), m, n,

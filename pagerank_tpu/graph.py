@@ -170,9 +170,7 @@ def build_graph(
             raise ValueError("dangling_mask marks a vertex that has out-edges")
     zero_in_mask = in_degree == 0
 
-    with np.errstate(divide="ignore"):
-        inv_out = np.where(out_degree > 0, 1.0 / out_degree.astype(np.float64), 0.0)
-    edge_weight = inv_out[src_s]
+    edge_weight = inv_out_degree(out_degree)[src_s]
 
     return Graph(
         n=n,
@@ -185,6 +183,23 @@ def build_graph(
         edge_weight=edge_weight,
         vertex_names=vertex_names,
     )
+
+
+def inv_out_degree(out_degree, xp=np, dtype=None):
+    """``1/out_degree`` with 0 where out_degree == 0 — the row
+    normalization of Aᵀ (the reference's rank/out_degree scatter,
+    Sparky.java:207). Works for numpy and jax.numpy; the single home for
+    this formula (used by graph build, both engines, and the on-device
+    builder)."""
+    deg = out_degree
+    if dtype is not None:
+        deg = deg.astype(dtype)
+    else:
+        deg = deg.astype(xp.float64 if xp is np else xp.float32)
+    if xp is np:
+        with np.errstate(divide="ignore"):
+            return np.where(out_degree > 0, 1.0 / deg, 0.0)
+    return xp.where(out_degree > 0, 1.0 / deg, 0.0)
 
 
 def to_csr_transpose(graph: Graph):

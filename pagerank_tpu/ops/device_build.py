@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pagerank_tpu import graph as graph_lib
+
 LANES = 128
 
 
@@ -63,6 +65,7 @@ class DeviceEllGraph:
     perm: jax.Array  # int32 [n] relabeled -> original
     dangling_mask: jax.Array  # bool [n] ORIGINAL id space
     zero_in_mask: jax.Array  # bool [n] ORIGINAL id space
+    out_degree: jax.Array  # int32 [n] ORIGINAL id space (unique targets)
     num_edges: int  # unique edge count
 
     @property
@@ -147,9 +150,7 @@ def _relabel_and_rows(src_s, dst_s, unique, out_degree, in_degree, n_padded,
 
     # Weight = 1/out_degree[src] on unique slots, 0 on duplicate slots.
     # out_degree is indexed by ORIGINAL id — use the pre-relabel src ids.
-    inv_out = jnp.where(
-        out_degree > 0, 1.0 / out_degree.astype(weight_dtype), 0.0
-    ).astype(weight_dtype)
+    inv_out = graph_lib.inv_out_degree(out_degree, jnp, dtype=weight_dtype)
     w = jnp.where(unique2, inv_out[src_s[order2]], 0.0).astype(weight_dtype)
 
     # Slot depth = k-th in-edge of its dst, counting duplicates too (the
@@ -216,6 +217,7 @@ def build_ell_device(
             perm=jnp.arange(n, dtype=jnp.int32),
             dangling_mask=jnp.ones(n, bool),
             zero_in_mask=jnp.ones(n, bool),
+            out_degree=jnp.zeros(n, jnp.int32),
             num_edges=0,
         )
 
@@ -235,5 +237,5 @@ def build_ell_device(
         n=n, n_padded=n_padded, num_blocks=num_blocks,
         src=src_slots, weight=w_slots, row_block=row_block,
         perm=perm, dangling_mask=mass_mask, zero_in_mask=zero_in,
-        num_edges=num_edges,
+        out_degree=out_degree.astype(jnp.int32), num_edges=num_edges,
     )

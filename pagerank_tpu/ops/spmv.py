@@ -47,21 +47,32 @@ def edge_contrib_segment_sum(r, src, dst, w, n, accum_dtype=None):
     )
 
 
-def ell_contrib(z, src_slots, w_slots, row_block, num_blocks, accum_dtype=None,
+def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
                 gather_width=8, chunk_rows=None):
-    """contrib = Aᵀ_norm z over blocked-ELL slots (ops/ell.py layout).
+    """contrib = Aᵀ_norm r over blocked-ELL slots (ops/ell.py layout),
+    with the row-normalization PRE-SCALED into the rank vector.
 
-    TPU-native formulation of the same scatter pipeline: XLA's per-edge
-    scatter on TPU measures ~100M edges/s, so the reduce is restructured
-    as (a) a dense per-slot gather-multiply and (b) a segment-sum over
-    slot *rows* (128 slots each) — 128x fewer scatter keys. The gather
-    uses a width-8 row-gather + one-hot dot, the fastest XLA gather form
-    measured on v5e (~2.3x plain take).
+    TPU-native formulation of the reference's scatter pipeline
+    (Sparky.java:192-229): XLA's per-edge scatter on TPU measures ~100M
+    edges/s, so the reduce is restructured as (a) a dense per-slot gather
+    and (b) a segment-sum over slot *rows* (128 slots each) — 128x fewer
+    scatter keys. The gather uses a width-8 row-gather + one-hot dot, the
+    fastest XLA gather form measured on v5e (~2.3x plain take).
+
+    The caller passes ``z_ext = concat(r * inv_out_degree, zeros(gw))``:
+    scaling by 1/out_degree once per vertex (instead of once per slot)
+    removes the per-slot weight array entirely — half the slot bytes
+    streamed from HBM — and inert slots (ELL padding, duplicate edges)
+    simply point at the zero sentinel block ``z_ext[n_pad:]``. When the
+    caller performs the prescale multiply in the accumulation dtype
+    (jax_engine does), products are bit-identical to the per-slot form:
+    w_slot was exactly ``inv_out[src]``.
 
     Args:
-      z: [n_pad8] rank vector, padded to a multiple of gather_width.
-      src_slots: int32 [rows, 128] relabeled source per slot.
-      w_slots: [rows, 128] per-slot weight (0 for padding).
+      z_ext: [n_pad + gather_width] pre-scaled rank vector; the trailing
+        ``gather_width`` lanes MUST be zero (sentinel block).
+      src_slots: int32 [rows, 128] relabeled source per slot; inert slots
+        hold the sentinel index ``n_pad``.
       row_block: int32 [rows] ascending dst-block id per row.
       num_blocks: static number of 128-lane dst blocks.
       chunk_rows: process slot rows in chunks of this size via lax.scan —
@@ -72,28 +83,27 @@ def ell_contrib(z, src_slots, w_slots, row_block, num_blocks, accum_dtype=None,
     Returns:
       [num_blocks * 128] contribution sums (relabeled, padded).
     """
-    acc = accum_dtype or z.dtype
-    zw = z.reshape(-1, gather_width)
+    acc = accum_dtype or z_ext.dtype
+    zw = z_ext.reshape(-1, gather_width)
     shift = gather_width.bit_length() - 1
     mask = gather_width - 1
 
-    def chunk_sum(src_c, w_c, rb_c):
+    def chunk_sum(src_c, rb_c):
         rows = zw[src_c >> shift]  # (chunk, 128, gather_width)
         sel = jax.nn.one_hot(src_c & mask, gather_width, dtype=acc)
-        v = (rows.astype(acc) * sel).sum(-1) * w_c.astype(acc)
+        v = (rows.astype(acc) * sel).sum(-1)
         return jax.ops.segment_sum(
             v, rb_c, num_segments=num_blocks, indices_are_sorted=True
         )
 
     n_rows = src_slots.shape[0]
     if chunk_rows is None or chunk_rows >= n_rows:
-        return chunk_sum(src_slots, w_slots, row_block).reshape(-1)
+        return chunk_sum(src_slots, row_block).reshape(-1)
     if n_rows % chunk_rows:
         raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
     nc = n_rows // chunk_rows
 
     src_c = src_slots.reshape(nc, chunk_rows, 128)
-    w_c = w_slots.reshape(nc, chunk_rows, 128)
     rb_c = row_block.reshape(nc, chunk_rows)
 
     def body(y2, args):
@@ -103,8 +113,8 @@ def ell_contrib(z, src_slots, w_slots, row_block, num_blocks, accum_dtype=None,
     # carry is device-varying like the body output.
     y2, _ = jax.lax.scan(
         body,
-        chunk_sum(src_c[0], w_c[0], rb_c[0]),
-        (src_c[1:], w_c[1:], rb_c[1:]),
+        chunk_sum(src_c[0], rb_c[0]),
+        (src_c[1:], rb_c[1:]),
     )
     return y2.reshape(-1)
 

@@ -111,9 +111,7 @@ def main():
                 out_shape=jax.ShapeDtypeStruct((rows, 128), dtype),
                 grid=(rows // CHUNK,),
                 in_specs=[
-                    pl.BlockSpec((n // 1, ), lambda i: (0,), memory_space=pltpu.VMEM)
-                    if False
-                    else pl.BlockSpec(memory_space=pltpu.VMEM),  # z whole
+                    pl.BlockSpec(memory_space=pltpu.VMEM),  # z, whole, resident
                     pl.BlockSpec((CHUNK, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
                     pl.BlockSpec((CHUNK, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
                 ],
