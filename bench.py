@@ -37,7 +37,8 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=50)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--dtype", default="float32")
-    p.add_argument("--kernel", default="auto", help="auto|ell|coo (engine kernels)")
+    p.add_argument("--kernel", default="auto",
+                   help="auto|ell|pallas|coo (engine kernels)")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
     p.add_argument("--accuracy-check", action="store_true",

@@ -16,10 +16,17 @@ step per iteration:
     (the reference instead re-caches every iteration with no unpersist,
     Sparky.java:216,235 — SURVEY.md §3.3).
 
-Two SpMV kernels (config.kernel):
+Three SpMV kernels (config.kernel):
   - "ell": blocked-ELL slots + row segment-sum + width-8 row-gather
-    (ops/ell.py, ops/spmv.py:ell_contrib) — the TPU-fast path. Vertices
-    are relabeled by in-degree internally; ranks() translates back.
+    (ops/ell.py, ops/spmv.py:ell_contrib) — the TPU-fast XLA path.
+    Vertices are relabeled by in-degree internally; ranks() translates
+    back. The rank vector is pre-scaled by 1/out_degree so slots carry
+    only a source index (ops/spmv.py docstring).
+  - "pallas": hand Mosaic kernel with the pre-scaled rank vector pinned
+    in VMEM (ops/pallas_spmv.py). Requires the vector to fit a ~12MB
+    VMEM budget; gather strategies ("take", then "onehot8") are
+    probe-compiled at build and the engine falls back to "ell" if
+    Mosaic rejects both on this TPU generation.
   - "coo": dst-sorted COO + per-edge sorted segment-sum — simple
     portable baseline.
 
@@ -93,8 +100,8 @@ class JaxTpuEngine(PageRankEngine):
         cfg = self.config
         self.graph = dg
         self._begin_build()
-        if (cfg.kernel if cfg.kernel != "auto" else "ell") != "ell":
-            raise ValueError("build_device supports the ell kernel only")
+        if (cfg.kernel if cfg.kernel != "auto" else "ell") not in ("ell", "pallas"):
+            raise ValueError("build_device supports the ell/pallas kernels only")
 
         n, pad = dg.n, dg.n_padded - dg.n
         # Masks arrive in ORIGINAL id space; permute to relabeled space
@@ -149,7 +156,7 @@ class JaxTpuEngine(PageRankEngine):
         )
         zero_in = graph.zero_in_mask
 
-        if kernel == "ell":
+        if kernel in ("ell", "pallas"):
             pack = ell_lib.ell_pack(graph)
             self._pack = pack
             self._perm = pack.perm
@@ -216,14 +223,18 @@ class JaxTpuEngine(PageRankEngine):
         dtype = self._dtype
         accum = self._accum_dtype
         gw = self.GATHER_WIDTH
-        self._kernel = "ell"
+        want_pallas = cfg.kernel == "pallas"
+        self._kernel = "pallas" if want_pallas else "ell"
         shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
         e_shard = mesh_lib.edge_sharding(mesh)
 
         # Chunk the gather so its (slots, 8) intermediate stays ~100MB
         # regardless of graph size; pad rows so chunks divide evenly.
+        # The pallas kernel instead streams fixed 256-row chunks (its
+        # VMEM scratch and one-hot matmul are sized by this).
         rows_per_dev = -(-max(1, num_rows) // ndev)
-        chunk_rows = min(32768, rows_per_dev)
+        pallas_chunk = 256
+        chunk_rows = pallas_chunk if want_pallas else min(32768, rows_per_dev)
         pad_multiple = ndev * chunk_rows
         xp = np if isinstance(src_slots, np.ndarray) else jnp
         # Inert slots (weight 0) -> sentinel index n_state; real slots
@@ -245,24 +256,109 @@ class JaxTpuEngine(PageRankEngine):
             inv_out_rel = inv_out_rel.astype(z_dtype)
         self._inv_out = jax.device_put(inv_out_rel, mesh_lib.replicated(mesh))
 
-        def sharded_contrib(z_ext, src, row_block):
-            part = spmv.ell_contrib(
-                z_ext, src, row_block, num_blocks, accum_dtype=accum,
-                gather_width=gw, chunk_rows=chunk_rows,
-            )
-            return jax.lax.psum(part, axis)
+        def make_contrib(mode):
+            """mode: 'ell' (XLA path) or a pallas gather strategy name."""
+            if mode != "ell":
+                from pagerank_tpu.ops import pallas_spmv
 
-        contrib_fn = shard_map(
-            sharded_contrib,
-            mesh=mesh,
-            in_specs=(P(), P(axis, None), P(axis)),
-            out_specs=P(),
-        )
+                interp = jax.default_backend() != "tpu"
+
+                def sharded_contrib(z_ext, src, row_block):
+                    rb0 = row_block[::pallas_chunk]
+                    part = pallas_spmv.ell_contrib_pallas(
+                        z_ext, src, row_block, rb0, num_blocks,
+                        chunk=pallas_chunk, gather=mode,
+                        accum_dtype=accum, interpret=interp,
+                    )
+                    return jax.lax.psum(part, axis)
+            else:
+                # Rows were padded to a multiple of ndev*pallas_chunk when
+                # pallas was requested; pick the largest tuned (~32k-row)
+                # chunk that still divides the per-device row count so a
+                # fallback never runs the XLA path with tiny 256-row
+                # chunks.
+                rows_padded_dev = src_slots.shape[0] // ndev
+                step = pallas_chunk if want_pallas else 1
+                c = min(32768, rows_padded_dev)
+                c -= c % step
+                while c > step and rows_padded_dev % c:
+                    c -= step
+                ell_chunk = max(c, step)
+
+                def sharded_contrib(z_ext, src, row_block):
+                    part = spmv.ell_contrib(
+                        z_ext, src, row_block, num_blocks, accum_dtype=accum,
+                        gather_width=gw, chunk_rows=ell_chunk,
+                    )
+                    return jax.lax.psum(part, axis)
+
+            return shard_map(
+                sharded_contrib,
+                mesh=mesh,
+                in_specs=(P(), P(axis, None), P(axis)),
+                out_specs=P(),
+                # pallas_call's out_shape carries no varying-mesh-axes
+                # annotation, which the checker insists on; the psum
+                # already makes the output replicated.
+                check_vma=(mode == "ell"),
+            )
+
         inv_out = self._inv_out
 
         def prescale(r):
             z = r.astype(inv_out.dtype) * inv_out
             return jnp.concatenate([z, jnp.zeros(gw, dtype=z.dtype)])
+
+        if want_pallas:
+            # The pallas kernel pins z_ext in VMEM; refuse graphs that
+            # cannot fit (the XLA path has no such limit).
+            z_bytes = (n_state + gw) * jnp.dtype(self._inv_out.dtype).itemsize
+            if z_bytes > 12 * 1024 * 1024:
+                raise ValueError(
+                    f"kernel='pallas' needs the rank vector resident in "
+                    f"VMEM ({z_bytes / 1e6:.0f}MB > 12MB budget at "
+                    f"n_padded={n_state}); use kernel='ell'"
+                )
+            # Probe-compile each gather strategy at build: Mosaic gather
+            # support varies by TPU generation — try the direct take,
+            # then the one-hot form, then fall back to the XLA path.
+            contrib_fn = None
+            for mode in ("take", "onehot8"):
+                candidate = make_contrib(mode)
+                try:
+                    probe = jax.jit(
+                        lambda src, rb, fn=candidate: fn(
+                            prescale(jnp.zeros(n_state, self._inv_out.dtype)),
+                            src, rb,
+                        )
+                    )
+                    jax.block_until_ready(probe(self._src, self._row_block))
+                    contrib_fn = candidate
+                    self._kernel = f"pallas:{mode}"
+                    break
+                except Exception as e:  # pragma: no cover - hw-dependent
+                    import sys
+
+                    msg = str(e).splitlines()[0][:160] if str(e) else ""
+                    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+                        raise  # OOM is not a lowering problem; surface it
+                    print(
+                        f"pagerank_tpu: pallas gather '{mode}' unavailable "
+                        f"({type(e).__name__}: {msg})",
+                        file=sys.stderr,
+                    )
+            if contrib_fn is None:
+                import sys
+
+                print(
+                    "pagerank_tpu: pallas kernel unavailable; falling back "
+                    "to the XLA ell path",
+                    file=sys.stderr,
+                )
+                self._kernel = "ell"
+                contrib_fn = make_contrib("ell")
+        else:
+            contrib_fn = make_contrib("ell")
 
         self._finalize(
             contrib_fn, (self._src, self._row_block),

@@ -1,0 +1,153 @@
+"""Pallas TPU kernel for the blocked-ELL contribution SpMV (the L3 hot
+op; SURVEY.md §7 step 4).
+
+Why a hand kernel when ops/spmv.py:ell_contrib already reformulates the
+scatter for XLA: the XLA path's per-slot gather re-reads the rank vector
+from HBM with random access every chunk — on a power-law graph the
+access pattern defeats locality and the op is latency-bound far below
+HBM bandwidth. This kernel pins the (pre-scaled) rank vector ``z_ext``
+in VMEM for the *entire* sweep, so every gather is served on-chip and
+HBM traffic drops to the streaming minimum: 4 bytes per slot (the
+source index) plus one read-modify-write of the output block rows.
+
+Structure (grid = row chunks, sequential on the core):
+
+  - ``z_ext`` [n_pad + 8] lives whole in VMEM (BlockSpec with no
+    blocking). Budget: ~4 bytes/vertex => graphs to ~2-3M vertices per
+    core in f32; the engine falls back to the XLA path above that.
+  - Each grid step streams a (CHUNK, 128) block of source indices into
+    VMEM, gathers/multiplies against z_ext, and reduces rows to their
+    dst blocks with a one-hot matmul on the MXU (block ids within a
+    chunk are gap-free because empty blocks are sorted to the tail by
+    the in-degree relabel — ops/ell.py).
+  - The (CHUNK, 128) segment partial is accumulated into the HBM output
+    at a data-dependent row offset (per-chunk first-block id, delivered
+    via PrefetchScalarGridSpec) with an explicit DMA read-modify-write.
+    The output buffer is donated zeros (input_output_aliased), so
+    cross-chunk boundary blocks accumulate correctly; the grid is
+    sequential, so the RMW cannot race.
+
+Gather strategies (Mosaic support differs by generation; the engine
+probes once at build):
+  - "take":    z_ext[src] — direct dynamic gather.
+  - "onehot8": width-8 row gather + one-hot dot (the XLA trick, but
+               against VMEM-resident data).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+
+
+def _kernel(rb0_ref, z_ref, src_ref, rb_ref, out_in_ref, out_ref, acc, sem,
+            *, chunk, gather, accum_dtype):
+    del out_in_ref  # aliased with out_ref (donated zeros)
+    i = pl.program_id(0)
+    rb0 = rb0_ref[i]
+
+    src = src_ref[...]  # (chunk, 128) int32
+    z = z_ref[...]
+    if gather == "take":
+        v = z[src].astype(accum_dtype)
+    elif gather == "onehot8":
+        zw = z.reshape(-1, 8)
+        rows = zw[src >> 3]  # (chunk, 128, 8)
+        sel = jax.nn.one_hot(src & 7, 8, dtype=accum_dtype)
+        v = (rows.astype(accum_dtype) * sel).sum(-1)
+    else:
+        raise ValueError(f"unknown gather strategy {gather!r}")
+
+    # Row -> dst-block segment sum on the MXU: one_hot over the chunk's
+    # (gap-free, ascending) local block ids, contracted over rows.
+    rb_local = rb_ref[...].reshape(chunk) - rb0
+    oh = jax.nn.one_hot(rb_local, chunk, dtype=accum_dtype)  # (chunk, chunk)
+    seg = jax.lax.dot_general(
+        oh, v, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=accum_dtype,
+    )  # (chunk, 128)
+
+    # Accumulate into out[rb0 : rb0+chunk] (HBM) via explicit RMW DMA.
+    load = pltpu.make_async_copy(
+        out_ref.at[pl.ds(rb0, chunk), :], acc, sem
+    )
+    load.start()
+    load.wait()
+    acc[...] += seg.astype(out_ref.dtype)
+    store = pltpu.make_async_copy(
+        acc, out_ref.at[pl.ds(rb0, chunk), :], sem
+    )
+    store.start()
+    store.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_blocks", "chunk", "gather", "accum_dtype",
+                     "interpret"),
+)
+def ell_contrib_pallas(
+    z_ext, src_slots, row_block, rb0_per_chunk, num_blocks, *,
+    chunk=256, gather="take", accum_dtype=jnp.float32, interpret=False,
+):
+    """contrib = Aᵀ_norm r over sentinel-form ELL slots (see
+    ops/spmv.py:ell_contrib for the prescaled-z_ext contract).
+
+    Args:
+      z_ext: [n_pad + 8] pre-scaled rank vector (trailing 8 lanes zero).
+      src_slots: int32 [rows, 128]; rows must be a multiple of ``chunk``.
+      row_block: int32 [rows] ascending dst-block id per row.
+      rb0_per_chunk: int32 [rows/chunk] first block id of each chunk
+        (host-precomputed: ``row_block[::chunk]``).
+      num_blocks: static count of 128-lane dst blocks.
+
+    Returns:
+      [num_blocks * 128] contribution sums (relabeled, padded).
+    """
+    n_rows = src_slots.shape[0]
+    if n_rows % chunk:
+        raise ValueError(f"rows {n_rows} not a multiple of chunk {chunk}")
+    nc = n_rows // chunk
+    num_blocks_pad = num_blocks + chunk  # slack so the last RMW stays in range
+    out_init = jnp.zeros((num_blocks_pad, LANES), z_ext.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # z_ext, whole, resident
+            pl.BlockSpec((chunk, LANES), lambda i, rb0: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, 1), lambda i, rb0: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # out buffer stays in HBM
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((chunk, LANES), z_ext.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(
+        _kernel, chunk=chunk,
+        gather=gather, accum_dtype=jnp.dtype(accum_dtype),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_blocks_pad, LANES), z_ext.dtype),
+        input_output_aliases={4: 0},  # donated zeros -> output (RMW target)
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(
+        rb0_per_chunk, z_ext, src_slots,
+        row_block.reshape(-1, 1), out_init,
+    )
+    return out[:num_blocks].reshape(-1)

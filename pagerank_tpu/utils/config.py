@@ -35,7 +35,10 @@ class PageRankConfig:
     dtype: str = "float32"
     accum_dtype: str = "float32"
 
-    # SpMV kernel: "ell" = blocked-ELL + row segment-sum (TPU-fast,
+    # SpMV kernel: "pallas" = hand Pallas kernel, rank vector pinned in
+    # VMEM (ops/pallas_spmv.py; probes Mosaic support at build and falls
+    # back to ell; refuses graphs over the VMEM budget);
+    # "ell" = blocked-ELL + row segment-sum (TPU-fast,
     # ops/ell.py), "coo" = dst-sorted COO + per-edge segment-sum
     # (simple; also the portable baseline), "auto" = ell.
     kernel: str = "auto"
@@ -65,7 +68,7 @@ class PageRankConfig:
             raise ValueError(f"damping must be in (0,1), got {self.damping}")
         if self.num_iters < 0:
             raise ValueError("num_iters must be >= 0")
-        if self.kernel not in ("auto", "ell", "coo"):
+        if self.kernel not in ("auto", "ell", "coo", "pallas"):
             raise ValueError(f"unknown kernel: {self.kernel!r}")
         return self
 
