@@ -19,7 +19,7 @@ import numpy as np
 
 from pagerank_tpu import PageRankConfig, build_graph, make_engine
 from pagerank_tpu.utils.metrics import MetricsLogger
-from pagerank_tpu.utils.snapshot import Snapshotter, resume_engine
+from pagerank_tpu.utils.snapshot import Snapshotter, TextDumper, resume_engine
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="snapshot cadence in iterations; 0 disables (reference: every iter)",
     )
     p.add_argument("--resume", action="store_true", help="resume from latest snapshot")
+    p.add_argument(
+        "--dump-text-dir",
+        default=None,
+        help="also write plain-text rank dumps per iteration "
+        "(PageRank{i}/part-00000 tuple lines, mirroring the reference's "
+        "per-iteration saveAsTextFile)",
+    )
     p.add_argument("--out", default=None, help="write final ranks (TSV: id/url, rank)")
     p.add_argument("--log-every", type=int, default=1, help="0 silences per-iter logs")
     p.add_argument("--jsonl", default=None, help="append per-iter metrics to this JSONL file")
@@ -150,10 +157,21 @@ def main(argv=None) -> int:
         graph.num_edges, num_chips, log_every=args.log_every, jsonl_path=args.jsonl
     )
 
+    dumper = None
+    if args.dump_text_dir:
+        dumper = TextDumper(
+            args.dump_text_dir, names=ids.names if ids is not None else None
+        )
+
     def on_iteration(i, info):
         metrics(i, info)
-        if snap and args.snapshot_every and (i + 1) % args.snapshot_every == 0:
-            snap.save(i + 1, engine.ranks())
+        want_snap = snap and args.snapshot_every and (i + 1) % args.snapshot_every == 0
+        if want_snap or dumper is not None:
+            ranks = engine.ranks()  # one device->host fetch for both sinks
+            if want_snap:
+                snap.save(i + 1, ranks)
+            if dumper is not None:
+                dumper.dump(i, ranks)
 
     profiling = False
     if args.profile_dir:

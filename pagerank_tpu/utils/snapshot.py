@@ -67,6 +67,31 @@ class Snapshotter:
             }
             return z["ranks"].copy(), meta
 
+class TextDumper:
+    """Per-iteration plain-text rank dumps mirroring the reference's
+    ``ranks.saveAsTextFile("…/PageRank"+iter+"/")`` (Sparky.java:237):
+    one directory per iteration, ``(key,rank)`` tuple lines, Spark
+    part-file naming. Pair with :class:`Snapshotter` when you also want
+    binary resumable checkpoints."""
+
+    def __init__(self, directory: str, names=None):
+        self.directory = directory
+        self.names = names
+        os.makedirs(directory, exist_ok=True)
+
+    def dump(self, iteration: int, ranks: np.ndarray) -> str:
+        d = os.path.join(self.directory, f"PageRank{iteration}")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "part-00000")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            for i, r in enumerate(ranks):
+                key = self.names[i] if self.names is not None else i
+                f.write(f"({key},{float(r)!r})\n")
+        os.replace(tmp, path)
+        return path
+
+
 def resume_engine(engine, snap: Snapshotter) -> int:
     """Restore the latest snapshot into ``engine``; returns the iteration
     resumed from (0 if none found). Refuses a snapshot taken on a

@@ -5,7 +5,21 @@ import numpy as np
 import pytest
 
 from pagerank_tpu import JaxTpuEngine, PageRankConfig, ReferenceCpuEngine, build_graph
-from pagerank_tpu.utils.snapshot import Snapshotter, resume_engine
+from pagerank_tpu.utils.snapshot import Snapshotter, TextDumper, resume_engine
+
+
+def test_text_dumper_reference_format(tmp_path):
+    # Mirrors the reference's per-iteration saveAsTextFile layout:
+    # <dir>/PageRank{i}/part-00000 with (key,rank) tuple lines.
+    d = TextDumper(str(tmp_path / "dumps"), names=["a", "b"])
+    p = d.dump(3, np.array([1.5, 0.25]))
+    assert p.endswith("PageRank3/part-00000")
+    lines = open(p).read().splitlines()
+    assert lines == ["(a,1.5)", "(b,0.25)"]
+    # integer keys when no name table
+    d2 = TextDumper(str(tmp_path / "dumps2"))
+    p2 = d2.dump(0, np.array([2.0]))
+    assert open(p2).read() == "(0,2.0)\n"
 
 
 def toy_graph(seed=0, n=50, e=300):
