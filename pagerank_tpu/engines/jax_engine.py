@@ -201,7 +201,19 @@ class JaxTpuEngine(PageRankEngine):
             )
             return self
 
-    GATHER_WIDTH = 8
+    GATHER_WIDTH = 8  # minimum; _gather_width widens for large tables
+
+    @staticmethod
+    def _gather_width(n_state: int) -> int:
+        """XLA's fast TPU gather regime (measured on v5e, see
+        scripts/probe_gather.py) needs the reshaped (rows, width) table to
+        have <= 2**17 rows and <= 512-byte rows; outside it throughput
+        drops ~3.5x. Widen the row until the row count fits, capping at
+        128 lanes (= 512B in f32)."""
+        width = 8
+        while width < 128 and n_state // width > (1 << 17):
+            width *= 2
+        return width
 
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
                    valid, *, n, n_state, num_blocks, num_rows, inv_out_rel):
@@ -222,7 +234,7 @@ class JaxTpuEngine(PageRankEngine):
         ndev = mesh.devices.size
         dtype = self._dtype
         accum = self._accum_dtype
-        gw = self.GATHER_WIDTH
+        gw = max(self.GATHER_WIDTH, self._gather_width(n_state))
         want_pallas = cfg.kernel == "pallas"
         self._kernel = "pallas" if want_pallas else "ell"
         shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
@@ -234,7 +246,10 @@ class JaxTpuEngine(PageRankEngine):
         # VMEM scratch and one-hot matmul are sized by this).
         rows_per_dev = -(-max(1, num_rows) // ndev)
         pallas_chunk = 256
-        chunk_rows = pallas_chunk if want_pallas else min(32768, rows_per_dev)
+        # Scale the chunk down with the gather width so the (chunk, 128,
+        # gw) intermediate keeps the same footprint at every width.
+        ell_chunk_cap = max(256, 32768 * 8 // gw)
+        chunk_rows = pallas_chunk if want_pallas else min(ell_chunk_cap, rows_per_dev)
         pad_multiple = ndev * chunk_rows
         xp = np if isinstance(src_slots, np.ndarray) else jnp
         # Inert slots (weight 0) -> sentinel index n_state; real slots
@@ -279,7 +294,7 @@ class JaxTpuEngine(PageRankEngine):
                 # chunks.
                 rows_padded_dev = src_slots.shape[0] // ndev
                 step = pallas_chunk if want_pallas else 1
-                c = min(32768, rows_padded_dev)
+                c = min(ell_chunk_cap, rows_padded_dev)
                 c -= c % step
                 while c > step and rows_padded_dev % c:
                     c -= step
