@@ -103,15 +103,23 @@ def main(argv=None):
 
         s2, d2 = rmat_edges(16, 16, seed=3)
         g2 = build_graph(s2, d2, n=1 << 16)
-        c2 = PageRankConfig(num_iters=20, dtype=args.dtype, accum_dtype=args.dtype)
-        r_tpu = JaxTpuEngine(c2).build(g2).run_fast()
-        r_cpu = ReferenceCpuEngine(c2).build(g2).run()
-        l1 = float(np.abs(r_tpu - r_cpu).sum())
-        print(
-            f"accuracy: L1 vs f64 oracle {l1:.3e} "
-            f"({l1 / g2.n:.3e}/vertex, scale-16, 20 iters)",
-            file=sys.stderr,
-        )
+        oracle = PageRankConfig(num_iters=20, dtype="float64", accum_dtype="float64")
+        r_cpu = ReferenceCpuEngine(oracle).build(g2).run()
+        for label, c2 in (
+            (f"fast {args.dtype}",
+             PageRankConfig(num_iters=20, dtype=args.dtype,
+                            accum_dtype=args.dtype)),
+            (f"{args.dtype}+f64-accum",
+             PageRankConfig(num_iters=20, dtype=args.dtype,
+                            accum_dtype="float64")),
+        ):
+            r_tpu = JaxTpuEngine(c2).build(g2).run_fast()
+            l1 = float(np.abs(r_tpu - r_cpu).sum())
+            print(
+                f"accuracy[{label}]: L1 vs f64 oracle {l1:.3e} "
+                f"(normalized {l1 / np.abs(r_cpu).sum():.3e}, scale-16, 20 iters)",
+                file=sys.stderr,
+            )
 
     print(
         json.dumps(

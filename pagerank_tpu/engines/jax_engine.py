@@ -87,8 +87,28 @@ class JaxTpuEngine(PageRankEngine):
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
         )
+        for d in (cfg.dtype, cfg.accum_dtype):
+            if np.dtype(d).itemsize == 8 and not jax.config.jax_enable_x64:
+                import sys
+
+                print(
+                    f"pagerank_tpu: config requests {d}; enabling "
+                    "jax_enable_x64 (process-global)",
+                    file=sys.stderr,
+                )
+                jax.config.update("jax_enable_x64", True)
         self._dtype = jnp.dtype(cfg.dtype)
         self._accum_dtype = jnp.dtype(cfg.accum_dtype)
+        # 64-bit accumulation can run the pair-packed gather + wide
+        # reduce (ops/spmv.py:ell_contrib_pair) — TPUs have no native
+        # f64, so the f64 work is confined to one add per slot + the
+        # segment-sum. config.wide_accum: "auto" picks pair only on TPU
+        # (native f64 gathers elsewhere are exact and fast).
+        wide = self._accum_dtype.itemsize == 8
+        mode = cfg.wide_accum
+        if mode == "auto":
+            mode = "pair" if jax.default_backend() == "tpu" else "native"
+        self._pair = wide and mode == "pair"
 
     def build_device(self, dg) -> "JaxTpuEngine":
         """Build from an on-device blocked-ELL graph
@@ -110,9 +130,16 @@ class JaxTpuEngine(PageRankEngine):
         zin = dg.zero_in_mask[dg.perm]
         zpad = jnp.zeros(pad, bool)
         self._perm = np.asarray(jax.device_get(dg.perm))
-        inv = graph_mod.inv_out_degree(dg.out_degree, jnp, dtype=self._dtype)
+        # Compute 1/out_degree directly in the widest dtype the solver
+        # will use — the pair-packed path splits it exactly from this.
+        inv_dtype = (
+            self._accum_dtype
+            if self._accum_dtype.itemsize > self._dtype.itemsize
+            else self._dtype
+        )
+        inv = graph_mod.inv_out_degree(dg.out_degree, jnp, dtype=inv_dtype)
         inv_out_rel = jnp.concatenate(
-            [inv[dg.perm], jnp.zeros(pad, self._dtype)]
+            [inv[dg.perm], jnp.zeros(pad, inv_dtype)]
         )
         self._setup_ell(
             dg.src, dg.weight, dg.row_block,
@@ -204,14 +231,15 @@ class JaxTpuEngine(PageRankEngine):
     GATHER_WIDTH = 8  # minimum; _gather_width widens for large tables
 
     @staticmethod
-    def _gather_width(n_state: int) -> int:
+    def _gather_width(n_state: int, max_width: int = 128) -> int:
         """XLA's fast TPU gather regime (measured on v5e, see
         scripts/probe_gather.py) needs the reshaped (rows, width) table to
         have <= 2**17 rows and <= 512-byte rows; outside it throughput
         drops ~3.5x. Widen the row until the row count fits, capping at
-        128 lanes (= 512B in f32)."""
+        ``max_width`` lanes (128 f32 lanes = 512B for the plain table; 64
+        for the pair-packed table whose rows carry 2x lanes)."""
         width = 8
-        while width < 128 and n_state // width > (1 << 17):
+        while width < max_width and n_state // width > (1 << 17):
             width *= 2
         return width
 
@@ -234,7 +262,11 @@ class JaxTpuEngine(PageRankEngine):
         ndev = mesh.devices.size
         dtype = self._dtype
         accum = self._accum_dtype
-        gw = max(self.GATHER_WIDTH, self._gather_width(n_state))
+        pair = self._pair
+        gw = max(
+            self.GATHER_WIDTH,
+            self._gather_width(n_state, 64 if pair else 128),
+        )
         want_pallas = cfg.kernel == "pallas"
         self._kernel = "pallas" if want_pallas else "ell"
         shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
@@ -300,17 +332,30 @@ class JaxTpuEngine(PageRankEngine):
                     c -= step
                 ell_chunk = max(c, step)
 
-                def sharded_contrib(z_ext, src, row_block):
-                    part = spmv.ell_contrib(
-                        z_ext, src, row_block, num_blocks, accum_dtype=accum,
-                        gather_width=gw, chunk_rows=ell_chunk,
-                    )
-                    return jax.lax.psum(part, axis)
+                if pair:
 
+                    def sharded_contrib(z_hi, z_lo, src, row_block):
+                        part = spmv.ell_contrib_pair(
+                            z_hi, z_lo, src, row_block, num_blocks,
+                            accum_dtype=accum, gather_width=gw,
+                            chunk_rows=ell_chunk,
+                        )
+                        return jax.lax.psum(part, axis)
+                else:
+
+                    def sharded_contrib(z_ext, src, row_block):
+                        part = spmv.ell_contrib(
+                            z_ext, src, row_block, num_blocks,
+                            accum_dtype=accum,
+                            gather_width=gw, chunk_rows=ell_chunk,
+                        )
+                        return jax.lax.psum(part, axis)
+
+            z_specs = (P(), P()) if (pair and mode == "ell") else (P(),)
             return shard_map(
                 sharded_contrib,
                 mesh=mesh,
-                in_specs=(P(), P(axis, None), P(axis)),
+                in_specs=z_specs + (P(axis, None), P(axis)),
                 out_specs=P(),
                 # pallas_call's out_shape carries no varying-mesh-axes
                 # annotation, which the checker insists on; the psum
@@ -320,9 +365,25 @@ class JaxTpuEngine(PageRankEngine):
 
         inv_out = self._inv_out
 
-        def prescale(r):
+        # Dekker split of the wide prescale: z = hi + lo exactly, both
+        # f32 — ops/spmv.py:ell_contrib_pair docstring. The pallas kernel
+        # instead consumes the plain (wide) z pinned in VMEM, so the
+        # prescale is bound per-kernel after the probe below.
+        def prescale_pair(r):
+            z = r.astype(inv_out.dtype) * inv_out
+            hi = z.astype(jnp.float32)
+            lo = (z - hi.astype(z.dtype)).astype(jnp.float32)
+            pad = jnp.zeros(gw, dtype=jnp.float32)
+            return (
+                jnp.concatenate([hi, pad]),
+                jnp.concatenate([lo, pad]),
+            )
+
+        def prescale_plain(r):
             z = r.astype(inv_out.dtype) * inv_out
             return jnp.concatenate([z, jnp.zeros(gw, dtype=z.dtype)])
+
+        prescale = prescale_pair if pair else prescale_plain
 
         if want_pallas:
             # The pallas kernel pins z_ext in VMEM; refuse graphs that
@@ -343,12 +404,15 @@ class JaxTpuEngine(PageRankEngine):
                 try:
                     probe = jax.jit(
                         lambda src, rb, fn=candidate: fn(
-                            prescale(jnp.zeros(n_state, self._inv_out.dtype)),
+                            prescale_plain(
+                                jnp.zeros(n_state, self._inv_out.dtype)
+                            ),
                             src, rb,
                         )
                     )
                     jax.block_until_ready(probe(self._src, self._row_block))
                     contrib_fn = candidate
+                    prescale = prescale_plain
                     self._kernel = f"pallas:{mode}"
                     break
                 except Exception as e:  # pragma: no cover - hw-dependent
@@ -412,7 +476,8 @@ class JaxTpuEngine(PageRankEngine):
         @functools.partial(jax.jit, donate_argnums=(0,))
         def step_fn(r, dangling, zero_in, valid_m, *c_args):
             z = r if prescale is None else prescale(r)
-            contrib = contrib_fn(z, *c_args)[: r.shape[0]]
+            zs = z if isinstance(z, tuple) else (z,)
+            contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
             m = spmv.dangling_mass(r, dangling, accum)
             r_new = pr_model.apply_update(
                 contrib, r.astype(accum), zero_in.astype(accum), m, n,

@@ -119,6 +119,74 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
     return y2.reshape(-1)
 
 
+def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
+                     accum_dtype=None, gather_width=8, chunk_rows=None):
+    """``ell_contrib`` with the pre-scaled rank vector carried as an exact
+    f32 (hi, lo) pair and the reduction done in a wide dtype — the fast
+    path to f64-grade accuracy on TPU (which has no native f64).
+
+    The per-vertex values are ``z = hi + lo`` exactly (hi = f32(z64),
+    lo = f32(z64 - hi) — a Dekker split of the f64 prescale). hi and lo
+    rows are packed side by side into ONE (n/w, 2w) gather table, so the
+    expensive row gather runs once at plain-f32 cost; the two one-hot
+    contractions are exact (pure selection), and only the per-slot
+    ``hi64 + lo64`` add and the row/block segment-sum pay the emulated
+    f64 price. Per-iteration rounding is then O(2^-48) relative, vs
+    O(2^-24) for the plain f32 path — the 1e-6 L1 north-star gate
+    (BASELINE.md) with room to spare, at a fraction of full-f64 cost.
+
+    Row-byte note: the packed row is ``2*gather_width`` f32 lanes; the
+    fast-gather regime needs rows <= 512B, so gather_width caps at 64
+    here (vs 128 for the plain table).
+
+    Args:
+      z_hi_ext, z_lo_ext: [n_pad + gather_width] f32 pair; trailing
+        ``gather_width`` lanes MUST be zero (sentinel block).
+      src_slots, row_block, num_blocks, chunk_rows: as in ``ell_contrib``.
+      accum_dtype: reduction dtype, default float64 (requires x64).
+
+    Returns:
+      [num_blocks * 128] contribution sums in accum_dtype.
+    """
+    acc = accum_dtype or jnp.float64
+    w = gather_width
+    shift = w.bit_length() - 1
+    mask = w - 1
+    zw = jnp.concatenate(
+        [z_hi_ext.reshape(-1, w), z_lo_ext.reshape(-1, w)], axis=1
+    )  # (n_pad/w + 1, 2w): hi lanes then lo lanes, sentinel row all-zero
+
+    def chunk_sum(src_c, rb_c):
+        rows = zw[src_c >> shift]  # (chunk, 128, 2w) — ONE gather
+        sel = jax.nn.one_hot(src_c & mask, w, dtype=rows.dtype)
+        v_hi = (rows[..., :w] * sel).sum(-1)  # exact: selection
+        v_lo = (rows[..., w:] * sel).sum(-1)  # exact: selection
+        v = v_hi.astype(acc) + v_lo.astype(acc)
+        return jax.ops.segment_sum(
+            v, rb_c, num_segments=num_blocks, indices_are_sorted=True
+        )
+
+    n_rows = src_slots.shape[0]
+    if chunk_rows is None or chunk_rows >= n_rows:
+        return chunk_sum(src_slots, row_block).reshape(-1)
+    if n_rows % chunk_rows:
+        raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
+    nc = n_rows // chunk_rows
+
+    src_c = src_slots.reshape(nc, chunk_rows, 128)
+    rb_c = row_block.reshape(nc, chunk_rows)
+
+    def body(y2, args):
+        return y2 + chunk_sum(*args), None
+
+    y2, _ = jax.lax.scan(
+        body,
+        chunk_sum(src_c[0], rb_c[0]),
+        (src_c[1:], rb_c[1:]),
+    )
+    return y2.reshape(-1)
+
+
 def dangling_mass(r, dangling, accum_dtype=None):
     """m = Σ_{out_degree==0} r — the reference's ``danglingContrib`` loop
     (one distributed lookup per dangling URL per iteration,

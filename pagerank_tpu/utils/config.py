@@ -43,6 +43,14 @@ class PageRankConfig:
     # (simple; also the portable baseline), "auto" = ell.
     kernel: str = "auto"
 
+    # How a 64-bit accum_dtype runs the ELL gather when it is wider than
+    # dtype's storage: "pair" = pair-packed f32 (hi, lo) split gather +
+    # wide reduce (fast on TPU, ~2^-48 relative per slot;
+    # ops/spmv.py:ell_contrib_pair), "native" = gather genuinely wide
+    # rows (exact to ~1 ulp; ~3.4x slower on TPU where f64 is emulated),
+    # "auto" = pair on TPU backends, native elsewhere.
+    wide_accum: str = "auto"
+
     # Early stop: if set, stop when L1(r' - r) <= tol. The reference has
     # no convergence check (Sparky.java:187); None reproduces that.
     tol: Optional[float] = None
@@ -70,6 +78,15 @@ class PageRankConfig:
             raise ValueError("num_iters must be >= 0")
         if self.kernel not in ("auto", "ell", "coo", "pallas"):
             raise ValueError(f"unknown kernel: {self.kernel!r}")
+        if self.wide_accum not in ("auto", "pair", "native"):
+            raise ValueError(f"unknown wide_accum mode: {self.wide_accum!r}")
+        import numpy as _np
+
+        if _np.dtype(self.accum_dtype).itemsize < _np.dtype(self.dtype).itemsize:
+            raise ValueError(
+                f"accum_dtype {self.accum_dtype} narrower than dtype "
+                f"{self.dtype}"
+            )
         return self
 
     def replace(self, **kw) -> "PageRankConfig":

@@ -1,0 +1,76 @@
+"""The pair-packed wide-accumulation gather (ops/spmv.py:ell_contrib_pair)
+— f64-grade accuracy from f32 gathers (the BASELINE.md 1e-6 L1 gate)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, ReferenceCpuEngine, build_graph
+from pagerank_tpu.ops import ell as ell_lib, spmv
+
+
+def _pack(rng, n=1024, e=8000):
+    g = build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+    return g, ell_lib.ell_pack(g)
+
+
+@pytest.mark.parametrize("chunk", [None, 64])
+def test_ell_contrib_pair_matches_f64_reference(chunk):
+    rng = np.random.default_rng(0)
+    g, pack = _pack(rng)
+    n_state = pack.n_padded
+    gw = 8
+    srcs = np.where(pack.weight != 0, pack.src, np.int32(n_state))
+    if chunk:
+        rows = srcs.shape[0]
+        padr = -(-rows // chunk) * chunk
+        srcs = np.concatenate(
+            [srcs, np.full((padr - rows, 128), n_state, np.int32)]
+        )
+        rb = np.concatenate(
+            [pack.row_block, np.full(padr - rows, pack.num_blocks - 1, np.int32)]
+        )
+    else:
+        rb = pack.row_block
+
+    z64 = rng.random(n_state)  # f64
+    hi = z64.astype(np.float32)
+    lo = (z64 - hi.astype(np.float64)).astype(np.float32)
+    pad = np.zeros(gw, np.float32)
+    out = spmv.ell_contrib_pair(
+        jnp.asarray(np.concatenate([hi, pad])),
+        jnp.asarray(np.concatenate([lo, pad])),
+        jnp.asarray(srcs), jnp.asarray(rb), pack.num_blocks,
+        gather_width=gw, chunk_rows=chunk,
+    )
+    assert out.dtype == jnp.float64
+
+    # numpy f64 oracle on the exact split values (weight-free slot form:
+    # real slots select zs[src], inert slots contribute 0)
+    zs = hi.astype(np.float64) + lo.astype(np.float64)
+    v = np.where(pack.weight != 0, zs[np.minimum(pack.src, pack.n - 1)], 0.0)
+    y2 = np.zeros((pack.num_blocks, 128))
+    np.add.at(y2, pack.row_block, v)
+    np.testing.assert_allclose(
+        np.asarray(out)[: pack.n], y2.reshape(-1)[: pack.n], rtol=1e-13, atol=1e-13
+    )
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_pair_engine_close_to_oracle(dtype):
+    rng = np.random.default_rng(5)
+    g = build_graph(rng.integers(0, 3000, 40000), rng.integers(0, 3000, 40000), n=3000)
+    cfg = PageRankConfig(
+        num_iters=20, dtype=dtype, accum_dtype="float64", wide_accum="pair"
+    )
+    r_t = JaxTpuEngine(cfg).build(g).run_fast()
+    r_c = ReferenceCpuEngine(cfg.replace(dtype="float64")).build(g).run()
+    norm_l1 = np.abs(r_t - r_c).sum() / np.abs(r_c).sum()
+    gate = 1e-7 if dtype == "float32" else 1e-12
+    assert norm_l1 < gate, norm_l1
+
+
+def test_wide_accum_requires_not_narrower_than_dtype():
+    with pytest.raises(ValueError):
+        PageRankConfig(dtype="float64", accum_dtype="float32").validate()
