@@ -114,7 +114,12 @@ def parse_ppr_sources(spec: str, ids, n: int) -> np.ndarray:
         return v
 
     if spec.startswith("random:"):
-        k = int(spec.split(":", 1)[1])
+        try:
+            k = int(spec.split(":", 1)[1])
+        except ValueError:
+            raise SystemExit(f"--ppr-sources: bad count in {spec!r}")
+        if k <= 0:
+            raise SystemExit(f"--ppr-sources: count must be positive in {spec!r}")
         rng = np.random.default_rng(0)
         return rng.choice(n, size=min(k, n), replace=False).astype(np.int64)
     if os.path.exists(spec):
@@ -125,7 +130,28 @@ def parse_ppr_sources(spec: str, ids, n: int) -> np.ndarray:
 
 
 def run_ppr(args, graph, ids) -> int:
-    from pagerank_tpu.engines.ppr import PprJaxEngine
+    # Flags that only apply to the global-PageRank path; reject loudly
+    # rather than silently dropping what the user asked for.
+    ignored = [
+        (name, flag)
+        for name, flag in (
+            ("--semantics", args.semantics != "reference"),
+            ("--tol", args.tol is not None),
+            ("--snapshot-dir", args.snapshot_dir is not None),
+            ("--resume", args.resume),
+            ("--dump-text-dir", args.dump_text_dir is not None),
+            ("--jsonl", args.jsonl is not None),
+            ("--profile-dir", args.profile_dir is not None),
+        )
+        if flag
+    ]
+    if ignored:
+        raise SystemExit(
+            "ppr mode does not support: "
+            + ", ".join(name for name, _ in ignored)
+        )
+    if args.ppr_chunk is not None and args.ppr_chunk <= 0:
+        raise SystemExit("--ppr-chunk must be positive")
 
     cfg = PageRankConfig(
         num_iters=args.iters,
@@ -136,11 +162,22 @@ def run_ppr(args, graph, ids) -> int:
     )
     sources = parse_ppr_sources(args.ppr_sources, ids, graph.n)
     t0 = time.perf_counter()
-    eng = PprJaxEngine(cfg, dangling_to=args.ppr_dangling).build(graph)
-    res = eng.run(sources, topk=args.ppr_topk, chunk=args.ppr_chunk)
+    if args.engine == "cpu":
+        from pagerank_tpu.engines.ppr import ppr_cpu_topk
+
+        res = ppr_cpu_topk(
+            graph, cfg, sources, topk=args.ppr_topk,
+            dangling_to=args.ppr_dangling,
+        )
+    else:
+        from pagerank_tpu.engines.ppr import PprJaxEngine
+
+        eng = PprJaxEngine(cfg, dangling_to=args.ppr_dangling).build(graph)
+        res = eng.run(sources, topk=args.ppr_topk, chunk=args.ppr_chunk)
     dt = time.perf_counter() - t0
+    topk = int(res.topk_ids.shape[1])
     print(
-        f"ppr: {len(sources)} sources x {args.iters} iters, top-{args.ppr_topk} "
+        f"ppr: {len(sources)} sources x {args.iters} iters, top-{topk} "
         f"in {dt:.2f}s ({graph.num_edges * len(sources) * args.iters / dt:.3g} "
         f"edge·vectors/s)",
         file=sys.stderr,
@@ -157,8 +194,9 @@ def run_ppr(args, graph, ids) -> int:
     finally:
         if out:
             f.close()
-            print(f"wrote {len(res.sources)}x{args.ppr_topk} ppr rows to {out}",
-                  file=sys.stderr)
+    if out:
+        print(f"wrote {len(res.sources)}x{topk} ppr rows to {out}",
+              file=sys.stderr)
     return 0
 
 

@@ -122,6 +122,17 @@ class JaxTpuEngine(PageRankEngine):
         self._begin_build()
         if (cfg.kernel if cfg.kernel != "auto" else "ell") not in ("ell", "pallas"):
             raise ValueError("build_device supports the ell/pallas kernels only")
+        if dg.n_padded > self._stripe_max():
+            import sys
+
+            print(
+                f"pagerank_tpu: device-built graph has n_padded="
+                f"{dg.n_padded} > {self._stripe_max()} — the on-device "
+                "pack is single-stripe, so the gather runs outside the "
+                "fast regime (~3.5x slower SpMV); use the host build "
+                "(striped) for graphs this large",
+                file=sys.stderr,
+            )
 
         n, pad = dg.n, dg.n_padded - dg.n
         # Masks arrive in ORIGINAL id space; permute to relabeled space
@@ -147,7 +158,7 @@ class JaxTpuEngine(PageRankEngine):
             jnp.concatenate([zin, zpad]),
             jnp.concatenate([jnp.ones(n, bool), zpad]),
             n=n, n_state=dg.n_padded, num_blocks=dg.num_blocks,
-            num_rows=dg.num_rows, inv_out_rel=inv_out_rel,
+            inv_out_rel=inv_out_rel,
         )
         # The slot arrays are donated to the engine: _setup_ell derives
         # its sentinel-ized copies, and keeping the originals referenced
@@ -184,7 +195,16 @@ class JaxTpuEngine(PageRankEngine):
         zero_in = graph.zero_in_mask
 
         if kernel in ("ell", "pallas"):
-            pack = ell_lib.ell_pack(graph)
+            stripe_max = self._stripe_max()
+            n_padded = -(-n // 128) * 128
+            if n_padded > stripe_max:
+                pack = ell_lib.ell_pack_striped(graph, stripe_size=stripe_max)
+                srcs, weights, rbs = pack.src, pack.weight, pack.row_block
+                stripe_size = pack.stripe_size
+            else:
+                pack = ell_lib.ell_pack(graph)
+                srcs, weights, rbs = [pack.src], [pack.weight], [pack.row_block]
+                stripe_size = None
             self._pack = pack
             self._perm = pack.perm
             n_state = pack.n_padded  # device rank vector length (padded)
@@ -196,10 +216,11 @@ class JaxTpuEngine(PageRankEngine):
             inv = graph_mod.inv_out_degree(graph.out_degree)
             inv_out_rel = np.concatenate([inv[pack.perm], np.zeros(pad)])
             self._setup_ell(
-                pack.src, pack.weight, pack.row_block,
+                srcs, weights, rbs,
                 mass_mask, zero_in, valid,
                 n=n, n_state=n_state, num_blocks=pack.num_blocks,
-                num_rows=pack.num_rows, inv_out_rel=inv_out_rel,
+                inv_out_rel=inv_out_rel,
+                stripe_size=stripe_size,
             )
             return self
         else:
@@ -230,6 +251,18 @@ class JaxTpuEngine(PageRankEngine):
 
     GATHER_WIDTH = 8  # minimum; _gather_width widens for large tables
 
+    def _stripe_max(self) -> int:
+        """Largest per-stripe vertex range that keeps the gather table in
+        the fast regime (<= 2**17 rows of <= 512B): 128 f32 lanes for the
+        plain table, 64 for pair-packed (2x lanes/row) or native-f64
+        (8B lanes) tables."""
+        z_item = max(
+            self._dtype.itemsize,
+            self._accum_dtype.itemsize if not self._pair else 4,
+        )
+        lanes = 64 if self._pair else 512 // z_item
+        return lanes * (1 << 17)
+
     @staticmethod
     def _gather_width(n_state: int, max_width: int = 128) -> int:
         """XLA's fast TPU gather regime (measured on v5e, see
@@ -244,7 +277,8 @@ class JaxTpuEngine(PageRankEngine):
         return width
 
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
-                   valid, *, n, n_state, num_blocks, num_rows, inv_out_rel):
+                   valid, *, n, n_state, num_blocks, inv_out_rel,
+                   stripe_size=None):
         """Common ELL-path setup from slot arrays (host numpy or device
         jnp) — pads rows to the per-device chunk multiple, places arrays
         over the mesh, builds the sharded contribution fn.
@@ -263,41 +297,78 @@ class JaxTpuEngine(PageRankEngine):
         dtype = self._dtype
         accum = self._accum_dtype
         pair = self._pair
-        gw = max(
-            self.GATHER_WIDTH,
-            self._gather_width(n_state, 64 if pair else 128),
-        )
-        want_pallas = cfg.kernel == "pallas"
-        self._kernel = "pallas" if want_pallas else "ell"
-        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
-        e_shard = mesh_lib.edge_sharding(mesh)
 
-        # Chunk the gather so its (slots, 8) intermediate stays ~100MB
-        # regardless of graph size; pad rows so chunks divide evenly.
-        # The pallas kernel instead streams fixed 256-row chunks (its
-        # VMEM scratch and one-hot matmul are sized by this).
-        rows_per_dev = -(-max(1, num_rows) // ndev)
-        pallas_chunk = 256
-        # Scale the chunk down with the gather width so the (chunk, 128,
-        # gw) intermediate keeps the same footprint at every width.
-        ell_chunk_cap = max(256, 32768 * 8 // gw)
-        chunk_rows = pallas_chunk if want_pallas else min(ell_chunk_cap, rows_per_dev)
-        pad_multiple = ndev * chunk_rows
-        xp = np if isinstance(src_slots, np.ndarray) else jnp
-        # Inert slots (weight 0) -> sentinel index n_state; real slots
-        # keep their source id. Row padding (added below) is all-inert.
-        src_slots = xp.where(w_slots != 0, src_slots, np.int32(n_state))
-        src_slots = _pad_rows(src_slots, pad_multiple, np.int32(n_state), xp)
-        row_block = _pad_rows(row_block, pad_multiple, max(0, num_blocks - 1), xp)
+        # Normalize to the striped form: lists of per-stripe slot arrays
+        # (ops/ell.py:StripedEllPack). Single-stripe packs arrive as bare
+        # arrays; stripe_size None means one stripe spanning n_state.
+        if not isinstance(src_slots, (list, tuple)):
+            src_slots, w_slots, row_block = [src_slots], [w_slots], [row_block]
+        sz = int(stripe_size) if stripe_size else n_state
+        n_stripes = len(src_slots)
+        assert n_stripes == -(-n_state // sz), (n_stripes, n_state, sz)
 
-        self._src = jax.device_put(src_slots, shard2d)
-        self._row_block = jax.device_put(row_block, e_shard)
         # 1/out_degree in RELABELED space, zero-padded to n_state. Kept
         # (and the prescale multiply performed) in accum_dtype when that
         # is wider than the rank dtype, so per-edge products carry accum
         # precision into the segment-sum exactly as the per-slot-weight
         # form did.
         z_dtype = accum if jnp.dtype(accum).itemsize > jnp.dtype(dtype).itemsize else dtype
+        z_item = 4 if pair else jnp.dtype(z_dtype).itemsize
+        # Cap at 128 lanes: array lengths are only guaranteed multiples
+        # of 128, and the reshape contract needs gw | sz.
+        gw = max(
+            self.GATHER_WIDTH,
+            self._gather_width(sz, 64 if pair else min(128, 512 // z_item)),
+        )
+        want_pallas = cfg.kernel == "pallas"
+        if want_pallas and n_stripes > 1:
+            import sys
+
+            print(
+                "pagerank_tpu: kernel='pallas' cannot run the striped "
+                "large-graph layout; using the XLA ell path",
+                file=sys.stderr,
+            )
+            want_pallas = False
+        self._kernel = "pallas" if want_pallas else "ell"
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+        e_shard = mesh_lib.edge_sharding(mesh)
+
+        # Chunk the gather so its (slots, gw) intermediate keeps a
+        # constant footprint at every width; pad each stripe's rows so
+        # chunks divide evenly. The pallas kernel instead streams fixed
+        # 256-row chunks (its VMEM scratch is sized by this).
+        pallas_chunk = 256
+        ell_chunk_cap = max(256, 32768 * 8 // gw)
+        xp = np if isinstance(src_slots[0], np.ndarray) else jnp
+        self._src, self._row_block, ell_chunks = [], [], []
+        for s in range(n_stripes):
+            # Inert slots (weight 0) -> per-stripe sentinel index ``sz``;
+            # real slots keep their stripe-local source id. Row padding
+            # (added below) is all-inert.
+            sent = np.int32(sz)
+            ss = xp.where(w_slots[s] != 0, src_slots[s], sent)
+            rows_s = ss.shape[0]
+            rows_per_dev = -(-max(1, rows_s) // ndev)
+            chunk_rows = (
+                pallas_chunk if want_pallas else min(ell_chunk_cap, rows_per_dev)
+            )
+            pad_multiple = ndev * chunk_rows
+            ss = _pad_rows(ss, pad_multiple, sent, xp)
+            rb = _pad_rows(row_block[s], pad_multiple, max(0, num_blocks - 1), xp)
+            self._src.append(jax.device_put(ss, shard2d))
+            self._row_block.append(jax.device_put(rb, e_shard))
+            # Largest chunk that divides the padded per-device rows (a
+            # pallas fallback keeps the 256-row step so the XLA path
+            # never runs with tiny chunks).
+            rows_padded_dev = ss.shape[0] // ndev
+            step = pallas_chunk if want_pallas else 1
+            c = min(ell_chunk_cap, rows_padded_dev)
+            c -= c % step
+            while c > step and rows_padded_dev % c:
+                c -= step
+            ell_chunks.append(max(c, step))
+
         inv_out_rel = xp.asarray(inv_out_rel)
         if inv_out_rel.dtype != z_dtype:
             inv_out_rel = inv_out_rel.astype(z_dtype)
@@ -318,44 +389,44 @@ class JaxTpuEngine(PageRankEngine):
                         accum_dtype=accum, interpret=interp,
                     )
                     return jax.lax.psum(part, axis)
+
+                in_specs = (P(), P(axis, None), P(axis))
             else:
-                # Rows were padded to a multiple of ndev*pallas_chunk when
-                # pallas was requested; pick the largest tuned (~32k-row)
-                # chunk that still divides the per-device row count so a
-                # fallback never runs the XLA path with tiny 256-row
-                # chunks.
-                rows_padded_dev = src_slots.shape[0] // ndev
-                step = pallas_chunk if want_pallas else 1
-                c = min(ell_chunk_cap, rows_padded_dev)
-                c -= c % step
-                while c > step and rows_padded_dev % c:
-                    c -= step
-                ell_chunk = max(c, step)
+                nz = 2 if pair else 1
 
-                if pair:
+                def sharded_contrib(*args):
+                    zs, rest = args[:nz], args[nz:]
+                    total = None
+                    for s in range(n_stripes):
+                        src, rb = rest[2 * s], rest[2 * s + 1]
+                        z_s = [
+                            jnp.concatenate(
+                                [z[s * sz : (s + 1) * sz],
+                                 jnp.zeros(gw, z.dtype)]
+                            )
+                            for z in zs
+                        ]
+                        if pair:
+                            part = spmv.ell_contrib_pair(
+                                z_s[0], z_s[1], src, rb, num_blocks,
+                                accum_dtype=accum, gather_width=gw,
+                                chunk_rows=ell_chunks[s],
+                            )
+                        else:
+                            part = spmv.ell_contrib(
+                                z_s[0], src, rb, num_blocks,
+                                accum_dtype=accum, gather_width=gw,
+                                chunk_rows=ell_chunks[s],
+                            )
+                        total = part if total is None else total + part
+                    return jax.lax.psum(total, axis)
 
-                    def sharded_contrib(z_hi, z_lo, src, row_block):
-                        part = spmv.ell_contrib_pair(
-                            z_hi, z_lo, src, row_block, num_blocks,
-                            accum_dtype=accum, gather_width=gw,
-                            chunk_rows=ell_chunk,
-                        )
-                        return jax.lax.psum(part, axis)
-                else:
+                in_specs = (P(),) * nz + (P(axis, None), P(axis)) * n_stripes
 
-                    def sharded_contrib(z_ext, src, row_block):
-                        part = spmv.ell_contrib(
-                            z_ext, src, row_block, num_blocks,
-                            accum_dtype=accum,
-                            gather_width=gw, chunk_rows=ell_chunk,
-                        )
-                        return jax.lax.psum(part, axis)
-
-            z_specs = (P(), P()) if (pair and mode == "ell") else (P(),)
             return shard_map(
                 sharded_contrib,
                 mesh=mesh,
-                in_specs=z_specs + (P(axis, None), P(axis)),
+                in_specs=in_specs,
                 out_specs=P(),
                 # pallas_call's out_shape carries no varying-mesh-axes
                 # annotation, which the checker insists on; the psum
@@ -364,22 +435,31 @@ class JaxTpuEngine(PageRankEngine):
             )
 
         inv_out = self._inv_out
+        total_z = n_stripes * sz  # >= n_state; prescale zero-fills the tail
 
         # Dekker split of the wide prescale: z = hi + lo exactly, both
-        # f32 — ops/spmv.py:ell_contrib_pair docstring. The pallas kernel
-        # instead consumes the plain (wide) z pinned in VMEM, so the
-        # prescale is bound per-kernel after the probe below.
-        def prescale_pair(r):
+        # f32 — ops/spmv.py:ell_contrib_pair docstring. Per-stripe
+        # sentinel pads are appended inside the contrib fn; the pallas
+        # kernel instead consumes a gw-padded plain z pinned in VMEM, so
+        # the prescale is bound per-kernel after the probe below.
+        def _z(r):
             z = r.astype(inv_out.dtype) * inv_out
+            if total_z > n_state:
+                z = jnp.concatenate(
+                    [z, jnp.zeros(total_z - n_state, z.dtype)]
+                )
+            return z
+
+        def prescale_pair(r):
+            z = _z(r)
             hi = z.astype(jnp.float32)
             lo = (z - hi.astype(z.dtype)).astype(jnp.float32)
-            pad = jnp.zeros(gw, dtype=jnp.float32)
-            return (
-                jnp.concatenate([hi, pad]),
-                jnp.concatenate([lo, pad]),
-            )
+            return hi, lo
 
         def prescale_plain(r):
+            return _z(r)
+
+        def prescale_pallas(r):
             z = r.astype(inv_out.dtype) * inv_out
             return jnp.concatenate([z, jnp.zeros(gw, dtype=z.dtype)])
 
@@ -404,15 +484,17 @@ class JaxTpuEngine(PageRankEngine):
                 try:
                     probe = jax.jit(
                         lambda src, rb, fn=candidate: fn(
-                            prescale_plain(
+                            prescale_pallas(
                                 jnp.zeros(n_state, self._inv_out.dtype)
                             ),
                             src, rb,
                         )
                     )
-                    jax.block_until_ready(probe(self._src, self._row_block))
+                    jax.block_until_ready(
+                        probe(self._src[0], self._row_block[0])
+                    )
                     contrib_fn = candidate
-                    prescale = prescale_plain
+                    prescale = prescale_pallas
                     self._kernel = f"pallas:{mode}"
                     break
                 except Exception as e:  # pragma: no cover - hw-dependent
@@ -439,8 +521,15 @@ class JaxTpuEngine(PageRankEngine):
         else:
             contrib_fn = make_contrib("ell")
 
+        if self._kernel.startswith("pallas"):
+            contrib_args = (self._src[0], self._row_block[0])
+        else:
+            contrib_args = tuple(
+                a for pair_sr in zip(self._src, self._row_block)
+                for a in pair_sr
+            )
         self._finalize(
-            contrib_fn, (self._src, self._row_block),
+            contrib_fn, contrib_args,
             mass_mask, zero_in, valid, n, n_state, prescale=prescale,
         )
 

@@ -55,6 +55,25 @@ def ppr_cpu(
     return r
 
 
+def ppr_cpu_topk(
+    graph: Graph, config: PageRankConfig, sources: np.ndarray,
+    topk: int = 100, dangling_to: str = ppr_model.DANGLING_TO_SOURCE,
+) -> PprResult:
+    """Run the float64 CPU oracle and shape its full [n, s] matrix into
+    the same top-k ``PprResult`` the device engine returns (CLI
+    ``--engine cpu`` path)."""
+    sources = np.asarray(sources, dtype=np.int64)
+    r = ppr_cpu(
+        graph, sources, num_iters=config.num_iters,
+        damping=config.damping, dangling_to=dangling_to,
+    )  # [n, s]
+    k = min(topk, graph.n)
+    order = np.argsort(-r, axis=0, kind="stable")[:k]  # [k, s]
+    ids = order.T.astype(np.int32)  # [s, k]
+    scores = np.take_along_axis(r, order, axis=0).T
+    return PprResult(sources=sources, topk_ids=ids, topk_scores=scores)
+
+
 class PprJaxEngine:
     """Chunked batched PPR on the device mesh."""
 

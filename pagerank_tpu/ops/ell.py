@@ -127,6 +127,127 @@ def ell_pack(graph: Graph) -> EllPack:
     )
 
 
+@dataclass
+class StripedEllPack:
+    """ELL packing split into contiguous SOURCE-range stripes.
+
+    The fast XLA gather regime caps the reshaped rank table at 2**17 rows
+    of <=512B (engines/jax_engine.py:_gather_width), i.e. ~16.8M vertices
+    for a plain f32 table and ~8.4M for the pair-packed one. Larger
+    graphs split the (relabeled) vertex range into ``n_stripes``
+    contiguous stripes; each stripe packs ONLY the edges whose source
+    lies in it, with stripe-LOCAL source indices, so each per-stripe
+    gather table stays in the fast regime. The solver sums the stripes'
+    block outputs (same dst-block space) before the mesh psum.
+
+    Per-stripe padding: a dst block contributes rows to every stripe
+    that feeds it, so total slots grow with stripe count on hub-heavy
+    blocks — the price of keeping the gather fast (SURVEY.md §7 "hard
+    parts": power-law skew).
+    """
+
+    n: int
+    n_padded: int
+    num_blocks: int
+    stripe_size: int  # vertices per stripe (multiple of 128; last may be short of n_padded)
+    src: list  # [stripes] int32 [rows_s, 128] — STRIPE-LOCAL source per slot
+    weight: list  # [stripes] float64 [rows_s, 128]
+    row_block: list  # [stripes] int32 [rows_s], ascending per stripe
+    perm: np.ndarray
+    inv_perm: np.ndarray
+    num_real_edges: int
+
+    @property
+    def n_stripes(self) -> int:
+        return len(self.src)
+
+    @property
+    def num_rows(self) -> int:
+        return int(sum(s.shape[0] for s in self.src))
+
+    @property
+    def padding_ratio(self) -> float:
+        return self.num_rows * LANES / max(1, self.num_real_edges)
+
+
+def ell_pack_striped(graph: Graph, stripe_size: int) -> StripedEllPack:
+    """Pack a graph into source-striped blocked-ELL form.
+
+    ``stripe_size`` must be a positive multiple of 128; sources with
+    relabeled id in [s*stripe_size, (s+1)*stripe_size) land in stripe s.
+    """
+    if stripe_size <= 0 or stripe_size % LANES:
+        raise ValueError(f"stripe_size must be a positive multiple of {LANES}")
+    n = graph.n
+    n_padded = -(-n // LANES) * LANES
+    num_blocks = n_padded // LANES
+    n_stripes = -(-n_padded // stripe_size)
+
+    order = np.argsort(-graph.in_degree.astype(np.int64), kind="stable")
+    perm = order.astype(np.int32)
+    inv_perm = np.empty(n, dtype=np.int32)
+    inv_perm[perm] = np.arange(n, dtype=np.int32)
+
+    new_dst = inv_perm[graph.dst].astype(np.int64)
+    new_src = inv_perm[graph.src].astype(np.int64)
+    stripe_of = new_src // stripe_size
+    # Sort edges by (stripe, dst): within each stripe, dst-major slot order.
+    sort = np.lexsort((new_dst, stripe_of))
+    new_dst = new_dst[sort]
+    new_src = new_src[sort]
+    weight = graph.edge_weight[sort]
+    stripe_of = stripe_of[sort]
+
+    srcs, weights, row_blocks = [], [], []
+    bounds = np.searchsorted(stripe_of, np.arange(n_stripes + 1))
+    for s in range(n_stripes):
+        lo, hi = bounds[s], bounds[s + 1]
+        d_s = new_dst[lo:hi]
+        s_s = (new_src[lo:hi] - s * stripe_size).astype(np.int32)
+        w_s = weight[lo:hi]
+        e = d_s.shape[0]
+        if e == 0:
+            srcs.append(np.zeros((0, LANES), np.int32))
+            weights.append(np.zeros((0, LANES), np.float64))
+            row_blocks.append(np.zeros(0, np.int32))
+            continue
+        first = np.searchsorted(d_s, d_s)
+        depth = np.arange(e, dtype=np.int64) - first
+        block = d_s // LANES
+        lane = d_s % LANES
+        # Rows per block within THIS stripe = max per-dst count in the
+        # block (counts are NOT monotone within a stripe, so a real max
+        # is needed). d_s is already sorted: unique values and counts
+        # come from run boundaries — no re-sort, and only the blocks
+        # present in the stripe are touched (O(e_s), not O(n)).
+        starts = np.flatnonzero(np.r_[True, d_s[1:] != d_s[:-1]])
+        uniq = d_s[starts]
+        cnt = np.diff(np.r_[starts, e])
+        ub = uniq // LANES  # sorted block id per unique dst
+        bstarts = np.flatnonzero(np.r_[True, ub[1:] != ub[:-1]])
+        block_rows = np.zeros(num_blocks, np.int64)
+        block_rows[ub[bstarts]] = np.maximum.reduceat(cnt, bstarts)
+        row_offset = np.concatenate([[0], np.cumsum(block_rows)])
+        rows_total = int(row_offset[-1])
+        src_slots = np.zeros((rows_total, LANES), np.int32)
+        w_slots = np.zeros((rows_total, LANES), np.float64)
+        flat = (row_offset[block] + depth) * LANES + lane
+        src_slots.reshape(-1)[flat] = s_s
+        w_slots.reshape(-1)[flat] = w_s
+        srcs.append(src_slots)
+        weights.append(w_slots)
+        row_blocks.append(
+            np.repeat(np.arange(num_blocks, dtype=np.int32), block_rows)
+        )
+
+    return StripedEllPack(
+        n=n, n_padded=n_padded, num_blocks=num_blocks,
+        stripe_size=stripe_size, src=srcs, weight=weights,
+        row_block=row_blocks, perm=perm, inv_perm=inv_perm,
+        num_real_edges=int(new_dst.shape[0]),
+    )
+
+
 def ell_spmv_reference(pack: EllPack, z: np.ndarray) -> np.ndarray:
     """Numpy oracle for the packed SpMV: y[d] = sum over in-edges of
     z[src]*w, in RELABELED space. z and result are length n (relabeled)."""

@@ -135,3 +135,43 @@ def test_cli_ppr_bad_source(edges_file):
     path, _, _ = edges_file
     with pytest.raises(SystemExit):
         main(["--input", path, "--ppr-sources", "999999", "--log-every", "0"])
+
+
+def test_cli_ppr_cpu_engine_matches_jax(tmp_path, edges_file):
+    path, src, dst = edges_file
+    out_j = str(tmp_path / "ppr_jax.tsv")
+    out_c = str(tmp_path / "ppr_cpu.tsv")
+    base = ["--input", path, "--iters", "8", "--ppr-sources", "0,3",
+            "--ppr-topk", "4", "--log-every", "0", "--dtype", "float64"]
+    assert main(base + ["--engine", "jax", "--out", out_j]) == 0
+    assert main(base + ["--engine", "cpu", "--out", out_c]) == 0
+    rows_j = [l.split("\t") for l in open(out_j).read().splitlines()]
+    rows_c = [l.split("\t") for l in open(out_c).read().splitlines()]
+    assert [r[:2] for r in rows_j] == [r[:2] for r in rows_c]
+    np.testing.assert_allclose(
+        [float(r[2]) for r in rows_j], [float(r[2]) for r in rows_c],
+        rtol=1e-9,
+    )
+
+
+def test_cli_ppr_rejects_global_only_flags(tmp_path, edges_file):
+    path, _, _ = edges_file
+    with pytest.raises(SystemExit, match="--snapshot-dir"):
+        main(["--input", path, "--ppr-sources", "0", "--snapshot-dir",
+              str(tmp_path / "s"), "--log-every", "0"])
+
+
+@pytest.mark.parametrize("spec", ["random:abc", "random:-3", "random:0"])
+def test_cli_ppr_bad_random_spec(edges_file, spec):
+    path, _, _ = edges_file
+    with pytest.raises(SystemExit, match="--ppr-sources"):
+        main(["--input", path, "--ppr-sources", spec, "--log-every", "0"])
+
+
+def test_cli_ppr_topk_clamped_message(edges_file, capsys):
+    path, _, _ = edges_file
+    rc = main(["--input", path, "--iters", "3", "--ppr-sources", "0",
+               "--ppr-topk", "100000", "--log-every", "0"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "top-40" in err  # clamped to n=40, and reported as such
