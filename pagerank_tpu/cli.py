@@ -165,6 +165,11 @@ def run_ppr(args, graph, ids) -> int:
     if args.engine == "cpu":
         from pagerank_tpu.engines.ppr import ppr_cpu_topk
 
+        print(
+            "ppr --engine cpu runs the float64 numpy oracle; "
+            "--ppr-chunk/--num-devices/--dtype/--accum-dtype do not apply",
+            file=sys.stderr,
+        )
         res = ppr_cpu_topk(
             graph, cfg, sources, topk=args.ppr_topk,
             dangling_to=args.ppr_dangling,

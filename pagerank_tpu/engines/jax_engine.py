@@ -17,11 +17,17 @@ step per iteration:
     Sparky.java:216,235 — SURVEY.md §3.3).
 
 Three SpMV kernels (config.kernel):
-  - "ell": blocked-ELL slots + row segment-sum + width-8 row-gather
-    (ops/ell.py, ops/spmv.py:ell_contrib) — the TPU-fast XLA path.
-    Vertices are relabeled by in-degree internally; ranks() translates
-    back. The rank vector is pre-scaled by 1/out_degree so slots carry
-    only a source index (ops/spmv.py docstring).
+  - "ell": blocked-ELL slots + row segment-sum + adaptive-width row
+    gather (ops/ell.py, ops/spmv.py:ell_contrib) — the TPU-fast XLA
+    path. Vertices are relabeled by in-degree internally; ranks()
+    translates back. The rank vector is pre-scaled by 1/out_degree so
+    slots carry only a source index (ops/spmv.py docstring). The gather
+    row widens with the state size (_gather_width) and graphs past the
+    fast-gather regime use the source-striped layout
+    (ops/ell.py:ell_pack_striped). A 64-bit accum_dtype runs the
+    pair-packed (hi, lo) f32 gather with wide reduction
+    (ops/spmv.py:ell_contrib_pair) for f64-grade accuracy at near-f32
+    speed (config.wide_accum).
   - "pallas": hand Mosaic kernel with the pre-scaled rank vector pinned
     in VMEM (ops/pallas_spmv.py). Requires the vector to fit a ~12MB
     VMEM budget; gather strategies ("take", then "onehot8") are
@@ -222,6 +228,19 @@ class JaxTpuEngine(PageRankEngine):
                 inv_out_rel=inv_out_rel,
                 stripe_size=stripe_size,
             )
+            # The engine's sentinel-ized slot copies now live on device;
+            # drop the host-side arrays (float64 weights are 8B/slot —
+            # multi-GB at the scales the striped layout targets). Stats
+            # survive in _pack_stats for introspection.
+            self._pack_stats = {
+                "num_rows": pack.num_rows,
+                "padding_ratio": pack.padding_ratio,
+                "n_stripes": getattr(pack, "n_stripes", 1),
+            }
+            if isinstance(pack, ell_lib.StripedEllPack):
+                pack.src, pack.weight, pack.row_block = [], [], []
+            else:
+                pack.src = pack.weight = pack.row_block = None
             return self
         else:
             self._pack = None

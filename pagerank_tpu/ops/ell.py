@@ -61,69 +61,23 @@ class EllPack:
 
 
 def ell_pack(graph: Graph) -> EllPack:
-    """Pack a dst-sorted COO graph into blocked-ELL form."""
-    n = graph.n
-    n_padded = -(-n // LANES) * LANES
-
-    # Relabel by descending in-degree (stable => deterministic).
-    order = np.argsort(-graph.in_degree.astype(np.int64), kind="stable")
-    perm = order.astype(np.int32)  # relabeled -> original
-    inv_perm = np.empty(n, dtype=np.int32)
-    inv_perm[perm] = np.arange(n, dtype=np.int32)
-
-    # Relabeled edges, sorted by new dst then slot order.
-    new_dst = inv_perm[graph.dst].astype(np.int64)
-    new_src = inv_perm[graph.src].astype(np.int32)
-    sort = np.argsort(new_dst, kind="stable")
-    new_dst = new_dst[sort]
-    new_src = new_src[sort]
-    weight = graph.edge_weight[sort]  # float64; engine casts to compute dtype
-
-    # Per-edge slot depth: k-th in-edge of its dst (0-based). new_dst is
-    # sorted, so depth = position - first-position-of-dst.
-    e = new_dst.shape[0]
-    if e == 0:
-        return EllPack(
-            n=n, n_padded=n_padded, num_blocks=n_padded // LANES,
-            src=np.zeros((0, LANES), np.int32),
-            weight=np.zeros((0, LANES), np.float64),
-            row_block=np.zeros(0, np.int32),
-            perm=perm, inv_perm=inv_perm, num_real_edges=0,
-        )
-    first = np.searchsorted(new_dst, new_dst)  # first index of each dst value
-    depth = (np.arange(e, dtype=np.int64) - first).astype(np.int64)
-
-    block = new_dst // LANES  # per-edge dst block
-    lane = (new_dst % LANES).astype(np.int64)
-
-    # Rows per block = max in-degree within the block. After the
-    # descending in-degree relabel, in-degrees are non-increasing, so the
-    # block max is simply the block's FIRST vertex's in-degree — no
-    # scatter-max needed (np.maximum.at is pathologically slow at scale).
-    num_blocks = n_padded // LANES
-    indeg_rel = np.zeros(n_padded, dtype=np.int64)
-    indeg_rel[:n] = graph.in_degree[perm]
-    block_rows = indeg_rel[0::LANES].copy()
-
-    row_offset = np.concatenate([[0], np.cumsum(block_rows)])
-    rows_total = int(row_offset[-1])
-
-    src_slots = np.zeros((rows_total, LANES), dtype=np.int32)
-    w_slots = np.zeros((rows_total, LANES), dtype=np.float64)
-    flat_pos = (row_offset[block] + depth) * LANES + lane
-    src_flat = src_slots.reshape(-1)
-    w_flat = w_slots.reshape(-1)
-    src_flat[flat_pos] = new_src
-    w_flat[flat_pos] = weight
-
-    row_block = np.repeat(
-        np.arange(num_blocks, dtype=np.int32), block_rows
-    )
-
+    """Pack a dst-sorted COO graph into blocked-ELL form (the
+    single-stripe specialization of :func:`ell_pack_striped` — one stripe
+    spanning the whole padded vertex range, so stripe-local source ids
+    equal relabeled ids)."""
+    n_padded = -(-graph.n // LANES) * LANES
+    sp = ell_pack_striped(graph, stripe_size=max(LANES, n_padded))
+    if sp.n_stripes == 0:  # n == 0 edge case: no stripes at all
+        src = np.zeros((0, LANES), np.int32)
+        weight = np.zeros((0, LANES), np.float64)
+        row_block = np.zeros(0, np.int32)
+    else:
+        src, weight, row_block = sp.src[0], sp.weight[0], sp.row_block[0]
     return EllPack(
-        n=n, n_padded=n_padded, num_blocks=num_blocks,
-        src=src_slots, weight=w_slots, row_block=row_block,
-        perm=perm, inv_perm=inv_perm, num_real_edges=e,
+        n=sp.n, n_padded=sp.n_padded, num_blocks=sp.num_blocks,
+        src=src, weight=weight, row_block=row_block,
+        perm=sp.perm, inv_perm=sp.inv_perm,
+        num_real_edges=sp.num_real_edges,
     )
 
 
