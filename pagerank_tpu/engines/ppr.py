@@ -75,7 +75,20 @@ def ppr_cpu_topk(
 
 
 class PprJaxEngine:
-    """Chunked batched PPR on the device mesh."""
+    """Chunked batched PPR on the device mesh.
+
+    Layout: the same source-striped blocked-ELL packing as the
+    rank-vector solver (ops/ell.py), with one twist — the batch of k
+    personalized columns IS the gather row (ops/spmv.py:ell_contrib_spmm
+    docstring), so stripes are sized to 2**17 - 128 sources to keep each
+    (sz + 1, k) table slice in the fast-gather regime. Rows stream in
+    fixed chunks, bounding the gather intermediate (the earlier COO path
+    materialized an (edges, k) product that OOM'd real graphs)."""
+
+    # Stripe sources so the per-stripe table (sz + 1 rows with the zero
+    # sentinel appended) stays within the <= 2**17-row fast regime.
+    STRIPE = (1 << 17) - 128
+    CHUNK_ROWS = 1024  # (chunk, 128, k) gather intermediate, ~32MB at k=64
 
     def __init__(self, config: Optional[PageRankConfig] = None,
                  dangling_to: str = ppr_model.DANGLING_TO_SOURCE,
@@ -93,11 +106,17 @@ class PprJaxEngine:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        from pagerank_tpu import graph as graph_lib
+        from pagerank_tpu.ops import ell as ell_lib
         from pagerank_tpu.ops import spmv
         from pagerank_tpu.parallel import mesh as mesh_lib
-        from pagerank_tpu.parallel import partition
 
         cfg = self.config
+        for d in (cfg.dtype, cfg.accum_dtype):
+            if np.dtype(d).itemsize == 8 and not jax.config.jax_enable_x64:
+                raise ValueError(
+                    f"dtype {d} needs jax_enable_x64 (see conftest.py)"
+                )
         self.graph = graph
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
@@ -106,47 +125,106 @@ class PprJaxEngine:
         ndev = self._mesh.devices.size
         dtype = jnp.dtype(cfg.dtype)
         accum = jnp.dtype(cfg.accum_dtype)
-        n = graph.n
+        n = graph.n  # Graph guarantees n >= 1, so S >= 1 stripes below
+        n_padded = -(-n // 128) * 128
 
-        shards = partition.partition_edges(graph, ndev, weight_dtype=dtype)
+        sz = max(128, min(self.STRIPE, n_padded))
+        pack = ell_lib.ell_pack_striped(graph, stripe_size=sz)
+        S = pack.n_stripes
+        n_state = pack.n_padded
+        self._perm = pack.perm  # relabeled -> original
+        num_blocks = pack.num_blocks
+        pad = n_state - n
+
+        shard2d = jax.sharding.NamedSharding(self._mesh, P(axis, None))
         e_shard = mesh_lib.edge_sharding(self._mesh)
         rep = mesh_lib.replicated(self._mesh)
-        self._src = jax.device_put(shards.src, e_shard)
-        self._dst = jax.device_put(shards.dst, e_shard)
-        self._w = jax.device_put(shards.weight, e_shard)
+
+        srcs, rbs, chunks = [], [], []
+        for s in range(S):
+            ss = np.where(pack.weight[s] != 0, pack.src[s], np.int32(sz))
+            rows = ss.shape[0]
+            # Chunk per stripe: a short tail stripe pads only to its own
+            # ndev*chunk_s, not to the largest stripe's chunk.
+            chunk_s = min(self.CHUNK_ROWS, -(-max(rows, 1) // ndev))
+            mult = ndev * chunk_s
+            tgt = -(-max(rows, 1) // mult) * mult
+            ss = np.concatenate(
+                [ss, np.full((tgt - rows, 128), np.int32(sz), np.int32)]
+            )
+            rb = np.concatenate(
+                [pack.row_block[s],
+                 np.full(tgt - rows, max(0, num_blocks - 1), np.int32)]
+            )
+            srcs.append(jax.device_put(ss, shard2d))
+            rbs.append(jax.device_put(rb, e_shard))
+            chunks.append(chunk_s)
+        pack.src = pack.weight = pack.row_block = []  # free host copies
+
+        # Prescale in the widest dtype the solver uses, so per-edge
+        # products carry accum precision into the segment-sum exactly as
+        # a per-slot-weight form would (same rule as jax_engine).
+        inv_dtype = accum if accum.itemsize > dtype.itemsize else dtype
+        inv = graph_lib.inv_out_degree(graph.out_degree, dtype=inv_dtype)
+        inv_rel = np.concatenate([inv[pack.perm], np.zeros(pad, inv_dtype)])
+        self._inv_out = jax.device_put(inv_rel, rep)
+        dang = (graph.out_degree == 0)[pack.perm]
         self._dangling = jax.device_put(
-            (graph.out_degree == 0).astype(dtype), rep
+            np.concatenate([dang, np.zeros(pad, bool)]).astype(dtype), rep
         )
+        valid = np.concatenate([np.ones(n, dtype), np.zeros(pad, dtype)])
+        self._valid = jax.device_put(valid, rep)
+        self._slot_args = tuple(a for sr in zip(srcs, rbs) for a in sr)
 
         damping = cfg.damping
         dangling_to = self.dangling_to
+        total_z = S * sz
 
-        def sharded_contrib(r, src, dst, w):
-            part = spmv.edge_contrib_segment_sum(r, src, dst, w, n, accum)
-            return jax.lax.psum(part, axis)
+        def sharded_contrib(z2, *slots):
+            total = None
+            for s in range(S):
+                src_s, rb_s = slots[2 * s], slots[2 * s + 1]
+                z_s = jnp.concatenate(
+                    [z2[s * sz : (s + 1) * sz],
+                     jnp.zeros((1, z2.shape[1]), z2.dtype)]
+                )
+                part = spmv.ell_contrib_spmm(
+                    z_s, src_s, rb_s, num_blocks, accum_dtype=accum,
+                    chunk_rows=chunks[s],
+                )
+                total = part if total is None else total + part
+            return jax.lax.psum(total, axis)
 
         contrib_fn = shard_map(
             sharded_contrib,
             mesh=self._mesh,
-            in_specs=(P(), P(axis), P(axis), P(axis)),
+            in_specs=(P(),) + (P(axis, None), P(axis)) * S,
             out_specs=P(),
         )
 
         @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
-        def run_chunk(r, p_onehot, num_iters, src, dst, w, dangling):
+        def run_chunk(r, p_onehot, num_iters, inv_out, dangling, valid_m,
+                      *slots):
             def body(_, r):
-                contrib = contrib_fn(r, src, dst, w).astype(accum)
+                z2 = r * inv_out[:, None]
+                if total_z > n_state:
+                    z2 = jnp.concatenate(
+                        [z2, jnp.zeros((total_z - n_state, z2.shape[1]),
+                                       z2.dtype)]
+                    )
+                contrib = contrib_fn(z2, *slots)[:n_state].astype(accum)
                 mass = dangling.astype(accum) @ r.astype(accum)
-                return ppr_model.apply_ppr_update(
+                r_new = ppr_model.apply_ppr_update(
                     contrib, p_onehot.astype(accum), mass, n, damping,
                     dangling_to, jnp,
-                ).astype(r.dtype)
+                )
+                return (r_new * valid_m[:, None].astype(accum)).astype(r.dtype)
 
             return jax.lax.fori_loop(0, num_iters, body, r)
 
         @functools.partial(jax.jit, static_argnums=(1,))
         def topk_fn(r, k):
-            scores, ids = jax.lax.top_k(r.T, k)  # per column
+            scores, ids = jax.lax.top_k(r.T, k)  # per column, relabeled
             return ids, scores
 
         self._run_chunk = run_chunk
@@ -154,6 +232,8 @@ class PprJaxEngine:
         self._jnp = jnp
         self._jax = jax
         self._dtype = dtype
+        self._n_state = n_state
+        self._inv_perm = pack.inv_perm  # original -> relabeled id
         return self
 
     def run(
@@ -165,28 +245,36 @@ class PprJaxEngine:
     ) -> PprResult:
         if self.graph is None:
             raise RuntimeError("call build(graph) before run()")
-        jax, jnp = self._jax, self._jnp
         cfg = self.config
         iters = cfg.num_iters if num_iters is None else num_iters
         n = self.graph.n
         sources = np.asarray(sources, dtype=np.int64)
         topk = min(topk, n)
 
+        jax, jnp = self._jax, self._jnp
         ids_out = np.zeros((len(sources), topk), np.int32)
         scores_out = np.zeros((len(sources), topk), self._dtype)
         from pagerank_tpu.parallel.mesh import replicated
 
         rep = replicated(self._mesh)
+        inv_perm = self._inv_perm
         for lo in range(0, len(sources), chunk):
             batch = sources[lo : lo + chunk]
-            p = np.zeros((n, len(batch)), dtype=self._dtype)
-            p[batch, np.arange(len(batch))] = 1.0
+            p = np.zeros((self._n_state, len(batch)), dtype=self._dtype)
+            p[inv_perm[batch], np.arange(len(batch))] = 1.0
             p_dev = jax.device_put(jnp.asarray(p), rep)
             r = self._run_chunk(
                 p_dev.copy(), p_dev, iters,
-                self._src, self._dst, self._w, self._dangling,
+                self._inv_out, self._dangling, self._valid,
+                *self._slot_args,
             )
             ids, scores = self._topk(r, topk)
-            ids_out[lo : lo + len(batch)] = np.asarray(jax.device_get(ids))
+            ids_rel = np.asarray(jax.device_get(ids))
+            # Padding lanes carry score exactly 0 and original ids only
+            # exist for relabeled ids < n; clip (their score 0 keeps
+            # ordering honest — a real vertex with score 0 ties anyway).
+            ids_out[lo : lo + len(batch)] = self._perm[
+                np.minimum(ids_rel, n - 1)
+            ]
             scores_out[lo : lo + len(batch)] = np.asarray(jax.device_get(scores))
         return PprResult(sources=sources, topk_ids=ids_out, topk_scores=scores_out)

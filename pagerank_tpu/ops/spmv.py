@@ -47,6 +47,35 @@ def edge_contrib_segment_sum(r, src, dst, w, n, accum_dtype=None):
     )
 
 
+def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows):
+    """Run ``chunk_sum(src_chunk, row_block_chunk)`` over slot rows in
+    ``chunk_rows``-sized chunks via lax.scan, summing the per-block
+    results. Bounds the gather intermediate each chunk materializes.
+
+    The scan carry is seeded from chunk 0 (not zeros) so that under
+    shard_map the carry is device-varying like the body output.
+    """
+    n_rows = src_slots.shape[0]
+    if chunk_rows is None or chunk_rows >= n_rows:
+        return chunk_sum(src_slots, row_block)
+    if n_rows % chunk_rows:
+        raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
+    nc = n_rows // chunk_rows
+
+    src_c = src_slots.reshape(nc, chunk_rows, 128)
+    rb_c = row_block.reshape(nc, chunk_rows)
+
+    def body(y2, args):
+        return y2 + chunk_sum(*args), None
+
+    y2, _ = jax.lax.scan(
+        body,
+        chunk_sum(src_c[0], rb_c[0]),
+        (src_c[1:], rb_c[1:]),
+    )
+    return y2
+
+
 def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
                 gather_width=8, chunk_rows=None):
     """contrib = Aᵀ_norm r over blocked-ELL slots (ops/ell.py layout),
@@ -96,27 +125,9 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
             v, rb_c, num_segments=num_blocks, indices_are_sorted=True
         )
 
-    n_rows = src_slots.shape[0]
-    if chunk_rows is None or chunk_rows >= n_rows:
-        return chunk_sum(src_slots, row_block).reshape(-1)
-    if n_rows % chunk_rows:
-        raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
-    nc = n_rows // chunk_rows
-
-    src_c = src_slots.reshape(nc, chunk_rows, 128)
-    rb_c = row_block.reshape(nc, chunk_rows)
-
-    def body(y2, args):
-        return y2 + chunk_sum(*args), None
-
-    # Seed the carry from chunk 0 (not zeros) so that under shard_map the
-    # carry is device-varying like the body output.
-    y2, _ = jax.lax.scan(
-        body,
-        chunk_sum(src_c[0], rb_c[0]),
-        (src_c[1:], rb_c[1:]),
-    )
-    return y2.reshape(-1)
+    return _chunked_block_sum(
+        chunk_sum, src_slots, row_block, chunk_rows
+    ).reshape(-1)
 
 
 def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
@@ -166,25 +177,49 @@ def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
             v, rb_c, num_segments=num_blocks, indices_are_sorted=True
         )
 
-    n_rows = src_slots.shape[0]
-    if chunk_rows is None or chunk_rows >= n_rows:
-        return chunk_sum(src_slots, row_block).reshape(-1)
-    if n_rows % chunk_rows:
-        raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
-    nc = n_rows // chunk_rows
+    return _chunked_block_sum(
+        chunk_sum, src_slots, row_block, chunk_rows
+    ).reshape(-1)
 
-    src_c = src_slots.reshape(nc, chunk_rows, 128)
-    rb_c = row_block.reshape(nc, chunk_rows)
 
-    def body(y2, args):
-        return y2 + chunk_sum(*args), None
+def ell_contrib_spmm(z2_ext, src_slots, row_block, num_blocks,
+                     accum_dtype=None, chunk_rows=None):
+    """Batched blocked-ELL contribution (SpMM): k personalized rank
+    columns at once (BASELINE.md config 5).
 
-    y2, _ = jax.lax.scan(
-        body,
-        chunk_sum(src_c[0], rb_c[0]),
-        (src_c[1:], rb_c[1:]),
-    )
-    return y2.reshape(-1)
+    Where the rank-vector path reshapes a 1-D table into (rows, width)
+    lanes, the batch IS the row here: ``z2_ext`` is a (sz + 1, k)
+    pre-scaled rank *matrix* slice whose LAST row is the zero sentinel
+    (inert slots point at index sz). One row gather per slot fetches all
+    k columns — the per-slot issue cost is paid once for k columns of
+    work, so edge·vector throughput scales ~k-fold over the vector path
+    while the table stays inside the fast-gather regime (callers stripe
+    sources so sz + 1 <= 2**17 rows; k*4B <= 512B rows for f32 k<=128).
+
+    Args:
+      z2_ext: [sz + 1, k] pre-scaled rank columns; last row MUST be zero.
+      src_slots: int32 [rows, 128] stripe-local source per slot (sz for
+        inert slots).
+      row_block: int32 [rows] ascending dst-block id per row.
+      num_blocks: static number of 128-lane dst blocks.
+      chunk_rows: lax.scan chunking (bounds the (chunk, 128, k) gather
+        intermediate); must divide the row count. None = single chunk.
+
+    Returns:
+      [num_blocks * 128, k] contribution sums in accum_dtype.
+    """
+    acc = accum_dtype or z2_ext.dtype
+    k = z2_ext.shape[1]
+
+    def chunk_sum(src_c, rb_c):
+        v = z2_ext[src_c].astype(acc)  # (chunk, 128, k) row gather
+        return jax.ops.segment_sum(
+            v, rb_c, num_segments=num_blocks, indices_are_sorted=True
+        )
+
+    return _chunked_block_sum(
+        chunk_sum, src_slots, row_block, chunk_rows
+    ).reshape(num_blocks * 128, k)
 
 
 def dangling_mass(r, dangling, accum_dtype=None):
