@@ -53,6 +53,9 @@ def main(argv=None):
     p.add_argument("--dtype", default="float32")
     p.add_argument("--kernel", default="auto",
                    help="auto|ell|pallas|coo (engine kernels)")
+    p.add_argument("--lane-group", type=int, default=64,
+                   help="grouped-lane ELL group size (64 measured best "
+                        "on v5e at bench scale; see ops/ell.py)")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
     p.add_argument("--accuracy-check", action="store_true",
@@ -62,10 +65,19 @@ def main(argv=None):
     _enable_compile_cache()
     from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
 
+    # Clamp the lane group so packed slot words (src << log2g | sub) fit
+    # int32 at this scale (the packers raise otherwise).
+    n_padded = -(-(1 << args.scale) // 128) * 128
+    grp = args.lane_group
+    while grp > 1 and (n_padded + 1) * grp > 2**31 - 1:
+        grp //= 2
+    if grp != args.lane_group:
+        print(f"bench: lane group clamped to {grp} at scale {args.scale}",
+              file=sys.stderr)
     cfg = PageRankConfig(
         num_iters=args.iters, dtype=args.dtype, accum_dtype=args.dtype,
-        kernel=args.kernel,
-    )
+        kernel=args.kernel, lane_group=grp,
+    ).validate()
 
     t0 = time.perf_counter()
     if args.kernel == "coo" and not args.host_build:
@@ -83,7 +95,8 @@ def main(argv=None):
         from pagerank_tpu.ops import device_build as db
 
         src, dst = db.rmat_edges_device(args.scale, args.edge_factor, seed=0)
-        dg = db.build_ell_device(src, dst, n=1 << args.scale)
+        grp = 1 if cfg.kernel == "pallas" else cfg.lane_group
+        dg = db.build_ell_device(src, dst, n=1 << args.scale, group=grp)
         num_edges = dg.num_edges
         engine = JaxTpuEngine(cfg).build_device(dg)
     t_build = time.perf_counter() - t0

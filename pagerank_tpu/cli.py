@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-devices", type=int, default=None)
     p.add_argument("--dtype", default="float32")
     p.add_argument("--accum-dtype", default=None, help="defaults to --dtype")
+    p.add_argument(
+        "--lane-group", type=int, default=None,
+        help="grouped-lane ELL group size (power of two, 1..128; "
+        "default: config default; 64 is fastest on v5e for large "
+        "power-law graphs)",
+    )
     p.add_argument("--tol", type=float, default=None, help="L1 early-stop (default: none)")
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument(
@@ -159,7 +165,7 @@ def run_ppr(args, graph, ids) -> int:
         dtype=args.dtype,
         accum_dtype=args.accum_dtype or args.dtype,
         num_devices=args.num_devices,
-    )
+    ).validate()
     sources = parse_ppr_sources(args.ppr_sources, ids, graph.n)
     t0 = time.perf_counter()
     if args.engine == "cpu":
@@ -277,6 +283,9 @@ def main(argv=None) -> int:
         snapshot_every=args.snapshot_every,
         log_every=args.log_every,
     )
+    if args.lane_group is not None:
+        cfg = cfg.replace(lane_group=args.lane_group)
+    cfg.validate()
     engine = make_engine(args.engine, cfg)
     engine.build(graph)
 

@@ -128,6 +128,12 @@ class JaxTpuEngine(PageRankEngine):
         self._begin_build()
         if (cfg.kernel if cfg.kernel != "auto" else "ell") not in ("ell", "pallas"):
             raise ValueError("build_device supports the ell/pallas kernels only")
+        group = getattr(dg, "group", 1)
+        if cfg.kernel == "pallas" and group > 1:
+            raise ValueError(
+                "kernel='pallas' needs a group=1 device graph; pass "
+                "group=1 to build_ell_device"
+            )
         if dg.n_padded > self._stripe_max():
             import sys
 
@@ -164,7 +170,7 @@ class JaxTpuEngine(PageRankEngine):
             jnp.concatenate([zin, zpad]),
             jnp.concatenate([jnp.ones(n, bool), zpad]),
             n=n, n_state=dg.n_padded, num_blocks=dg.num_blocks,
-            inv_out_rel=inv_out_rel,
+            inv_out_rel=inv_out_rel, group=group,
         )
         # The slot arrays are donated to the engine: _setup_ell derives
         # its sentinel-ized copies, and keeping the originals referenced
@@ -203,12 +209,17 @@ class JaxTpuEngine(PageRankEngine):
         if kernel in ("ell", "pallas"):
             stripe_max = self._stripe_max()
             n_padded = -(-n // 128) * 128
+            # The pallas kernel consumes plain source ids; group only on
+            # the XLA ell path.
+            group = 1 if kernel == "pallas" else cfg.lane_group
             if n_padded > stripe_max:
-                pack = ell_lib.ell_pack_striped(graph, stripe_size=stripe_max)
+                pack = ell_lib.ell_pack_striped(
+                    graph, stripe_size=stripe_max, group=group
+                )
                 srcs, weights, rbs = pack.src, pack.weight, pack.row_block
                 stripe_size = pack.stripe_size
             else:
-                pack = ell_lib.ell_pack(graph)
+                pack = ell_lib.ell_pack(graph, group=group)
                 srcs, weights, rbs = [pack.src], [pack.weight], [pack.row_block]
                 stripe_size = None
             self._pack = pack
@@ -226,7 +237,7 @@ class JaxTpuEngine(PageRankEngine):
                 mass_mask, zero_in, valid,
                 n=n, n_state=n_state, num_blocks=pack.num_blocks,
                 inv_out_rel=inv_out_rel,
-                stripe_size=stripe_size,
+                stripe_size=stripe_size, group=group,
             )
             # The engine's sentinel-ized slot copies now live on device;
             # drop the host-side arrays (float64 weights are 8B/slot —
@@ -297,7 +308,7 @@ class JaxTpuEngine(PageRankEngine):
 
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
                    valid, *, n, n_state, num_blocks, inv_out_rel,
-                   stripe_size=None):
+                   stripe_size=None, group=1):
         """Common ELL-path setup from slot arrays (host numpy or device
         jnp) — pads rows to the per-device chunk multiple, places arrays
         over the mesh, builds the sharded contribution fn.
@@ -361,11 +372,13 @@ class JaxTpuEngine(PageRankEngine):
         ell_chunk_cap = max(256, 32768 * 8 // gw)
         xp = np if isinstance(src_slots[0], np.ndarray) else jnp
         self._src, self._row_block, ell_chunks = [], [], []
+        log2g = group.bit_length() - 1
         for s in range(n_stripes):
-            # Inert slots (weight 0) -> per-stripe sentinel index ``sz``;
-            # real slots keep their stripe-local source id. Row padding
+            # Inert slots (weight 0) -> per-stripe sentinel index ``sz``
+            # (shifted into the packed-word form when grouped); real
+            # slots keep their stripe-local source id. Row padding
             # (added below) is all-inert.
-            sent = np.int32(sz)
+            sent = np.int32(sz << log2g)
             ss = xp.where(w_slots[s] != 0, src_slots[s], sent)
             rows_s = ss.shape[0]
             rows_per_dev = -(-max(1, rows_s) // ndev)
@@ -429,13 +442,13 @@ class JaxTpuEngine(PageRankEngine):
                             part = spmv.ell_contrib_pair(
                                 z_s[0], z_s[1], src, rb, num_blocks,
                                 accum_dtype=accum, gather_width=gw,
-                                chunk_rows=ell_chunks[s],
+                                chunk_rows=ell_chunks[s], group=group,
                             )
                         else:
                             part = spmv.ell_contrib(
                                 z_s[0], src, rb, num_blocks,
                                 accum_dtype=accum, gather_width=gw,
-                                chunk_rows=ell_chunks[s],
+                                chunk_rows=ell_chunks[s], group=group,
                             )
                         total = part if total is None else total + part
                     return jax.lax.psum(total, axis)

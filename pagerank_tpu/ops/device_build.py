@@ -59,7 +59,7 @@ class DeviceEllGraph:
     n: int
     n_padded: int
     num_blocks: int
-    src: jax.Array  # int32 [rows, 128] relabeled source per slot
+    src: jax.Array  # int32 [rows, 128] relabeled source per slot; packed (src << log2(group)) | lane_sub when group > 1
     weight: jax.Array  # f32 [rows, 128], 0 for padding/duplicate slots
     row_block: jax.Array  # int32 [rows], ascending dst-block id
     perm: jax.Array  # int32 [n] relabeled -> original
@@ -67,6 +67,7 @@ class DeviceEllGraph:
     zero_in_mask: jax.Array  # bool [n] ORIGINAL id space
     out_degree: jax.Array  # int32 [n] ORIGINAL id space (unique targets)
     num_edges: int  # unique edge count
+    group: int = 1  # lane-group size (ops/ell.py grouped-lane layout)
 
     @property
     def num_rows(self) -> int:
@@ -129,9 +130,9 @@ def _sort_dedup_degrees(src, dst, n):
     return src_s, dst_s, unique, out_degree, in_degree
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6))
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
 def _relabel_and_rows(src_s, dst_s, unique, out_degree, in_degree, n_padded,
-                      weight_dtype=jnp.float32):
+                      weight_dtype=jnp.float32, group=1):
     """In-degree-descending relabel + per-edge ELL slot coordinates.
 
     Returns (new_src, new_dst_sorted order arrays...) — everything needed
@@ -157,38 +158,44 @@ def _relabel_and_rows(src_s, dst_s, unique, out_degree, in_degree, n_padded,
     inv_out = graph_lib.inv_out_degree(out_degree, jnp, dtype=weight_dtype)
     w = jnp.where(unique2, inv_out[src_s[order2]], 0.0).astype(weight_dtype)
 
-    # Slot depth = k-th in-edge of its dst, counting duplicates too (the
-    # host packer indexes depth over the deduped edge list; duplicates
-    # here occupy a slot with weight 0 — harmless, slightly deeper
-    # blocks). new_dst is sorted, so first-index-of-dst is the running
-    # max of run-start positions — one cummax scan, not a searchsorted
-    # (33M binary searches = ~840M random gathers, ~25s on a v5e).
+    # Slot rank k = position within the slot's LANE GROUP run (group=1:
+    # k-th in-edge of its dst), counting duplicates too (the host packer
+    # indexes depth over the deduped edge list; duplicates here occupy a
+    # slot with weight 0 — harmless, slightly deeper blocks). new_dst is
+    # sorted, so first-index-of-group is the running max of run-start
+    # positions — one cummax scan, not a searchsorted (33M binary
+    # searches = ~840M random gathers, ~25s on a v5e).
+    log2g = group.bit_length() - 1
     e = new_dst.shape[0]
     idx = jnp.arange(e, dtype=jnp.int32)
-    is_start = jnp.concatenate(
-        [jnp.ones(1, bool), new_dst[1:] != new_dst[:-1]]
-    )
+    grp = new_dst >> log2g
+    is_start = jnp.concatenate([jnp.ones(1, bool), grp[1:] != grp[:-1]])
     first = jax.lax.cummax(jnp.where(is_start, idx, 0))
-    depth = idx - first
+    k = idx - first
+    row = k >> log2g
+    # Slot position within the 128-lane row: the lane group's band of
+    # ``group`` positions, then k's phase within the group (ops/ell.py
+    # grouped-lane layout; group=1 reduces to pos = lane).
+    pos = ((new_dst % LANES) >> log2g) * group + (k & (group - 1))
+    word = new_src if group == 1 else (
+        (new_src << log2g) | (new_dst & (group - 1))
+    )
 
-    # Rows per 128-dst block = in-degree of the block's FIRST vertex
-    # (descending relabel => block max is its first vertex) plus the
-    # duplicate slots that extend a block's depth. For exact parity with
-    # the host packer, count actual max depth per block: segment_max.
+    # Rows per 128-dst block = max rows any of its lane groups uses (for
+    # exact parity with the host packer: segment_max of actual use).
     block = new_dst // LANES
-    lane = new_dst % LANES
     num_blocks = n_padded // LANES
     block_rows = jax.ops.segment_max(
-        depth + 1, block, num_segments=num_blocks, indices_are_sorted=True
+        row + 1, block, num_segments=num_blocks, indices_are_sorted=True
     )
     block_rows = jnp.maximum(block_rows, 0)  # empty blocks: segment_max = -inf
     row_offset = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(block_rows).astype(jnp.int32)]
     )
-    row_idx = row_offset[block] + depth
+    row_idx = row_offset[block] + row
     mass_mask = out_degree == 0
     zero_in = in_degree == 0
-    return new_src, w, row_idx, lane, block_rows, row_offset, perm, mass_mask, zero_in
+    return word, w, row_idx, pos, block_rows, row_offset, perm, mass_mask, zero_in
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6))
@@ -206,14 +213,23 @@ def _scatter_slots(new_src, w, row_idx, lane, block_rows, rows_total, num_blocks
 
 
 def build_ell_device(
-    src: jax.Array, dst: jax.Array, n: int, weight_dtype=jnp.float32
+    src: jax.Array, dst: jax.Array, n: int, weight_dtype=jnp.float32,
+    group: int = 1,
 ) -> DeviceEllGraph:
     """Full graph build on device from raw (possibly duplicated) edges.
 
     One scalar (rows_total) crosses device->host to size the slot
-    buffers; everything else stays on device.
+    buffers; everything else stays on device. ``group`` selects the
+    grouped-lane slot layout (ops/ell.py module docstring).
     """
+    if group < 1 or group > LANES or (group & (group - 1)):
+        raise ValueError(f"group must be a power of two in [1, {LANES}]")
     n_padded = -(-n // LANES) * LANES
+    if group > 1 and (n_padded + 1) * group > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"grouped slot words overflow int32: n_padded {n_padded} * "
+            f"group {group} (reduce group; same guard as ell_pack_striped)"
+        )
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
     if src.shape[0] == 0:  # edge-free graph (e.g. comment-only input)
@@ -228,24 +244,25 @@ def build_ell_device(
             dangling_mask=jnp.ones(n, bool),
             zero_in_mask=jnp.ones(n, bool),
             out_degree=jnp.zeros(n, jnp.int32),
-            num_edges=0,
+            num_edges=0, group=group,
         )
 
     src_s, dst_s, unique, out_degree, in_degree = _sort_dedup_degrees(src, dst, n)
-    (new_src, w, row_idx, lane, block_rows, row_offset, perm, mass_mask,
+    (word, w, row_idx, pos, block_rows, row_offset, perm, mass_mask,
      zero_in) = _relabel_and_rows(
         src_s, dst_s, unique, out_degree, in_degree, n_padded,
-        jnp.dtype(weight_dtype),
+        jnp.dtype(weight_dtype), group,
     )
     num_blocks = n_padded // LANES
     rows_total = int(jax.device_get(row_offset[-1]))
     num_edges = int(jax.device_get(unique.sum()))
     src_slots, w_slots, row_block = _scatter_slots(
-        new_src, w, row_idx, lane, block_rows, rows_total, num_blocks
+        word, w, row_idx, pos, block_rows, rows_total, num_blocks
     )
     return DeviceEllGraph(
         n=n, n_padded=n_padded, num_blocks=num_blocks,
         src=src_slots, weight=w_slots, row_block=row_block,
         perm=perm, dangling_mask=mass_mask, zero_in_mask=zero_in,
         out_degree=out_degree.astype(jnp.int32), num_edges=num_edges,
+        group=group,
     )

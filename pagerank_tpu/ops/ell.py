@@ -21,6 +21,16 @@ Layout:
   - blocks whose 128 dsts all have in-degree 0 produce no rows at all
     (zero-in vertices cost nothing in the SpMV).
 
+Grouped-lane variant (``group`` > 1): per-block rows cost
+max-over-LANES(in_degree), which on measured power-law graphs is 20-30%
+padding even after the in-degree sort. Letting a slot serve ANY of
+``group`` adjacent lanes collapses that to max-over-GROUPS(ceil(
+group_edges / group)) — ~8% at group=8 on R-MAT — at the cost of one
+extra ``group``-wide one-hot redistribution per slot in the SpMV (VPU
+noise next to the gather). Slot words are then packed as
+``(src << log2(group)) | lane_sub``: the slot at row position p serves
+lane ``(p & ~(group-1)) | lane_sub``. group=1 keeps plain source ids.
+
 All ids inside the packed arrays are in RELABELED space; `perm` maps
 relabeled -> original id, `inv_perm` the reverse.
 """
@@ -43,12 +53,13 @@ class EllPack:
     n: int  # vertex count (unpadded)
     n_padded: int  # next multiple of 128
     num_blocks: int  # n_padded // 128
-    src: np.ndarray  # int32 [rows, 128] — RELABELED source id per slot
+    src: np.ndarray  # int32 [rows, 128] — RELABELED source id per slot; packed (src << log2(group)) | lane_sub when group > 1
     weight: np.ndarray  # float64 [rows, 128] — 1/out_degree, 0 for padding (cast to compute dtype at device placement)
     row_block: np.ndarray  # int32 [rows] — dst block id per row, ascending
     perm: np.ndarray  # int32 [n] — relabeled id -> original id
     inv_perm: np.ndarray  # int32 [n] — original id -> relabeled id
     num_real_edges: int
+    group: int = 1  # lane-group size (see module docstring)
 
     @property
     def num_rows(self) -> int:
@@ -60,13 +71,13 @@ class EllPack:
         return slots / max(1, self.num_real_edges)
 
 
-def ell_pack(graph: Graph) -> EllPack:
+def ell_pack(graph: Graph, group: int = 1) -> EllPack:
     """Pack a dst-sorted COO graph into blocked-ELL form (the
     single-stripe specialization of :func:`ell_pack_striped` — one stripe
     spanning the whole padded vertex range, so stripe-local source ids
     equal relabeled ids)."""
     n_padded = -(-graph.n // LANES) * LANES
-    sp = ell_pack_striped(graph, stripe_size=max(LANES, n_padded))
+    sp = ell_pack_striped(graph, stripe_size=max(LANES, n_padded), group=group)
     if sp.n_stripes == 0:  # n == 0 edge case: no stripes at all
         src = np.zeros((0, LANES), np.int32)
         weight = np.zeros((0, LANES), np.float64)
@@ -77,7 +88,7 @@ def ell_pack(graph: Graph) -> EllPack:
         n=sp.n, n_padded=sp.n_padded, num_blocks=sp.num_blocks,
         src=src, weight=weight, row_block=row_block,
         perm=sp.perm, inv_perm=sp.inv_perm,
-        num_real_edges=sp.num_real_edges,
+        num_real_edges=sp.num_real_edges, group=group,
     )
 
 
@@ -104,12 +115,13 @@ class StripedEllPack:
     n_padded: int
     num_blocks: int
     stripe_size: int  # vertices per stripe (multiple of 128; last may be short of n_padded)
-    src: list  # [stripes] int32 [rows_s, 128] — STRIPE-LOCAL source per slot
+    src: list  # [stripes] int32 [rows_s, 128] — STRIPE-LOCAL source per slot (packed with lane_sub when group > 1)
     weight: list  # [stripes] float64 [rows_s, 128]
     row_block: list  # [stripes] int32 [rows_s], ascending per stripe
     perm: np.ndarray
     inv_perm: np.ndarray
     num_real_edges: int
+    group: int = 1
 
     @property
     def n_stripes(self) -> int:
@@ -124,14 +136,20 @@ class StripedEllPack:
         return self.num_rows * LANES / max(1, self.num_real_edges)
 
 
-def ell_pack_striped(graph: Graph, stripe_size: int) -> StripedEllPack:
+def ell_pack_striped(
+    graph: Graph, stripe_size: int, group: int = 1
+) -> StripedEllPack:
     """Pack a graph into source-striped blocked-ELL form.
 
     ``stripe_size`` must be a positive multiple of 128; sources with
     relabeled id in [s*stripe_size, (s+1)*stripe_size) land in stripe s.
+    ``group`` (power of two, <= 128) enables the grouped-lane layout:
+    slot words become ``(src << log2(group)) | lane_sub``.
     """
     if stripe_size <= 0 or stripe_size % LANES:
         raise ValueError(f"stripe_size must be a positive multiple of {LANES}")
+    if group < 1 or group > LANES or (group & (group - 1)):
+        raise ValueError(f"group must be a power of two in [1, {LANES}]")
     n = graph.n
     n_padded = -(-n // LANES) * LANES
     num_blocks = n_padded // LANES
@@ -152,6 +170,12 @@ def ell_pack_striped(graph: Graph, stripe_size: int) -> StripedEllPack:
     weight = graph.edge_weight[sort]
     stripe_of = stripe_of[sort]
 
+    log2g = group.bit_length() - 1
+    if group > 1 and (stripe_size + 1) << log2g > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"grouped slot words overflow int32: stripe_size {stripe_size} "
+            f"* group {group}"
+        )
     srcs, weights, row_blocks = [], [], []
     bounds = np.searchsorted(stripe_of, np.arange(n_stripes + 1))
     for s in range(n_stripes):
@@ -165,28 +189,36 @@ def ell_pack_striped(graph: Graph, stripe_size: int) -> StripedEllPack:
             weights.append(np.zeros((0, LANES), np.float64))
             row_blocks.append(np.zeros(0, np.int32))
             continue
-        first = np.searchsorted(d_s, d_s)
-        depth = np.arange(e, dtype=np.int64) - first
         block = d_s // LANES
-        lane = d_s % LANES
-        # Rows per block within THIS stripe = max per-dst count in the
-        # block (counts are NOT monotone within a stripe, so a real max
-        # is needed). d_s is already sorted: unique values and counts
-        # come from run boundaries — no re-sort, and only the blocks
-        # present in the stripe are touched (O(e_s), not O(n)).
-        starts = np.flatnonzero(np.r_[True, d_s[1:] != d_s[:-1]])
-        uniq = d_s[starts]
-        cnt = np.diff(np.r_[starts, e])
-        ub = uniq // LANES  # sorted block id per unique dst
-        bstarts = np.flatnonzero(np.r_[True, ub[1:] != ub[:-1]])
+        # Lane-group run index: with group=1 a "lane group" is a single
+        # dst and this reduces exactly to per-dst depth. d_s is sorted,
+        # so groups are runs; k counts a slot's rank within its group.
+        grp = d_s >> log2g
+        gstarts = np.flatnonzero(np.r_[True, grp[1:] != grp[:-1]])
+        cnt = np.diff(np.r_[gstarts, e])
+        k = np.arange(e, dtype=np.int64) - np.repeat(gstarts, cnt)
+        row = k >> log2g
+        pos = ((d_s % LANES) >> log2g) * group + (k & (group - 1))
+        # Rows per block within THIS stripe = max over its lane groups of
+        # ceil(group_edges / group) (counts are NOT monotone within a
+        # stripe, so a real max is needed). Only blocks present in the
+        # stripe are touched (O(e_s), not O(n)).
+        g_rows = -(-cnt // group)
+        gb = grp[gstarts] >> (7 - log2g)  # block id per group run
+        bstarts = np.flatnonzero(np.r_[True, gb[1:] != gb[:-1]])
         block_rows = np.zeros(num_blocks, np.int64)
-        block_rows[ub[bstarts]] = np.maximum.reduceat(cnt, bstarts)
+        block_rows[gb[bstarts]] = np.maximum.reduceat(g_rows, bstarts)
         row_offset = np.concatenate([[0], np.cumsum(block_rows)])
         rows_total = int(row_offset[-1])
         src_slots = np.zeros((rows_total, LANES), np.int32)
         w_slots = np.zeros((rows_total, LANES), np.float64)
-        flat = (row_offset[block] + depth) * LANES + lane
-        src_slots.reshape(-1)[flat] = s_s
+        flat = (row_offset[block] + row) * LANES + pos
+        word = (
+            s_s if group == 1
+            else (s_s.astype(np.int32) << log2g)
+            | (d_s & (group - 1)).astype(np.int32)
+        )
+        src_slots.reshape(-1)[flat] = word
         w_slots.reshape(-1)[flat] = w_s
         srcs.append(src_slots)
         weights.append(w_slots)
@@ -198,14 +230,22 @@ def ell_pack_striped(graph: Graph, stripe_size: int) -> StripedEllPack:
         n=n, n_padded=n_padded, num_blocks=num_blocks,
         stripe_size=stripe_size, src=srcs, weight=weights,
         row_block=row_blocks, perm=perm, inv_perm=inv_perm,
-        num_real_edges=int(new_dst.shape[0]),
+        num_real_edges=int(new_dst.shape[0]), group=group,
     )
 
 
 def ell_spmv_reference(pack: EllPack, z: np.ndarray) -> np.ndarray:
     """Numpy oracle for the packed SpMV: y[d] = sum over in-edges of
     z[src]*w, in RELABELED space. z and result are length n (relabeled)."""
-    v = z[pack.src] * pack.weight  # (rows, 128)
+    g = pack.group
     y2 = np.zeros((pack.num_blocks, LANES), dtype=z.dtype)
-    np.add.at(y2, pack.row_block, v)
+    if g == 1:
+        v = z[pack.src] * pack.weight  # (rows, 128)
+        np.add.at(y2, pack.row_block, v)
+    else:
+        log2g = g.bit_length() - 1
+        v = z[pack.src >> log2g] * pack.weight
+        pos = np.arange(LANES)
+        lane = (pos[None, :] & ~(g - 1)) | (pack.src & (g - 1))
+        np.add.at(y2, (pack.row_block[:, None], lane), v)
     return y2.reshape(-1)[: pack.n]

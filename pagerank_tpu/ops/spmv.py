@@ -47,6 +47,18 @@ def edge_contrib_segment_sum(r, src, dst, w, n, accum_dtype=None):
     )
 
 
+def _group_scatter(v, sub, group, acc):
+    """Redistribute per-slot values to lanes within their lane group
+    (ops/ell.py grouped-lane layout): the slot at row position p carries
+    ``sub`` selecting lane ``(p & ~(group-1)) | sub``. One ``group``-wide
+    one-hot contraction per slot — VPU noise next to the slot gather."""
+    c, lanes = v.shape
+    ng = lanes // group
+    v4 = v.reshape(c, ng, group)
+    sel = jax.nn.one_hot(sub.reshape(c, ng, group), group, dtype=acc)
+    return (v4[..., None].astype(acc) * sel).sum(2).reshape(c, lanes)
+
+
 def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows):
     """Run ``chunk_sum(src_chunk, row_block_chunk)`` over slot rows in
     ``chunk_rows``-sized chunks via lax.scan, summing the per-block
@@ -77,7 +89,7 @@ def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows):
 
 
 def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
-                gather_width=8, chunk_rows=None):
+                gather_width=8, chunk_rows=None, group=1):
     """contrib = Aᵀ_norm r over blocked-ELL slots (ops/ell.py layout),
     with the row-normalization PRE-SCALED into the rank vector.
 
@@ -101,13 +113,16 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
       z_ext: [n_pad + gather_width] pre-scaled rank vector; the trailing
         ``gather_width`` lanes MUST be zero (sentinel block).
       src_slots: int32 [rows, 128] relabeled source per slot; inert slots
-        hold the sentinel index ``n_pad``.
+        hold the sentinel index ``n_pad``. When ``group`` > 1 the words
+        are packed ``(src << log2(group)) | lane_sub`` (ops/ell.py
+        grouped-lane layout; sentinel = ``n_pad << log2(group)``).
       row_block: int32 [rows] ascending dst-block id per row.
       num_blocks: static number of 128-lane dst blocks.
       chunk_rows: process slot rows in chunks of this size via lax.scan —
         bounds the (slots, gather_width) gather intermediate (which would
         otherwise materialize ~8x the slot array in HBM). Must divide the
         row count. None = single chunk.
+      group: lane-group size of the packing (static).
 
     Returns:
       [num_blocks * 128] contribution sums (relabeled, padded).
@@ -116,11 +131,17 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
     zw = z_ext.reshape(-1, gather_width)
     shift = gather_width.bit_length() - 1
     mask = gather_width - 1
+    log2g = group.bit_length() - 1
 
     def chunk_sum(src_c, rb_c):
+        if group > 1:
+            sub = src_c & (group - 1)
+            src_c = src_c >> log2g
         rows = zw[src_c >> shift]  # (chunk, 128, gather_width)
         sel = jax.nn.one_hot(src_c & mask, gather_width, dtype=acc)
         v = (rows.astype(acc) * sel).sum(-1)
+        if group > 1:
+            v = _group_scatter(v, sub, group, acc)
         return jax.ops.segment_sum(
             v, rb_c, num_segments=num_blocks, indices_are_sorted=True
         )
@@ -131,7 +152,8 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
 
 
 def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
-                     accum_dtype=None, gather_width=8, chunk_rows=None):
+                     accum_dtype=None, gather_width=8, chunk_rows=None,
+                     group=1):
     """``ell_contrib`` with the pre-scaled rank vector carried as an exact
     f32 (hi, lo) pair and the reduction done in a wide dtype — the fast
     path to f64-grade accuracy on TPU (which has no native f64).
@@ -163,16 +185,22 @@ def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
     w = gather_width
     shift = w.bit_length() - 1
     mask = w - 1
+    log2g = group.bit_length() - 1
     zw = jnp.concatenate(
         [z_hi_ext.reshape(-1, w), z_lo_ext.reshape(-1, w)], axis=1
     )  # (n_pad/w + 1, 2w): hi lanes then lo lanes, sentinel row all-zero
 
     def chunk_sum(src_c, rb_c):
+        if group > 1:
+            sub = src_c & (group - 1)
+            src_c = src_c >> log2g
         rows = zw[src_c >> shift]  # (chunk, 128, 2w) — ONE gather
         sel = jax.nn.one_hot(src_c & mask, w, dtype=rows.dtype)
         v_hi = (rows[..., :w] * sel).sum(-1)  # exact: selection
         v_lo = (rows[..., w:] * sel).sum(-1)  # exact: selection
         v = v_hi.astype(acc) + v_lo.astype(acc)
+        if group > 1:
+            v = _group_scatter(v, sub, group, acc)
         return jax.ops.segment_sum(
             v, rb_c, num_segments=num_blocks, indices_are_sorted=True
         )
