@@ -56,6 +56,11 @@ def main(argv=None):
     p.add_argument("--lane-group", type=int, default=64,
                    help="grouped-lane ELL group size (64 measured best "
                         "on v5e at bench scale; see ops/ell.py)")
+    p.add_argument("--stripe-size", type=int, default=0,
+                   help="source-stripe span in vertices (0 = auto: "
+                        "single stripe up to 8.4M f32 vertices / 4.2M "
+                        "f64, stripes of half that above — the measured "
+                        "optimum, see jax_engine._stripe_max)")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
     p.add_argument("--accuracy-check", action="store_true",
@@ -65,11 +70,28 @@ def main(argv=None):
     _enable_compile_cache()
     from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
 
-    # Clamp the lane group so packed slot words (src << log2g | sub) fit
-    # int32 at this scale (the packers raise otherwise).
+    # Stripe sources once the gather table outgrows the single-stripe
+    # bound; use the engine's own limits so the two can't diverge (a
+    # 64-bit dtype runs the pair-packed table on TPU, which carries 2x
+    # lanes/row).
+    from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+
     n_padded = -(-(1 << args.scale) // 128) * 128
+    pair = np.dtype(args.dtype).itemsize == 8
+    fast_cap, stripe_target = JaxTpuEngine.stripe_limits(
+        4 if pair else np.dtype(args.dtype).itemsize, pair
+    )
+    stripe = args.stripe_size or (0 if n_padded <= fast_cap else stripe_target)
+    # Clamp the lane group so packed slot words (src << log2g | sub) fit
+    # int32 at the span the chosen build will actually pack (the host
+    # path ignores --stripe-size; the engine stripes it at stripe_target
+    # when n_padded exceeds fast_cap).
+    span = min(stripe or n_padded, n_padded)
+    if args.host_build:
+        span = min(stripe_target if n_padded > fast_cap else n_padded,
+                   n_padded)
     grp = args.lane_group
-    while grp > 1 and (n_padded + 1) * grp > 2**31 - 1:
+    while grp > 1 and (span + 1) * grp > 2**31 - 1:
         grp //= 2
     if grp != args.lane_group:
         print(f"bench: lane group clamped to {grp} at scale {args.scale}",
@@ -95,8 +117,12 @@ def main(argv=None):
         from pagerank_tpu.ops import device_build as db
 
         src, dst = db.rmat_edges_device(args.scale, args.edge_factor, seed=0)
-        grp = 1 if cfg.kernel == "pallas" else cfg.lane_group
-        dg = db.build_ell_device(src, dst, n=1 << args.scale, group=grp)
+        pallas = cfg.kernel == "pallas"
+        dg = db.build_ell_device(
+            src, dst, n=1 << args.scale,
+            group=1 if pallas else cfg.lane_group,
+            stripe_size=0 if pallas else stripe,
+        )
         num_edges = dg.num_edges
         engine = JaxTpuEngine(cfg).build_device(dg)
     t_build = time.perf_counter() - t0

@@ -129,20 +129,21 @@ class JaxTpuEngine(PageRankEngine):
         if (cfg.kernel if cfg.kernel != "auto" else "ell") not in ("ell", "pallas"):
             raise ValueError("build_device supports the ell/pallas kernels only")
         group = getattr(dg, "group", 1)
-        if cfg.kernel == "pallas" and group > 1:
+        stripe_size = getattr(dg, "stripe_size", 0)
+        if cfg.kernel == "pallas" and (group > 1 or stripe_size):
             raise ValueError(
-                "kernel='pallas' needs a group=1 device graph; pass "
-                "group=1 to build_ell_device"
+                "kernel='pallas' needs a group=1 single-stripe device "
+                "graph; pass group=1, stripe_size=0 to build_ell_device"
             )
-        if dg.n_padded > self._stripe_max():
+        sz = stripe_size or dg.n_padded
+        if sz > self._stripe_max():
             import sys
 
             print(
-                f"pagerank_tpu: device-built graph has n_padded="
-                f"{dg.n_padded} > {self._stripe_max()} — the on-device "
-                "pack is single-stripe, so the gather runs outside the "
-                "fast regime (~3.5x slower SpMV); use the host build "
-                "(striped) for graphs this large",
+                f"pagerank_tpu: device-built graph has stripe span "
+                f"{sz} > {self._stripe_max()} — the gather runs outside "
+                "the fast regime (~4x slower SpMV); rebuild with "
+                f"stripe_size<={self._stripe_max()}",
                 file=sys.stderr,
             )
 
@@ -171,6 +172,7 @@ class JaxTpuEngine(PageRankEngine):
             jnp.concatenate([jnp.ones(n, bool), zpad]),
             n=n, n_state=dg.n_padded, num_blocks=dg.num_blocks,
             inv_out_rel=inv_out_rel, group=group,
+            stripe_size=stripe_size or None,
         )
         # The slot arrays are donated to the engine: _setup_ell derives
         # its sentinel-ized copies, and keeping the originals referenced
@@ -214,7 +216,7 @@ class JaxTpuEngine(PageRankEngine):
             group = 1 if kernel == "pallas" else cfg.lane_group
             if n_padded > stripe_max:
                 pack = ell_lib.ell_pack_striped(
-                    graph, stripe_size=stripe_max, group=group
+                    graph, stripe_size=self._stripe_target(), group=group
                 )
                 srcs, weights, rbs = pack.src, pack.weight, pack.row_block
                 stripe_size = pack.stripe_size
@@ -281,30 +283,124 @@ class JaxTpuEngine(PageRankEngine):
 
     GATHER_WIDTH = 8  # minimum; _gather_width widens for large tables
 
+    @staticmethod
+    def stripe_limits(z_item: int, pair: bool):
+        """(stripe_max, stripe_target) for a gather table of ``z_item``
+        bytes/lane (pair tables carry 2x lanes/row).
+
+        stripe_max: largest vertex range worth keeping in ONE stripe — a
+        ~33MB f32 gather table (8.4M vertices). Gather throughput
+        degrades with table bytes (0.345 Gslot/s at 8MB -> 0.29 at 33MB
+        on v5e) then cliffs ~2x at 67MB (spills XLA's working set), at
+        which point striping wins despite its padding cost. Measured at
+        R-MAT scale 23/25: single stripe beats 4.2M stripes below this
+        bound, loses above it.
+
+        stripe_target: span to use once striping IS needed — half the
+        bound (~16MB f32 table, 4.2M vertices). At R-MAT scale 25, 4.2M
+        stripes beat 8.4M (2.09e8 vs 1.64e8 edges/s/chip) and 2.1M
+        stripes OOM from per-stripe row padding.
+
+        Shared by the engine and bench.py so the two can't diverge."""
+        lanes = 32 if pair else 256 // z_item
+        smax = lanes * (1 << 17)
+        return smax, max(128, (smax // 2) // 128 * 128)
+
     def _stripe_max(self) -> int:
-        """Largest per-stripe vertex range that keeps the gather table in
-        the fast regime (<= 2**17 rows of <= 512B): 128 f32 lanes for the
-        plain table, 64 for pair-packed (2x lanes/row) or native-f64
-        (8B lanes) tables."""
         z_item = max(
             self._dtype.itemsize,
             self._accum_dtype.itemsize if not self._pair else 4,
         )
-        lanes = 64 if self._pair else 512 // z_item
-        return lanes * (1 << 17)
+        return self.stripe_limits(z_item, self._pair)[0]
+
+    def _stripe_target(self) -> int:
+        z_item = max(
+            self._dtype.itemsize,
+            self._accum_dtype.itemsize if not self._pair else 4,
+        )
+        return self.stripe_limits(z_item, self._pair)[1]
 
     @staticmethod
     def _gather_width(n_state: int, max_width: int = 128) -> int:
         """XLA's fast TPU gather regime (measured on v5e, see
         scripts/probe_gather.py) needs the reshaped (rows, width) table to
         have <= 2**17 rows and <= 512-byte rows; outside it throughput
-        drops ~3.5x. Widen the row until the row count fits, capping at
+        drops ~4x. Widen the row until the row count fits, capping at
         ``max_width`` lanes (128 f32 lanes = 512B for the plain table; 64
         for the pair-packed table whose rows carry 2x lanes)."""
         width = 8
         while width < max_width and n_state // width > (1 << 17):
             width *= 2
         return width
+
+    def _autotune_chunk(self, cands, stripe_rows_dev, sz, z_item, gw, group,
+                        pair, accum, num_blocks, ndev):
+        """Pick the scan chunk for the ELL gather by TIMING the candidate
+        chunks on the largest stripe's real slot arrays.
+
+        Rationale (measured on v5e): below ~16MB of gather table the
+        chunk barely matters (mild preference for larger chunks), so the
+        LARGEST candidate is returned untimed. Above it, XLA's
+        fusion/working-set behavior flips the
+        winner between geometries in ways static rules mispredict (33MB
+        intermediates win at sz=8.4M/gw64 but lose at sz=4.2M/gw32 with
+        group=64), so ~seconds of build-time timing buys back minutes of
+        iteration time. Runs only on the single-device mesh (the
+        multi-device case times under shard_map semantics the probe
+        can't cheaply reproduce) and on TPU backends."""
+        cands = [c for c in cands if c <= max(stripe_rows_dev)]
+        if not cands:
+            return 256
+        if sz * z_item < (1 << 24) or len(cands) < 2:
+            # Small tables are chunk-insensitive with a mild preference
+            # for larger chunks (fewer scan steps) — measured 96 vs 98
+            # ms/iter at R-MAT scale 21.
+            return cands[-1]
+        if ndev != 1 or jax.default_backend() != "tpu":
+            # Can't time representatively: take the ~33MB-intermediate
+            # candidate, the safe default for big tables.
+            return cands[0]
+        import functools
+        import time as _time
+
+        s_big = int(np.argmax(stripe_rows_dev))
+        src_a, rb_a = self._src[s_big], self._row_block[s_big]
+        rows = stripe_rows_dev[s_big]
+        if pair:
+            z_args = (
+                jnp.ones(sz + gw, jnp.float32),
+                jnp.zeros(sz + gw, jnp.float32),
+            )
+            op = functools.partial(
+                spmv.ell_contrib_pair, accum_dtype=accum, gather_width=gw,
+                group=group,
+            )
+        else:
+            z_args = (jnp.ones(sz + gw, jnp.dtype(f"float{z_item * 8}")),)
+            op = functools.partial(
+                spmv.ell_contrib, accum_dtype=accum, gather_width=gw,
+                group=group,
+            )
+        best, best_t = cands[0], None
+        for c in cands:
+            if rows % c:
+                continue
+            fn = jax.jit(functools.partial(
+                op, num_blocks=num_blocks, chunk_rows=c
+            ))
+            try:
+                out = fn(*z_args, src_a, rb_a)
+                jax.device_get(jnp.sum(out))  # compile + settle
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    out = fn(*z_args, src_a, rb_a)
+                jax.device_get(jnp.sum(out))
+                dt = (_time.perf_counter() - t0) / 3
+            except Exception:  # OOM or lowering issue: skip candidate
+                continue
+            if best_t is None or dt < best_t:
+                best, best_t = c, dt
+        return best
 
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
                    valid, *, n, n_state, num_blocks, inv_out_rel,
@@ -364,14 +460,28 @@ class JaxTpuEngine(PageRankEngine):
         shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
         e_shard = mesh_lib.edge_sharding(mesh)
 
-        # Chunk the gather so its (slots, gw) intermediate keeps a
-        # constant footprint at every width; pad each stripe's rows so
-        # chunks divide evenly. The pallas kernel instead streams fixed
-        # 256-row chunks (its VMEM scratch is sized by this).
+        # Chunk the gather so its per-chunk intermediates — the (chunk,
+        # 128, gw[, 2]) gather rows and the (chunk, 128, group) grouped-
+        # lane one-hot — stay bounded. Small tables (< ~16MB) are
+        # insensitive to the chunk; large ones interact with XLA's
+        # fusion/working-set heuristics in ways simple rules mispredict
+        # (measured on v5e: at sz=8.4M the ~33MB rule wins 4x, at
+        # sz=4.2M with group=64 a 67MB one-hot beats the 33MB one), so
+        # for big tables the build TIMES the candidate chunks on the
+        # real arrays and keeps the winner (_autotune_chunk). Rows are
+        # padded to the largest candidate so every candidate divides.
+        # The pallas kernel instead streams fixed 256-row chunks (its
+        # VMEM scratch is sized by this).
         pallas_chunk = 256
-        ell_chunk_cap = max(256, 32768 * 8 // gw)
+        fetch_lanes = gw * (2 if pair else 1)  # pair gathers (hi|lo) rows
+        chunk_cands = sorted({
+            max(256, 8192 * 8 // max(fetch_lanes, group)),
+            max(256, 8192 * 8 // fetch_lanes),
+            max(256, 32768 * 8 // fetch_lanes),
+        })
+        cand_max = chunk_cands[-1]
         xp = np if isinstance(src_slots[0], np.ndarray) else jnp
-        self._src, self._row_block, ell_chunks = [], [], []
+        self._src, self._row_block, stripe_rows_dev = [], [], []
         log2g = group.bit_length() - 1
         for s in range(n_stripes):
             # Inert slots (weight 0) -> per-stripe sentinel index ``sz``
@@ -382,24 +492,33 @@ class JaxTpuEngine(PageRankEngine):
             ss = xp.where(w_slots[s] != 0, src_slots[s], sent)
             rows_s = ss.shape[0]
             rows_per_dev = -(-max(1, rows_s) // ndev)
-            chunk_rows = (
-                pallas_chunk if want_pallas else min(ell_chunk_cap, rows_per_dev)
-            )
+            if want_pallas:
+                chunk_rows = pallas_chunk
+            elif rows_per_dev >= cand_max:
+                chunk_rows = cand_max
+            else:
+                # Round small stripes up to a power of two so every
+                # (power-of-two) chunk candidate divides them.
+                chunk_rows = 1 << (rows_per_dev - 1).bit_length()
             pad_multiple = ndev * chunk_rows
             ss = _pad_rows(ss, pad_multiple, sent, xp)
             rb = _pad_rows(row_block[s], pad_multiple, max(0, num_blocks - 1), xp)
             self._src.append(jax.device_put(ss, shard2d))
             self._row_block.append(jax.device_put(rb, e_shard))
-            # Largest chunk that divides the padded per-device rows (a
-            # pallas fallback keeps the 256-row step so the XLA path
-            # never runs with tiny chunks).
-            rows_padded_dev = ss.shape[0] // ndev
-            step = pallas_chunk if want_pallas else 1
-            c = min(ell_chunk_cap, rows_padded_dev)
-            c -= c % step
-            while c > step and rows_padded_dev % c:
-                c -= step
-            ell_chunks.append(max(c, step))
+            stripe_rows_dev.append(ss.shape[0] // ndev)
+
+        if want_pallas:
+            ell_chunks = [pallas_chunk] * n_stripes
+        else:
+            chosen = self._autotune_chunk(
+                chunk_cands, stripe_rows_dev, sz, z_item, gw, group, pair,
+                accum, num_blocks, ndev,
+            )
+            # Per-stripe: the chosen chunk, clamped to the stripe's
+            # padded per-device rows (short stripes run one chunk;
+            # divisibility holds because padded rows are a multiple of
+            # cand_max or a power of two >= the clamped chunk).
+            ell_chunks = [min(chosen, r) for r in stripe_rows_dev]
 
         inv_out_rel = xp.asarray(inv_out_rel)
         if inv_out_rel.dtype != z_dtype:

@@ -59,18 +59,25 @@ class DeviceEllGraph:
     n: int
     n_padded: int
     num_blocks: int
-    src: jax.Array  # int32 [rows, 128] relabeled source per slot; packed (src << log2(group)) | lane_sub when group > 1
-    weight: jax.Array  # f32 [rows, 128], 0 for padding/duplicate slots
-    row_block: jax.Array  # int32 [rows], ascending dst-block id
+    # Striped form (stripe_size set): src/weight/row_block are LISTS of
+    # per-stripe arrays with STRIPE-LOCAL source ids, mirroring
+    # ops/ell.py:StripedEllPack. Single-stripe: bare arrays, ids span
+    # n_padded.
+    src: object  # int32 [rows, 128] (or list) source per slot; packed (src << log2(group)) | lane_sub when group > 1
+    weight: object  # f32 [rows, 128] (or list), 0 for padding/duplicate slots
+    row_block: object  # int32 [rows] (or list), ascending dst-block id
     perm: jax.Array  # int32 [n] relabeled -> original
     dangling_mask: jax.Array  # bool [n] ORIGINAL id space
     zero_in_mask: jax.Array  # bool [n] ORIGINAL id space
     out_degree: jax.Array  # int32 [n] ORIGINAL id space (unique targets)
     num_edges: int  # unique edge count
     group: int = 1  # lane-group size (ops/ell.py grouped-lane layout)
+    stripe_size: int = 0  # 0 = single stripe spanning n_padded
 
     @property
     def num_rows(self) -> int:
+        if isinstance(self.src, (list, tuple)):
+            return int(sum(s.shape[0] for s in self.src))
         return int(self.src.shape[0])
 
 
@@ -113,13 +120,16 @@ def rmat_edges_device(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0, 1))
 def _sort_dedup_degrees(src, dst, n):
     """Sort edges by (dst, src), mark duplicates, compute unique-edge
-    degrees. Returns (src_s, dst_s, unique, out_degree, in_degree)."""
-    order = jnp.lexsort((src, dst))
-    src_s = src[order]
-    dst_s = dst[order]
+    degrees. Returns (src_s, dst_s, unique, out_degree, in_degree).
+
+    Uses a multi-key lax.sort (no argsort payload indices, no int64
+    keys) and donates the raw edge arrays — at 500M+ edges every 4-byte
+    per-edge temporary is 2GB+ of HBM, and the build's peak live set is
+    what bounds single-chip graph capacity."""
+    dst_s, src_s = jax.lax.sort((dst, src), num_keys=2)
     same = (src_s[1:] == src_s[:-1]) & (dst_s[1:] == dst_s[:-1])
     unique = jnp.concatenate([jnp.ones(1, bool), ~same])
     uniq_i = unique.astype(jnp.int32)
@@ -130,45 +140,76 @@ def _sort_dedup_degrees(src, dst, n):
     return src_s, dst_s, unique, out_degree, in_degree
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7))
-def _relabel_and_rows(src_s, dst_s, unique, out_degree, in_degree, n_padded,
-                      weight_dtype=jnp.float32, group=1):
-    """In-degree-descending relabel + per-edge ELL slot coordinates.
+@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0, 1, 2))
+def _relabel_resort(src_s, dst_s, unique, in_degree, n_padded, stripe_size):
+    """In-degree-descending relabel + re-sort by (stripe, new dst, new
+    src). Returns (sb_dst, new_src, perm): ``sb_dst`` is the composite
+    int32 key stripe * n_padded + relabeled_dst (decodable, so the big
+    dst/stripe arrays aren't carried twice).
 
-    Returns (new_src, new_dst_sorted order arrays...) — everything needed
-    to scatter slots once rows_total is known on host."""
-    n = out_degree.shape[0]
+    The dedup flags are NOT carried through the sort (a payload operand
+    would cost another per-edge array through the sort's double buffer);
+    duplicates stay adjacent under the new total order, so the caller
+    recomputes them from key adjacency."""
+    del unique  # recomputed post-sort from key adjacency (see docstring)
+    n = in_degree.shape[0]
     order = jnp.argsort(-in_degree.astype(jnp.int64), stable=True)
     perm = order.astype(jnp.int32)  # relabeled -> original
     inv_perm = jnp.zeros(n, jnp.int32).at[perm].set(
         jnp.arange(n, dtype=jnp.int32)
     )
-
     new_dst = inv_perm[dst_s]
     new_src = inv_perm[src_s]
-    # Re-sort by relabeled dst (stable keeps src-ascending order within a
-    # dst, matching the host packer's slot order).
-    order2 = jnp.argsort(new_dst, stable=True)
-    new_dst = new_dst[order2]
-    new_src = new_src[order2]
-    unique2 = unique[order2]
+    sz = stripe_size or n_padded
+    n_stripes = -(-n_padded // sz)
+    if n_stripes > 1:
+        # Composite int32 key; build_ell_device guards the range.
+        sb_dst = (new_src // sz) * n_padded + new_dst
+    else:
+        sb_dst = new_dst
+    sb_dst, new_src = jax.lax.sort((sb_dst, new_src), num_keys=2)
+    return sb_dst, new_src, perm
 
-    # Weight = 1/out_degree[src] on unique slots, 0 on duplicate slots.
-    # out_degree is indexed by ORIGINAL id — use the pre-relabel src ids.
-    inv_out = graph_lib.inv_out_degree(out_degree, jnp, dtype=weight_dtype)
-    w = jnp.where(unique2, inv_out[src_s[order2]], 0.0).astype(weight_dtype)
 
-    # Slot rank k = position within the slot's LANE GROUP run (group=1:
-    # k-th in-edge of its dst), counting duplicates too (the host packer
-    # indexes depth over the deduped edge list; duplicates here occupy a
-    # slot with weight 0 — harmless, slightly deeper blocks). new_dst is
-    # sorted, so first-index-of-group is the running max of run-start
+@functools.partial(
+    jax.jit, static_argnums=(3, 4, 5, 6), donate_argnums=(0, 1)
+)
+def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
+                 group, stripe_size):
+    """Per-edge ELL slot coordinates from the (stripe, dst, src)-sorted
+    composite key. Returns everything needed to scatter slots once
+    rows_total is known on host. With striping, the row space is keyed
+    by (stripe, block): stripe s owns the contiguous row range
+    [row_offset[s*num_blocks], row_offset[(s+1)*num_blocks]) and slot
+    words hold STRIPE-LOCAL source ids (ops/ell.py:StripedEllPack)."""
+    sz = stripe_size or n_padded
+    n_stripes = -(-n_padded // sz)
+    new_dst = sb_dst % n_padded if n_stripes > 1 else sb_dst
+    stripe_of = sb_dst // n_padded if n_stripes > 1 else None
+
+    # Duplicate edges are adjacent under the (stripe, dst, src) order;
+    # re-derive first-occurrence flags here (see _relabel_resort).
+    unique2 = jnp.concatenate(
+        [jnp.ones(1, bool),
+         (sb_dst[1:] != sb_dst[:-1]) | (new_src[1:] != new_src[:-1])]
+    )
+    # Weight = 1/out_degree[src] on unique slots, 0 on duplicate slots
+    # (they occupy a slot that contributes nothing — the static-shape
+    # alternative to compacting; see module docstring).
+    inv_out = graph_lib.inv_out_degree(
+        out_degree_rel, jnp, dtype=weight_dtype
+    )
+    w = jnp.where(unique2, inv_out[new_src], 0.0).astype(weight_dtype)
+
+    # Slot rank k = position within the slot's (stripe, LANE GROUP) run
+    # (group=1: k-th in-edge of its dst within the stripe). Runs are
+    # contiguous, so first-index-of-run is the running max of run-start
     # positions — one cummax scan, not a searchsorted (33M binary
     # searches = ~840M random gathers, ~25s on a v5e).
     log2g = group.bit_length() - 1
     e = new_dst.shape[0]
     idx = jnp.arange(e, dtype=jnp.int32)
-    grp = new_dst >> log2g
+    grp = sb_dst >> log2g  # composite key keeps (stripe, group) distinct
     is_start = jnp.concatenate([jnp.ones(1, bool), grp[1:] != grp[:-1]])
     first = jax.lax.cummax(jnp.where(is_start, idx, 0))
     k = idx - first
@@ -176,37 +217,50 @@ def _relabel_and_rows(src_s, dst_s, unique, out_degree, in_degree, n_padded,
     # Slot position within the 128-lane row: the lane group's band of
     # ``group`` positions, then k's phase within the group (ops/ell.py
     # grouped-lane layout; group=1 reduces to pos = lane).
-    pos = ((new_dst % LANES) >> log2g) * group + (k & (group - 1))
-    word = new_src if group == 1 else (
-        (new_src << log2g) | (new_dst & (group - 1))
+    pos = (
+        ((new_dst % LANES) >> log2g) * group + (k & (group - 1))
+    ).astype(jnp.int8)
+    local_src = (
+        new_src - stripe_of * sz if n_stripes > 1 else new_src
+    )
+    word = local_src if group == 1 else (
+        (local_src << log2g) | (new_dst & (group - 1))
     )
 
-    # Rows per 128-dst block = max rows any of its lane groups uses (for
-    # exact parity with the host packer: segment_max of actual use).
-    block = new_dst // LANES
+    # Rows per (stripe, 128-dst block) = max rows any of its lane groups
+    # uses (for exact parity with the host packer: segment_max of actual
+    # use).
     num_blocks = n_padded // LANES
-    block_rows = jax.ops.segment_max(
-        row + 1, block, num_segments=num_blocks, indices_are_sorted=True
+    sb = (
+        stripe_of * num_blocks + new_dst // LANES
+        if n_stripes > 1 else new_dst // LANES
     )
-    block_rows = jnp.maximum(block_rows, 0)  # empty blocks: segment_max = -inf
+    sb_rows = jax.ops.segment_max(
+        row + 1, sb, num_segments=n_stripes * num_blocks,
+        indices_are_sorted=True,
+    )
+    sb_rows = jnp.maximum(sb_rows, 0)  # empty blocks: segment_max = -inf
     row_offset = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jnp.cumsum(block_rows).astype(jnp.int32)]
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(sb_rows).astype(jnp.int32)]
     )
-    row_idx = row_offset[block] + row
-    mass_mask = out_degree == 0
-    zero_in = in_degree == 0
-    return word, w, row_idx, pos, block_rows, row_offset, perm, mass_mask, zero_in
+    row_idx = row_offset[sb] + row
+    return word, w, row_idx, pos, sb_rows, row_offset
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6))
-def _scatter_slots(new_src, w, row_idx, lane, block_rows, rows_total, num_blocks):
+@functools.partial(
+    jax.jit, static_argnums=(5, 6, 7), donate_argnums=(0, 1, 2, 3)
+)
+def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
+                   n_stripes=1):
+    pos = pos.astype(jnp.int32)  # int8 across the phase boundary saves
+    # a per-edge array; JAX indexing needs a type that can hold 128
     src_slots = jnp.zeros((rows_total, LANES), jnp.int32)
     w_slots = jnp.zeros((rows_total, LANES), w.dtype)
-    src_slots = src_slots.at[row_idx, lane].set(new_src, mode="drop")
-    w_slots = w_slots.at[row_idx, lane].set(w, mode="drop")
+    src_slots = src_slots.at[row_idx, pos].set(word, mode="drop")
+    w_slots = w_slots.at[row_idx, pos].set(w, mode="drop")
     row_block = jnp.repeat(
-        jnp.arange(num_blocks, dtype=jnp.int32),
-        block_rows,
+        jnp.tile(jnp.arange(num_blocks, dtype=jnp.int32), n_stripes),
+        sb_rows,
         total_repeat_length=rows_total,
     )
     return src_slots, w_slots, row_block
@@ -214,55 +268,108 @@ def _scatter_slots(new_src, w, row_idx, lane, block_rows, rows_total, num_blocks
 
 def build_ell_device(
     src: jax.Array, dst: jax.Array, n: int, weight_dtype=jnp.float32,
-    group: int = 1,
+    group: int = 1, stripe_size: int = 0,
 ) -> DeviceEllGraph:
     """Full graph build on device from raw (possibly duplicated) edges.
 
-    One scalar (rows_total) crosses device->host to size the slot
-    buffers; everything else stays on device. ``group`` selects the
-    grouped-lane slot layout (ops/ell.py module docstring).
+    One small transfer (per-stripe row offsets) crosses device->host to
+    size the slot buffers; everything else stays on device. ``group``
+    selects the grouped-lane slot layout, ``stripe_size`` (multiple of
+    128) the source-striped layout for graphs whose gather table exceeds
+    the fast regime (ops/ell.py module docstring); 0 = single stripe.
+
+    ``src``/``dst`` are CONSUMED (donated into the build's sorts — at
+    500M+ edges every per-edge buffer matters); don't reuse them after.
+    On backends without donation support this emits a harmless
+    "donated buffers were not usable" warning.
     """
     if group < 1 or group > LANES or (group & (group - 1)):
         raise ValueError(f"group must be a power of two in [1, {LANES}]")
     n_padded = -(-n // LANES) * LANES
-    if group > 1 and (n_padded + 1) * group > np.iinfo(np.int32).max:
+    if stripe_size and (stripe_size <= 0 or stripe_size % LANES):
+        raise ValueError("stripe_size must be a positive multiple of 128")
+    sz = min(stripe_size, n_padded) if stripe_size and n_padded else n_padded
+    if stripe_size and sz < stripe_size:
+        stripe_size = sz  # single short stripe; keep ids consistent
+    if group > 1 and (sz + 1) * group > np.iinfo(np.int32).max:
         raise ValueError(
-            f"grouped slot words overflow int32: n_padded {n_padded} * "
+            f"grouped slot words overflow int32: stripe span {sz} * "
             f"group {group} (reduce group; same guard as ell_pack_striped)"
+        )
+    n_stripes = -(-n_padded // sz) if n_padded else 0
+    if n_stripes > 1 and n_stripes * n_padded > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"striped sort key overflows int32: {n_stripes} stripes * "
+            f"n_padded {n_padded} (graphs this large exceed single-chip "
+            "HBM anyway; use the host build)"
         )
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
-    if src.shape[0] == 0:  # edge-free graph (e.g. comment-only input)
-        num_blocks = n_padded // LANES
-        wdt = jnp.dtype(weight_dtype)
+    num_blocks = n_padded // LANES
+    wdt = jnp.dtype(weight_dtype)
+    if src.shape[0] == 0 or n == 0:  # edge-free graph (comment-only input)
+        empty = (
+            [jnp.zeros((0, LANES), jnp.int32)] * n_stripes
+            if stripe_size else jnp.zeros((0, LANES), jnp.int32)
+        )
+        empty_w = (
+            [jnp.zeros((0, LANES), wdt)] * n_stripes
+            if stripe_size else jnp.zeros((0, LANES), wdt)
+        )
+        empty_rb = (
+            [jnp.zeros(0, jnp.int32)] * n_stripes
+            if stripe_size else jnp.zeros(0, jnp.int32)
+        )
         return DeviceEllGraph(
             n=n, n_padded=n_padded, num_blocks=num_blocks,
-            src=jnp.zeros((0, LANES), jnp.int32),
-            weight=jnp.zeros((0, LANES), wdt),
-            row_block=jnp.zeros(0, jnp.int32),
+            src=empty, weight=empty_w, row_block=empty_rb,
             perm=jnp.arange(n, dtype=jnp.int32),
             dangling_mask=jnp.ones(n, bool),
             zero_in_mask=jnp.ones(n, bool),
             out_degree=jnp.zeros(n, jnp.int32),
-            num_edges=0, group=group,
+            num_edges=0, group=group, stripe_size=stripe_size,
         )
 
     src_s, dst_s, unique, out_degree, in_degree = _sort_dedup_degrees(src, dst, n)
-    (word, w, row_idx, pos, block_rows, row_offset, perm, mass_mask,
-     zero_in) = _relabel_and_rows(
-        src_s, dst_s, unique, out_degree, in_degree, n_padded,
-        jnp.dtype(weight_dtype), group,
-    )
-    num_blocks = n_padded // LANES
-    rows_total = int(jax.device_get(row_offset[-1]))
     num_edges = int(jax.device_get(unique.sum()))
-    src_slots, w_slots, row_block = _scatter_slots(
-        word, w, row_idx, pos, block_rows, rows_total, num_blocks
+    mass_mask = out_degree == 0
+    zero_in = in_degree == 0
+    stripe_arg = sz if n_stripes > 1 else 0
+    sb_dst, new_src, perm = _relabel_resort(
+        src_s, dst_s, unique, in_degree, n_padded, stripe_arg
     )
+    del src_s, dst_s, unique
+    word, w, row_idx, pos, sb_rows, row_offset = _slot_coords(
+        sb_dst, new_src, out_degree[perm], n_padded, wdt, group, stripe_arg
+    )
+    del sb_dst, new_src
+    # Per-stripe row bounds (S + 1 scalars): one small device->host
+    # transfer. row_offset has n_stripes*num_blocks + 1 entries, so the
+    # stride-num_blocks slice lands exactly on stripe starts + the total.
+    stripe_bounds = [int(b) for b in jax.device_get(row_offset[::num_blocks])]
+    rows_total = stripe_bounds[-1]
+    src_slots, w_slots, row_block = _scatter_slots(
+        word, w, row_idx, pos, sb_rows, rows_total, num_blocks, n_stripes
+    )
+    del word, w, row_idx, pos  # donated into the scatter
+    if n_stripes > 1 or stripe_size:
+        # Slice the concatenated buffers into per-stripe arrays (device
+        # copies; the big buffers are dropped right after, so the peak is
+        # transient).
+        srcs, ws, rbs = [], [], []
+        for s in range(n_stripes):
+            lo, hi = stripe_bounds[s], stripe_bounds[s + 1]
+            srcs.append(src_slots[lo:hi])
+            ws.append(w_slots[lo:hi])
+            rbs.append(row_block[lo:hi])
+        del src_slots, w_slots, row_block
+        src_out, w_out, rb_out = srcs, ws, rbs
+    else:
+        src_out, w_out, rb_out = src_slots, w_slots, row_block
     return DeviceEllGraph(
         n=n, n_padded=n_padded, num_blocks=num_blocks,
-        src=src_slots, weight=w_slots, row_block=row_block,
+        src=src_out, weight=w_out, row_block=rb_out,
         perm=perm, dangling_mask=mass_mask, zero_in_mask=zero_in,
         out_degree=out_degree.astype(jnp.int32), num_edges=num_edges,
-        group=group,
+        group=group, stripe_size=stripe_size,
     )

@@ -163,8 +163,11 @@ def ell_pack_striped(
     new_dst = inv_perm[graph.dst].astype(np.int64)
     new_src = inv_perm[graph.src].astype(np.int64)
     stripe_of = new_src // stripe_size
-    # Sort edges by (stripe, dst): within each stripe, dst-major slot order.
-    sort = np.lexsort((new_dst, stripe_of))
+    # Sort edges by (stripe, dst, relabeled src): dst-major slot order
+    # within each stripe, relabeled-src-ascending within a dst (the same
+    # total order the device builder's multi-key sort produces, so the
+    # two packers agree slot-for-slot).
+    sort = np.lexsort((new_src, new_dst, stripe_of))
     new_dst = new_dst[sort]
     new_src = new_src[sort]
     weight = graph.edge_weight[sort]

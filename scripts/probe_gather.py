@@ -83,6 +83,45 @@ def main():
             make_onehot(width), z_d, src_d, w_d, iters=args.iters
         )
 
+    def make_onehot_chunked(width):
+        """The engine's production form: scan over row chunks sized so
+        the (chunk, 128, width) gather intermediate stays ~33MB — beyond
+        that, tables >= ~16MB collapse ~4x (measured on v5e; small
+        tables are insensitive)."""
+        shift = width.bit_length() - 1
+        mask = width - 1
+        chunk = max(256, 8192 * 8 // width)
+
+        @jax.jit
+        def f(z, s, w):
+            zw = z.reshape(-1, width)
+            nc = s.shape[0] // chunk
+
+            def body(acc, args):
+                s_c, w_c = args
+                rows_g = zw[s_c >> shift]
+                sel = jax.nn.one_hot(s_c & mask, width, dtype=z.dtype)
+                return acc + ((rows_g * sel).sum(-1) * w_c).sum(0), None
+
+            acc, _ = jax.lax.scan(
+                body, jnp.zeros(128, z.dtype),
+                (s.reshape(nc, chunk, 128), w.reshape(nc, chunk, 128)),
+            )
+            return acc
+
+        return f
+
+    for width in (8, 16, 32, 64, 128):
+        if rows % max(256, 8192 * 8 // width):
+            results[f"onehot{width}c"] = "SKIP rows not chunk-divisible"
+            continue
+        if (n // width) * width != n:
+            results[f"onehot{width}c"] = "SKIP width does not divide n"
+            continue
+        results[f"onehot{width}c"] = timeit(
+            make_onehot_chunked(width), z_d, src_d, w_d, iters=args.iters
+        )
+
     # MXU form: per slot, one_hot(128) dot the gathered 128-row.
     @jax.jit
     def onehot128mxu(z, s, w):

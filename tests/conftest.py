@@ -3,6 +3,14 @@ pod (SURVEY.md §4 "Distributed without a cluster"), and enable x64 so the
 float64 oracle/accumulation paths are real doubles."""
 
 import os
+import warnings
+
+# The device-build jits donate their per-edge buffers (an HBM-capacity
+# measure on TPU); the CPU backend used for tests lacks donation support
+# and warns every build — pure noise here.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at a TPU
 _flags = os.environ.get("XLA_FLAGS", "")

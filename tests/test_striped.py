@@ -63,6 +63,7 @@ def test_striped_engine_matches_unstriped(monkeypatch, ndev, accum):
     )
     r_plain = JaxTpuEngine(cfg).build(g).run_fast()
     monkeypatch.setattr(JaxTpuEngine, "_stripe_max", lambda self: 256)
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_target", lambda self: 256)
     eng = JaxTpuEngine(cfg).build(g)
     assert len(eng._src) == -(-eng._n_state // 256)
     r_striped = eng.run_fast()
@@ -76,7 +77,8 @@ def test_striped_engine_matches_unstriped(monkeypatch, ndev, accum):
 def test_striped_engine_f64_matches_oracle(monkeypatch):
     rng = np.random.default_rng(3)
     g = _graph(rng)
-    monkeypatch.setattr(JaxTpuEngine, "_stripe_max", lambda self: 384 // 3 * 3)
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_max", lambda self: 384)
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_target", lambda self: 384)
     cfg = PageRankConfig(num_iters=12, dtype="float64", accum_dtype="float64")
     r = JaxTpuEngine(cfg).build(g).run_fast()
     r_cpu = ReferenceCpuEngine(cfg).build(g).run()
