@@ -334,7 +334,7 @@ class JaxTpuEngine(PageRankEngine):
         return width
 
     def _autotune_chunk(self, cands, stripe_rows_dev, sz, z_item, gw, group,
-                        pair, accum, num_blocks, ndev):
+                        pair, accum, num_present, ndev):
         """Pick the scan chunk for the ELL gather by TIMING the candidate
         chunks on the largest stripe's real slot arrays.
 
@@ -366,6 +366,7 @@ class JaxTpuEngine(PageRankEngine):
         s_big = int(np.argmax(stripe_rows_dev))
         src_a, rb_a = self._src[s_big], self._row_block[s_big]
         rows = stripe_rows_dev[s_big]
+        Ps = num_present[s_big]
         if pair:
             z_args = (
                 jnp.ones(sz + gw, jnp.float32),
@@ -373,20 +374,22 @@ class JaxTpuEngine(PageRankEngine):
             )
             op = functools.partial(
                 spmv.ell_contrib_pair, accum_dtype=accum, gather_width=gw,
-                group=group,
+                group=group, num_present=Ps,
             )
         else:
             z_args = (jnp.ones(sz + gw, jnp.dtype(f"float{z_item * 8}")),)
             op = functools.partial(
                 spmv.ell_contrib, accum_dtype=accum, gather_width=gw,
-                group=group,
+                group=group, num_present=Ps,
             )
         best, best_t = cands[0], None
         for c in cands:
             if rows % c:
                 continue
+            # num_blocks is unused by the ops in compact (num_present)
+            # mode; pass Ps for shape sanity.
             fn = jax.jit(functools.partial(
-                op, num_blocks=num_blocks, chunk_rows=c
+                op, num_blocks=Ps, chunk_rows=c
             ))
             try:
                 out = fn(*z_args, src_a, rb_a)
@@ -482,6 +485,8 @@ class JaxTpuEngine(PageRankEngine):
         cand_max = chunk_cands[-1]
         xp = np if isinstance(src_slots[0], np.ndarray) else jnp
         self._src, self._row_block, stripe_rows_dev = [], [], []
+        present_ids, num_present, prefix_flags = [], [], []
+        rep = mesh_lib.replicated(mesh)
         log2g = group.bit_length() - 1
         for s in range(n_stripes):
             # Inert slots (weight 0) -> per-stripe sentinel index ``sz``
@@ -491,6 +496,46 @@ class JaxTpuEngine(PageRankEngine):
             sent = np.int32(sz << log2g)
             ss = xp.where(w_slots[s] != 0, src_slots[s], sent)
             rows_s = ss.shape[0]
+            rb = row_block[s]
+            if want_pallas:
+                # The pallas kernel consumes GLOBAL block ids (it does
+                # its own slab RMW against the full output). The ids
+                # placeholder keeps the contrib-arg shape for the
+                # probe-failure fallback to the non-slab ell path.
+                ids = jnp.zeros(1, jnp.int32)
+                pcount, prefix = num_blocks, True
+            else:
+                # Dense block RANKS per stripe: the slab-scan accumulator
+                # (ops/spmv.py:_chunked_block_sum) needs gap-free ids so
+                # a chunk's rank span is bounded by its row count; the
+                # compact (pcount, 128) result is expanded to blocks
+                # below.
+                if xp is np:
+                    # rb is ascending by packer invariant
+                    # (tests/test_ell.py::test_pack_invariants), so dense
+                    # ranks come from run starts — no O(n log n) unique.
+                    starts = (
+                        np.concatenate([[True], rb[1:] != rb[:-1]])
+                        if len(rb) else np.zeros(0, bool)
+                    )
+                    ids = rb[starts]
+                    rb = (np.cumsum(starts) - 1).astype(np.int32)
+                    pcount = max(1, len(ids))
+                    prefix = bool(
+                        len(ids) == ids[-1] + 1 if len(ids) else True
+                    )
+                    if len(ids) == 0:
+                        ids = np.array([num_blocks - 1], np.int32)
+                else:
+                    present = jnp.zeros(num_blocks, bool).at[rb].set(True)
+                    pcount = max(1, int(present.sum()))
+                    rank_of = (jnp.cumsum(present) - 1).astype(jnp.int32)
+                    rb = rank_of[rb]
+                    ids = jnp.nonzero(
+                        present, size=pcount, fill_value=num_blocks - 1
+                    )[0].astype(jnp.int32)
+                    prefix = bool(jax.device_get(ids[-1]) == pcount - 1)
+                ids = jax.device_put(jnp.asarray(ids), rep)
             rows_per_dev = -(-max(1, rows_s) // ndev)
             if want_pallas:
                 chunk_rows = pallas_chunk
@@ -502,17 +547,25 @@ class JaxTpuEngine(PageRankEngine):
                 chunk_rows = 1 << (rows_per_dev - 1).bit_length()
             pad_multiple = ndev * chunk_rows
             ss = _pad_rows(ss, pad_multiple, sent, xp)
-            rb = _pad_rows(row_block[s], pad_multiple, max(0, num_blocks - 1), xp)
+            pad_id = max(0, (num_blocks if want_pallas else pcount) - 1)
+            rb = _pad_rows(rb, pad_multiple, pad_id, xp)
             self._src.append(jax.device_put(ss, shard2d))
             self._row_block.append(jax.device_put(rb, e_shard))
             stripe_rows_dev.append(ss.shape[0] // ndev)
+            present_ids.append(ids)
+            num_present.append(pcount)
+            prefix_flags.append(prefix)
 
+        # Whether the placed arrays follow the slab contract (dense
+        # ranks); pallas-built arrays keep global ids, and the probe
+        # fallback below must run them non-slab.
+        arrays_slab = not want_pallas
         if want_pallas:
             ell_chunks = [pallas_chunk] * n_stripes
         else:
             chosen = self._autotune_chunk(
                 chunk_cands, stripe_rows_dev, sz, z_item, gw, group, pair,
-                accum, num_blocks, ndev,
+                accum, num_present, ndev,
             )
             # Per-stripe: the chosen chunk, clamped to the stripe's
             # padded per-device rows (short stripes run one chunk;
@@ -549,7 +602,7 @@ class JaxTpuEngine(PageRankEngine):
                     zs, rest = args[:nz], args[nz:]
                     total = None
                     for s in range(n_stripes):
-                        src, rb = rest[2 * s], rest[2 * s + 1]
+                        src, rb, ids = rest[3 * s : 3 * s + 3]
                         z_s = [
                             jnp.concatenate(
                                 [z[s * sz : (s + 1) * sz],
@@ -557,22 +610,46 @@ class JaxTpuEngine(PageRankEngine):
                             )
                             for z in zs
                         ]
+                        # Arrays built for the pallas kernel carry GLOBAL
+                        # block ids (slab's dense-rank contract doesn't
+                        # hold) — the probe-failure fallback runs them in
+                        # full non-slab mode.
+                        Ps = num_present[s] if arrays_slab else None
                         if pair:
                             part = spmv.ell_contrib_pair(
                                 z_s[0], z_s[1], src, rb, num_blocks,
                                 accum_dtype=accum, gather_width=gw,
                                 chunk_rows=ell_chunks[s], group=group,
+                                num_present=Ps,
                             )
                         else:
                             part = spmv.ell_contrib(
                                 z_s[0], src, rb, num_blocks,
                                 accum_dtype=accum, gather_width=gw,
                                 chunk_rows=ell_chunks[s], group=group,
+                                num_present=Ps,
                             )
-                        total = part if total is None else total + part
-                    return jax.lax.psum(total, axis)
+                        # Expand the compact (Ps, 128) sums to global
+                        # blocks: a static-slice add when the stripe's
+                        # present blocks are the prefix 0..Ps-1 (always
+                        # true single-stripe, usually for hub stripes),
+                        # a sorted-unique scatter-add otherwise.
+                        width = Ps if Ps is not None else num_blocks
+                        p2 = part.reshape(width, 128)
+                        if total is None:
+                            total = jnp.zeros((num_blocks, 128), p2.dtype)
+                        if Ps is None or prefix_flags[s]:
+                            total = total.at[:width].add(p2)
+                        else:
+                            total = total.at[ids].add(
+                                p2, indices_are_sorted=True,
+                                unique_indices=True,
+                            )
+                    return jax.lax.psum(total.reshape(-1), axis)
 
-                in_specs = (P(),) * nz + (P(axis, None), P(axis)) * n_stripes
+                in_specs = (P(),) * nz + (
+                    P(axis, None), P(axis), P()
+                ) * n_stripes
 
             return shard_map(
                 sharded_contrib,
@@ -676,8 +753,8 @@ class JaxTpuEngine(PageRankEngine):
             contrib_args = (self._src[0], self._row_block[0])
         else:
             contrib_args = tuple(
-                a for pair_sr in zip(self._src, self._row_block)
-                for a in pair_sr
+                a for triple in zip(self._src, self._row_block, present_ids)
+                for a in triple
             )
         self._finalize(
             contrib_fn, contrib_args,

@@ -59,17 +59,33 @@ def _group_scatter(v, sub, group, acc):
     return (v4[..., None].astype(acc) * sel).sum(2).reshape(c, lanes)
 
 
-def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows):
-    """Run ``chunk_sum(src_chunk, row_block_chunk)`` over slot rows in
-    ``chunk_rows``-sized chunks via lax.scan, summing the per-block
-    results. Bounds the gather intermediate each chunk materializes.
+def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows,
+                       num_segments, slab):
+    """Run ``chunk_sum(src_chunk, segment_ids_chunk, n_seg)`` over slot
+    rows in ``chunk_rows``-sized chunks via lax.scan, accumulating the
+    per-segment results. Bounds the gather intermediate each chunk
+    materializes.
 
-    The scan carry is seeded from chunk 0 (not zeros) so that under
-    shard_map the carry is device-varying like the body output.
+    Two accumulation modes:
+      - slab=False: each chunk segment-sums into the FULL
+        (num_segments, ...) output and the scan adds them. Simple, but
+        the carry traffic is num_segments*128*itemsize bytes per chunk —
+        ruinous for big graphs (134MB/chunk at 33M vertices).
+      - slab=True: ``row_block`` must be DENSE ranks (gap-free ascending
+        per stripe; ops/ell.py packers + the engine provide this), so a
+        chunk of R rows touches <= R consecutive ranks. Each chunk
+        segment-sums LOCALLY (ids - ids[0], chunk_rows segments) and
+        read-modify-writes a chunk-sized slab of the carry at its first
+        rank — carry traffic per chunk drops to the slab (1MB at
+        chunk=2048), independent of graph size. The carry has
+        ``chunk_rows`` slack rows so the final slab never clamps.
+
+    The scan carry is seeded from chunk 0 (not plain zeros) so that
+    under shard_map the carry is device-varying like the body output.
     """
     n_rows = src_slots.shape[0]
     if chunk_rows is None or chunk_rows >= n_rows:
-        return chunk_sum(src_slots, row_block)
+        return chunk_sum(src_slots, row_block, num_segments)
     if n_rows % chunk_rows:
         raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
     nc = n_rows // chunk_rows
@@ -77,19 +93,48 @@ def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows):
     src_c = src_slots.reshape(nc, chunk_rows, 128)
     rb_c = row_block.reshape(nc, chunk_rows)
 
+    if not slab:
+        def body(y2, args):
+            s_c, r_c = args
+            return y2 + chunk_sum(s_c, r_c, num_segments), None
+
+        y2, _ = jax.lax.scan(
+            body,
+            chunk_sum(src_c[0], rb_c[0], num_segments),
+            (src_c[1:], rb_c[1:]),
+        )
+        return y2
+
+    def slab_add(y2, s_c, r_c):
+        r0 = r_c[0]
+        part = chunk_sum(s_c, r_c - r0, chunk_rows)
+        # All start indices must share one dtype (x64 would promote
+        # literal zeros to int64 against an int32 r0).
+        zero = jnp.zeros((), r0.dtype)
+        start = (r0,) + (zero,) * (part.ndim - 1)
+        cur = jax.lax.dynamic_slice(y2, start, part.shape)
+        return jax.lax.dynamic_update_slice(y2, cur + part, start)
+
+    probe = jax.eval_shape(
+        lambda s, r: chunk_sum(s, r, chunk_rows), src_c[0], rb_c[0]
+    )
+    zeros = jnp.zeros(
+        (num_segments + chunk_rows,) + probe.shape[1:], probe.dtype
+    )
+
     def body(y2, args):
-        return y2 + chunk_sum(*args), None
+        return slab_add(y2, *args), None
 
     y2, _ = jax.lax.scan(
         body,
-        chunk_sum(src_c[0], rb_c[0]),
+        slab_add(zeros, src_c[0], rb_c[0]),
         (src_c[1:], rb_c[1:]),
     )
-    return y2
+    return y2[:num_segments]
 
 
 def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
-                gather_width=8, chunk_rows=None, group=1):
+                gather_width=8, chunk_rows=None, group=1, num_present=None):
     """contrib = Aᵀ_norm r over blocked-ELL slots (ops/ell.py layout),
     with the row-normalization PRE-SCALED into the rank vector.
 
@@ -123,9 +168,17 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
         otherwise materialize ~8x the slot array in HBM). Must divide the
         row count. None = single chunk.
       group: lane-group size of the packing (static).
+      num_present: static count of DISTINCT blocks with rows. When set,
+        ``row_block`` must hold dense block RANKS (0..num_present-1,
+        gap-free ascending) and the result is the COMPACT
+        [num_present * 128] sums — the slab-scan mode of
+        _chunked_block_sum, whose carry traffic is O(chunk), not
+        O(num_blocks); the caller expands ranks to blocks. None keeps
+        global block ids and a full-width result.
 
     Returns:
-      [num_blocks * 128] contribution sums (relabeled, padded).
+      [num_blocks * 128] contribution sums (relabeled, padded), or
+      [num_present * 128] compact sums when ``num_present`` is set.
     """
     acc = accum_dtype or z_ext.dtype
     zw = z_ext.reshape(-1, gather_width)
@@ -133,7 +186,7 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
     mask = gather_width - 1
     log2g = group.bit_length() - 1
 
-    def chunk_sum(src_c, rb_c):
+    def chunk_sum(src_c, rb_c, nseg):
         if group > 1:
             sub = src_c & (group - 1)
             src_c = src_c >> log2g
@@ -143,17 +196,18 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
         if group > 1:
             v = _group_scatter(v, sub, group, acc)
         return jax.ops.segment_sum(
-            v, rb_c, num_segments=num_blocks, indices_are_sorted=True
+            v, rb_c, num_segments=nseg, indices_are_sorted=True
         )
 
     return _chunked_block_sum(
-        chunk_sum, src_slots, row_block, chunk_rows
+        chunk_sum, src_slots, row_block, chunk_rows,
+        num_present or num_blocks, slab=num_present is not None,
     ).reshape(-1)
 
 
 def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
                      accum_dtype=None, gather_width=8, chunk_rows=None,
-                     group=1):
+                     group=1, num_present=None):
     """``ell_contrib`` with the pre-scaled rank vector carried as an exact
     f32 (hi, lo) pair and the reduction done in a wide dtype — the fast
     path to f64-grade accuracy on TPU (which has no native f64).
@@ -190,7 +244,7 @@ def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
         [z_hi_ext.reshape(-1, w), z_lo_ext.reshape(-1, w)], axis=1
     )  # (n_pad/w + 1, 2w): hi lanes then lo lanes, sentinel row all-zero
 
-    def chunk_sum(src_c, rb_c):
+    def chunk_sum(src_c, rb_c, nseg):
         if group > 1:
             sub = src_c & (group - 1)
             src_c = src_c >> log2g
@@ -202,11 +256,12 @@ def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
         if group > 1:
             v = _group_scatter(v, sub, group, acc)
         return jax.ops.segment_sum(
-            v, rb_c, num_segments=num_blocks, indices_are_sorted=True
+            v, rb_c, num_segments=nseg, indices_are_sorted=True
         )
 
     return _chunked_block_sum(
-        chunk_sum, src_slots, row_block, chunk_rows
+        chunk_sum, src_slots, row_block, chunk_rows,
+        num_present or num_blocks, slab=num_present is not None,
     ).reshape(-1)
 
 
@@ -239,14 +294,14 @@ def ell_contrib_spmm(z2_ext, src_slots, row_block, num_blocks,
     acc = accum_dtype or z2_ext.dtype
     k = z2_ext.shape[1]
 
-    def chunk_sum(src_c, rb_c):
+    def chunk_sum(src_c, rb_c, nseg):
         v = z2_ext[src_c].astype(acc)  # (chunk, 128, k) row gather
         return jax.ops.segment_sum(
-            v, rb_c, num_segments=num_blocks, indices_are_sorted=True
+            v, rb_c, num_segments=nseg, indices_are_sorted=True
         )
 
     return _chunked_block_sum(
-        chunk_sum, src_slots, row_block, chunk_rows
+        chunk_sum, src_slots, row_block, chunk_rows, num_blocks, slab=False
     ).reshape(num_blocks * 128, k)
 
 

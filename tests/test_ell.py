@@ -168,3 +168,43 @@ def test_grouped_striped_engine_matches_oracle():
     r = SmallStripe(cfg).build(g).run_fast()
     r_ref = ReferenceCpuEngine(cfg).build(g).run()
     np.testing.assert_allclose(r, r_ref, rtol=0, atol=1e-12)
+
+
+def test_autotune_chunk_times_candidates(monkeypatch):
+    # Force the timing branch (normally TPU-only + big-table-only) on
+    # CPU with a tiny graph: it must run the candidate ops and return
+    # one of the candidates.
+    import jax
+
+    g = random_graph(seed=17, n=9000, e=150000)
+    cfg = PageRankConfig(num_iters=2, lane_group=8)
+    eng = JaxTpuEngine(cfg).build(g)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    rows = int(eng._src[0].shape[0])
+    assert rows >= 512  # candidates must survive the rows filter
+    P = int(np.asarray(eng._row_block[0]).max()) + 1
+    chosen = eng._autotune_chunk(
+        [256, 512], [rows], 1 << 23, 4, 8, 8, False, "float32", [P], 1
+    )
+    assert chosen in (256, 512)
+
+
+def test_pallas_probe_failure_falls_back_to_ell(monkeypatch):
+    # If Mosaic rejects every pallas gather strategy, the engine reruns
+    # the pallas-built arrays (GLOBAL block ids) through the non-slab
+    # ell path — results must still match the oracle.
+    from pagerank_tpu.ops import pallas_spmv
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(pallas_spmv, "ell_contrib_pallas", boom)
+    g = random_graph(seed=19, n=700, e=6000)
+    cfg = PageRankConfig(num_iters=10, kernel="pallas")
+    eng = JaxTpuEngine(cfg).build(g)
+    assert eng._kernel == "ell"
+    r = eng.run_fast()
+    cfg64 = PageRankConfig(num_iters=10, dtype="float64",
+                           accum_dtype="float64")
+    r_ref = ReferenceCpuEngine(cfg64).build(g).run()
+    np.testing.assert_allclose(r, r_ref, rtol=0, atol=1e-4)
