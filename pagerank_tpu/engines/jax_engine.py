@@ -511,21 +511,9 @@ class JaxTpuEngine(PageRankEngine):
                 # compact (pcount, 128) result is expanded to blocks
                 # below.
                 if xp is np:
-                    # rb is ascending by packer invariant
-                    # (tests/test_ell.py::test_pack_invariants), so dense
-                    # ranks come from run starts — no O(n log n) unique.
-                    starts = (
-                        np.concatenate([[True], rb[1:] != rb[:-1]])
-                        if len(rb) else np.zeros(0, bool)
+                    rb, ids, pcount, prefix = ell_lib.dense_block_ranks(
+                        rb, num_blocks
                     )
-                    ids = rb[starts]
-                    rb = (np.cumsum(starts) - 1).astype(np.int32)
-                    pcount = max(1, len(ids))
-                    prefix = bool(
-                        len(ids) == ids[-1] + 1 if len(ids) else True
-                    )
-                    if len(ids) == 0:
-                        ids = np.array([num_blocks - 1], np.int32)
                 else:
                     present = jnp.zeros(num_blocks, bool).at[rb].set(True)
                     pcount = max(1, int(present.sum()))
@@ -630,20 +618,17 @@ class JaxTpuEngine(PageRankEngine):
                                 num_present=Ps,
                             )
                         # Expand the compact (Ps, 128) sums to global
-                        # blocks: a static-slice add when the stripe's
-                        # present blocks are the prefix 0..Ps-1 (always
-                        # true single-stripe, usually for hub stripes),
-                        # a sorted-unique scatter-add otherwise.
+                        # blocks (full-width plain add on the non-slab
+                        # fallback).
                         width = Ps if Ps is not None else num_blocks
                         p2 = part.reshape(width, 128)
                         if total is None:
                             total = jnp.zeros((num_blocks, 128), p2.dtype)
-                        if Ps is None or prefix_flags[s]:
-                            total = total.at[:width].add(p2)
+                        if Ps is None:
+                            total = total + p2
                         else:
-                            total = total.at[ids].add(
-                                p2, indices_are_sorted=True,
-                                unique_indices=True,
+                            total = spmv.scatter_block_sums(
+                                total, p2, ids, prefix_flags[s]
                             )
                     return jax.lax.psum(total.reshape(-1), axis)
 

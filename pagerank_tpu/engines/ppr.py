@@ -141,9 +141,17 @@ class PprJaxEngine:
         rep = mesh_lib.replicated(self._mesh)
 
         srcs, rbs, chunks = [], [], []
+        pres_ids, num_present, prefix_flags = [], [], []
         for s in range(S):
             ss = np.where(pack.weight[s] != 0, pack.src[s], np.int32(sz))
             rows = ss.shape[0]
+            # Dense block ranks for the slab-scan accumulator
+            # (ops/spmv.py:_chunked_block_sum) — the carry matters
+            # k-fold more in the SpMM than the vector path.
+            rb, ids, pcount, prefix = ell_lib.dense_block_ranks(
+                pack.row_block[s], num_blocks
+            )
+            prefix_flags.append(prefix)
             # Chunk per stripe: a short tail stripe pads only to its own
             # ndev*chunk_s, not to the largest stripe's chunk.
             chunk_s = min(self.CHUNK_ROWS, -(-max(rows, 1) // ndev))
@@ -153,12 +161,13 @@ class PprJaxEngine:
                 [ss, np.full((tgt - rows, 128), np.int32(sz), np.int32)]
             )
             rb = np.concatenate(
-                [pack.row_block[s],
-                 np.full(tgt - rows, max(0, num_blocks - 1), np.int32)]
+                [rb, np.full(tgt - rows, pcount - 1, np.int32)]
             )
             srcs.append(jax.device_put(ss, shard2d))
             rbs.append(jax.device_put(rb, e_shard))
             chunks.append(chunk_s)
+            pres_ids.append(jax.device_put(jnp.asarray(ids), rep))
+            num_present.append(pcount)
         pack.src = pack.weight = pack.row_block = []  # free host copies
 
         # Prescale in the widest dtype the solver uses, so per-edge
@@ -174,31 +183,39 @@ class PprJaxEngine:
         )
         valid = np.concatenate([np.ones(n, dtype), np.zeros(pad, dtype)])
         self._valid = jax.device_put(valid, rep)
-        self._slot_args = tuple(a for sr in zip(srcs, rbs) for a in sr)
+        self._slot_args = tuple(
+            a for triple in zip(srcs, rbs, pres_ids) for a in triple
+        )
 
         damping = cfg.damping
         dangling_to = self.dangling_to
         total_z = S * sz
 
         def sharded_contrib(z2, *slots):
+            k = z2.shape[1]
             total = None
             for s in range(S):
-                src_s, rb_s = slots[2 * s], slots[2 * s + 1]
+                src_s, rb_s, ids_s = slots[3 * s : 3 * s + 3]
                 z_s = jnp.concatenate(
                     [z2[s * sz : (s + 1) * sz],
-                     jnp.zeros((1, z2.shape[1]), z2.dtype)]
+                     jnp.zeros((1, k), z2.dtype)]
                 )
+                Ps = num_present[s]
                 part = spmv.ell_contrib_spmm(
                     z_s, src_s, rb_s, num_blocks, accum_dtype=accum,
-                    chunk_rows=chunks[s],
+                    chunk_rows=chunks[s], num_present=Ps,
+                ).reshape(Ps, 128, k)
+                if total is None:
+                    total = jnp.zeros((num_blocks, 128, k), part.dtype)
+                total = spmv.scatter_block_sums(
+                    total, part, ids_s, prefix_flags[s]
                 )
-                total = part if total is None else total + part
-            return jax.lax.psum(total, axis)
+            return jax.lax.psum(total.reshape(num_blocks * 128, k), axis)
 
         contrib_fn = shard_map(
             sharded_contrib,
             mesh=self._mesh,
-            in_specs=(P(),) + (P(axis, None), P(axis)) * S,
+            in_specs=(P(),) + (P(axis, None), P(axis), P()) * S,
             out_specs=P(),
         )
 

@@ -237,6 +237,31 @@ def ell_pack_striped(
     )
 
 
+def dense_block_ranks(row_block: np.ndarray, num_blocks: int):
+    """(ranks, present_ids, num_present, is_prefix) for a SORTED block-id
+    array — the dense-rank inputs of the slab-scan accumulator
+    (ops/spmv.py:_chunked_block_sum).
+
+    ``ranks`` renumbers each distinct block to its 0-based run index
+    (gap-free ascending), ``present_ids`` maps rank -> block id,
+    ``is_prefix`` says the present blocks are exactly 0..num_present-1
+    (letting callers expand with a static-slice add instead of a
+    scatter). Empty input gets one sentinel id so downstream shapes stay
+    non-empty (its sums are all zero)."""
+    rb = row_block
+    starts = (
+        np.concatenate([[True], rb[1:] != rb[:-1]])
+        if len(rb) else np.zeros(0, bool)
+    )
+    ids = rb[starts].astype(np.int32)
+    ranks = (np.cumsum(starts) - 1).astype(np.int32)
+    pcount = max(1, len(ids))
+    prefix = bool(len(ids) == ids[-1] + 1 if len(ids) else True)
+    if len(ids) == 0:
+        ids = np.array([num_blocks - 1], np.int32)
+    return ranks, ids, pcount, prefix
+
+
 def ell_spmv_reference(pack: EllPack, z: np.ndarray) -> np.ndarray:
     """Numpy oracle for the packed SpMV: y[d] = sum over in-edges of
     z[src]*w, in RELABELED space. z and result are length n (relabeled)."""

@@ -266,7 +266,7 @@ def ell_contrib_pair(z_hi_ext, z_lo_ext, src_slots, row_block, num_blocks,
 
 
 def ell_contrib_spmm(z2_ext, src_slots, row_block, num_blocks,
-                     accum_dtype=None, chunk_rows=None):
+                     accum_dtype=None, chunk_rows=None, num_present=None):
     """Batched blocked-ELL contribution (SpMM): k personalized rank
     columns at once (BASELINE.md config 5).
 
@@ -287,9 +287,13 @@ def ell_contrib_spmm(z2_ext, src_slots, row_block, num_blocks,
       num_blocks: static number of 128-lane dst blocks.
       chunk_rows: lax.scan chunking (bounds the (chunk, 128, k) gather
         intermediate); must divide the row count. None = single chunk.
+      num_present: as in :func:`ell_contrib` — dense-rank ``row_block``
+        and a compact [num_present * 128, k] result (the slab carry
+        matters k-fold more here than in the vector path).
 
     Returns:
-      [num_blocks * 128, k] contribution sums in accum_dtype.
+      [num_blocks * 128, k] contribution sums in accum_dtype, or the
+      compact [num_present * 128, k] when ``num_present`` is set.
     """
     acc = accum_dtype or z2_ext.dtype
     k = z2_ext.shape[1]
@@ -301,8 +305,21 @@ def ell_contrib_spmm(z2_ext, src_slots, row_block, num_blocks,
         )
 
     return _chunked_block_sum(
-        chunk_sum, src_slots, row_block, chunk_rows, num_blocks, slab=False
-    ).reshape(num_blocks * 128, k)
+        chunk_sum, src_slots, row_block, chunk_rows,
+        num_present or num_blocks, slab=num_present is not None,
+    ).reshape((num_present or num_blocks) * 128, k)
+
+
+def scatter_block_sums(total, part, ids, is_prefix):
+    """Add compact per-present-block sums ``part`` ([P, 128, ...]) into
+    the global block array ``total`` ([num_blocks, 128, ...]): a static
+    prefix-slice add when the present blocks are 0..P-1, else a
+    sorted-unique scatter-add at ``ids``."""
+    if is_prefix:
+        return total.at[: part.shape[0]].add(part)
+    return total.at[ids].add(
+        part, indices_are_sorted=True, unique_indices=True
+    )
 
 
 def dangling_mass(r, dangling, accum_dtype=None):
