@@ -122,6 +122,7 @@ def main(argv=None):
             src, dst, n=1 << args.scale,
             group=1 if pallas else cfg.lane_group,
             stripe_size=0 if pallas else stripe,
+            with_weights=False,  # presentinel: no per-slot weight plane
         )
         num_edges = dg.num_edges
         engine = JaxTpuEngine(cfg).build_device(dg)

@@ -492,9 +492,14 @@ class JaxTpuEngine(PageRankEngine):
             # Inert slots (weight 0) -> per-stripe sentinel index ``sz``
             # (shifted into the packed-word form when grouped); real
             # slots keep their stripe-local source id. Row padding
-            # (added below) is all-inert.
+            # (added below) is all-inert. presentinel device builds
+            # (with_weights=False) arrive already sentinel-ized with no
+            # weight plane at all.
             sent = np.int32(sz << log2g)
-            ss = xp.where(w_slots[s] != 0, src_slots[s], sent)
+            if w_slots[s] is None:
+                ss = src_slots[s]
+            else:
+                ss = xp.where(w_slots[s] != 0, src_slots[s], sent)
             rows_s = ss.shape[0]
             rb = row_block[s]
             if want_pallas:

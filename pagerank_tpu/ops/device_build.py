@@ -73,6 +73,10 @@ class DeviceEllGraph:
     num_edges: int  # unique edge count
     group: int = 1  # lane-group size (ops/ell.py grouped-lane layout)
     stripe_size: int = 0  # 0 = single stripe spanning n_padded
+    # True: weight is None and slot words are already sentinel-ized
+    # (inert slots hold stripe_span << log2(group)) — built with
+    # with_weights=False, saving two per-slot planes of HBM.
+    presentinel: bool = False
 
     @property
     def num_rows(self) -> int:
@@ -153,7 +157,10 @@ def _relabel_resort(src_s, dst_s, unique, in_degree, n_padded, stripe_size):
     recomputes them from key adjacency."""
     del unique  # recomputed post-sort from key adjacency (see docstring)
     n = in_degree.shape[0]
-    order = jnp.argsort(-in_degree.astype(jnp.int64), stable=True)
+    # in_degree <= num edges < 2^31, so int32 negation cannot overflow
+    # (int64 here would be silently truncated anyway when x64 is off,
+    # with a noisy warning per build).
+    order = jnp.argsort(-in_degree.astype(jnp.int32), stable=True)
     perm = order.astype(jnp.int32)  # relabeled -> original
     inv_perm = jnp.zeros(n, jnp.int32).at[perm].set(
         jnp.arange(n, dtype=jnp.int32)
@@ -172,10 +179,10 @@ def _relabel_resort(src_s, dst_s, unique, in_degree, n_padded, stripe_size):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(3, 4, 5, 6), donate_argnums=(0, 1)
+    jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(0, 1)
 )
 def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
-                 group, stripe_size):
+                 group, stripe_size, with_weights=True):
     """Per-edge ELL slot coordinates from the (stripe, dst, src)-sorted
     composite key. Returns everything needed to scatter slots once
     rows_total is known on host. With striping, the row space is keyed
@@ -193,13 +200,16 @@ def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
         [jnp.ones(1, bool),
          (sb_dst[1:] != sb_dst[:-1]) | (new_src[1:] != new_src[:-1])]
     )
-    # Weight = 1/out_degree[src] on unique slots, 0 on duplicate slots
-    # (they occupy a slot that contributes nothing — the static-shape
-    # alternative to compacting; see module docstring).
-    inv_out = graph_lib.inv_out_degree(
-        out_degree_rel, jnp, dtype=weight_dtype
-    )
-    w = jnp.where(unique2, inv_out[new_src], 0.0).astype(weight_dtype)
+    if with_weights:
+        # Weight = 1/out_degree[src] on unique slots, 0 on duplicate
+        # slots (they occupy a slot that contributes nothing — the
+        # static-shape alternative to compacting; see module docstring).
+        inv_out = graph_lib.inv_out_degree(
+            out_degree_rel, jnp, dtype=weight_dtype
+        )
+        w = jnp.where(unique2, inv_out[new_src], 0.0).astype(weight_dtype)
+    else:
+        w = None
 
     # Slot rank k = position within the slot's (stripe, LANE GROUP) run
     # (group=1: k-th in-edge of its dst within the stripe). Runs are
@@ -244,20 +254,28 @@ def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
         [jnp.zeros(1, jnp.int32), jnp.cumsum(sb_rows).astype(jnp.int32)]
     )
     row_idx = row_offset[sb] + row
+    if not with_weights:
+        # Without a weight plane to mark them inert, duplicate slots are
+        # DROPPED at scatter instead: route them out of bounds (the
+        # sentinel-initialized buffer keeps their slot inert).
+        row_idx = jnp.where(unique2, row_idx, row_offset[-1] + 1)
     return word, w, row_idx, pos, sb_rows, row_offset
 
 
 @functools.partial(
-    jax.jit, static_argnums=(5, 6, 7), donate_argnums=(0, 1, 2, 3)
+    jax.jit, static_argnums=(5, 6, 7, 8), donate_argnums=(0, 1, 2, 3)
 )
 def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
-                   n_stripes=1):
+                   n_stripes=1, fill=0):
     pos = pos.astype(jnp.int32)  # int8 across the phase boundary saves
     # a per-edge array; JAX indexing needs a type that can hold 128
-    src_slots = jnp.zeros((rows_total, LANES), jnp.int32)
-    w_slots = jnp.zeros((rows_total, LANES), w.dtype)
+    src_slots = jnp.full((rows_total, LANES), jnp.int32(fill))
     src_slots = src_slots.at[row_idx, pos].set(word, mode="drop")
-    w_slots = w_slots.at[row_idx, pos].set(w, mode="drop")
+    if w is not None:
+        w_slots = jnp.zeros((rows_total, LANES), w.dtype)
+        w_slots = w_slots.at[row_idx, pos].set(w, mode="drop")
+    else:
+        w_slots = None
     row_block = jnp.repeat(
         jnp.tile(jnp.arange(num_blocks, dtype=jnp.int32), n_stripes),
         sb_rows,
@@ -268,7 +286,7 @@ def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
 
 def build_ell_device(
     src: jax.Array, dst: jax.Array, n: int, weight_dtype=jnp.float32,
-    group: int = 1, stripe_size: int = 0,
+    group: int = 1, stripe_size: int = 0, with_weights: bool = True,
 ) -> DeviceEllGraph:
     """Full graph build on device from raw (possibly duplicated) edges.
 
@@ -277,6 +295,13 @@ def build_ell_device(
     selects the grouped-lane slot layout, ``stripe_size`` (multiple of
     128) the source-striped layout for graphs whose gather table exceeds
     the fast regime (ops/ell.py module docstring); 0 = single stripe.
+
+    ``with_weights=False`` skips the per-slot weight plane entirely:
+    inert slots (padding, duplicate edges) are written as the engine's
+    sentinel word directly (``presentinel`` graphs), saving two
+    per-slot/per-edge f32 planes of HBM — the difference between a
+    scale-26 build fitting and OOM. The prescaled solver never needs
+    per-slot weights; keep weights only for inspection/parity checks.
 
     ``src``/``dst`` are CONSUMED (donated into the build's sorts — at
     500M+ edges every per-edge buffer matters); don't reuse them after.
@@ -312,10 +337,13 @@ def build_ell_device(
             [jnp.zeros((0, LANES), jnp.int32)] * n_stripes
             if stripe_size else jnp.zeros((0, LANES), jnp.int32)
         )
-        empty_w = (
-            [jnp.zeros((0, LANES), wdt)] * n_stripes
-            if stripe_size else jnp.zeros((0, LANES), wdt)
-        )
+        if with_weights:
+            empty_w = (
+                [jnp.zeros((0, LANES), wdt)] * n_stripes
+                if stripe_size else jnp.zeros((0, LANES), wdt)
+            )
+        else:
+            empty_w = [None] * n_stripes if stripe_size else None
         empty_rb = (
             [jnp.zeros(0, jnp.int32)] * n_stripes
             if stripe_size else jnp.zeros(0, jnp.int32)
@@ -328,6 +356,7 @@ def build_ell_device(
             zero_in_mask=jnp.ones(n, bool),
             out_degree=jnp.zeros(n, jnp.int32),
             num_edges=0, group=group, stripe_size=stripe_size,
+            presentinel=not with_weights,
         )
 
     src_s, dst_s, unique, out_degree, in_degree = _sort_dedup_degrees(src, dst, n)
@@ -340,7 +369,8 @@ def build_ell_device(
     )
     del src_s, dst_s, unique
     word, w, row_idx, pos, sb_rows, row_offset = _slot_coords(
-        sb_dst, new_src, out_degree[perm], n_padded, wdt, group, stripe_arg
+        sb_dst, new_src, out_degree[perm], n_padded, wdt, group, stripe_arg,
+        with_weights,
     )
     del sb_dst, new_src
     # Per-stripe row bounds (S + 1 scalars): one small device->host
@@ -348,21 +378,27 @@ def build_ell_device(
     # stride-num_blocks slice lands exactly on stripe starts + the total.
     stripe_bounds = [int(b) for b in jax.device_get(row_offset[::num_blocks])]
     rows_total = stripe_bounds[-1]
+    log2g = group.bit_length() - 1
+    fill = 0 if with_weights else (sz << log2g)  # engine sentinel word
     src_slots, w_slots, row_block = _scatter_slots(
-        word, w, row_idx, pos, sb_rows, rows_total, num_blocks, n_stripes
+        word, w, row_idx, pos, sb_rows, rows_total, num_blocks, n_stripes,
+        fill,
     )
     del word, w, row_idx, pos  # donated into the scatter
     if n_stripes > 1 or stripe_size:
         # Slice the concatenated buffers into per-stripe arrays (device
-        # copies; the big buffers are dropped right after, so the peak is
-        # transient).
+        # copies; the big buffers are dropped one by one as the slices
+        # replace them, so the peak is transient and per-plane).
         srcs, ws, rbs = [], [], []
         for s in range(n_stripes):
             lo, hi = stripe_bounds[s], stripe_bounds[s + 1]
             srcs.append(src_slots[lo:hi])
-            ws.append(w_slots[lo:hi])
+        del src_slots
+        for s in range(n_stripes):
+            lo, hi = stripe_bounds[s], stripe_bounds[s + 1]
+            ws.append(w_slots[lo:hi] if w_slots is not None else None)
             rbs.append(row_block[lo:hi])
-        del src_slots, w_slots, row_block
+        del w_slots, row_block
         src_out, w_out, rb_out = srcs, ws, rbs
     else:
         src_out, w_out, rb_out = src_slots, w_slots, row_block
@@ -372,4 +408,5 @@ def build_ell_device(
         perm=perm, dangling_mask=mass_mask, zero_in_mask=zero_in,
         out_degree=out_degree.astype(jnp.int32), num_edges=num_edges,
         group=group, stripe_size=stripe_size,
+        presentinel=not with_weights,
     )

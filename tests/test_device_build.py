@@ -180,3 +180,30 @@ def test_striped_device_engine_matches_oracle():
     ref = ReferenceCpuEngine(cfg).build(g)
     ref.run()
     np.testing.assert_allclose(eng.ranks(), ref.ranks(), rtol=0, atol=1e-12)
+
+
+def test_presentinel_build_matches_weighted():
+    # with_weights=False builds (sentinel-ized slot words, no weight
+    # plane) must produce identical PageRank to the weighted build.
+    rng = np.random.default_rng(41)
+    n, e = 800, 7000
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    cfg = PageRankConfig(
+        num_iters=12, dtype="float64", accum_dtype="float64", lane_group=8
+    )
+
+    def run(with_weights, stripe):
+        dg = db.build_ell_device(
+            jax.numpy.asarray(src), jax.numpy.asarray(dst), n=n,
+            group=8, stripe_size=stripe, with_weights=with_weights,
+        )
+        assert dg.presentinel == (not with_weights)
+        eng = JaxTpuEngine(cfg).build_device(dg)
+        eng.run()
+        return eng.ranks()
+
+    for stripe in (0, 256):
+        np.testing.assert_allclose(
+            run(False, stripe), run(True, stripe), rtol=0, atol=0
+        )
