@@ -90,10 +90,13 @@ def main(argv=None):
     if args.host_build:
         span = min(stripe_target if n_padded > fast_cap else n_padded,
                    n_padded)
-    grp = args.lane_group
+    # 0 = auto: resolve like the engine does (64 plain / 16 pair) so the
+    # device-build packer receives a concrete group.
+    grp_req = args.lane_group or (16 if pair else 64)
+    grp = grp_req
     while grp > 1 and (span + 1) * grp > 2**31 - 1:
         grp //= 2
-    if grp != args.lane_group:
+    if grp != grp_req:
         print(f"bench: lane group clamped to {grp} at scale {args.scale}",
               file=sys.stderr)
     cfg = PageRankConfig(

@@ -213,7 +213,10 @@ class JaxTpuEngine(PageRankEngine):
             n_padded = -(-n // 128) * 128
             # The pallas kernel consumes plain source ids; group only on
             # the XLA ell path.
-            group = 1 if kernel == "pallas" else cfg.lane_group
+            group = (
+                1 if kernel == "pallas"
+                else cfg.effective_lane_group(self._pair)
+            )
             if n_padded > stripe_max:
                 pack = ell_lib.ell_pack_striped(
                     graph, stripe_size=self._stripe_target(), group=group

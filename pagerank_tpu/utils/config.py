@@ -46,10 +46,13 @@ class PageRankConfig:
     # Lane-group size for the blocked-ELL layout (ops/ell.py grouped-lane
     # variant): a slot may serve any of ``lane_group`` adjacent dsts,
     # collapsing per-lane ELL padding (20-30% on power-law graphs) to
-    # ~8% at 8 and ~4% at 64 (64 measured fastest end-to-end on v5e;
-    # 128's one-hot cost regresses). Power of two, 1..128; applies to
-    # the ell kernel (pallas packs at group 1).
-    lane_group: int = 8
+    # ~8% at 8 and ~4% at 64. Power of two, 1..128, or 0 = auto: 64 for
+    # plain accumulation, 16 for the pair-packed wide path (both measured
+    # fastest end-to-end on v5e at bench scale — the pair path's
+    # group-redistribution one-hot runs in the wide dtype, so smaller
+    # groups win there; 128's one-hot cost regresses either way).
+    # Applies to the ell kernel (pallas packs at group 1).
+    lane_group: int = 0
 
     # How a 64-bit accum_dtype runs the ELL gather when it is wider than
     # dtype's storage: "pair" = pair-packed f32 (hi, lo) split gather +
@@ -89,9 +92,10 @@ class PageRankConfig:
         if self.wide_accum not in ("auto", "pair", "native"):
             raise ValueError(f"unknown wide_accum mode: {self.wide_accum!r}")
         g = self.lane_group
-        if not (1 <= g <= 128) or (g & (g - 1)):
+        if g != 0 and (not (1 <= g <= 128) or (g & (g - 1))):
             raise ValueError(
-                f"lane_group must be a power of two in [1, 128], got {g}"
+                f"lane_group must be 0 (auto) or a power of two in "
+                f"[1, 128], got {g}"
             )
         import numpy as _np
 
@@ -104,3 +108,11 @@ class PageRankConfig:
 
     def replace(self, **kw) -> "PageRankConfig":
         return dataclasses.replace(self, **kw)
+
+    def effective_lane_group(self, pair: bool) -> int:
+        """Resolve ``lane_group`` (0 = auto) for the chosen accumulation
+        mode: 16 when the pair-packed wide path is active, 64 otherwise
+        (the v5e-measured optima — see the field comment)."""
+        if self.lane_group:
+            return self.lane_group
+        return 16 if pair else 64

@@ -40,3 +40,19 @@ def test_metrics_logger_summary(tmp_path):
     assert s["iters"] == 3
     assert s["edges_per_sec_per_chip"] > 0
     assert len(open(jsonl).readlines()) == 3
+
+
+def test_lane_group_auto_resolution():
+    from pagerank_tpu.utils.config import PageRankConfig
+
+    cfg = PageRankConfig().validate()  # default 0 = auto
+    assert cfg.effective_lane_group(pair=False) == 64
+    assert cfg.effective_lane_group(pair=True) == 16
+    # explicit values pass through untouched
+    assert PageRankConfig(lane_group=8).validate().effective_lane_group(
+        pair=True
+    ) == 8
+    import pytest
+
+    with pytest.raises(ValueError):
+        PageRankConfig(lane_group=3).validate()
