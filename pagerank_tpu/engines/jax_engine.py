@@ -783,8 +783,7 @@ class JaxTpuEngine(PageRankEngine):
         damping = cfg.damping
         semantics = cfg.semantics
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def step_fn(r, dangling, zero_in, valid_m, *c_args):
+        def step_core(r, dangling, zero_in, valid_m, *c_args):
             z = r if prescale is None else prescale(r)
             zs = z if isinstance(z, tuple) else (z,)
             contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
@@ -798,7 +797,9 @@ class JaxTpuEngine(PageRankEngine):
             return r_new, delta, m
 
         self._contrib_args = contrib_args
-        self._step_fn = step_fn
+        self._step_core = step_core
+        self._step_fn = jax.jit(step_core, donate_argnums=(0,))
+        self._fused_cache = {}
 
     # -- iteration --------------------------------------------------------
 
@@ -824,6 +825,47 @@ class JaxTpuEngine(PageRankEngine):
             self.iteration += 1
         if delta is not None:
             jax.device_get(delta)  # honest fence (see module docstring)
+        return self.ranks()
+
+    def run_fused(self, num_iters: Optional[int] = None) -> np.ndarray:
+        """All remaining iterations in ONE device dispatch: a
+        ``lax.scan`` over the step body with the rank buffer donated —
+        the literal realization of SURVEY.md §3.2's mapping ("the entire
+        loop body becomes one jitted function; zero host round-trips").
+
+        Equivalent math to :meth:`run_fast` (the scan body IS
+        ``step_core``); differs only in dispatch: one XLA invocation for
+        the whole hot loop, so per-step dispatch/queueing overhead and
+        remote-backend (tunnel) latency vanish from the run. Snapshots,
+        per-iteration logging and ``tol`` early-stop need host control
+        between steps — use :meth:`PageRankEngine.run` for those.
+        Per-iteration (l1_delta, dangling_mass) traces are kept as device
+        arrays in :attr:`last_run_metrics`.
+        """
+        total = self.config.num_iters if num_iters is None else num_iters
+        k = total - self.iteration
+        if k <= 0:
+            return self.ranks()
+        fused = self._fused_cache.get(k)
+        if fused is None:
+            core = self._step_core
+
+            def fused_fn(r, dangling, zero_in, valid_m, *c_args):
+                def body(rr, _):
+                    r2, delta, m = core(rr, dangling, zero_in, valid_m,
+                                        *c_args)
+                    return r2, (delta, m)
+
+                return jax.lax.scan(body, r, None, length=k)
+
+            fused = jax.jit(fused_fn, donate_argnums=(0,))
+            self._fused_cache[k] = fused
+        self._r, (deltas, masses) = fused(
+            self._r, self._dangling, self._zero_in, self._valid,
+            *self._contrib_args,
+        )
+        self.iteration = total
+        self.last_run_metrics = {"l1_delta": deltas, "dangling_mass": masses}
         return self.ranks()
 
     def fence(self) -> None:

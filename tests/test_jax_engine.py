@@ -84,3 +84,26 @@ def test_set_ranks_resume_midway():
     resumed.set_ranks(snap, iteration=4)
     r = resumed.run()
     np.testing.assert_allclose(r, full, rtol=0, atol=1e-13)
+
+
+def test_run_fused_equals_stepwise():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    cfg = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+    r1 = JaxTpuEngine(cfg).build(graph).run_fast()
+    eng = JaxTpuEngine(cfg).build(graph)
+    r2 = eng.run_fused()
+    # Same math, but the scan body and the standalone step are separate
+    # XLA programs — last-ulp differences are allowed.
+    np.testing.assert_allclose(r1, r2, rtol=0, atol=1e-13)
+    assert eng.iteration == 10
+    # per-iteration traces captured as device arrays
+    m = eng.last_run_metrics
+    assert m["l1_delta"].shape == (10,)
+    assert m["dangling_mass"].shape == (10,)
+    # resuming mid-way fuses only the remainder
+    eng2 = JaxTpuEngine(cfg).build(graph)
+    eng2.run(num_iters=4)
+    r3 = eng2.run_fused()
+    np.testing.assert_allclose(r3, r1, rtol=0, atol=1e-13)
+    # idempotent once complete
+    np.testing.assert_array_equal(eng.run_fused(), r2)  # no-op: already complete
