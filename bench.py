@@ -33,13 +33,18 @@ NORTH_STAR_EDGES_PER_SEC_PER_CHIP = 1.47e9 * 50 / 60 / 8
 
 def _enable_compile_cache():
     """Persist XLA executables across bench runs — the graph-build and
-    step compiles are ~2 minutes of the wall-clock otherwise."""
+    step compiles are ~2 minutes of the wall-clock otherwise.
+
+    min_compile_time_secs=0: the device build + engine setup issue ~50
+    small jitted ops, each ~0.6s to compile through the remote-compile
+    service but far under the 1s default cache threshold — caching them
+    cuts the warm scale-21 build from ~49s to ~10s (measured v5e)."""
     import jax
 
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as e:  # cache is an optimization, never a requirement
         print(f"bench: compilation cache unavailable ({e})", file=sys.stderr)
 
@@ -53,9 +58,10 @@ def main(argv=None):
     p.add_argument("--dtype", default="float32")
     p.add_argument("--kernel", default="auto",
                    help="auto|ell|pallas|coo (engine kernels)")
-    p.add_argument("--lane-group", type=int, default=64,
-                   help="grouped-lane ELL group size (64 measured best "
-                        "on v5e at bench scale; see ops/ell.py)")
+    p.add_argument("--lane-group", type=int, default=0,
+                   help="grouped-lane ELL group size; 0 = auto (64 plain "
+                        "/ 16 pair, the v5e-measured optima; see "
+                        "ops/ell.py and docs/PERF_NOTES.md)")
     p.add_argument("--stripe-size", type=int, default=0,
                    help="source-stripe span in vertices (0 = auto: "
                         "single stripe up to 8.4M f32 vertices / 4.2M "
@@ -90,9 +96,11 @@ def main(argv=None):
     if args.host_build:
         span = min(stripe_target if n_padded > fast_cap else n_padded,
                    n_padded)
-    # 0 = auto: resolve like the engine does (64 plain / 16 pair) so the
-    # device-build packer receives a concrete group.
-    grp_req = args.lane_group or (16 if pair else 64)
+    # 0 = auto: resolve through the engine's own table so the optima
+    # live in one place. bench targets the TPU backend, where
+    # wide_accum="auto" always resolves to pair for 64-bit dtypes —
+    # hence the itemsize predicate above.
+    grp_req = args.lane_group or PageRankConfig().effective_lane_group(pair)
     grp = grp_req
     while grp > 1 and (span + 1) * grp > 2**31 - 1:
         grp //= 2
