@@ -55,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
         "power-law graphs)",
     )
     p.add_argument("--tol", type=float, default=None, help="L1 early-stop (default: none)")
+    p.add_argument(
+        "--fused", action="store_true",
+        help="run the whole iteration loop as ONE device dispatch "
+        "(JaxTpuEngine.run_fused: a jitted lax.scan over the step; "
+        "per-iteration metrics come from on-device traces and wall-clock "
+        "is averaged). jax engine only; incompatible with --tol, "
+        "--snapshot-dir and --dump-text-dir, which need host control "
+        "between iterations",
+    )
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument(
         "--snapshot-every",
@@ -259,6 +268,27 @@ def load_graph(args):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.fused:
+        # Pure-args validation BEFORE the (potentially minutes-long)
+        # graph load and engine build.
+        bad = [
+            flag for flag, on in (
+                ("--tol", args.tol is not None),
+                ("--snapshot-dir", args.snapshot_dir is not None),
+                ("--dump-text-dir", args.dump_text_dir is not None),
+                ("--ppr-sources", bool(args.ppr_sources)),
+            ) if on
+        ]
+        if bad:
+            print(
+                f"--fused runs the loop in one device dispatch; "
+                f"{', '.join(bad)} need host control between iterations",
+                file=sys.stderr,
+            )
+            return 2
+        if args.engine != "jax":
+            print("--fused requires --engine jax", file=sys.stderr)
+            return 2
     t0 = time.perf_counter()
     graph, ids = load_graph(args)
     t_load = time.perf_counter() - t0
@@ -327,7 +357,26 @@ def main(argv=None) -> int:
         jax.profiler.start_trace(args.profile_dir)
         profiling = True
     try:
-        ranks = engine.run(on_iteration=on_iteration)
+        if args.fused:
+            import jax
+
+            first = engine.iteration
+            engine.prepare_fused()  # compile outside the timed region
+            t_run = time.perf_counter()
+            ranks = engine.run_fused()
+            total = time.perf_counter() - t_run
+            tr = engine.last_run_metrics
+            deltas = np.asarray(jax.device_get(tr["l1_delta"]))
+            masses = np.asarray(jax.device_get(tr["dangling_mass"]))
+            k = max(1, len(deltas))
+            for i in range(len(deltas)):
+                metrics.record(
+                    first + i,
+                    {"l1_delta": deltas[i], "dangling_mass": masses[i]},
+                    total / k,
+                )
+        else:
+            ranks = engine.run(on_iteration=on_iteration)
     finally:
         if profiling:
             import jax

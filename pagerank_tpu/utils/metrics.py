@@ -37,6 +37,13 @@ class MetricsLogger:
         now = time.perf_counter()
         dt = now - self._t_last
         self._t_last = now
+        self.record(iteration, info, dt)
+
+    def record(self, iteration: int, info: Dict[str, float],
+               dt: float) -> None:
+        """Log one iteration with explicit wall-clock ``dt`` — for fused
+        runs where per-iteration timing is an average of one device
+        dispatch (JaxTpuEngine.run_fused) rather than measured per call."""
         rec = {
             "iter": iteration,
             "seconds": dt,

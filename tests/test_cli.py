@@ -175,3 +175,35 @@ def test_cli_ppr_topk_clamped_message(edges_file, capsys):
     assert rc == 0
     err = capsys.readouterr().err
     assert "top-40" in err  # clamped to n=40, and reported as such
+
+
+def test_cli_fused_matches_stepwise(tmp_path, edges_file):
+    path, _, _ = edges_file
+
+    out1 = tmp_path / "r1.tsv"
+    out2 = tmp_path / "r2.tsv"
+    jsonl = tmp_path / "m.jsonl"
+    assert main(["--input", path, "--iters", "8",
+                 "--out", str(out1), "--log-every", "0"]) == 0
+    assert main(["--input", path, "--iters", "8", "--fused",
+                 "--out", str(out2), "--jsonl", str(jsonl),
+                 "--log-every", "0"]) == 0
+    r1 = {l.split("\t")[0]: float(l.split("\t")[1]) for l in open(out1)}
+    r2 = {l.split("\t")[0]: float(l.split("\t")[1]) for l in open(out2)}
+    assert r1.keys() == r2.keys()
+    for k in r1:
+        assert abs(r1[k] - r2[k]) < 1e-5
+    # per-iteration traces landed in the JSONL
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert len(recs) == 8 and all("l1_delta" in r for r in recs)
+
+
+def test_cli_fused_rejects_host_control_flags(tmp_path, edges_file):
+    path, _, _ = edges_file
+
+    assert main(["--input", path, "--fused",
+                 "--tol", "1e-6"]) == 2
+    assert main(["--input", path, "--fused",
+                 "--snapshot-dir", str(tmp_path / "s")]) == 2
+    assert main(["--input", path, "--fused",
+                 "--engine", "cpu"]) == 2

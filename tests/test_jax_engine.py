@@ -107,3 +107,13 @@ def test_run_fused_equals_stepwise():
     np.testing.assert_allclose(r3, r1, rtol=0, atol=1e-13)
     # idempotent once complete
     np.testing.assert_array_equal(eng.run_fused(), r2)  # no-op: already complete
+
+
+def test_run_fused_zero_iters():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    cfg = PageRankConfig(num_iters=0, dtype="float64", accum_dtype="float64")
+    eng = JaxTpuEngine(cfg).build(graph)
+    assert eng.prepare_fused() == 0
+    r = eng.run_fused()
+    assert r.shape == (graph.n,)
+    assert eng.last_run_metrics["l1_delta"].shape == (0,)
