@@ -30,16 +30,23 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native PageRank (reference or textbook semantics).",
     )
     src = p.add_mutually_exclusive_group(required=True)
-    src.add_argument("--input", help="edge list (.txt/.tsv), binary .npz, or crawl TSV")
+    src.add_argument(
+        "--input",
+        help="edge list (.txt/.tsv), binary .npz, crawl TSV, or Hadoop "
+        "SequenceFile(s) of (Text url, Text json) — a file, a segment "
+        "directory, or a comma-joined list (the reference's input form, "
+        "Sparky.java:42-61)",
+    )
     src.add_argument(
         "--synthetic",
         help="synthetic graph, e.g. rmat:20 (scale) or uniform:1000000:16000000 (n:e)",
     )
     p.add_argument(
         "--format",
-        choices=["auto", "edgelist", "npz", "crawl"],
+        choices=["auto", "edgelist", "npz", "crawl", "seqfile"],
         default="auto",
-        help="input format (auto: by extension, .tsv with non-integer columns => crawl)",
+        help="input format (auto: by extension/magic — 'SEQ' magic => "
+        "seqfile, .tsv with non-integer columns => crawl)",
     )
     p.add_argument("--iters", type=int, default=10, help="iterations (reference: 10)")
     p.add_argument("--damping", type=float, default=0.85)
@@ -241,7 +248,27 @@ def load_graph(args):
     fmt = args.format
     path = args.input
     if fmt == "auto":
-        if path.endswith(".npz"):
+        import os as _os
+
+        from pagerank_tpu.ingest.seqfile import expand_seqfile_paths
+
+        probe = path
+        if _os.path.isdir(path) or ("," in path and not _os.path.exists(path)):
+            # Comma-joined lists / segment dirs only make sense for
+            # SequenceFile segments (the reference's input form); probe
+            # the first file's magic. A plain file whose NAME contains a
+            # comma is still a plain file.
+            probe = expand_seqfile_paths(path)[0]
+        with open(probe, "rb") as fb:
+            magic = fb.read(4)
+        if magic[:3] == b"SEQ":
+            fmt = "seqfile"
+        elif probe != path:
+            raise SystemExit(
+                f"{path}: directory / comma-list inputs are for Hadoop "
+                f"SequenceFile segments, but {probe} has no SEQ magic"
+            )
+        elif path.endswith(".npz"):
             fmt = "npz"
         else:
             with open(path, "r", errors="replace") as f:
@@ -254,6 +281,11 @@ def load_graph(args):
                 if len(tokens) == 2 and all(t.lstrip("-").isdigit() for t in tokens)
                 else "crawl"
             )
+    if fmt == "seqfile":
+        from pagerank_tpu.ingest import load_crawl_seqfile
+
+        graph, ids = load_crawl_seqfile(path, strict=args.strict_parse)
+        return graph, ids
     if fmt == "crawl":
         from pagerank_tpu.ingest import load_crawl_file
 

@@ -805,10 +805,7 @@ class JaxTpuEngine(PageRankEngine):
 
     def _device_step(self):
         """One iteration; returns (delta, mass) as device scalars."""
-        self._r, delta, m = self._step_fn(
-            self._r, self._dangling, self._zero_in, self._valid,
-            *self._contrib_args,
-        )
+        self._r, delta, m = self._step_fn(*self._device_args())
         return delta, m
 
     def step(self) -> Dict[str, float]:
@@ -845,16 +842,16 @@ class JaxTpuEngine(PageRankEngine):
         total = self.config.num_iters if num_iters is None else num_iters
         k = total - self.iteration
         if k <= 0:
-            self.last_run_metrics = {
-                "l1_delta": np.zeros(0, self._accum_dtype),
-                "dangling_mass": np.zeros(0, self._accum_dtype),
-            }
+            if not hasattr(self, "last_run_metrics"):
+                # Nothing ever ran: empty traces (a completed prior
+                # run's traces are kept — repeat calls are no-ops).
+                self.last_run_metrics = {
+                    "l1_delta": np.zeros(0, self._accum_dtype),
+                    "dangling_mass": np.zeros(0, self._accum_dtype),
+                }
             return self.ranks()
         fused = self._get_fused(k)
-        self._r, (deltas, masses) = fused(
-            self._r, self._dangling, self._zero_in, self._valid,
-            *self._contrib_args,
-        )
+        self._r, (deltas, masses) = fused(*self._device_args())
         self.iteration = total
         self.last_run_metrics = {"l1_delta": deltas, "dangling_mass": masses}
         return self.ranks()
@@ -886,11 +883,16 @@ class JaxTpuEngine(PageRankEngine):
                 return jax.lax.scan(body, r, None, length=k)
 
             fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
-                self._r, self._dangling, self._zero_in, self._valid,
-                *self._contrib_args,
+                *self._device_args()
             ).compile()
             self._fused_cache[k] = fused
         return fused
+
+    def _device_args(self):
+        """The step/fused argument tuple — ONE spelling so the
+        AOT-lowered signature and the dispatch call cannot drift."""
+        return (self._r, self._dangling, self._zero_in, self._valid,
+                *self._contrib_args)
 
     def fence(self) -> None:
         """Block until all queued steps actually finished on device."""

@@ -5,6 +5,11 @@ from pagerank_tpu.ingest.edgelist import (
     save_binary_edges,
 )
 from pagerank_tpu.ingest.crawljson import parse_metadata_record, load_crawl_file
+from pagerank_tpu.ingest.seqfile import (
+    load_crawl_seqfile,
+    read_sequence_file,
+    write_sequence_file,
+)
 
 __all__ = [
     "IdMap",
@@ -14,4 +19,7 @@ __all__ = [
     "save_binary_edges",
     "parse_metadata_record",
     "load_crawl_file",
+    "load_crawl_seqfile",
+    "read_sequence_file",
+    "write_sequence_file",
 ]

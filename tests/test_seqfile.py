@@ -1,0 +1,217 @@
+"""Hadoop SequenceFile ingestion (the reference's literal input format,
+Sparky.java:44-61): encoding primitives, roundtrip, parity with the TSV
+crawl path, and CLI autodetection."""
+
+import io
+import json
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from pagerank_tpu.ingest import (
+    load_crawl_file,
+    load_crawl_seqfile,
+    read_sequence_file,
+    write_sequence_file,
+)
+from pagerank_tpu.ingest.seqfile import (
+    TEXT_CLASS,
+    _read_vint,
+    _text_bytes,
+    _write_vint,
+    expand_seqfile_paths,
+)
+
+
+def meta(url, targets):
+    links = [{"type": "a", "href": t} for t in targets]
+    return json.dumps({"url": url, "content": {"links": links}})
+
+
+RECORDS = [
+    ("http://a.example/", meta("http://a.example/", ["http://b.example/",
+                                                     "http://c.example/"])),
+    ("http://b.example/", meta("http://b.example/", ["http://a.example/"])),
+    ("http://c.example/", meta("http://c.example/", [])),  # linkless page
+    ("http://d.example/", meta("http://d.example/", ["http://x.example/"])),
+]
+
+
+def test_vint_roundtrip_hadoop_values():
+    # Hadoop WritableUtils boundary cases, incl. the single-byte range
+    # [-112, 127] and multi-byte positives/negatives.
+    for v in (0, 1, -1, 127, -112, 128, -113, 255, 256, 65535, -65536,
+              2**31 - 1, -(2**31), 2**53):
+        buf = io.BytesIO()
+        _write_vint(buf, v)
+        buf.seek(0)
+        assert _read_vint(buf) == v, v
+
+
+def test_vint_known_hadoop_encodings():
+    # Values Hadoop encodes in one byte are stored verbatim.
+    for v in (0, 5, 127, -100):
+        buf = io.BytesIO()
+        _write_vint(buf, v)
+        assert buf.getvalue() == struct.pack("b", v)
+    # 200 > 127: marker byte -113 (one payload byte), then 0xC8.
+    buf = io.BytesIO()
+    _write_vint(buf, 200)
+    assert buf.getvalue() == bytes([0x8F, 0xC8])
+
+
+def test_roundtrip(tmp_path):
+    p = str(tmp_path / "part-00000")
+    n = write_sequence_file(p, RECORDS, sync_every=2)  # exercise sync escapes
+    assert n == len(RECORDS)
+    assert list(read_sequence_file(p)) == RECORDS
+
+
+def test_graph_matches_tsv_crawl_path(tmp_path):
+    seq = str(tmp_path / "metadata-00000")
+    write_sequence_file(seq, RECORDS)
+    tsv = tmp_path / "crawl.tsv"
+    tsv.write_text("".join(f"{u}\t{m}\n" for u, m in RECORDS))
+
+    g1, ids1 = load_crawl_seqfile(seq)
+    g2, ids2 = load_crawl_file(str(tsv))
+    assert g1.n == g2.n and g1.num_edges == g2.num_edges
+    assert ids1.names == ids2.names
+    np.testing.assert_array_equal(g1.src, g2.src)
+    np.testing.assert_array_equal(g1.dst, g2.dst)
+    np.testing.assert_array_equal(g1.dangling_mask, g2.dangling_mask)
+
+
+def test_segment_directory_and_comma_list(tmp_path):
+    d = tmp_path / "segment"
+    d.mkdir()
+    for i, rec in enumerate(RECORDS):
+        write_sequence_file(str(d / f"metadata-{i:05d}"), [rec])
+    (d / "_SUCCESS").write_text("")  # Hadoop job marker: must be skipped
+    paths = expand_seqfile_paths(str(d))
+    assert len(paths) == len(RECORDS)
+
+    g_dir, _ = load_crawl_seqfile(str(d))
+    g_one, _ = load_crawl_seqfile(
+        ",".join(str(d / f"metadata-{i:05d}") for i in range(len(RECORDS)))
+    )
+    assert g_dir.num_edges == g_one.num_edges
+    assert g_dir.n == g_one.n
+
+
+def test_record_compressed_deflate(tmp_path):
+    # Hand-build a record-compressed (DefaultCodec) file; values are
+    # deflate(serialized Text).
+    p = tmp_path / "deflate.seq"
+    sync = bytes(range(16))
+    with open(p, "wb") as f:
+        f.write(b"SEQ" + bytes([6]))
+        f.write(_text_bytes(TEXT_CLASS))
+        f.write(_text_bytes(TEXT_CLASS))
+        f.write(b"\x01\x00")
+        f.write(_text_bytes("org.apache.hadoop.io.compress.DefaultCodec"))
+        f.write(struct.pack(">i", 0))
+        f.write(sync)
+        k = _text_bytes("http://a/")
+        v = zlib.compress(_text_bytes(meta("http://a/", ["http://b/"])))
+        f.write(struct.pack(">i", len(k) + len(v)))
+        f.write(struct.pack(">i", len(k)))
+        f.write(k)
+        f.write(v)
+    pairs = list(read_sequence_file(str(p)))
+    assert pairs[0][0] == "http://a/"
+    assert "http://b/" in pairs[0][1]
+
+
+@pytest.mark.parametrize(
+    "mutate, err",
+    [
+        (lambda b: b"BAD" + b[3:], "not a SequenceFile"),
+        (lambda b: b[:3] + bytes([4]) + b[4:], "version"),
+        (lambda b: b[:-10], "truncated|EOF"),
+    ],
+)
+def test_malformed_files_rejected(tmp_path, mutate, err):
+    import re
+
+    p = str(tmp_path / "x.seq")
+    write_sequence_file(p, RECORDS)
+    blob = open(p, "rb").read()
+    open(p, "wb").write(mutate(blob))
+    with pytest.raises((ValueError, EOFError)) as ei:
+        list(read_sequence_file(p))
+    assert re.search(err, str(ei.value), re.I)
+
+
+def test_non_text_classes_rejected(tmp_path):
+    p = str(tmp_path / "x.seq")
+    with open(p, "wb") as f:
+        f.write(b"SEQ" + bytes([6]))
+        f.write(_text_bytes("org.apache.hadoop.io.LongWritable"))
+        f.write(_text_bytes(TEXT_CLASS))
+        f.write(b"\x00\x00")
+        f.write(struct.pack(">i", 0))
+        f.write(bytes(16))
+    with pytest.raises(ValueError, match="Text/Text"):
+        list(read_sequence_file(p))
+
+
+def test_cli_seqfile_autodetect(tmp_path):
+    from pagerank_tpu.cli import main
+
+    d = tmp_path / "seg"
+    d.mkdir()
+    write_sequence_file(str(d / "metadata-00000"), RECORDS)
+    out = tmp_path / "r.tsv"
+    rc = main(["--input", str(d), "--iters", "5", "--out", str(out),
+               "--log-every", "0"])
+    assert rc == 0
+    ranks = {l.split("\t")[0]: float(l.split("\t")[1]) for l in open(out)}
+    # Vertex universe: 4 crawled + 1 uncrawled target (x.example).
+    assert len(ranks) == 5 and "http://x.example/" in ranks
+
+    # equivalent run through the TSV path gives identical ranks
+    tsv = tmp_path / "c.tsv"
+    tsv.write_text("".join(f"{u}\t{m}\n" for u, m in RECORDS))
+    out2 = tmp_path / "r2.tsv"
+    assert main(["--input", str(tsv), "--iters", "5", "--out", str(out2),
+                 "--log-every", "0"]) == 0
+    ranks2 = {l.split("\t")[0]: float(l.split("\t")[1]) for l in open(out2)}
+    assert ranks == ranks2
+
+
+def test_cli_comma_in_filename_still_plain_file(tmp_path):
+    from pagerank_tpu.cli import main
+
+    p = tmp_path / "a,b.txt"
+    p.write_text("0 1\n1 0\n")
+    out = tmp_path / "r.tsv"
+    assert main(["--input", str(p), "--iters", "2", "--out", str(out),
+                 "--log-every", "0"]) == 0
+
+
+def test_truncated_length_field_raises_eoferror(tmp_path):
+    p = str(tmp_path / "x.seq")
+    write_sequence_file(p, RECORDS)
+    blob = open(p, "rb").read()
+    # chop mid key-length of the first record: header end = start of
+    # first record; cut 2 bytes into its key-length field
+    # (find the first record by re-reading offsets is overkill — just
+    # binary-search a cut that lands inside a 4-byte field)
+    for cut in range(len(blob) - 7, 60, -1):
+        open(p, "wb").write(blob[:cut])
+        try:
+            list(read_sequence_file(p))
+        except (EOFError, ValueError):
+            continue  # every truncation must raise a documented type
+        except Exception as e:  # pragma: no cover
+            raise AssertionError(f"cut={cut}: undocumented {type(e).__name__}: {e}")
+
+
+def test_segment_dir_skips_subdirectories(tmp_path):
+    d = tmp_path / "seg"
+    (d / "nested").mkdir(parents=True)
+    write_sequence_file(str(d / "metadata-00000"), RECORDS)
+    assert expand_seqfile_paths(str(d)) == [str(d / "metadata-00000")]
