@@ -80,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--resume", action="store_true", help="resume from latest snapshot")
     p.add_argument(
+        "--sync-io", action="store_true",
+        help="write snapshots/text dumps synchronously in the iteration "
+        "loop instead of overlapping the device->host offload with "
+        "compute (AsyncRankWriter)",
+    )
+    p.add_argument(
         "--dump-text-dir",
         default=None,
         help="also write plain-text rank dumps per iteration "
@@ -372,15 +378,42 @@ def main(argv=None) -> int:
             args.dump_text_dir, names=ids.names if ids is not None else None
         )
 
-    def on_iteration(i, info):
-        metrics(i, info)
-        want_snap = snap and args.snapshot_every and (i + 1) % args.snapshot_every == 0
-        if want_snap or dumper is not None:
-            ranks = engine.ranks()  # one device->host fetch for both sinks
+    # Async offload (C17 build target): the iteration loop submits a
+    # device-side rank copy and keeps dispatching; a worker thread does
+    # the device->host transfer + file writes. --sync-io restores the
+    # reference-like per-iteration barrier; the cpu engine's ranks are
+    # already host-side, so it stays synchronous.
+    writer = None
+    can_write = dumper is not None or (snap and args.snapshot_every)
+    if can_write and args.engine == "jax" and not args.sync_io:
+        from pagerank_tpu.utils.snapshot import AsyncRankWriter
+
+        def write_sinks(i, payload):
+            want_snap, ranks = payload
             if want_snap:
                 snap.save(i + 1, ranks)
             if dumper is not None:
                 dumper.dump(i, ranks)
+
+        writer = AsyncRankWriter(
+            lambda p: (p[0], engine.decode_ranks(p[1])), [write_sinks]
+        )
+
+    def on_iteration(i, info):
+        metrics(i, info)
+        want_snap = bool(
+            snap and args.snapshot_every and (i + 1) % args.snapshot_every == 0
+        )
+        if not (want_snap or dumper is not None):
+            return
+        if writer is not None:
+            writer.submit(i, (want_snap, engine.device_ranks()))
+            return
+        ranks = engine.ranks()  # one device->host fetch for both sinks
+        if want_snap:
+            snap.save(i + 1, ranks)
+        if dumper is not None:
+            dumper.dump(i, ranks)
 
     profiling = False
     if args.profile_dir:
@@ -410,6 +443,16 @@ def main(argv=None) -> int:
         else:
             ranks = engine.run(on_iteration=on_iteration)
     finally:
+        # Capture BEFORE any nested try: inside an except handler,
+        # sys.exc_info() would report the just-caught close() error.
+        propagating = sys.exc_info()[0] is not None
+        if writer is not None:
+            try:
+                writer.close()  # flush pending writes; surface failures
+            except Exception:
+                if not propagating:
+                    raise
+                # an engine error is already propagating; don't mask it
         if profiling:
             import jax
 

@@ -899,7 +899,21 @@ class JaxTpuEngine(PageRankEngine):
         jax.device_get(jnp.sum(self._r))
 
     def ranks(self) -> np.ndarray:
-        r = np.asarray(jax.device_get(self._r))[: self.graph.n]
+        return self.decode_ranks(self._r)
+
+    def device_ranks(self):
+        """Device-side copy of the current (padded, relabeled) rank
+        vector. The live buffer is donated to the next step, so callers
+        that overlap offload with compute (utils/snapshot.py:
+        AsyncRankWriter) must hold a copy; pass it to
+        :meth:`decode_ranks` off-thread."""
+        return jnp.copy(self._r)
+
+    def decode_ranks(self, padded) -> np.ndarray:
+        """Fetch a padded relabeled rank vector to host and undo the
+        in-degree relabel. Blocking; safe to call from a worker thread
+        (the transfer releases the GIL)."""
+        r = np.asarray(jax.device_get(padded))[: self.graph.n]
         if self._perm is not None:
             out = np.empty(self.graph.n, dtype=r.dtype)
             out[self._perm] = r

@@ -12,8 +12,10 @@ tests/test_snapshot.py).
 from __future__ import annotations
 
 import os
+import queue
 import re
-from typing import Dict, Optional, Tuple
+import threading
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
@@ -90,6 +92,80 @@ class TextDumper:
                 f.write(f"({key},{float(r)!r})\n")
         os.replace(tmp, path)
         return path
+
+
+class AsyncRankWriter:
+    """Overlap the device->host rank offload and file writes with device
+    compute — C17's TPU-native build target (SURVEY.md §2: "async
+    device→host offload + file write per iteration"), vs the
+    reference's synchronous ``saveAsTextFile`` barrier per iteration
+    (Sparky.java:237).
+
+    The iteration loop calls ``submit(i, payload)`` with a cheap
+    payload — for the JAX engine a *device-side copy* of the rank
+    vector (``engine.device_ranks()``; the live buffer is donated to
+    the next step, so a copy is required) — and keeps dispatching
+    steps. A worker thread runs ``decode(payload)`` (the blocking
+    device->host transfer releases the GIL) and feeds every sink.
+    ``max_pending`` bounds in-flight copies; when the writer falls
+    behind, ``submit`` blocks — snapshots are never dropped. Worker
+    errors surface on the next ``submit`` or on ``close``.
+    """
+
+    def __init__(
+        self,
+        decode: Callable[[object], np.ndarray],
+        sinks: Iterable[Callable[[int, np.ndarray], object]],
+        max_pending: int = 4,
+    ):
+        self._decode = decode
+        self._sinks = list(sinks)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="rank-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                if self._err is not None:
+                    continue  # drain after failure
+                iteration, payload = item
+                ranks = self._decode(payload)
+                for sink in self._sinks:
+                    sink(iteration, ranks)
+            except BaseException as e:  # surfaced to the submitter
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _check(self):
+        if self._err is not None:
+            raise RuntimeError(
+                f"async rank writer failed: {self._err}"
+            ) from self._err
+
+    def submit(self, iteration: int, payload) -> None:
+        self._check()
+        self._q.put((iteration, payload))
+
+    def close(self) -> None:
+        """Flush all pending writes and stop the worker; raises if any
+        write failed."""
+        self._q.put(None)
+        self._thread.join()
+        self._check()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def resume_engine(engine, snap: Snapshotter) -> int:

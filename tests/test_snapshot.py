@@ -91,3 +91,83 @@ def test_resume_refuses_wrong_semantics(tmp_path):
     s2 = Snapshotter(str(tmp_path), g.fingerprint(), "textbook")
     with pytest.raises(ValueError, match="semantics"):
         resume_engine(eng, s2)
+
+
+def test_async_rank_writer_matches_sync(tmp_path):
+    """CLI: async offload (default) writes byte-identical snapshots and
+    text dumps to --sync-io."""
+    import filecmp
+
+    from pagerank_tpu.cli import main
+
+    edges = tmp_path / "e.txt"
+    rng = np.random.default_rng(2)
+    edges.write_text(
+        "".join(f"{s} {d}\n" for s, d in
+                zip(rng.integers(0, 60, 400), rng.integers(0, 60, 400)))
+    )
+    outs = {}
+    for mode, extra in (("async", []), ("sync", ["--sync-io"])):
+        sd = tmp_path / f"snap_{mode}"
+        td = tmp_path / f"dump_{mode}"
+        assert main(["--input", str(edges), "--iters", "6",
+                     "--snapshot-dir", str(sd), "--dump-text-dir", str(td),
+                     "--log-every", "0", *extra]) == 0
+        outs[mode] = (sd, td)
+    sa, ta = outs["async"]; ss, ts = outs["sync"]
+    snaps = sorted(p.name for p in sa.iterdir())
+    assert snaps == sorted(p.name for p in ss.iterdir()) and len(snaps) == 6
+    for name in snaps:
+        za = np.load(sa / name); zs = np.load(ss / name)
+        np.testing.assert_array_equal(za["ranks"], zs["ranks"])
+    for i in range(6):
+        fa = ta / f"PageRank{i}" / "part-00000"
+        fs = ts / f"PageRank{i}" / "part-00000"
+        assert filecmp.cmp(fa, fs, shallow=False), i
+
+
+def test_async_rank_writer_error_propagates():
+    from pagerank_tpu.utils.snapshot import AsyncRankWriter
+
+    def bad_sink(i, ranks):
+        raise IOError("disk full")
+
+    w = AsyncRankWriter(lambda p: np.asarray(p), [bad_sink], max_pending=2)
+    w.submit(0, np.ones(4))
+    with pytest.raises(RuntimeError, match="disk full"):
+        w.close()
+
+
+def test_async_rank_writer_backpressure_and_order(tmp_path):
+    from pagerank_tpu.utils.snapshot import AsyncRankWriter
+
+    seen = []
+    w = AsyncRankWriter(lambda p: p, [lambda i, r: seen.append((i, float(r[0])))],
+                        max_pending=1)
+    for i in range(20):
+        w.submit(i, np.full(2, i, dtype=np.float64))
+    w.close()
+    assert seen == [(i, float(i)) for i in range(20)]
+
+
+def test_cli_async_writer_failure_fails_the_run(tmp_path, monkeypatch):
+    """A write failure surfacing only at close() must fail the CLI, not
+    be swallowed by the cleanup path."""
+    from pagerank_tpu import cli as cli_mod
+    from pagerank_tpu.utils import snapshot as snap_mod
+
+    edges = tmp_path / "e.txt"
+    edges.write_text("0 1\n1 2\n2 0\n")
+
+    real_save = snap_mod.Snapshotter.save
+
+    def failing_save(self, iteration, ranks):
+        if iteration >= 3:
+            raise IOError("disk full")
+        return real_save(self, iteration, ranks)
+
+    monkeypatch.setattr(snap_mod.Snapshotter, "save", failing_save)
+    with pytest.raises(RuntimeError, match="disk full"):
+        cli_mod.main(["--input", str(edges), "--iters", "5",
+                      "--snapshot-dir", str(tmp_path / "s"),
+                      "--log-every", "0"])
