@@ -383,17 +383,19 @@ def main(argv=None) -> int:
     # the device->host transfer + file writes. --sync-io restores the
     # reference-like per-iteration barrier; the cpu engine's ranks are
     # already host-side, so it stays synchronous.
+    def write_sinks(i, payload):
+        # THE single sink path — async and --sync-io runs must stay
+        # byte-identical (tests/test_snapshot.py asserts it).
+        want_snap, ranks = payload
+        if want_snap:
+            snap.save(i + 1, ranks)
+        if dumper is not None:
+            dumper.dump(i, ranks)
+
     writer = None
     can_write = dumper is not None or (snap and args.snapshot_every)
     if can_write and args.engine == "jax" and not args.sync_io:
         from pagerank_tpu.utils.snapshot import AsyncRankWriter
-
-        def write_sinks(i, payload):
-            want_snap, ranks = payload
-            if want_snap:
-                snap.save(i + 1, ranks)
-            if dumper is not None:
-                dumper.dump(i, ranks)
 
         writer = AsyncRankWriter(
             lambda p: (p[0], engine.decode_ranks(p[1])), [write_sinks]
@@ -408,12 +410,9 @@ def main(argv=None) -> int:
             return
         if writer is not None:
             writer.submit(i, (want_snap, engine.device_ranks()))
-            return
-        ranks = engine.ranks()  # one device->host fetch for both sinks
-        if want_snap:
-            snap.save(i + 1, ranks)
-        if dumper is not None:
-            dumper.dump(i, ranks)
+        else:
+            # one device->host fetch for both sinks
+            write_sinks(i, (want_snap, engine.ranks()))
 
     profiling = False
     if args.profile_dir:
@@ -446,17 +445,22 @@ def main(argv=None) -> int:
         # Capture BEFORE any nested try: inside an except handler,
         # sys.exc_info() would report the just-caught close() error.
         propagating = sys.exc_info()[0] is not None
-        if writer is not None:
-            try:
-                writer.close()  # flush pending writes; surface failures
-            except Exception:
-                if not propagating:
-                    raise
-                # an engine error is already propagating; don't mask it
-        if profiling:
-            import jax
+        try:
+            if writer is not None:
+                try:
+                    writer.close()  # flush pending writes; surface failures
+                except Exception:
+                    if not propagating:
+                        raise
+                    # an engine error is already propagating; don't mask it
+        finally:
+            # Always finalize the profiler trace — even when close()
+            # raises, the trace of the failing run is what the user
+            # wants to inspect.
+            if profiling:
+                import jax
 
-            jax.profiler.stop_trace()
+                jax.profiler.stop_trace()
     summary = metrics.summary()
     metrics.close()
     if summary:

@@ -1,0 +1,67 @@
+"""Worker process for the multi-host (DCN) validation test.
+
+Each of two processes fakes 2 local CPU devices, joins a
+``jax.distributed`` cluster through the framework's own init helper
+(parallel/distributed.py — the TPU-native stand-in for the reference's
+Spark cluster manager, SURVEY.md §5), builds the SAME graph host-side,
+and runs the sharded engine over the 4-device GLOBAL mesh. Process 0
+writes the final ranks; the parent test diffs them against a
+single-process run. Run only via tests/test_multihost.py.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+)
+
+
+def main():
+    coordinator, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    # The site plugin in this image pins the platform programmatically;
+    # re-pin to CPU (config beats env).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    from pagerank_tpu.parallel.distributed import (
+        maybe_initialize_distributed,
+        process_info,
+    )
+
+    assert maybe_initialize_distributed(
+        coordinator_address=coordinator, num_processes=2, process_id=pid
+    )
+    idx, count = process_info()
+    assert count == 2 and idx == pid, (idx, count)
+    assert len(jax.devices()) == 4, jax.devices()
+
+    import numpy as np
+
+    from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
+
+    rng = np.random.default_rng(0)  # identical graph in both processes
+    n, e = 400, 4000
+    g = build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+    cfg = PageRankConfig(
+        num_iters=10, dtype="float64", accum_dtype="float64", lane_group=8
+    )
+    eng = JaxTpuEngine(cfg).build(g)
+    assert eng.mesh.devices.size == 4
+    ranks = eng.run_fast()
+    if idx == 0:
+        np.save(out_path, ranks)
+    # All processes must reach teardown together (collectives in flight).
+    jax.effects_barrier()
+
+
+if __name__ == "__main__":
+    main()

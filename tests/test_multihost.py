@@ -1,0 +1,74 @@
+"""Multi-host (DCN) validation: the sharded engine under a REAL
+two-process ``jax.distributed`` runtime (SURVEY.md §5 "Distributed
+communication backend" — the reference's Spark cluster manager + netty
+shuffle, rebuilt as jax.distributed + XLA collectives).
+
+Two worker processes × 2 fake CPU devices each form a 4-device global
+mesh; the final ranks must match a single-process 4-device run of the
+same graph bit-for-bit (the deterministic-reduction guarantee of
+SURVEY.md §4 "Distributed without a cluster", extended across process
+boundaries).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_matches_single_process(tmp_path):
+    # Bounded by the workers' communicate(timeout=240) below.
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    out = str(tmp_path / "ranks.npy")
+    coordinator = f"127.0.0.1:{_free_port()}"
+
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # Workers set their own platform/device-count flags; drop the
+        # conftest's so they don't double-apply.
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, str(pid), out],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240) for p in procs]
+    finally:
+        for p in procs:  # never leak hung workers (coordinator port!)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
+
+    multi = np.load(out)
+
+    # Single-process oracle on an equivalent 4-device mesh (the test
+    # session itself runs with 8 fake devices; cap at 4).
+    from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
+
+    rng = np.random.default_rng(0)
+    n, e = 400, 4000
+    g = build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+    cfg = PageRankConfig(
+        num_iters=10, dtype="float64", accum_dtype="float64", lane_group=8,
+        num_devices=4,
+    )
+    single = JaxTpuEngine(cfg).build(g).run_fast()
+    np.testing.assert_array_equal(multi, single)
