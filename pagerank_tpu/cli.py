@@ -14,6 +14,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -254,12 +255,10 @@ def load_graph(args):
     fmt = args.format
     path = args.input
     if fmt == "auto":
-        import os as _os
-
         from pagerank_tpu.ingest.seqfile import expand_seqfile_paths
 
         probe = path
-        if _os.path.isdir(path) or ("," in path and not _os.path.exists(path)):
+        if os.path.isdir(path) or ("," in path and not os.path.exists(path)):
             # Comma-joined lists / segment dirs only make sense for
             # SequenceFile segments (the reference's input form); probe
             # the first file's magic. A plain file whose NAME contains a

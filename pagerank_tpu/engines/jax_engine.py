@@ -800,6 +800,12 @@ class JaxTpuEngine(PageRankEngine):
         self._step_core = step_core
         self._step_fn = jax.jit(step_core, donate_argnums=(0,))
         self._fused_cache = {}
+        # Per-iteration traces of the most recent run_fused; empty until
+        # one runs (kept across no-op repeat calls).
+        self.last_run_metrics = {
+            "l1_delta": np.zeros(0, self._accum_dtype),
+            "dangling_mass": np.zeros(0, self._accum_dtype),
+        }
 
     # -- iteration --------------------------------------------------------
 
@@ -842,13 +848,7 @@ class JaxTpuEngine(PageRankEngine):
         total = self.config.num_iters if num_iters is None else num_iters
         k = total - self.iteration
         if k <= 0:
-            if not hasattr(self, "last_run_metrics"):
-                # Nothing ever ran: empty traces (a completed prior
-                # run's traces are kept — repeat calls are no-ops).
-                self.last_run_metrics = {
-                    "l1_delta": np.zeros(0, self._accum_dtype),
-                    "dangling_mass": np.zeros(0, self._accum_dtype),
-                }
+            # No-op: a completed prior run's traces are kept.
             return self.ranks()
         fused = self._get_fused(k)
         self._r, (deltas, masses) = fused(*self._device_args())
