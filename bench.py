@@ -100,7 +100,17 @@ def main(argv=None):
     # live in one place. bench targets the TPU backend, where
     # wide_accum="auto" always resolves to pair for 64-bit dtypes —
     # hence the itemsize predicate above.
-    grp_req = args.lane_group or PageRankConfig().effective_lane_group(pair)
+    # "striped" must mirror the layout the chosen build actually packs:
+    # the host path ignores --stripe-size (the engine stripes iff
+    # n_padded > fast_cap), and an explicit span >= n_padded still packs
+    # one stripe.
+    if args.host_build:
+        is_striped = n_padded > fast_cap
+    else:
+        is_striped = bool(stripe) and stripe < n_padded
+    grp_req = args.lane_group or PageRankConfig().effective_lane_group(
+        pair, striped=is_striped
+    )
     grp = grp_req
     while grp > 1 and (span + 1) * grp > 2**31 - 1:
         grp //= 2

@@ -123,7 +123,6 @@ def parse_ppr_sources(spec: str, ids, n: int) -> np.ndarray:
     """--ppr-sources value -> vertex id array. Accepts 'random:K', a
     comma list of ids (or urls when the graph has an id map), or a path
     to a file of one id/url per line."""
-    import os
 
     def resolve(tok: str) -> int:
         tok = tok.strip()

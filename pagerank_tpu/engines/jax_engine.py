@@ -212,10 +212,13 @@ class JaxTpuEngine(PageRankEngine):
             stripe_max = self._stripe_max()
             n_padded = -(-n // 128) * 128
             # The pallas kernel consumes plain source ids; group only on
-            # the XLA ell path.
+            # the XLA ell path. Stripedness is known before packing and
+            # flips the pair-mode optimum (config.effective_lane_group).
             group = (
                 1 if kernel == "pallas"
-                else cfg.effective_lane_group(self._pair)
+                else cfg.effective_lane_group(
+                    self._pair, striped=n_padded > stripe_max
+                )
             )
             if n_padded > stripe_max:
                 pack = ell_lib.ell_pack_striped(

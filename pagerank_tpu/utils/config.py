@@ -109,10 +109,14 @@ class PageRankConfig:
     def replace(self, **kw) -> "PageRankConfig":
         return dataclasses.replace(self, **kw)
 
-    def effective_lane_group(self, pair: bool) -> int:
+    def effective_lane_group(self, pair: bool, striped: bool = False) -> int:
         """Resolve ``lane_group`` (0 = auto) for the chosen accumulation
-        mode: 16 when the pair-packed wide path is active, 64 otherwise
-        (the v5e-measured optima — see the field comment)."""
+        mode and layout: 16 for the pair-packed wide path on a
+        single-stripe layout, 64 otherwise (v5e-measured optima: the
+        pair path's group one-hot runs in the wide dtype, so smaller
+        groups win — UNTIL source striping sparsifies the per-(stripe,
+        block, group) cells and small-group padding dominates: striped
+        pair at R-MAT scale 23 measured 2.5x FASTER at 64 than at 16)."""
         if self.lane_group:
             return self.lane_group
-        return 16 if pair else 64
+        return 16 if (pair and not striped) else 64

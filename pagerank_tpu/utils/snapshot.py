@@ -91,6 +91,11 @@ class TextDumper:
                 key = self.names[i] if self.names is not None else i
                 f.write(f"({key},{float(r)!r})\n")
         os.replace(tmp, path)
+        # Hadoop job-completion marker (saveAsTextFile writes one per
+        # output dir); written LAST so its presence certifies a
+        # complete, untorn dump to downstream Hadoop-convention tooling.
+        with open(os.path.join(d, "_SUCCESS"), "w"):
+            pass
         return path
 
 

@@ -171,3 +171,9 @@ def test_cli_async_writer_failure_fails_the_run(tmp_path, monkeypatch):
         cli_mod.main(["--input", str(edges), "--iters", "5",
                       "--snapshot-dir", str(tmp_path / "s"),
                       "--log-every", "0"])
+
+
+def test_text_dumper_writes_success_marker(tmp_path):
+    d = TextDumper(str(tmp_path))
+    d.dump(0, np.array([1.0, 2.0]))
+    assert (tmp_path / "PageRank0" / "_SUCCESS").exists()

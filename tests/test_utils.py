@@ -48,6 +48,9 @@ def test_lane_group_auto_resolution():
     cfg = PageRankConfig().validate()  # default 0 = auto
     assert cfg.effective_lane_group(pair=False) == 64
     assert cfg.effective_lane_group(pair=True) == 16
+    # striping sparsifies lane groups: pair flips back to 64
+    assert cfg.effective_lane_group(pair=True, striped=True) == 64
+    assert cfg.effective_lane_group(pair=False, striped=True) == 64
     # explicit values pass through untouched
     assert PageRankConfig(lane_group=8).validate().effective_lane_group(
         pair=True
