@@ -51,7 +51,12 @@ def _enable_compile_cache():
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--scale", type=int, default=21, help="R-MAT scale (2^scale vertices)")
+    p.add_argument("--scale", type=int, default=22,
+                   help="R-MAT scale (2^scale vertices). 22 = 4.2M "
+                        "vertices / 65M unique edges, the best-measured "
+                        "single-stripe point (3.52e8 edges/s/chip on "
+                        "v5e-1; scales 21-25 all land 2.0-2.3x the "
+                        "north-star rate, BASELINE.md)")
     p.add_argument("--edge-factor", type=int, default=16)
     p.add_argument("--iters", type=int, default=50)
     p.add_argument("--warmup", type=int, default=3)
