@@ -68,9 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the whole iteration loop as ONE device dispatch "
         "(JaxTpuEngine.run_fused: a jitted lax.scan over the step; "
         "per-iteration metrics come from on-device traces and wall-clock "
-        "is averaged). jax engine only; incompatible with --tol, "
-        "--snapshot-dir and --dump-text-dir, which need host control "
-        "between iterations",
+        "is averaged). With --tol the early stop runs on device too "
+        "(run_fused_tol: lax.while_loop; only the final delta/mass "
+        "exist). jax engine only; incompatible with --snapshot-dir and "
+        "--dump-text-dir, which need host control between iterations",
     )
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument(
@@ -306,10 +307,10 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.fused:
         # Pure-args validation BEFORE the (potentially minutes-long)
-        # graph load and engine build.
+        # graph load and engine build. (--tol IS fused-compatible: the
+        # early stop runs on device via run_fused_tol.)
         bad = [
             flag for flag, on in (
-                ("--tol", args.tol is not None),
                 ("--snapshot-dir", args.snapshot_dir is not None),
                 ("--dump-text-dir", args.dump_text_dir is not None),
                 ("--ppr-sources", bool(args.ppr_sources)),
@@ -423,20 +424,30 @@ def main(argv=None) -> int:
             import jax
 
             first = engine.iteration
-            engine.prepare_fused()  # compile outside the timed region
+            # compile outside the timed region
+            engine.prepare_fused(tol=args.tol)
             t_run = time.perf_counter()
-            ranks = engine.run_fused()
+            if args.tol is not None:
+                # On-device early stop: only the FINAL iteration's
+                # delta/mass exist (dynamic trip count).
+                ranks = engine.run_fused_tol(args.tol)
+            else:
+                ranks = engine.run_fused()
             total = time.perf_counter() - t_run
             tr = engine.last_run_metrics
             deltas = np.asarray(jax.device_get(tr["l1_delta"]))
             masses = np.asarray(jax.device_get(tr["dangling_mass"]))
-            k = max(1, len(deltas))
+            done = engine.iteration - first
             for i in range(len(deltas)):
+                # fixed-length runs: one record per iteration; tol runs:
+                # a single final record at the true average dt.
+                it = first + (done - 1 if args.tol is not None else i)
                 metrics.record(
-                    first + i,
+                    it,
                     {"l1_delta": deltas[i], "dangling_mass": masses[i]},
-                    total / k,
+                    total / max(1, done),
                 )
+            fused_summary = dict(iters=done, total_seconds=total)
         else:
             ranks = engine.run(on_iteration=on_iteration)
     finally:
@@ -459,7 +470,9 @@ def main(argv=None) -> int:
                 import jax
 
                 jax.profiler.stop_trace()
-    summary = metrics.summary()
+    # Fused runs know the true iteration count and wall-clock directly
+    # (the tol form records only the final iteration).
+    summary = metrics.summary(**fused_summary) if args.fused else metrics.summary()
     metrics.close()
     if summary:
         print(

@@ -68,18 +68,36 @@ class MetricsLogger:
             parts.append(f"{rec['edges_per_sec_per_chip']:.3g} edges/s/chip")
             print("  ".join(parts), file=self.stream)
 
-    def summary(self) -> Dict[str, float]:
+    def summary(
+        self,
+        iters: Optional[int] = None,
+        total_seconds: Optional[float] = None,
+    ) -> Dict[str, float]:
+        """Aggregate stats. By default both the iteration count and the
+        wall-clock are inferred from the per-call history; fused tol
+        runs (one record for a dynamic trip count) pass the true
+        ``iters`` and ``total_seconds`` explicitly instead."""
+        if iters is not None:
+            if iters <= 0 or not total_seconds:
+                return {}
+            return {
+                "iters": iters,
+                "mean_iter_seconds": total_seconds / iters,
+                "iters_per_sec": iters / total_seconds,
+                "edges_per_sec_per_chip":
+                    self.num_edges * iters / total_seconds / self.num_chips,
+            }
         if not self.history:
             return {}
         # Skip iteration 0 (compile) when there are enough samples.
         hist = self.history[1:] if len(self.history) > 1 else self.history
         total = sum(h["seconds"] for h in hist)
-        iters = len(hist)
+        n = len(hist)
         return {
             "iters": len(self.history),
-            "mean_iter_seconds": total / iters,
-            "iters_per_sec": iters / total if total > 0 else float("inf"),
-            "edges_per_sec_per_chip": self.num_edges * iters / total / self.num_chips
+            "mean_iter_seconds": total / n,
+            "iters_per_sec": n / total if total > 0 else float("inf"),
+            "edges_per_sec_per_chip": self.num_edges * n / total / self.num_chips
             if total > 0
             else float("inf"),
         }

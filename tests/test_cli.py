@@ -1,6 +1,7 @@
 """CLI end-to-end tests (config/flag subsystem, SURVEY.md §5)."""
 
 import json
+import re
 
 import numpy as np
 import pytest
@@ -202,8 +203,25 @@ def test_cli_fused_rejects_host_control_flags(tmp_path, edges_file):
     path, _, _ = edges_file
 
     assert main(["--input", path, "--fused",
-                 "--tol", "1e-6"]) == 2
-    assert main(["--input", path, "--fused",
                  "--snapshot-dir", str(tmp_path / "s")]) == 2
     assert main(["--input", path, "--fused",
                  "--engine", "cpu"]) == 2
+
+
+def test_cli_fused_with_tol_stops_early(tmp_path, edges_file, capsys):
+    path, _, _ = edges_file
+    out = tmp_path / "r.tsv"
+    jsonl = tmp_path / "m.jsonl"
+    assert main(["--input", path, "--iters", "100", "--fused",
+                 "--tol", "1e-7", "--dtype", "float64",
+                 "--accum-dtype", "float64", "--out", str(out),
+                 "--jsonl", str(jsonl), "--log-every", "0"]) == 0
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert len(recs) == 1  # dynamic trip count -> final record only
+    assert recs[0]["l1_delta"] <= 1e-7
+    assert recs[0]["iter"] < 99  # stopped well before the budget
+    # the summary reports the TRUE iteration count, not len(history)
+    err = capsys.readouterr().err
+    m = re.search(r"done: (\d+) iters", err)
+    assert m, err[-300:]
+    assert 1 < int(m.group(1)) == recs[0]["iter"] + 1

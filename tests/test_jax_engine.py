@@ -117,3 +117,24 @@ def test_run_fused_zero_iters():
     r = eng.run_fused()
     assert r.shape == (graph.n,)
     assert eng.last_run_metrics["l1_delta"].shape == (0,)
+
+
+def test_run_fused_tol_matches_host_early_stop():
+    graph, _ = records_to_graph(TOY_RECORDS)
+    cfg = PageRankConfig(num_iters=50, dtype="float64", accum_dtype="float64",
+                         tol=1e-8)
+    host = JaxTpuEngine(cfg).build(graph)
+    r_host = host.run()  # host-checked early stop
+    fused = JaxTpuEngine(cfg).build(graph)
+    r_fused = fused.run_fused_tol()
+    # Host checks tol AFTER the step it just ran; the device cond checks
+    # BEFORE running another — identical stop iteration.
+    assert fused.iteration == host.iteration
+    np.testing.assert_allclose(r_fused, r_host, rtol=0, atol=1e-13)
+    assert fused.iteration < 50  # actually stopped early
+    assert fused.last_run_metrics["l1_delta"].shape == (1,)
+    assert float(fused.last_run_metrics["l1_delta"][0]) <= 1e-8
+    # budget exhaustion: loose budget, tight tol -> runs out of budget
+    capped = JaxTpuEngine(cfg.replace(num_iters=3, tol=1e-30)).build(graph)
+    capped.run_fused_tol()
+    assert capped.iteration == 3
