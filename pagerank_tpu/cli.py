@@ -438,7 +438,7 @@ def main(argv=None) -> int:
             deltas = np.asarray(jax.device_get(tr["l1_delta"]))
             masses = np.asarray(jax.device_get(tr["dangling_mass"]))
             done = engine.iteration - first
-            for i in range(len(deltas)):
+            for i in range(len(deltas) if done else 0):
                 # fixed-length runs: one record per iteration; tol runs:
                 # a single final record at the true average dt.
                 it = first + (done - 1 if args.tol is not None else i)

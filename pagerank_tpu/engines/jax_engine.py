@@ -302,15 +302,19 @@ class JaxTpuEngine(PageRankEngine):
         R-MAT scale 23/25: single stripe beats 4.2M stripes below this
         bound, loses above it.
 
-        stripe_target: span to use once striping IS needed — half the
-        bound (~16MB f32 table, 4.2M vertices). At R-MAT scale 25, 4.2M
-        stripes beat 8.4M (2.09e8 vs 1.64e8 edges/s/chip) and 2.1M
-        stripes OOM from per-stripe row padding.
+        stripe_target: span to use once striping IS needed. Plain
+        dtypes: half the bound (~16MB f32 table, 4.2M vertices) — at
+        R-MAT scale 25, 4.2M stripes beat 8.4M (2.09e8 vs 1.64e8
+        edges/s/chip) and 2.1M stripes OOM from per-stripe row padding.
+        Pair tables: the FULL bound — pair padding costs more than the
+        bigger table (scale-23 pair measured 1.77e8 at 4.2M-span stripes
+        vs 1.69e8 at 2.1M), so fewer, larger stripes win.
 
         Shared by the engine and bench.py so the two can't diverge."""
         lanes = 32 if pair else 256 // z_item
         smax = lanes * (1 << 17)
-        return smax, max(128, (smax // 2) // 128 * 128)
+        target = smax if pair else smax // 2
+        return smax, max(128, target // 128 * 128)
 
     def _stripe_max(self) -> int:
         z_item = max(
@@ -842,9 +846,10 @@ class JaxTpuEngine(PageRankEngine):
         Equivalent math to :meth:`run_fast` (the scan body IS
         ``step_core``); differs only in dispatch: one XLA invocation for
         the whole hot loop, so per-step dispatch/queueing overhead and
-        remote-backend (tunnel) latency vanish from the run. Snapshots,
-        per-iteration logging and ``tol`` early-stop need host control
-        between steps — use :meth:`PageRankEngine.run` for those.
+        remote-backend (tunnel) latency vanish from the run. Snapshots
+        and per-iteration logging need host control between steps — use
+        :meth:`PageRankEngine.run` for those; ``tol`` early-stopping has
+        its own fused, on-device form (:meth:`run_fused_tol`).
         Per-iteration (l1_delta, dangling_mass) traces are kept as device
         arrays in :attr:`last_run_metrics`.
         """

@@ -87,6 +87,10 @@ class PageRankConfig:
             raise ValueError(f"damping must be in (0,1), got {self.damping}")
         if self.num_iters < 0:
             raise ValueError("num_iters must be >= 0")
+        if self.tol is not None and not (0.0 < self.tol < float("inf")):
+            raise ValueError(
+                f"tol must be a finite positive float, got {self.tol}"
+            )
         if self.kernel not in ("auto", "ell", "coo", "pallas"):
             raise ValueError(f"unknown kernel: {self.kernel!r}")
         if self.wide_accum not in ("auto", "pair", "native"):

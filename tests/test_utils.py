@@ -59,3 +59,16 @@ def test_lane_group_auto_resolution():
 
     with pytest.raises(ValueError):
         PageRankConfig(lane_group=3).validate()
+
+
+def test_tol_validation():
+    import math
+
+    import pytest
+
+    from pagerank_tpu.utils.config import PageRankConfig
+
+    PageRankConfig(tol=1e-6).validate()
+    for bad in (0.0, -1.0, float("inf"), math.nan):
+        with pytest.raises(ValueError, match="tol"):
+            PageRankConfig(tol=bad).validate()
