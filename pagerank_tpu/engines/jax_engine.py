@@ -288,6 +288,14 @@ class JaxTpuEngine(PageRankEngine):
             return self
 
     GATHER_WIDTH = 8  # minimum; _gather_width widens for large tables
+    # Unrolled-stripe program-size budget: the per-stripe contrib code
+    # unrolls into the HLO, and past this many "units" (a pair stripe
+    # counts double — two z planes) the serialized program exceeds the
+    # remote-compile request limit (measured: 8 pair stripes = 16 units
+    # -> HTTP 413; 8 plain stripes = 8 units compile fine). Beyond it
+    # the engine falls back to the scan-over-stripes form (slower
+    # execution, but it runs).
+    SCAN_STRIPE_UNITS = 12
 
     @staticmethod
     def stripe_limits(z_item: int, pair: bool):
@@ -581,6 +589,51 @@ class JaxTpuEngine(PageRankEngine):
             inv_out_rel = inv_out_rel.astype(z_dtype)
         self._inv_out = jax.device_put(inv_out_rel, mesh_lib.replicated(mesh))
 
+        # Very-many-stripe layouts: restack the per-stripe arrays into
+        # ONE [n_stripes, ...] set and run the stripes as a lax.scan.
+        # The unrolled Python loop duplicates the whole chunked-gather
+        # program per stripe and its serialized HLO exceeds
+        # remote-compile request limits around 8 pair stripes (measured:
+        # R-MAT scale-25 f64-pair, HTTP 413) — but the scan body also
+        # knocks XLA off the fast-gather lowering (~3.7x slower
+        # execution, measured at scale 24; see docs/PERF_NOTES.md), so
+        # the scan form is strictly a COMPILE-SIZE fallback: unrolled
+        # whenever it can compile, scan only past the size threshold
+        # (pair stripes carry ~2x the program of plain ones). Uniform
+        # shapes under scan: every stripe pads to the longest stripe's
+        # rows and ONE shared chunk; compact widths unify at
+        # max(num_present); present-block ids pad with ``num_blocks`` —
+        # a dump row sliced off after the scan.
+        scan_stripes = (
+            not want_pallas
+            and n_stripes * (2 if pair else 1) > self.SCAN_STRIPE_UNITS
+        )
+        if scan_stripes:
+            sent = np.int32(sz << log2g)
+            chunk_scan = ell_chunks[int(np.argmax(stripe_rows_dev))]
+            rows_max = max(a.shape[0] for a in self._src)
+            rows_max = -(-rows_max // (ndev * chunk_scan)) * (ndev * chunk_scan)
+            P_max = max(num_present)
+            src_st, rb_st, ids_st = [], [], []
+            for s in range(n_stripes):
+                src_st.append(_pad_rows(self._src[s], rows_max, sent, jnp))
+                pad_id = max(0, num_present[s] - 1)
+                rb_st.append(_pad_rows(self._row_block[s], rows_max, pad_id,
+                                       jnp))
+                ids_st.append(_pad_rows(
+                    present_ids[s], P_max, np.int32(num_blocks), jnp
+                ))
+            self._src = [jax.device_put(
+                jnp.stack(src_st),
+                jax.sharding.NamedSharding(mesh, P(None, axis, None)),
+            )]
+            self._row_block = [jax.device_put(
+                jnp.stack(rb_st),
+                jax.sharding.NamedSharding(mesh, P(None, axis)),
+            )]
+            self._scan_ids = jax.device_put(jnp.stack(ids_st), rep)
+            del src_st, rb_st, ids_st
+
         def make_contrib(mode):
             """mode: 'ell' (XLA path) or a pallas gather strategy name."""
             if mode != "ell":
@@ -598,6 +651,63 @@ class JaxTpuEngine(PageRankEngine):
                     return jax.lax.psum(part, axis)
 
                 in_specs = (P(), P(axis, None), P(axis))
+            elif scan_stripes:
+                nz = 2 if pair else 1
+                chunk_s = chunk_scan
+                P_m = P_max
+
+                def sharded_contrib(*args):
+                    zs, (src_st, rb_st, ids_st) = args[:nz], args[nz:]
+                    # Stripe z slices ride the scan's xs (a STATIC
+                    # [S, sz] reshape) — an in-body dynamic_slice of the
+                    # gather table knocks XLA off the fast-gather
+                    # lowering (measured 3.7x slower at scale 24).
+                    z_rows = tuple(z.reshape(n_stripes, sz) for z in zs)
+
+                    def body(total, stripe):
+                        (*z_r, src, rb2, ids2) = stripe
+                        z_s = [
+                            jnp.concatenate([zr, jnp.zeros(gw, zr.dtype)])
+                            for zr in z_r
+                        ]
+                        if pair:
+                            part = spmv.ell_contrib_pair(
+                                z_s[0], z_s[1], src, rb2, num_blocks,
+                                accum_dtype=accum, gather_width=gw,
+                                chunk_rows=chunk_s, group=group,
+                                num_present=P_m,
+                            )
+                        else:
+                            part = spmv.ell_contrib(
+                                z_s[0], src, rb2, num_blocks,
+                                accum_dtype=accum, gather_width=gw,
+                                chunk_rows=chunk_s, group=group,
+                                num_present=P_m,
+                            )
+                        # ids pad with num_blocks -> the dump row;
+                        # sorted (ascending then constant tail) but NOT
+                        # unique, so no unique_indices here.
+                        total = total.at[ids2].add(
+                            part.reshape(P_m, 128), indices_are_sorted=True
+                        )
+                        return total, None
+
+                    # The carry must be device-varying under shard_map
+                    # (the body output depends on the sharded slots).
+                    total0 = jax.lax.pcast(
+                        jnp.zeros((num_blocks + 1, 128), accum),
+                        axis, to="varying",
+                    )
+                    total, _ = jax.lax.scan(
+                        body, total0, (*z_rows, src_st, rb_st, ids_st)
+                    )
+                    return jax.lax.psum(
+                        total[:num_blocks].reshape(-1), axis
+                    )
+
+                in_specs = (P(),) * nz + (
+                    P(None, axis, None), P(None, axis), P()
+                )
             else:
                 nz = 2 if pair else 1
 
@@ -751,6 +861,10 @@ class JaxTpuEngine(PageRankEngine):
 
         if self._kernel.startswith("pallas"):
             contrib_args = (self._src[0], self._row_block[0])
+        elif scan_stripes:
+            contrib_args = (
+                self._src[0], self._row_block[0], self._scan_ids
+            )
         else:
             contrib_args = tuple(
                 a for triple in zip(self._src, self._row_block, present_ids)

@@ -92,3 +92,29 @@ def test_bad_stripe_size_rejected():
         ell_lib.ell_pack_striped(g, stripe_size=100)  # not multiple of 128
     with pytest.raises(ValueError):
         ell_lib.ell_pack_striped(g, stripe_size=0)
+
+
+@pytest.mark.parametrize("ndev", [1, 2])
+@pytest.mark.parametrize("accum", ["float32", "float64"])
+def test_scan_stripes_fallback_matches_unstriped(monkeypatch, ndev, accum):
+    """The compile-size fallback (stripes stacked and run as a lax.scan,
+    engaged past SCAN_STRIPE_UNITS) must produce the same ranks as the
+    unstriped engine (and, transitively through
+    test_striped_engine_matches_unstriped, the unrolled striped form)."""
+    rng = np.random.default_rng(5)
+    g = _graph(rng)
+    cfg = PageRankConfig(
+        num_iters=10, dtype="float32", accum_dtype=accum,
+        wide_accum="pair", num_devices=ndev,
+    )
+    r_plain = JaxTpuEngine(cfg).build(g).run_fast()
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_max", lambda self: 256)
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_target", lambda self: 256)
+    monkeypatch.setattr(JaxTpuEngine, "SCAN_STRIPE_UNITS", 0)  # force scan
+    eng = JaxTpuEngine(cfg).build(g)
+    # stacked [n_stripes, rows, 128] slots + scan
+    assert len(eng._src) == 1
+    assert eng._src[0].ndim == 3
+    assert eng._src[0].shape[0] == -(-eng._n_state // 256)
+    r_scan = eng.run_fast()
+    np.testing.assert_allclose(r_scan, r_plain, rtol=1e-6, atol=1e-7)
