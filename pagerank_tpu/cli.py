@@ -266,7 +266,12 @@ def load_graph(args):
             probe = expand_seqfile_paths(path)[0]
         with open(probe, "rb") as fb:
             magic = fb.read(4)
-        if magic[:3] == b"SEQ":
+        # Require a binary (non-printable) version byte after 'SEQ' so a
+        # text file that merely *starts* with "SEQ…" falls through to
+        # the text-format detection; real SequenceFiles of any version
+        # (byte < 0x20) still reach the reader and its precise
+        # version/layout errors.
+        if magic[:3] == b"SEQ" and len(magic) == 4 and magic[3] < 0x20:
             fmt = "seqfile"
         elif probe != path:
             raise SystemExit(
@@ -446,6 +451,7 @@ def main(argv=None) -> int:
                     it,
                     {"l1_delta": deltas[i], "dangling_mass": masses[i]},
                     total / max(1, done),
+                    timing="averaged",
                 )
             fused_summary = dict(iters=done, total_seconds=total)
         else:

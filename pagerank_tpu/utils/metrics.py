@@ -40,10 +40,12 @@ class MetricsLogger:
         self.record(iteration, info, dt)
 
     def record(self, iteration: int, info: Dict[str, float],
-               dt: float) -> None:
+               dt: float, timing: Optional[str] = None) -> None:
         """Log one iteration with explicit wall-clock ``dt`` — for fused
         runs where per-iteration timing is an average of one device
-        dispatch (JaxTpuEngine.run_fused) rather than measured per call."""
+        dispatch (JaxTpuEngine.run_fused) rather than measured per call.
+        Pass ``timing="averaged"`` there so JSONL consumers can tell the
+        synthetic per-record seconds from genuinely measured ones."""
         rec = {
             "iter": iteration,
             "seconds": dt,
@@ -52,6 +54,8 @@ class MetricsLogger:
             if dt > 0
             else float("inf"),
         }
+        if timing is not None:
+            rec["timing"] = timing
         for k in ("l1_delta", "dangling_mass"):
             if k in info:
                 rec[k] = float(info[k])
@@ -76,7 +80,13 @@ class MetricsLogger:
         """Aggregate stats. By default both the iteration count and the
         wall-clock are inferred from the per-call history; fused tol
         runs (one record for a dynamic trip count) pass the true
-        ``iters`` and ``total_seconds`` explicitly instead."""
+        ``iters`` and ``total_seconds`` explicitly instead.
+
+        Note the ``iters`` semantics differ by path: the explicit-args
+        (fused) form counts iterations actually executed, while the
+        history-derived (stepwise) form counts records — which includes
+        the compile iteration 0 (its timing is excluded from the means
+        whenever more than one record exists)."""
         if iters is not None:
             if iters <= 0 or not total_seconds:
                 return {}

@@ -114,7 +114,11 @@ class AsyncRankWriter:
     device->host transfer releases the GIL) and feeds every sink.
     ``max_pending`` bounds in-flight copies; when the writer falls
     behind, ``submit`` blocks — snapshots are never dropped. Worker
-    errors surface on the next ``submit`` or on ``close``.
+    errors surface on the next ``submit`` or on ``close``; ``submit``
+    re-checks after enqueueing so a failure that lands during a blocking
+    put aborts immediately, but a sink error can still go unnoticed for
+    up to one iteration (the run keeps computing until the next submit —
+    acceptable for a side-channel sink, never for result correctness).
     """
 
     def __init__(
@@ -158,6 +162,9 @@ class AsyncRankWriter:
     def submit(self, iteration: int, payload) -> None:
         self._check()
         self._q.put((iteration, payload))
+        # Re-check: if the worker failed while the put above blocked on a
+        # full queue, fail now rather than queueing more device copies.
+        self._check()
 
     def close(self) -> None:
         """Flush all pending writes and stop the worker; raises if any

@@ -69,6 +69,21 @@ def test_cli_crawl_autodetect(tmp_path):
     assert "http://a\t" in text and "http://b\t" in text
 
 
+def test_cli_seq_prefixed_text_is_not_seqfile(tmp_path):
+    # A text input whose first bytes happen to be "SEQ" must fall through
+    # to the text-format detection (the binary sniff also requires a
+    # plausible version byte <= 6), not hard-fail in the SequenceFile
+    # reader (ADVICE r1).
+    p = tmp_path / "crawl.tsv"
+    meta = json.dumps({"content": {"links": [{"href": "http://b", "type": "a"}]}})
+    p.write_text(f"SEQ://a\t{meta}\nhttp://b\t{json.dumps({})}\n")
+    out = str(tmp_path / "ranks.tsv")
+    rc = main(["--input", str(p), "--iters", "2", "--engine", "cpu",
+               "--out", out, "--log-every", "0"])
+    assert rc == 0
+    assert "SEQ://a\t" in open(out).read()
+
+
 def test_cli_snapshot_resume(tmp_path, edges_file):
     path, src, dst = edges_file
     ck = str(tmp_path / "ckpt")
@@ -197,6 +212,19 @@ def test_cli_fused_matches_stepwise(tmp_path, edges_file):
     # per-iteration traces landed in the JSONL
     recs = [json.loads(l) for l in open(jsonl)]
     assert len(recs) == 8 and all("l1_delta" in r for r in recs)
+
+
+def test_cli_fused_jsonl_tags_averaged_timing(tmp_path, edges_file):
+    # Fused per-iteration records carry synthetic (averaged) seconds;
+    # the JSONL must say so (ADVICE r1).
+    path, *_ = edges_file
+    jsonl = str(tmp_path / "m.jsonl")
+    rc = main(["--input", path, "--iters", "4", "--fused",
+               "--jsonl", jsonl, "--log-every", "0"])
+    assert rc == 0
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert len(recs) == 4
+    assert all(r.get("timing") == "averaged" for r in recs)
 
 
 def test_cli_fused_rejects_host_control_flags(tmp_path, edges_file):
