@@ -21,6 +21,7 @@ import time
 import numpy as np
 
 from pagerank_tpu import PageRankConfig, build_graph, make_engine
+from pagerank_tpu.utils import fsio
 from pagerank_tpu.utils.metrics import MetricsLogger
 from pagerank_tpu.utils.snapshot import Snapshotter, TextDumper, resume_engine
 
@@ -36,7 +37,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="edge list (.txt/.tsv), binary .npz, crawl TSV, or Hadoop "
         "SequenceFile(s) of (Text url, Text json) — a file, a segment "
         "directory, or a comma-joined list (the reference's input form, "
-        "Sparky.java:42-61)",
+        "Sparky.java:42-61). Paths may use any URI scheme registered "
+        "with pagerank_tpu.utils.fsio (the reference reads s3n:// URIs)",
     )
     src.add_argument(
         "--synthetic",
@@ -151,8 +153,18 @@ def parse_ppr_sources(spec: str, ids, n: int) -> np.ndarray:
             raise SystemExit(f"--ppr-sources: count must be positive in {spec!r}")
         rng = np.random.default_rng(0)
         return rng.choice(n, size=min(k, n), replace=False).astype(np.int64)
-    if os.path.exists(spec):
-        with open(spec) as f:
+    # Treat the spec as a source FILE only when it plausibly is one: a
+    # local path that exists, or a registered-scheme URI that exists.
+    # URL-named vertices (crawl graphs) legitimately contain "://" —
+    # "http://a/,http://b/" must resolve through the id map, not fsio.
+    scheme = fsio.scheme_of(spec)
+    is_file = (
+        fsio.exists(spec)
+        if scheme is not None and fsio.registered(scheme)
+        else scheme is None and os.path.exists(spec)
+    )
+    if is_file:
+        with fsio.fopen(spec) as f:
             toks = [ln for ln in (l.strip() for l in f) if ln]
         return np.array([resolve(t) for t in toks], dtype=np.int64)
     return np.array([resolve(t) for t in spec.split(",")], dtype=np.int64)
@@ -218,7 +230,7 @@ def run_ppr(args, graph, ids) -> int:
     )
     names = ids.names if ids is not None else None
     out = args.out
-    f = open(out, "w") if out else sys.stdout
+    f = fsio.fopen(out, "w") if out else sys.stdout
     try:
         for si, s in enumerate(res.sources):
             skey = names[s] if names else s
@@ -258,13 +270,13 @@ def load_graph(args):
         from pagerank_tpu.ingest.seqfile import expand_seqfile_paths
 
         probe = path
-        if os.path.isdir(path) or ("," in path and not os.path.exists(path)):
+        if fsio.isdir(path) or ("," in path and not fsio.exists(path)):
             # Comma-joined lists / segment dirs only make sense for
             # SequenceFile segments (the reference's input form); probe
             # the first file's magic. A plain file whose NAME contains a
             # comma is still a plain file.
             probe = expand_seqfile_paths(path)[0]
-        with open(probe, "rb") as fb:
+        with fsio.fopen(probe, "rb") as fb:
             magic = fb.read(4)
         # Require a binary (non-printable) version byte after 'SEQ' so a
         # text file that merely *starts* with "SEQ…" falls through to
@@ -281,7 +293,7 @@ def load_graph(args):
         elif path.endswith(".npz"):
             fmt = "npz"
         else:
-            with open(path, "r", errors="replace") as f:
+            with fsio.fopen(path, "r", errors="replace") as f:
                 first = f.readline()
                 while first.startswith("#"):
                     first = f.readline()
@@ -490,7 +502,7 @@ def main(argv=None) -> int:
 
     if args.out:
         names = ids.names if ids is not None else None
-        with open(args.out, "w") as f:
+        with fsio.fopen(args.out, "w") as f:
             for i, r in enumerate(ranks):
                 key = names[i] if names else i
                 f.write(f"{key}\t{float(r)!r}\n")

@@ -26,6 +26,8 @@ from __future__ import annotations
 import json
 from typing import Iterator, List, Tuple
 
+from pagerank_tpu.utils import fsio
+
 
 def _render(value) -> str:
     """Gson ``JsonElement.toString()`` for primitives: strings keep their
@@ -71,7 +73,7 @@ def iter_crawl_records(
     path: str, strict: bool = True
 ) -> Iterator[Tuple[str, List[str]]]:
     """Yield (url, targets) from a TSV (url<TAB>json) or JSONL file."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
+    with fsio.fopen(path, "r", encoding="utf-8", errors="replace") as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:

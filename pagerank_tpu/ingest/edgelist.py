@@ -16,13 +16,17 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from pagerank_tpu.utils import fsio
+
 
 def load_edgelist(path: str, comments: str = "#") -> Tuple[np.ndarray, np.ndarray]:
     """Parse a whitespace-separated text edge list into (src, dst).
 
     Uses the native mmap/multithreaded parser (native/fast_ingest.cpp)
-    when available; falls back to numpy."""
-    if comments == "#":
+    when available; falls back to numpy. ``path`` may use a registered
+    URI scheme (utils/fsio); the native mmap parser applies to local
+    paths only."""
+    if comments == "#" and fsio.scheme_of(path) is None:
         from pagerank_tpu.ingest import native as native_lib
 
         try:
@@ -31,7 +35,7 @@ def load_edgelist(path: str, comments: str = "#") -> Tuple[np.ndarray, np.ndarra
             raise
         if out is not None:
             return out
-    with open(path, "rb") as f:
+    with fsio.fopen(path, "rb") as f:
         data = f.read()
     if comments:
         lines = [
@@ -51,11 +55,14 @@ def save_binary_edges(
     arrays = {"src": np.asarray(src, np.int64), "dst": np.asarray(dst, np.int64)}
     if n is not None:
         arrays["n"] = np.int64(n)
-    np.savez(path, **arrays)
+    if not path.endswith(".npz"):
+        path += ".npz"  # np.savez's path behavior, kept for file objects
+    with fsio.fopen(path, "wb") as f:
+        np.savez(f, **arrays)
 
 
 def load_binary_edges(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[int]]:
-    with np.load(path) as z:
+    with fsio.fopen(path, "rb") as f, np.load(f) as z:
         n = int(z["n"]) if "n" in z.files else None
         return z["src"], z["dst"], n
 

@@ -22,19 +22,32 @@ keys and values:
              recordLen == -1 -> a 16-byte sync marker follows (verified)
 
 ``Text`` payloads inside a record carry their own Hadoop VInt length
-prefix followed by UTF-8 bytes. Compressed files raise a clear error —
-the reference's segment files are uncompressed Text pairs; transparent
-codec support (zlib record compression) is accepted where Python's
-zlib suffices.
+prefix followed by UTF-8 bytes.
+
+Compression: the reference inherits transparent codec support through
+``ctx.sequenceFile`` (Sparky.java:61), so both Hadoop layouts of
+DefaultCodec/DeflateCodec (plain zlib) are read AND written here:
+
+- *record* compression (``compressed=1, blockCompressed=0``): each
+  record's value bytes are a zlib stream; keys stay raw.
+- *block* compression (``compressed=1, blockCompressed=1``): records
+  are buffered and flushed as blocks — each block is a sync marker,
+  a VInt record count, then FOUR length-prefixed zlib streams
+  (key lengths, keys, value lengths, values), per Hadoop's
+  ``SequenceFile.BlockCompressWriter``. Common Crawl segments of the
+  reference's vintage commonly use this layout.
+
+Other codecs (gzip framing, snappy, lzo) raise a clear error.
 """
 
 from __future__ import annotations
 
 import io
-import os
 import struct
 import zlib
 from typing import Iterable, Iterator, List, Tuple
+
+from pagerank_tpu.utils import fsio
 
 SEQ_MAGIC = b"SEQ"
 TEXT_CLASS = "org.apache.hadoop.io.Text"
@@ -113,11 +126,13 @@ def _text_bytes(s: str) -> bytes:
 def read_sequence_file(path: str) -> Iterator[Tuple[str, str]]:
     """Yield (key, value) Text pairs from one SequenceFile.
 
-    Supports version-6 record-oriented files with Text/Text classes,
-    uncompressed or per-record deflate (DefaultCodec). Block-compressed
-    files and non-Text classes raise ValueError.
+    Supports version-6 files with Text/Text classes: uncompressed,
+    per-record deflate, or block-compressed deflate (DefaultCodec —
+    plain zlib). Other codecs and non-Text classes raise ValueError.
+    ``path`` may use any registered URI scheme (utils/fsio) — the
+    reference reads these straight off S3 (Sparky.java:44-61).
     """
-    with open(path, "rb") as f:
+    with fsio.fopen(path, "rb") as f:
         magic = f.read(4)
         if magic[:3] != SEQ_MAGIC:
             raise ValueError(f"{path}: not a SequenceFile (magic {magic!r})")
@@ -137,9 +152,6 @@ def read_sequence_file(path: str) -> Iterator[Tuple[str, str]]:
             )
         compressed = f.read(1) != b"\x00"
         block_compressed = f.read(1) != b"\x00"
-        if block_compressed:
-            raise ValueError(f"{path}: block-compressed SequenceFiles "
-                             "are not supported")
         decompress = None
         if compressed:
             codec = _read_text(f).decode("utf-8")
@@ -153,6 +165,10 @@ def read_sequence_file(path: str) -> Iterator[Tuple[str, str]]:
         sync = f.read(16)
         if len(sync) != 16:
             raise EOFError(f"{path}: truncated header (sync marker)")
+
+        if block_compressed:
+            yield from _read_blocks(f, path, sync, decompress)
+            return
 
         while True:
             head = f.read(4)
@@ -181,6 +197,51 @@ def read_sequence_file(path: str) -> Iterator[Tuple[str, str]]:
             yield key, val
 
 
+def _read_blocks(f, path: str, sync: bytes, decompress) -> Iterator[Tuple[str, str]]:
+    """Iterate a block-compressed body: each block is SYNC_ESCAPE(-1) +
+    sync + VInt recordCount + four VInt-length-prefixed compressed
+    buffers (key lengths, keys, value lengths, values) — the layout
+    Hadoop's ``SequenceFile.BlockCompressWriter.sync()`` emits."""
+    if decompress is None:
+        raise ValueError(f"{path}: block-compressed flag set without a codec")
+
+    def read_buffer(what: str) -> io.BytesIO:
+        n = _read_vint(f)
+        if n < 0:
+            raise ValueError(f"{path}: bad {what} buffer length {n}")
+        data = f.read(n)
+        if len(data) != n:
+            raise EOFError(f"{path}: truncated {what} buffer")
+        return io.BytesIO(decompress(data))
+
+    while True:
+        head = f.read(4)
+        if len(head) < 4:
+            return  # clean EOF between blocks
+        if struct.unpack(">i", head)[0] != -1:
+            raise ValueError(f"{path}: expected block sync escape, got {head!r}")
+        marker = f.read(16)
+        if marker != sync:
+            raise ValueError(f"{path}: sync marker mismatch (corrupt file)")
+        n_rec = _read_vint(f)
+        if n_rec < 0:
+            raise ValueError(f"{path}: bad block record count {n_rec}")
+        key_lens = read_buffer("key-lengths")
+        keys = read_buffer("keys")
+        val_lens = read_buffer("value-lengths")
+        vals = read_buffer("values")
+        for _ in range(n_rec):
+            klen = _read_vint(key_lens)
+            key_raw = keys.read(klen)
+            vlen = _read_vint(val_lens)
+            val_raw = vals.read(vlen)
+            if len(key_raw) != klen or len(val_raw) != vlen:
+                raise EOFError(f"{path}: truncated block record")
+            key = _read_text(io.BytesIO(key_raw)).decode("utf-8", "replace")
+            val = _read_text(io.BytesIO(val_raw)).decode("utf-8", "replace")
+            yield key, val
+
+
 def expand_seqfile_paths(spec: str) -> List[str]:
     """A path, a directory (all non-hidden files, sorted — the layout of
     a crawl segment like the reference's `metadata-00000..00300`), or a
@@ -191,12 +252,12 @@ def expand_seqfile_paths(spec: str) -> List[str]:
         part = part.strip()
         if not part:
             continue
-        if os.path.isdir(part):
+        if fsio.isdir(part):
             paths.extend(
                 full
-                for name in sorted(os.listdir(part))
+                for name in sorted(fsio.listdir(part))
                 if not name.startswith((".", "_"))
-                and os.path.isfile(full := os.path.join(part, name))
+                and fsio.isfile(full := fsio.join(part, name))
             )
         else:
             paths.append(part)
@@ -227,26 +288,78 @@ def load_crawl_seqfile(spec: str, strict: bool = True):
 
 
 def write_sequence_file(
-    path: str, pairs: Iterable[Tuple[str, str]], sync_every: int = 100
+    path: str,
+    pairs: Iterable[Tuple[str, str]],
+    sync_every: int = 100,
+    compression: str = "none",
+    block_size: int = 1 << 20,
 ) -> int:
-    """Write (key, value) Text pairs as an uncompressed version-6
-    SequenceFile readable by Hadoop/Spark and :func:`read_sequence_file`.
-    Returns the record count."""
+    """Write (key, value) Text pairs as a version-6 SequenceFile
+    readable by Hadoop/Spark and :func:`read_sequence_file`. Returns the
+    record count.
+
+    ``compression``: "none", "record" (each value a zlib stream), or
+    "block" (Hadoop block layout: records buffered until ~``block_size``
+    raw bytes, then flushed as sync + VInt count + four compressed
+    buffers). Both compressed modes declare DefaultCodec."""
+    if compression not in ("none", "record", "block"):
+        raise ValueError(f"unknown compression {compression!r}")
     sync = bytes((i * 89 + 41) % 256 for i in range(16))
     count = 0
-    with open(path, "wb") as f:
+    with fsio.fopen(path, "wb") as f:
         f.write(SEQ_MAGIC + bytes([6]))
         f.write(_text_bytes(TEXT_CLASS))
         f.write(_text_bytes(TEXT_CLASS))
-        f.write(b"\x00\x00")  # not compressed, not block-compressed
+        f.write(b"\x00" if compression == "none" else b"\x01")
+        f.write(b"\x01" if compression == "block" else b"\x00")
+        if compression != "none":
+            f.write(_text_bytes(_DEFLATE_CODECS[0]))
         f.write(struct.pack(">i", 0))  # no metadata
         f.write(sync)
+
+        if compression == "block":
+            key_lens, keys = io.BytesIO(), io.BytesIO()
+            val_lens, vals = io.BytesIO(), io.BytesIO()
+            buffered = 0
+
+            def flush():
+                nonlocal buffered
+                if not buffered:
+                    return
+                f.write(struct.pack(">i", -1))
+                f.write(sync)
+                _write_vint(f, buffered)
+                for buf in (key_lens, keys, val_lens, vals):
+                    comp = zlib.compress(buf.getvalue())
+                    _write_vint(f, len(comp))
+                    f.write(comp)
+                    buf.seek(0)
+                    buf.truncate()
+                buffered = 0
+
+            for key, value in pairs:
+                k = _text_bytes(key)
+                v = _text_bytes(value)
+                _write_vint(key_lens, len(k))
+                keys.write(k)
+                _write_vint(val_lens, len(v))
+                vals.write(v)
+                buffered += 1
+                count += 1
+                if keys.tell() + vals.tell() >= block_size:
+                    flush()
+            flush()
+            return count
+
+        deflate = zlib.compress if compression == "record" else None
         for key, value in pairs:
             if count and sync_every and count % sync_every == 0:
                 f.write(struct.pack(">i", -1))
                 f.write(sync)
             k = _text_bytes(key)
             v = _text_bytes(value)
+            if deflate is not None:
+                v = deflate(v)
             f.write(struct.pack(">i", len(k) + len(v)))
             f.write(struct.pack(">i", len(k)))
             f.write(k)

@@ -1,0 +1,278 @@
+"""Pluggable URI-scheme file I/O (L1/L4 edges of the system).
+
+The reference's literal inputs are 301 ``s3n://`` URIs and its output an
+S3 bucket (``/root/reference/Sparky.java:44-58,237``) — the Hadoop
+filesystem layer resolves the scheme and streams bytes. This module is
+that seam for the TPU build: every loader (edge lists, .npz, crawl TSV,
+SequenceFiles) and every sink (Snapshotter, TextDumper, rank TSV, JSONL
+metrics) opens paths through here, so an object-store backend plugs in
+by registering a :class:`FileSystem` for its scheme — no loader changes.
+
+Scheme-less paths use the local OS filesystem unchanged. This zero-egress
+environment has no real S3 client to register; the contract is exercised
+by :class:`MemoryFileSystem` (an object-store-semantics in-memory store)
+under a ``mock://`` scheme in tests/test_fsio.py, which round-trips
+ingest -> snapshot -> resume through the CLI.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# Two+ characters: a single letter before :// is Windows drive syntax,
+# not a URI scheme.
+_SCHEME_RE = re.compile(r"^([A-Za-z][A-Za-z0-9+.-]+)://")
+
+
+def scheme_of(path: str) -> Optional[str]:
+    """URI scheme of ``path``, or None for a plain local path. Single-
+    letter "schemes" are never URIs (Windows drive syntax), and this
+    codebase treats anything without ``://`` as local."""
+    m = _SCHEME_RE.match(path)
+    return m.group(1).lower() if m else None
+
+
+def registered(scheme: Optional[str]) -> bool:
+    """Whether a filesystem is registered for ``scheme`` (None — local —
+    is always available)."""
+    return scheme is None or scheme.lower() in _REGISTRY
+
+
+class FileSystem:
+    """Minimal filesystem interface the loaders/sinks need. Implementors
+    receive FULL paths (scheme included) — an object store keys by URI.
+
+    ``replace`` must be atomic within the store (the Snapshotter's
+    torn-file guarantee rides on it; a backend without native rename can
+    implement copy+delete only if readers never see partial objects,
+    which object stores guarantee per-object)."""
+
+    def open(self, path: str, mode: str = "r", **kwargs):
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def isdir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def isfile(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        raise NotImplementedError
+
+    def replace(self, src: str, dst: str) -> None:
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    def open(self, path, mode="r", **kwargs):
+        return open(path, mode, **kwargs)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def isdir(self, path):
+        return os.path.isdir(path)
+
+    def isfile(self, path):
+        return os.path.isfile(path)
+
+    def listdir(self, path):
+        return os.listdir(path)
+
+    def makedirs(self, path, exist_ok=True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def replace(self, src, dst):
+        os.replace(src, dst)
+
+
+class _MemWriter(io.BytesIO):
+    """Write buffer that commits to the store atomically on clean close —
+    object-store PUT semantics (readers never see a partial object).
+    Exiting a ``with`` block on an exception ABORTS the put (a real
+    store abandons the upload), so a writer that dies mid-serialization
+    never publishes a torn object."""
+
+    def __init__(self, fs: "MemoryFileSystem", path: str, initial: bytes = b""):
+        super().__init__()
+        self.write(initial)
+        self._fs = fs
+        self._path = path
+        self._aborted = False
+
+    def abort(self):
+        self._aborted = True
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+    def close(self):
+        if not self.closed and not self._aborted:
+            self._fs._commit(self._path, self.getvalue())
+        super().close()
+
+
+class _MemTextWrapper(io.TextIOWrapper):
+    """Text wrapper that propagates with-block exceptions to the
+    underlying writer's abort-on-error semantics."""
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None and isinstance(self.buffer, _MemWriter):
+            self.buffer.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+
+class MemoryFileSystem(FileSystem):
+    """In-memory object store: flat ``{uri: bytes}`` plus implicit
+    directories (any key prefix), mirroring S3-style stores closely
+    enough to exercise every loader/sink contract. Thread-safe — the
+    async snapshot writer commits from a worker thread."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.files: Dict[str, bytes] = {}
+        self.dirs = set()
+
+    def _commit(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self.files[path] = data
+
+    def open(self, path, mode="r", **kwargs):
+        binary = "b" in mode
+        kind = mode.replace("b", "").replace("t", "") or "r"
+        with self._lock:
+            if kind == "r":
+                if path not in self.files:
+                    raise FileNotFoundError(path)
+                raw: io.IOBase = io.BytesIO(self.files[path])
+            elif kind in ("w", "x"):
+                if kind == "x" and path in self.files:
+                    raise FileExistsError(path)
+                raw = _MemWriter(self, path)
+            elif kind == "a":
+                raw = _MemWriter(self, path, self.files.get(path, b""))
+                raw.seek(0, io.SEEK_END)
+            else:
+                raise ValueError(f"unsupported mode {mode!r}")
+        if binary:
+            return raw
+        kwargs.pop("newline", None)
+        kwargs.setdefault("encoding", "utf-8")
+        return _MemTextWrapper(raw, **kwargs)
+
+    def exists(self, path):
+        return self.isfile(path) or self.isdir(path)
+
+    def isfile(self, path):
+        with self._lock:
+            return path in self.files
+
+    def isdir(self, path):
+        prefix = path.rstrip("/") + "/"
+        with self._lock:
+            return path.rstrip("/") in self.dirs or any(
+                k.startswith(prefix) for k in self.files
+            )
+
+    def listdir(self, path):
+        prefix = path.rstrip("/") + "/"
+        names = set()
+        with self._lock:
+            if not (path.rstrip("/") in self.dirs
+                    or any(k.startswith(prefix) for k in self.files)):
+                raise FileNotFoundError(path)
+            for k in list(self.files) + [d for d in self.dirs]:
+                if k.startswith(prefix):
+                    names.add(k[len(prefix):].split("/", 1)[0])
+        return sorted(n for n in names if n)
+
+    def makedirs(self, path, exist_ok=True):
+        key = path.rstrip("/")
+        with self._lock:
+            if not exist_ok and key in self.dirs:
+                raise FileExistsError(path)
+            self.dirs.add(key)
+
+    def replace(self, src, dst):
+        with self._lock:
+            if src not in self.files:
+                raise FileNotFoundError(src)
+            self.files[dst] = self.files.pop(src)
+
+
+_LOCAL = LocalFileSystem()
+_REGISTRY: Dict[str, FileSystem] = {}
+
+
+def register(scheme: str, fs: FileSystem) -> None:
+    """Make ``scheme://...`` paths resolve through ``fs`` everywhere
+    (loaders, snapshots, text dumps, CLI outputs)."""
+    _REGISTRY[scheme.lower()] = fs
+
+
+def unregister(scheme: str) -> None:
+    _REGISTRY.pop(scheme.lower(), None)
+
+
+def get_fs(path: str) -> FileSystem:
+    scheme = scheme_of(path)
+    if scheme is None:
+        return _LOCAL
+    fs = _REGISTRY.get(scheme)
+    if fs is None:
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} "
+            f"(path {path!r}); register one with "
+            f"pagerank_tpu.utils.fsio.register({scheme!r}, fs) "
+            f"(registered: {sorted(_REGISTRY) or 'none'})"
+        )
+    return fs
+
+
+# -- module-level conveniences (the loader/sink call surface) -------------
+
+
+def fopen(path: str, mode: str = "r", **kwargs):
+    return get_fs(path).open(path, mode, **kwargs)
+
+
+def exists(path: str) -> bool:
+    return get_fs(path).exists(path)
+
+
+def isdir(path: str) -> bool:
+    return get_fs(path).isdir(path)
+
+
+def isfile(path: str) -> bool:
+    return get_fs(path).isfile(path)
+
+
+def listdir(path: str) -> List[str]:
+    return get_fs(path).listdir(path)
+
+
+def makedirs(path: str, exist_ok: bool = True) -> None:
+    get_fs(path).makedirs(path, exist_ok=exist_ok)
+
+
+def replace(src: str, dst: str) -> None:
+    get_fs(src).replace(src, dst)
+
+
+def join(base: str, *parts: str) -> str:
+    """Path join that preserves URI schemes (os.path.join handles the
+    forward-slash layout both local posix paths and URIs use)."""
+    return os.path.join(base, *parts)

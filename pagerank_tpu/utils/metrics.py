@@ -13,6 +13,8 @@ import sys
 import time
 from typing import Dict, Optional, TextIO
 
+from pagerank_tpu.utils import fsio
+
 
 class MetricsLogger:
     """Per-iteration logger; use as the engine's ``on_iteration`` hook."""
@@ -29,7 +31,7 @@ class MetricsLogger:
         self.num_chips = max(1, num_chips)
         self.log_every = log_every
         self.stream = stream if stream is not None else sys.stderr
-        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._jsonl = fsio.fopen(jsonl_path, "a") if jsonl_path else None
         self._t_last = time.perf_counter()
         self.history = []
 

@@ -11,7 +11,6 @@ tests/test_snapshot.py).
 
 from __future__ import annotations
 
-import os
 import queue
 import re
 import threading
@@ -19,38 +18,43 @@ from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
+from pagerank_tpu.utils import fsio
+
 _PAT = re.compile(r"^ranks_iter(\d+)\.npz$")
 
 
 class Snapshotter:
-    """Writes ``ranks_iter{i}.npz`` files into ``directory``."""
+    """Writes ``ranks_iter{i}.npz`` files into ``directory`` — a local
+    path or any registered URI scheme (utils/fsio; the reference's sink
+    is an S3 bucket, Sparky.java:237)."""
 
     def __init__(self, directory: str, graph_fingerprint: str, semantics: str):
         self.directory = directory
         self.fingerprint = graph_fingerprint
         self.semantics = semantics
-        os.makedirs(directory, exist_ok=True)
+        fsio.makedirs(directory, exist_ok=True)
 
     def path(self, iteration: int) -> str:
-        return os.path.join(self.directory, f"ranks_iter{iteration}.npz")
+        return fsio.join(self.directory, f"ranks_iter{iteration}.npz")
 
     def save(self, iteration: int, ranks: np.ndarray) -> str:
         p = self.path(iteration)
         tmp = p + ".tmp.npz"
-        np.savez(
-            tmp,
-            ranks=ranks,
-            iteration=np.int64(iteration),
-            fingerprint=np.bytes_(self.fingerprint.encode()),
-            semantics=np.bytes_(self.semantics.encode()),
-        )
-        os.replace(tmp, p)  # atomic: a killed run never leaves a torn file
+        with fsio.fopen(tmp, "wb") as f:
+            np.savez(
+                f,
+                ranks=ranks,
+                iteration=np.int64(iteration),
+                fingerprint=np.bytes_(self.fingerprint.encode()),
+                semantics=np.bytes_(self.semantics.encode()),
+            )
+        fsio.replace(tmp, p)  # atomic: a killed run never leaves a torn file
         return p
 
     def latest(self) -> Optional[int]:
         best = None
         try:
-            entries = os.listdir(self.directory)
+            entries = fsio.listdir(self.directory)
         except FileNotFoundError:
             return None
         for name in entries:
@@ -61,7 +65,7 @@ class Snapshotter:
         return best
 
     def load(self, iteration: int) -> Tuple[np.ndarray, Dict[str, str]]:
-        with np.load(self.path(iteration)) as z:
+        with fsio.fopen(self.path(iteration), "rb") as f, np.load(f) as z:
             meta = {
                 "fingerprint": bytes(z["fingerprint"]).decode(),
                 "semantics": bytes(z["semantics"]).decode(),
@@ -79,22 +83,22 @@ class TextDumper:
     def __init__(self, directory: str, names=None):
         self.directory = directory
         self.names = names
-        os.makedirs(directory, exist_ok=True)
+        fsio.makedirs(directory, exist_ok=True)
 
     def dump(self, iteration: int, ranks: np.ndarray) -> str:
-        d = os.path.join(self.directory, f"PageRank{iteration}")
-        os.makedirs(d, exist_ok=True)
-        path = os.path.join(d, "part-00000")
+        d = fsio.join(self.directory, f"PageRank{iteration}")
+        fsio.makedirs(d, exist_ok=True)
+        path = fsio.join(d, "part-00000")
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        with fsio.fopen(tmp, "w") as f:
             for i, r in enumerate(ranks):
                 key = self.names[i] if self.names is not None else i
                 f.write(f"({key},{float(r)!r})\n")
-        os.replace(tmp, path)
+        fsio.replace(tmp, path)
         # Hadoop job-completion marker (saveAsTextFile writes one per
         # output dir); written LAST so its presence certifies a
         # complete, untorn dump to downstream Hadoop-convention tooling.
-        with open(os.path.join(d, "_SUCCESS"), "w"):
+        with fsio.fopen(fsio.join(d, "_SUCCESS"), "w"):
             pass
         return path
 

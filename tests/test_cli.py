@@ -138,6 +138,22 @@ def test_cli_ppr(tmp_path, edges_file):
     assert scores == sorted(scores, reverse=True)
 
 
+def test_cli_ppr_url_sources_resolve_through_id_map(tmp_path, capsys):
+    # URL-named vertices contain "://"; a comma list of them must go
+    # through the id map, not be mistaken for a filesystem path.
+    p = tmp_path / "crawl.tsv"
+    meta_a = json.dumps({"content": {"links": [{"href": "http://b", "type": "a"}]}})
+    meta_b = json.dumps({"content": {"links": [{"href": "http://a", "type": "a"}]}})
+    p.write_text(f"http://a\t{meta_a}\nhttp://b\t{meta_b}\n")
+    rc = main(["--input", str(p), "--iters", "5", "--engine", "cpu",
+               "--ppr-sources", "http://a,http://b", "--ppr-topk", "2",
+               "--log-every", "0"])
+    assert rc == 0
+    rows = [l for l in capsys.readouterr().out.splitlines() if l.count("\t") == 2]
+    assert len(rows) == 2 * 2
+    assert rows[0].startswith("http://a\t")
+
+
 def test_cli_ppr_random_sources(edges_file, capsys):
     path, _, _ = edges_file
     rc = main(["--input", path, "--iters", "5", "--ppr-sources", "random:4",

@@ -1,0 +1,170 @@
+"""Pluggable URI-scheme I/O (utils/fsio) — the seam standing in for the
+reference's Hadoop S3 filesystem (s3n:// inputs Sparky.java:44-58, S3
+output :237). A mock:// object store must round-trip every loader and
+sink: ingest -> snapshot -> resume -> final ranks through the CLI."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import PageRankConfig, ReferenceCpuEngine, build_graph
+from pagerank_tpu.cli import main
+from pagerank_tpu.utils import fsio
+
+
+@pytest.fixture
+def mockfs():
+    fs = fsio.MemoryFileSystem()
+    fsio.register("mock", fs)
+    yield fs
+    fsio.unregister("mock")
+
+
+def test_scheme_parsing():
+    assert fsio.scheme_of("s3n://bucket/key") == "s3n"
+    assert fsio.scheme_of("mock://x") == "mock"
+    assert fsio.scheme_of("/local/path") is None
+    assert fsio.scheme_of("relative/path") is None
+    assert fsio.scheme_of("edges.txt") is None
+
+
+def test_unregistered_scheme_error_is_actionable():
+    with pytest.raises(ValueError, match="no filesystem registered.*s3n"):
+        fsio.fopen("s3n://bucket/metadata-00000", "rb")
+
+
+def test_memory_fs_basics(mockfs):
+    with fsio.fopen("mock://b/dir/a.txt", "w") as f:
+        f.write("hello")
+    assert fsio.exists("mock://b/dir/a.txt")
+    assert fsio.isfile("mock://b/dir/a.txt")
+    assert fsio.isdir("mock://b/dir")
+    assert not fsio.isdir("mock://b/dir/a.txt")
+    with fsio.fopen("mock://b/dir/a.txt") as f:
+        assert f.read() == "hello"
+    with fsio.fopen("mock://b/dir/a.txt", "a") as f:
+        f.write(" world")
+    with fsio.fopen("mock://b/dir/a.txt", "rb") as f:
+        assert f.read() == b"hello world"
+    with pytest.raises(FileNotFoundError):
+        fsio.fopen("mock://b/missing", "rb")
+    # one-level listing, object-store style
+    with fsio.fopen("mock://b/dir/sub/c.bin", "wb") as f:
+        f.write(b"\x00\x01")
+    assert fsio.listdir("mock://b/dir") == ["a.txt", "sub"]
+    fsio.replace("mock://b/dir/a.txt", "mock://b/dir/b.txt")
+    assert not fsio.exists("mock://b/dir/a.txt")
+    assert fsio.listdir("mock://b/dir") == ["b.txt", "sub"]
+
+
+def test_memory_fs_write_is_atomic_on_close(mockfs):
+    f = fsio.fopen("mock://b/partial", "wb")
+    f.write(b"data")
+    # Not visible until close — object-store PUT semantics.
+    assert not fsio.exists("mock://b/partial")
+    f.close()
+    assert fsio.exists("mock://b/partial")
+
+
+@pytest.mark.parametrize("mode", ["wb", "w"])
+def test_memory_fs_aborts_put_on_with_block_exception(mockfs, mode):
+    # A writer that dies mid-serialization must not publish a torn
+    # object (a real store abandons the upload).
+    payload = b"torn" if mode == "wb" else "torn"
+    with pytest.raises(RuntimeError):
+        with fsio.fopen("mock://b/torn", mode) as f:
+            f.write(payload)
+            raise RuntimeError("dies mid-write")
+    assert not fsio.exists("mock://b/torn")
+
+
+def test_windows_drive_syntax_is_not_a_scheme():
+    assert fsio.scheme_of("C://data/edges.txt") is None
+    assert fsio.registered(None)
+    assert not fsio.registered("s3n")
+
+
+def _edges_to(uri, rng, n=50, e=300):
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    with fsio.fopen(uri, "w") as f:
+        for s, d in zip(src, dst):
+            f.write(f"{s} {d}\n")
+    return src, dst
+
+
+def test_cli_mock_scheme_ingest_snapshot_resume_roundtrip(mockfs):
+    """VERDICT r1 item 4 'done' criterion: a registered mock scheme
+    round-trips ingest -> snapshot -> resume, all storage on the mock
+    store (plus the final ranks sink)."""
+    rng = np.random.default_rng(0)
+    src, dst = _edges_to("mock://in/edges.txt", rng)
+
+    rc = main(["--input", "mock://in/edges.txt", "--iters", "3",
+               "--snapshot-dir", "mock://ckpt", "--sync-io",
+               "--log-every", "0"])
+    assert rc == 0
+    assert "ranks_iter3.npz" in fsio.listdir("mock://ckpt")
+    # No torn temp objects left behind.
+    assert not any(n.endswith(".tmp.npz") for n in fsio.listdir("mock://ckpt"))
+
+    rc = main(["--input", "mock://in/edges.txt", "--iters", "6",
+               "--snapshot-dir", "mock://ckpt", "--resume",
+               "--out", "mock://out/r.tsv", "--log-every", "0"])
+    assert rc == 0
+
+    g = build_graph(src, dst)
+    expected = ReferenceCpuEngine(PageRankConfig(num_iters=6)).build(g).run()
+    got = np.zeros(g.n)
+    with fsio.fopen("mock://out/r.tsv") as f:
+        for line in f:
+            k, v = line.split("\t")
+            got[int(k)] = float(v)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_cli_mock_scheme_crawl_and_text_dump(mockfs):
+    meta = json.dumps(
+        {"content": {"links": [{"href": "http://b", "type": "a"}]}}
+    )
+    with fsio.fopen("mock://in/crawl.tsv", "w") as f:
+        f.write(f"http://a\t{meta}\nhttp://b\t{json.dumps({})}\n")
+    rc = main(["--input", "mock://in/crawl.tsv", "--iters", "2",
+               "--engine", "cpu", "--dump-text-dir", "mock://dumps",
+               "--log-every", "0"])
+    assert rc == 0
+    assert fsio.listdir("mock://dumps") == ["PageRank0", "PageRank1"]
+    names = fsio.listdir("mock://dumps/PageRank1")
+    assert names == ["_SUCCESS", "part-00000"]
+    with fsio.fopen("mock://dumps/PageRank1/part-00000") as f:
+        body = f.read()
+    assert "(http://a," in body and "(http://b," in body
+
+
+def test_seqfile_roundtrip_over_mock_scheme(mockfs):
+    from pagerank_tpu.ingest import read_sequence_file, write_sequence_file
+
+    meta = json.dumps(
+        {"content": {"links": [{"href": "http://t", "type": "a"}]}}
+    )
+    pairs = [(f"http://u{i}", meta) for i in range(10)]
+    fsio.makedirs("mock://seg")
+    write_sequence_file("mock://seg/metadata-00000", pairs, sync_every=4)
+    back = list(read_sequence_file("mock://seg/metadata-00000"))
+    assert back == pairs
+    # Directory expansion over the mock scheme (segment-dir input form).
+    rc = main(["--input", "mock://seg", "--iters", "2", "--engine", "cpu",
+               "--log-every", "0"])
+    assert rc == 0
+
+
+def test_binary_edges_roundtrip_over_mock_scheme(mockfs):
+    from pagerank_tpu.ingest import load_binary_edges, save_binary_edges
+
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 2, 0], np.int64)
+    save_binary_edges("mock://b/edges.npz", src, dst, n=3)
+    s, d, n = load_binary_edges("mock://b/edges.npz")
+    assert n == 3
+    np.testing.assert_array_equal(s, src)
+    np.testing.assert_array_equal(d, dst)
