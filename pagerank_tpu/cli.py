@@ -67,13 +67,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tol", type=float, default=None, help="L1 early-stop (default: none)")
     p.add_argument(
         "--fused", action="store_true",
-        help="run the whole iteration loop as ONE device dispatch "
+        help="run the iteration loop as fused device dispatches "
         "(JaxTpuEngine.run_fused: a jitted lax.scan over the step; "
         "per-iteration metrics come from on-device traces and wall-clock "
-        "is averaged). With --tol the early stop runs on device too "
+        "is averaged). With --snapshot-dir, one fused dispatch per "
+        "--snapshot-every iterations with snapshots at the boundaries "
+        "(run_fused_chunked). With --tol the early stop runs on device "
         "(run_fused_tol: lax.while_loop; only the final delta/mass "
-        "exist). jax engine only; incompatible with --snapshot-dir and "
-        "--dump-text-dir, which need host control between iterations",
+        "exist) or at chunk boundaries when snapshotting. jax engine "
+        "only; incompatible with --dump-text-dir, which needs host "
+        "control every iteration",
     )
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument(
@@ -328,15 +331,14 @@ def main(argv=None) -> int:
         # early stop runs on device via run_fused_tol.)
         bad = [
             flag for flag, on in (
-                ("--snapshot-dir", args.snapshot_dir is not None),
                 ("--dump-text-dir", args.dump_text_dir is not None),
                 ("--ppr-sources", bool(args.ppr_sources)),
             ) if on
         ]
         if bad:
             print(
-                f"--fused runs the loop in one device dispatch; "
-                f"{', '.join(bad)} need host control between iterations",
+                f"--fused runs the loop in fused device dispatches; "
+                f"{', '.join(bad)} need host control every iteration",
                 file=sys.stderr,
             )
             return 2
@@ -441,10 +443,36 @@ def main(argv=None) -> int:
             import jax
 
             first = engine.iteration
+            chunked = snap is not None and args.snapshot_every
             # compile outside the timed region
-            engine.prepare_fused(tol=args.tol)
+            engine.prepare_fused(
+                tol=args.tol,
+                every=args.snapshot_every if chunked else None,
+            )
             t_run = time.perf_counter()
-            if args.tol is not None:
+            if chunked:
+                # Fused dispatches BETWEEN snapshot points; snapshots at
+                # chunk boundaries ride the same async writer/sink path
+                # as the stepwise loop.
+                def on_chunk(done_iters, dev_ranks, traces):
+                    # Same absolute cadence as the stepwise loop: no
+                    # snapshot at an off-cadence final-remainder
+                    # boundary, so both modes write identical file sets.
+                    if done_iters % args.snapshot_every != 0:
+                        return
+                    if writer is not None:
+                        writer.submit(done_iters - 1, (True, dev_ranks))
+                    else:
+                        write_sinks(
+                            done_iters - 1,
+                            (True, engine.decode_ranks(dev_ranks)),
+                        )
+
+                ranks = engine.run_fused_chunked(
+                    every=args.snapshot_every, on_chunk=on_chunk,
+                    tol=args.tol,
+                )
+            elif args.tol is not None:
                 # On-device early stop: only the FINAL iteration's
                 # delta/mass exist (dynamic trip count).
                 ranks = engine.run_fused_tol(args.tol)
@@ -456,9 +484,9 @@ def main(argv=None) -> int:
             masses = np.asarray(jax.device_get(tr["dangling_mass"]))
             done = engine.iteration - first
             for i in range(len(deltas) if done else 0):
-                # fixed-length runs: one record per iteration; tol runs:
-                # a single final record at the true average dt.
-                it = first + (done - 1 if args.tol is not None else i)
+                # one record per executed iteration, except the
+                # device-tol form which keeps only the final one.
+                it = first + (i if len(deltas) == done else done - 1)
                 metrics.record(
                     it,
                     {"l1_delta": deltas[i], "dangling_mass": masses[i]},

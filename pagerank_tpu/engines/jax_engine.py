@@ -1008,19 +1008,84 @@ class JaxTpuEngine(PageRankEngine):
         }
         return self.ranks()
 
+    def run_fused_chunked(
+        self,
+        num_iters: Optional[int] = None,
+        every: int = 1,
+        on_chunk=None,
+        tol: Optional[float] = None,
+    ) -> np.ndarray:
+        """Fused dispatches BETWEEN snapshot points: each chunk of
+        ``every`` iterations is one XLA invocation (the same cached scan
+        executable every full chunk), and ``on_chunk(iterations_done,
+        device_ranks_copy, (deltas, masses))`` fires at each boundary
+        with a device-side rank copy for the snapshot sinks to decode
+        off-thread. This is the C17 persistence contract
+        (every-iteration in the reference, Sparky.java:237; every-k
+        here) without giving up fused dispatch between snapshot points —
+        the fix for fused runs being uncheckpointable.
+
+        With ``tol``, stops after the first chunk whose final L1 delta
+        is <= tol — checked host-side at the boundary, which costs
+        nothing extra since the boundary already materializes the chunk
+        traces. Unlike :meth:`run_fused_tol`, per-iteration traces for
+        every executed iteration survive in ``last_run_metrics``.
+        """
+        total = self.config.num_iters if num_iters is None else num_iters
+        if every is not None and every < 0:
+            raise ValueError(f"every must be >= 0, got {every}")
+        every = int(every) if every else max(1, total - self.iteration)
+        ds, ms = [], []
+        while self.iteration < total:
+            # Align boundaries to ABSOLUTE multiples of ``every`` so a
+            # resumed run lands on the same snapshot cadence as the
+            # stepwise loop ((i+1) % every == 0); the final chunk may be
+            # a short remainder ending off-cadence at ``total``.
+            k = min(every - self.iteration % every, total - self.iteration)
+            fused = self._get_fused(k)
+            self._r, (deltas, masses) = fused(*self._device_args())
+            self.iteration += k
+            ds.append(deltas)
+            ms.append(masses)
+            if on_chunk is not None:
+                on_chunk(self.iteration, self.device_ranks(),
+                         (deltas, masses))
+            if tol is not None and float(jax.device_get(deltas[-1])) <= tol:
+                break
+        if ds:
+            self.last_run_metrics = {
+                "l1_delta": jnp.concatenate(ds),
+                "dangling_mass": jnp.concatenate(ms),
+            }
+        return self.ranks()
+
     def prepare_fused(
-        self, num_iters: Optional[int] = None, tol: Optional[float] = None
+        self,
+        num_iters: Optional[int] = None,
+        tol: Optional[float] = None,
+        every: Optional[int] = None,
     ) -> int:
         """Compile the fused executable for the remaining iteration count
         without running it; returns that count. Lets callers keep the
         one-time XLA compile out of timed regions (the stepwise path
         isolates compile in iteration 0; the fused dispatch would
         otherwise smear it across every iteration's average). With a
-        ``tol`` it prepares the while_loop form run_fused_tol uses."""
+        ``tol`` it prepares the while_loop form run_fused_tol uses; with
+        ``every`` the chunk executable run_fused_chunked reuses (a short
+        final remainder chunk, if any, still compiles lazily)."""
         total = self.config.num_iters if num_iters is None else num_iters
         k = total - self.iteration
         if k > 0:
-            if tol is not None:
+            if every and every > 0:
+                e = int(every)
+                # Chunks align to absolute multiples of ``e`` (see
+                # run_fused_chunked): compile the possibly-short first
+                # chunk and the steady-state full chunk.
+                first = min(e - self.iteration % e, k)
+                self._get_fused(first)
+                if k - first >= e:
+                    self._get_fused(e)
+            elif tol is not None:
                 self._get_fused_tol(k, float(tol))
             else:
                 self._get_fused(k)

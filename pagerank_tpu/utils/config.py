@@ -87,6 +87,11 @@ class PageRankConfig:
             raise ValueError(f"damping must be in (0,1), got {self.damping}")
         if self.num_iters < 0:
             raise ValueError("num_iters must be >= 0")
+        if self.snapshot_every < 0:
+            raise ValueError(
+                f"snapshot_every must be >= 0 (0 disables), got "
+                f"{self.snapshot_every}"
+            )
         if self.tol is not None and not (0.0 < self.tol < float("inf")):
             raise ValueError(
                 f"tol must be a finite positive float, got {self.tol}"

@@ -230,6 +230,95 @@ def test_cli_fused_matches_stepwise(tmp_path, edges_file):
     assert len(recs) == 8 and all("l1_delta" in r for r in recs)
 
 
+def test_cli_fused_snapshots_match_stepwise(tmp_path, edges_file):
+    """--fused --snapshot-dir runs chunked fused dispatches with
+    snapshots at the boundaries; files and final ranks must match the
+    stepwise run byte-for-byte (same arithmetic, same sink path)."""
+    import os
+
+    path, _, _ = edges_file
+    ck_f = str(tmp_path / "ck_fused")
+    ck_s = str(tmp_path / "ck_step")
+    jsonl = str(tmp_path / "m.jsonl")
+    assert main(["--input", path, "--iters", "6", "--fused",
+                 "--snapshot-dir", ck_f, "--snapshot-every", "2",
+                 "--jsonl", jsonl, "--log-every", "0"]) == 0
+    assert main(["--input", path, "--iters", "6",
+                 "--snapshot-dir", ck_s, "--snapshot-every", "2",
+                 "--log-every", "0"]) == 0
+    names = sorted(n for n in os.listdir(ck_f) if n.endswith(".npz"))
+    assert names == ["ranks_iter2.npz", "ranks_iter4.npz", "ranks_iter6.npz"]
+    assert names == sorted(n for n in os.listdir(ck_s) if n.endswith(".npz"))
+    for n in names:
+        a = np.load(os.path.join(ck_f, n))["ranks"]
+        b = np.load(os.path.join(ck_s, n))["ranks"]
+        np.testing.assert_array_equal(a, b)
+    # chunked runs keep every iteration's trace
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert len(recs) == 6 and all(r["timing"] == "averaged" for r in recs)
+
+
+def test_cli_fused_snapshot_resume(tmp_path, edges_file):
+    path, _, _ = edges_file
+    ck = str(tmp_path / "ck")
+    out_f = str(tmp_path / "rf.tsv")
+    out_c = str(tmp_path / "rc.tsv")
+    assert main(["--input", path, "--iters", "3", "--fused",
+                 "--snapshot-dir", ck, "--log-every", "0"]) == 0
+    # Resume from iteration 3 with cadence 2: chunks re-align to the
+    # ABSOLUTE grid (boundary at 4, then 6), exactly like stepwise.
+    assert main(["--input", path, "--iters", "7", "--fused",
+                 "--snapshot-dir", ck, "--resume", "--snapshot-every", "2",
+                 "--out", out_f, "--log-every", "0"]) == 0
+    import os
+
+    post = {n for n in os.listdir(ck) if n.endswith(".npz")}
+    assert {"ranks_iter4.npz", "ranks_iter6.npz"} <= post
+    assert "ranks_iter5.npz" not in post and "ranks_iter7.npz" not in post
+    assert main(["--input", path, "--iters", "7", "--out", out_c,
+                 "--log-every", "0"]) == 0
+    r1 = {l.split("\t")[0]: float(l.split("\t")[1]) for l in open(out_f)}
+    r2 = {l.split("\t")[0]: float(l.split("\t")[1]) for l in open(out_c)}
+    assert r1 == r2
+
+
+def test_cli_fused_remainder_chunk_follows_stepwise_cadence(tmp_path, edges_file):
+    """iters not divisible by --snapshot-every: the fused final
+    remainder chunk must NOT write an off-cadence snapshot — file sets
+    stay identical to stepwise. Negative cadence is rejected outright."""
+    import os
+
+    path, _, _ = edges_file
+    ck_f, ck_s = str(tmp_path / "f"), str(tmp_path / "s")
+    assert main(["--input", path, "--iters", "7", "--fused",
+                 "--snapshot-dir", ck_f, "--snapshot-every", "2",
+                 "--log-every", "0"]) == 0
+    assert main(["--input", path, "--iters", "7",
+                 "--snapshot-dir", ck_s, "--snapshot-every", "2",
+                 "--log-every", "0"]) == 0
+    names = sorted(n for n in os.listdir(ck_f) if n.endswith(".npz"))
+    assert names == ["ranks_iter2.npz", "ranks_iter4.npz", "ranks_iter6.npz"]
+    assert names == sorted(n for n in os.listdir(ck_s) if n.endswith(".npz"))
+    with pytest.raises(ValueError, match="snapshot_every"):
+        main(["--input", path, "--iters", "4", "--fused",
+              "--snapshot-dir", ck_f, "--snapshot-every", "-2",
+              "--log-every", "0"])
+
+
+def test_cli_fused_chunked_tol_stops_at_boundary(tmp_path, edges_file):
+    path, _, _ = edges_file
+    ck = str(tmp_path / "ck")
+    jsonl = str(tmp_path / "m.jsonl")
+    rc = main(["--input", path, "--iters", "60", "--fused", "--tol", "1e-3",
+               "--snapshot-dir", ck, "--snapshot-every", "5",
+               "--jsonl", jsonl, "--log-every", "0"])
+    assert rc == 0
+    recs = [json.loads(l) for l in open(jsonl)]
+    # stopped early, at a chunk boundary, with per-iteration traces
+    assert 0 < len(recs) < 60 and len(recs) % 5 == 0
+    assert recs[-1]["l1_delta"] <= 1e-3
+
+
 def test_cli_fused_jsonl_tags_averaged_timing(tmp_path, edges_file):
     # Fused per-iteration records carry synthetic (averaged) seconds;
     # the JSONL must say so (ADVICE r1).
@@ -247,7 +336,7 @@ def test_cli_fused_rejects_host_control_flags(tmp_path, edges_file):
     path, _, _ = edges_file
 
     assert main(["--input", path, "--fused",
-                 "--snapshot-dir", str(tmp_path / "s")]) == 2
+                 "--dump-text-dir", str(tmp_path / "d")]) == 2
     assert main(["--input", path, "--fused",
                  "--engine", "cpu"]) == 2
 

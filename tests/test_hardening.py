@@ -56,9 +56,13 @@ def _run_cli(args, env):
     )
 
 
-def test_sigkill_mid_run_then_resume(tmp_path):
+@pytest.mark.parametrize("fused", [False, True], ids=["stepwise", "fused"])
+def test_sigkill_mid_run_then_resume(tmp_path, fused):
     """Fault injection per SURVEY §5: kill -9 the process mid-run; the
-    atomic per-iteration snapshots allow an exact resume."""
+    atomic per-iteration snapshots allow an exact resume. Runs in both
+    dispatch modes — fused uses chunked dispatches between snapshot
+    points (run_fused_chunked), which must checkpoint and resume exactly
+    like the stepwise loop."""
     rng = np.random.default_rng(23)
     edges = tmp_path / "e.txt"
     edges.write_text(
@@ -80,6 +84,8 @@ def test_sigkill_mid_run_then_resume(tmp_path):
     base = ["--input", str(edges), "--iters", "40",
             "--snapshot-dir", str(snap_dir), "--dtype", "float64",
             "--accum-dtype", "float64", "--log-every", "0"]
+    if fused:
+        base.append("--fused")
 
     victim = subprocess.Popen(
         [sys.executable, "-m", "pagerank_tpu.cli", *base],
