@@ -66,6 +66,15 @@ from pagerank_tpu.parallel import mesh as mesh_lib
 from pagerank_tpu.parallel import partition
 
 
+def _split_pair(z):
+    """Dekker split z = hi + lo exactly, both f32 — the pair-packed
+    gather's two planes (ops/spmv.py:ell_contrib_pair docstring). One
+    spelling shared by every prescale so the split cannot drift."""
+    hi = z.astype(jnp.float32)
+    lo = (z - hi.astype(z.dtype)).astype(jnp.float32)
+    return hi, lo
+
+
 def _pad_rows(a, multiple: int, fill, xp=np):
     rows = a.shape[0]
     target = -(-max(rows, 1) // multiple) * multiple
@@ -85,6 +94,7 @@ class JaxTpuEngine(PageRankEngine):
         self._mesh = None
         self._pack: Optional[ell_lib.EllPack] = None
         self._perm: Optional[np.ndarray] = None  # relabeled -> original
+        self._ms_stripe = None  # set by _setup_multi_dispatch
 
     # -- build ------------------------------------------------------------
 
@@ -589,50 +599,35 @@ class JaxTpuEngine(PageRankEngine):
             inv_out_rel = inv_out_rel.astype(z_dtype)
         self._inv_out = jax.device_put(inv_out_rel, mesh_lib.replicated(mesh))
 
-        # Very-many-stripe layouts: restack the per-stripe arrays into
-        # ONE [n_stripes, ...] set and run the stripes as a lax.scan.
-        # The unrolled Python loop duplicates the whole chunked-gather
-        # program per stripe and its serialized HLO exceeds
-        # remote-compile request limits around 8 pair stripes (measured:
-        # R-MAT scale-25 f64-pair, HTTP 413) — but the scan body also
-        # knocks XLA off the fast-gather lowering (~3.7x slower
-        # execution, measured at scale 24; see docs/PERF_NOTES.md), so
-        # the scan form is strictly a COMPILE-SIZE fallback: unrolled
-        # whenever it can compile, scan only past the size threshold
-        # (pair stripes carry ~2x the program of plain ones). Uniform
-        # shapes under scan: every stripe pads to the longest stripe's
-        # rows and ONE shared chunk; compact widths unify at
-        # max(num_present); present-block ids pad with ``num_blocks`` —
-        # a dump row sliced off after the scan.
+        # Very-many-stripe layouts: the unrolled Python loop duplicates
+        # the whole chunked-gather program per stripe and its serialized
+        # HLO exceeds remote-compile request limits around 8 pair
+        # stripes (measured: R-MAT scale-25 f64-pair, HTTP 413). Past
+        # the threshold the stepwise path runs ONE SMALL EXECUTABLE PER
+        # STRIPE — exact per-stripe shapes, dispatched sequentially per
+        # iteration (_setup_multi_dispatch): every compile request is
+        # O(one stripe) and the fast top-level gather lowering is kept,
+        # with async dispatch pipelining hiding the per-dispatch cost.
+        # The fused single-program forms (run_fused / run_fused_tol)
+        # instead pad the same arrays to a shared geometry inside the
+        # program and scan over stripes — the scan body loses the fast
+        # gather (~3.7x slower execution, measured at scale 24;
+        # docs/PERF_NOTES.md), so fused is the slow form here and
+        # run_fast/run_fused_chunked the fast ones.
         scan_stripes = (
             not want_pallas
             and n_stripes * (2 if pair else 1) > self.SCAN_STRIPE_UNITS
         )
         if scan_stripes:
-            sent = np.int32(sz << log2g)
+            # Shared geometry for the fused scan form only — resident
+            # arrays keep their EXACT per-stripe shapes (power-law skew
+            # makes uniform rows_max padding multiply real gather work:
+            # measured 2.5s/iter vs ~0.5s expected at scale 22 with 8
+            # pair stripes). The scan form pads transiently in-program.
+            sent_scan = np.int32(sz << log2g)
             chunk_scan = ell_chunks[int(np.argmax(stripe_rows_dev))]
-            rows_max = max(a.shape[0] for a in self._src)
-            rows_max = -(-rows_max // (ndev * chunk_scan)) * (ndev * chunk_scan)
+            rows_max_dev = -(-max(stripe_rows_dev) // chunk_scan) * chunk_scan
             P_max = max(num_present)
-            src_st, rb_st, ids_st = [], [], []
-            for s in range(n_stripes):
-                src_st.append(_pad_rows(self._src[s], rows_max, sent, jnp))
-                pad_id = max(0, num_present[s] - 1)
-                rb_st.append(_pad_rows(self._row_block[s], rows_max, pad_id,
-                                       jnp))
-                ids_st.append(_pad_rows(
-                    present_ids[s], P_max, np.int32(num_blocks), jnp
-                ))
-            self._src = [jax.device_put(
-                jnp.stack(src_st),
-                jax.sharding.NamedSharding(mesh, P(None, axis, None)),
-            )]
-            self._row_block = [jax.device_put(
-                jnp.stack(rb_st),
-                jax.sharding.NamedSharding(mesh, P(None, axis)),
-            )]
-            self._scan_ids = jax.device_put(jnp.stack(ids_st), rep)
-            del src_st, rb_st, ids_st
 
         def make_contrib(mode):
             """mode: 'ell' (XLA path) or a pallas gather strategy name."""
@@ -657,7 +652,26 @@ class JaxTpuEngine(PageRankEngine):
                 P_m = P_max
 
                 def sharded_contrib(*args):
-                    zs, (src_st, rb_st, ids_st) = args[:nz], args[nz:]
+                    zs, rest = args[:nz], args[nz:]
+                    # Pad every stripe to the shared geometry and stack
+                    # for the scan — transient, inside this program
+                    # only; the resident arrays keep exact shapes for
+                    # the multi-dispatch stepwise path. Row padding is
+                    # all-sentinel (adds zero), rb pads to the stripe's
+                    # last present rank, ids pad to the dump row.
+                    src_st = jnp.stack([
+                        _pad_rows(a, rows_max_dev, sent_scan, jnp)
+                        for a in rest[0::3]
+                    ])
+                    rb_st = jnp.stack([
+                        _pad_rows(a, rows_max_dev,
+                                  np.int32(max(0, num_present[i] - 1)), jnp)
+                        for i, a in enumerate(rest[1::3])
+                    ])
+                    ids_st = jnp.stack([
+                        _pad_rows(a, P_max, np.int32(num_blocks), jnp)
+                        for a in rest[2::3]
+                    ])
                     # Stripe z slices ride the scan's xs (a STATIC
                     # [S, sz] reshape) — an in-body dynamic_slice of the
                     # gather table knocks XLA off the fast-gather
@@ -706,8 +720,8 @@ class JaxTpuEngine(PageRankEngine):
                     )
 
                 in_specs = (P(),) * nz + (
-                    P(None, axis, None), P(None, axis), P()
-                )
+                    P(axis, None), P(axis), P()
+                ) * n_stripes
             else:
                 nz = 2 if pair else 1
 
@@ -789,10 +803,7 @@ class JaxTpuEngine(PageRankEngine):
             return z
 
         def prescale_pair(r):
-            z = _z(r)
-            hi = z.astype(jnp.float32)
-            lo = (z - hi.astype(z.dtype)).astype(jnp.float32)
-            return hi, lo
+            return _split_pair(_z(r))
 
         def prescale_plain(r):
             return _z(r)
@@ -861,10 +872,6 @@ class JaxTpuEngine(PageRankEngine):
 
         if self._kernel.startswith("pallas"):
             contrib_args = (self._src[0], self._row_block[0])
-        elif scan_stripes:
-            contrib_args = (
-                self._src[0], self._row_block[0], self._scan_ids
-            )
         else:
             contrib_args = tuple(
                 a for triple in zip(self._src, self._row_block, present_ids)
@@ -874,6 +881,135 @@ class JaxTpuEngine(PageRankEngine):
             contrib_fn, contrib_args,
             mass_mask, zero_in, valid, n, n_state, prescale=prescale,
         )
+        if scan_stripes:
+            self._setup_multi_dispatch(
+                n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
+                accum=accum, num_blocks=num_blocks, chunks=ell_chunks,
+                num_present=num_present, prefix_flags=prefix_flags,
+                ids=present_ids, n=n, n_state=n_state,
+            )
+
+    def _setup_multi_dispatch(self, *, n_stripes, sz, gw, group, pair,
+                              accum, num_blocks, chunks, num_present,
+                              prefix_flags, ids, n, n_state):
+        """Fast stepwise path for very-many-stripe layouts: run each
+        stripe's contribution as its OWN dispatch (per-stripe compiled
+        executable, EXACT per-stripe shapes and a STATIC per-stripe z
+        slice — the literal unrolled-loop body as a standalone program),
+        each returning its compact per-present-block partial; ONE
+        finalize dispatch then scatters all partials into the global
+        block array, reduces across devices, and applies the rank
+        update.
+
+        Why: the unrolled single-program form exceeds the remote-compile
+        size limit past SCAN_STRIPE_UNITS, and the in-program
+        scan-over-stripes fallback loses XLA's fast gather lowering
+        (0.91e8 vs 3.33e8 edges/s/chip at scale 24, docs/PERF_NOTES.md
+        "Scan bodies defeat the fast gather"). Per-stripe dispatches get
+        both: each compile request is O(one stripe) — the 413 limit was
+        per-request, so S small requests are fine where one S-stripe
+        program was not — and each dispatch is a top-level program whose
+        gather table is a (statically sliced) root argument, keeping the
+        fast lowering. Two measured dead ends shaped this design
+        (scale-24 pair, 8x2.1M stripes, v5e):
+
+        - uniform rows_max shapes: power-law skew (stripe rows measured
+          [2.0M, 139K, 74K, 49K, 33K x4]) makes every stripe cost like
+          the biggest — 2.5 s/iter where ~0.5 s was expected;
+        - accumulating into a donated [num_blocks, 128] accum-dtype slab
+          per stripe: the scatter's full-table read-modify-write put a
+          ~60 ms FLOOR under every dispatch (measured flat across
+          stripes with 8x differing work) — hence compact per-stripe
+          outputs with all scatters batched into the one finalize
+          program.
+
+        Per-dispatch host latency (~1-5 ms measured) is hidden by async
+        dispatch pipelining. Used by ``_device_step`` (run_fast / run /
+        run_fused_chunked). The single-program fused forms (run_fused /
+        run_fused_tol) cannot contain host-driven dispatches and keep
+        the scan body.
+        """
+        mesh = self._mesh
+        axis = self.config.mesh_axis
+        total_z = n_stripes * sz
+
+        def ms_prescale(r, inv):
+            # Same math as the _setup_ell prescale closures, but ``inv``
+            # is a runtime ARGUMENT: a closed-over device array lowers
+            # as an embedded HLO constant, and at scale 25 the 268MB f64
+            # inv vector alone blew the remote-compile request limit
+            # (HTTP 413) for this otherwise-tiny program.
+            z = r.astype(inv.dtype) * inv
+            if total_z > n_state:
+                z = jnp.concatenate(
+                    [z, jnp.zeros(total_z - n_state, z.dtype)]
+                )
+            return _split_pair(z) if pair else (z,)
+
+        self._ms_prescale = jax.jit(ms_prescale)
+
+        nz = 2 if pair else 1
+
+        def make_stripe_fn(s, Ps, ck):
+            lo_ix = s * sz
+
+            def stripe_body(*args):
+                zs, (src, rb) = args[:nz], args[nz:]
+                z_s = [
+                    jnp.concatenate(
+                        [z[lo_ix : lo_ix + sz], jnp.zeros(gw, z.dtype)]
+                    )
+                    for z in zs
+                ]
+                if pair:
+                    part = spmv.ell_contrib_pair(
+                        z_s[0], z_s[1], src, rb, num_blocks,
+                        accum_dtype=accum, gather_width=gw, chunk_rows=ck,
+                        group=group, num_present=Ps,
+                    )
+                else:
+                    part = spmv.ell_contrib(
+                        z_s[0], src, rb, num_blocks, accum_dtype=accum,
+                        gather_width=gw, chunk_rows=ck, group=group,
+                        num_present=Ps,
+                    )
+                return part.reshape(1, Ps, 128)
+
+            return jax.jit(
+                shard_map(
+                    stripe_body,
+                    mesh=mesh,
+                    in_specs=(P(),) * nz + (P(axis, None), P(axis)),
+                    out_specs=P(axis, None, None),
+                )
+            )
+
+        self._ms_stripe_fns = [
+            make_stripe_fn(s, num_present[s], chunks[s])
+            for s in range(n_stripes)
+        ]
+        self._ms_stripe = self._ms_stripe_fns[0]  # engaged-flag + probe
+
+        update_tail = self._update_tail  # set by _finalize, shared
+
+        def final_body(r, *rest):
+            parts = rest[:n_stripes]
+            ids_l = rest[n_stripes : 2 * n_stripes]
+            dangling, zero_in, valid_m = rest[2 * n_stripes :]
+            total = jnp.zeros((num_blocks, 128), accum)
+            for s in range(n_stripes):
+                # .sum(0) collapses the per-device partials (GSPMD turns
+                # it into the cross-device reduce); the scatters stay in
+                # ONE program so XLA keeps one resident accumulator.
+                total = spmv.scatter_block_sums(
+                    total, parts[s].sum(0), ids_l[s], prefix_flags[s]
+                )
+            contrib = total.reshape(-1)[: r.shape[0]]
+            return update_tail(contrib, r, dangling, zero_in, valid_m)
+
+        self._ms_final = jax.jit(final_body, donate_argnums=(0,))
+        self._ms_ids = list(ids)
+        self._ms_n_stripes = n_stripes
 
     def _finalize(self, contrib_fn, contrib_args, mass_mask, zero_in, valid,
                   n, n_state, prescale=None):
@@ -904,10 +1040,10 @@ class JaxTpuEngine(PageRankEngine):
         damping = cfg.damping
         semantics = cfg.semantics
 
-        def step_core(r, dangling, zero_in, valid_m, *c_args):
-            z = r if prescale is None else prescale(r)
-            zs = z if isinstance(z, tuple) else (z,)
-            contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
+        def update_tail(contrib, r, dangling, zero_in, valid_m):
+            """Rank update + masks + L1 delta — the ONE spelling shared
+            by the fused step and the multi-dispatch finalize so the
+            semantics cannot drift between dispatch forms."""
             m = spmv.dangling_mass(r, dangling, accum)
             r_new = pr_model.apply_update(
                 contrib, r.astype(accum), zero_in.astype(accum), m, n,
@@ -916,6 +1052,14 @@ class JaxTpuEngine(PageRankEngine):
             r_new = (r_new * valid_m.astype(accum)).astype(r.dtype)
             delta = jnp.sum(jnp.abs(r_new.astype(accum) - r.astype(accum)))
             return r_new, delta, m
+
+        self._update_tail = update_tail
+
+        def step_core(r, dangling, zero_in, valid_m, *c_args):
+            z = r if prescale is None else prescale(r)
+            zs = z if isinstance(z, tuple) else (z,)
+            contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
+            return update_tail(contrib, r, dangling, zero_in, valid_m)
 
         self._contrib_args = contrib_args
         self._step_core = step_core
@@ -931,7 +1075,23 @@ class JaxTpuEngine(PageRankEngine):
     # -- iteration --------------------------------------------------------
 
     def _device_step(self):
-        """One iteration; returns (delta, mass) as device scalars."""
+        """One iteration; returns (delta, mass) as device scalars. On
+        very-many-stripe layouts this is the multi-dispatch sequence
+        (prescale, one dispatch per stripe, finalize) — see
+        _setup_multi_dispatch; otherwise one fused jitted step."""
+        if self._ms_stripe is not None:
+            zs = self._ms_prescale(self._r, self._inv_out)
+            parts = [
+                self._ms_stripe_fns[s](
+                    *zs, self._src[s], self._row_block[s]
+                )
+                for s in range(self._ms_n_stripes)
+            ]
+            self._r, delta, m = self._ms_final(
+                self._r, *parts, *self._ms_ids,
+                self._dangling, self._zero_in, self._valid,
+            )
+            return delta, m
         self._r, delta, m = self._step_fn(*self._device_args())
         return delta, m
 
@@ -964,6 +1124,12 @@ class JaxTpuEngine(PageRankEngine):
         and per-iteration logging need host control between steps — use
         :meth:`PageRankEngine.run` for those; ``tol`` early-stopping has
         its own fused, on-device form (:meth:`run_fused_tol`).
+
+        NOTE: on very-many-stripe layouts (past ``SCAN_STRIPE_UNITS``)
+        the single-program constraint forces the scan-over-stripes body,
+        which loses XLA's fast gather — there :meth:`run_fast` /
+        :meth:`run_fused_chunked` (multi-dispatch per stripe) are the
+        fast forms; see ``_setup_multi_dispatch``.
         Per-iteration (l1_delta, dangling_mass) traces are kept as device
         arrays in :attr:`last_run_metrics`.
         """
@@ -1042,9 +1208,21 @@ class JaxTpuEngine(PageRankEngine):
             # stepwise loop ((i+1) % every == 0); the final chunk may be
             # a short remainder ending off-cadence at ``total``.
             k = min(every - self.iteration % every, total - self.iteration)
-            fused = self._get_fused(k)
-            self._r, (deltas, masses) = fused(*self._device_args())
-            self.iteration += k
+            if self._ms_stripe is not None:
+                # Very-many-stripe layouts: pipelined multi-dispatch
+                # steps (the fast form there — the fused scan body loses
+                # the fast gather; _setup_multi_dispatch docstring).
+                dl, ml = [], []
+                for _ in range(k):
+                    d, m = self._device_step()
+                    dl.append(d)
+                    ml.append(m)
+                deltas, masses = jnp.stack(dl), jnp.stack(ml)
+                self.iteration += k  # _device_step does not count
+            else:
+                fused = self._get_fused(k)
+                self._r, (deltas, masses) = fused(*self._device_args())
+                self.iteration += k
             ds.append(deltas)
             ms.append(masses)
             if on_chunk is not None:
@@ -1077,6 +1255,8 @@ class JaxTpuEngine(PageRankEngine):
         k = total - self.iteration
         if k > 0:
             if every and every > 0:
+                if self._ms_stripe is not None:
+                    return k  # chunked runs step multi-dispatch there
                 e = int(every)
                 # Chunks align to absolute multiples of ``e`` (see
                 # run_fused_chunked): compile the possibly-short first

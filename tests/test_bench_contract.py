@@ -18,10 +18,38 @@ def _env():
     return env
 
 
-def test_bench_json_contract(tmp_path):
+def test_bench_json_contract_couple_mode(tmp_path):
+    """Default (couple) mode: pair-f64 headline + f32 secondary + the
+    standing scale-N accuracy field, all in ONE JSON line."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--scale", "10",
-         "--iters", "2", "--warmup", "1", "--host-build"],
+         "--iters", "2", "--warmup", "1", "--host-build",
+         "--accuracy-scale", "12"],
+        capture_output=True, text=True, env=_env(), timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, r.stdout
+    rec = json.loads(json_lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "fast_f32", "accuracy"}
+    assert rec["metric"] == "edges_per_sec_per_chip"
+    assert rec["unit"] == "edges/s/chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    assert rec["fast_f32"]["value"] > 0 and rec["fast_f32"]["vs_baseline"] > 0
+    acc = rec["accuracy"]
+    assert acc["config"] == "f32+pair-f64"
+    assert acc["scale"] == 12 and acc["iters"] == 2
+    # The accuracy-grade config must actually be accuracy-grade.
+    assert 0 <= acc["normalized_l1_vs_f64_oracle"] < 1e-5
+
+
+def test_bench_json_contract_single_mode(tmp_path):
+    """--dtype selects the original single-config schema."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--scale", "10",
+         "--dtype", "float32", "--iters", "2", "--warmup", "1",
+         "--host-build", "--no-accuracy"],
         capture_output=True, text=True, env=_env(), timeout=600,
     )
     assert r.returncode == 0, r.stderr[-800:]
@@ -29,8 +57,6 @@ def test_bench_json_contract(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
-    assert rec["metric"] == "edges_per_sec_per_chip"
-    assert rec["unit"] == "edges/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
 
 

@@ -97,8 +97,10 @@ def test_bad_stripe_size_rejected():
 @pytest.mark.parametrize("ndev", [1, 2])
 @pytest.mark.parametrize("accum", ["float32", "float64"])
 def test_scan_stripes_fallback_matches_unstriped(monkeypatch, ndev, accum):
-    """The compile-size fallback (stripes stacked and run as a lax.scan,
-    engaged past SCAN_STRIPE_UNITS) must produce the same ranks as the
+    """Past SCAN_STRIPE_UNITS the engine switches to uniform per-stripe
+    shapes: the stepwise path runs one shared executable per stripe
+    (multi-dispatch, fast gather preserved) and the fused path restacks
+    in-program and scans. Both must produce the same ranks as the
     unstriped engine (and, transitively through
     test_striped_engine_matches_unstriped, the unrolled striped form)."""
     rng = np.random.default_rng(5)
@@ -110,11 +112,19 @@ def test_scan_stripes_fallback_matches_unstriped(monkeypatch, ndev, accum):
     r_plain = JaxTpuEngine(cfg).build(g).run_fast()
     monkeypatch.setattr(JaxTpuEngine, "_stripe_max", lambda self: 256)
     monkeypatch.setattr(JaxTpuEngine, "_stripe_target", lambda self: 256)
-    monkeypatch.setattr(JaxTpuEngine, "SCAN_STRIPE_UNITS", 0)  # force scan
+    monkeypatch.setattr(JaxTpuEngine, "SCAN_STRIPE_UNITS", 0)  # force it
     eng = JaxTpuEngine(cfg).build(g)
-    # stacked [n_stripes, rows, 128] slots + scan
-    assert len(eng._src) == 1
-    assert eng._src[0].ndim == 3
-    assert eng._src[0].shape[0] == -(-eng._n_state // 256)
-    r_scan = eng.run_fast()
-    np.testing.assert_allclose(r_scan, r_plain, rtol=1e-6, atol=1e-7)
+    S = -(-eng._n_state // 256)
+    assert len(eng._src) == S
+    assert eng._ms_stripe is not None  # multi-dispatch stepwise engaged
+    assert len(eng._ms_stripe_fns) == S  # one executable per stripe shape
+    r_md = eng.run_fast()
+    np.testing.assert_allclose(r_md, r_plain, rtol=1e-6, atol=1e-7)
+    # The fused single-program form (in-program restack + lax.scan).
+    eng2 = JaxTpuEngine(cfg).build(g)
+    r_fused = eng2.run_fused()
+    np.testing.assert_allclose(r_fused, r_plain, rtol=1e-6, atol=1e-7)
+    # And fused-chunked, which steps via the multi-dispatch path.
+    eng3 = JaxTpuEngine(cfg).build(g)
+    r_ck = eng3.run_fused_chunked(every=3)
+    np.testing.assert_allclose(r_ck, r_plain, rtol=1e-6, atol=1e-7)
