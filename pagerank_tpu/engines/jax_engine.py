@@ -1256,7 +1256,15 @@ class JaxTpuEngine(PageRankEngine):
         if k > 0:
             if every and every > 0:
                 if self._ms_stripe is not None:
-                    return k  # chunked runs step multi-dispatch there
+                    # Chunked runs step the multi-dispatch path there:
+                    # warm ALL its executables with one throwaway step
+                    # on a copy of the state, so the caller's timed
+                    # region pays no per-stripe remote compiles.
+                    keep = jnp.copy(self._r)
+                    self._device_step()
+                    self.fence()
+                    self._r = keep
+                    return k
                 e = int(every)
                 # Chunks align to absolute multiples of ``e`` (see
                 # run_fused_chunked): compile the possibly-short first

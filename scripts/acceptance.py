@@ -59,9 +59,21 @@ def run_one(key: str):
     t0 = time.perf_counter()
     eng = JaxTpuEngine(cfg_pair).build(g)
     t_dev_build = time.perf_counter() - t0
+    # Compile outside the timed window, then restore the initial state
+    # (reference semantics: rank 1.0 per vertex, Sparky.java:168). The
+    # timed window covers steps + the honest scalar fence ONLY (bench.py
+    # pattern) — the full rank decode/D2H happens after, so it doesn't
+    # deflate the rate column.
+    eng.step()
+    eng.fence()
+    eng.set_ranks(np.full(g.n, 1.0), iteration=0)
+    chips = eng.mesh.devices.size
     t0 = time.perf_counter()
-    r_tpu = eng.run_fast()
+    for _ in range(iters):
+        eng._device_step()
+    eng.fence()
     t_run = time.perf_counter() - t0
+    r_tpu = eng.ranks()
 
     t0 = time.perf_counter()
     cfg_oracle = PageRankConfig(num_iters=iters, dtype="float64",
@@ -71,7 +83,7 @@ def run_one(key: str):
 
     l1 = float(np.abs(r_tpu - r_cpu).sum())
     norm = l1 / float(np.abs(r_cpu).sum())
-    rate = g.num_edges * iters / t_run
+    rate = g.num_edges * iters / t_run / chips
     rec = {
         "config": key,
         "label": spec["label"],
