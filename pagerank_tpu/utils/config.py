@@ -37,8 +37,12 @@ class PageRankConfig:
 
     # SpMV kernel: "pallas" = hand Pallas kernel, rank vector pinned in
     # VMEM (ops/pallas_spmv.py; probes Mosaic support at build and falls
-    # back to ell; refuses graphs over the VMEM budget);
-    # "ell" = blocked-ELL + row segment-sum (TPU-fast,
+    # back to ell; refuses graphs over the VMEM budget). EXPERIMENTAL:
+    # on the current jaxlib/Mosaic BOTH gather strategies fail to lower
+    # on real TPU hardware (docs/PERF_NOTES.md "The Pallas kernel,
+    # settled end-to-end"), so this always probe-falls-back to ell with
+    # a ~9% layout penalty — measured 2.99e8 vs 3.26e8 edges/s/chip at
+    # scale 21. "ell" = blocked-ELL + row segment-sum (TPU-fast,
     # ops/ell.py), "coo" = dst-sorted COO + per-edge segment-sum
     # (simple; also the portable baseline), "auto" = ell.
     kernel: str = "auto"
