@@ -38,10 +38,11 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     assert rec["fast_f32"]["value"] > 0 and rec["fast_f32"]["vs_baseline"] > 0
     acc = rec["accuracy"]
-    assert acc["config"] == "f32+pair-f64"
+    assert acc["config"] == "pair-f64"
     assert acc["scale"] == 12 and acc["iters"] == 2
     # The accuracy-grade config must actually be accuracy-grade.
     assert 0 <= acc["normalized_l1_vs_f64_oracle"] < 1e-5
+    assert 0 <= acc["mass_normalized_l1"] < 1e-5
 
 
 def test_bench_json_contract_single_mode(tmp_path):
