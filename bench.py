@@ -216,11 +216,9 @@ def run_accuracy(scale: int = 20, iters: int = 50):
     cfg_f64 = PageRankConfig(num_iters=iters, dtype="float64",
                              accum_dtype="float64")
     r_cpu = ReferenceCpuEngine(cfg_f64).build(g).run()
-    l1 = float(np.abs(r_tpu - r_cpu).sum())
-    norm = l1 / float(np.abs(r_cpu).sum())
-    mass_norm = float(np.abs(
-        r_tpu / r_tpu.sum() - r_cpu / r_cpu.sum()
-    ).sum())
+    from pagerank_tpu.utils.metrics import oracle_l1
+
+    l1, norm, mass_norm = oracle_l1(r_tpu, r_cpu)
     print(
         f"accuracy[pair-f64]: scale-{scale}, {iters} iters: "
         f"L1 vs f64 oracle {l1:.3e} (normalized {norm:.3e}, "

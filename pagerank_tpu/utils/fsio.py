@@ -193,7 +193,7 @@ class MemoryFileSystem(FileSystem):
             if not (path.rstrip("/") in self.dirs
                     or any(k.startswith(prefix) for k in self.files)):
                 raise FileNotFoundError(path)
-            for k in list(self.files) + [d for d in self.dirs]:
+            for k in list(self.files) + list(self.dirs):
                 if k.startswith(prefix):
                     names.add(k[len(prefix):].split("/", 1)[0])
         return sorted(n for n in names if n)

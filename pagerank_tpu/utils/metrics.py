@@ -16,6 +16,25 @@ from typing import Dict, Optional, TextIO
 from pagerank_tpu.utils import fsio
 
 
+def oracle_l1(r, r_ref):
+    """(raw L1, raw normalized L1, mass-normalized L1) between a rank
+    vector and an oracle's — the accuracy numbers bench.py and
+    scripts/acceptance.py report. Raw and mass-normalized both exist
+    because reference-mode mass growth turns TPU f64-emulation rounding
+    into a pure global-scale offset on the raw vectors
+    (docs/PERF_NOTES.md "Reference-mode mass growth"); the
+    mass-normalized number carries the relative structure PageRank
+    defines."""
+    import numpy as np
+
+    r = np.asarray(r, dtype=np.float64)
+    r_ref = np.asarray(r_ref, dtype=np.float64)
+    l1 = float(np.abs(r - r_ref).sum())
+    norm = l1 / float(np.abs(r_ref).sum())
+    mass = float(np.abs(r / r.sum() - r_ref / r_ref.sum()).sum())
+    return l1, norm, mass
+
+
 class MetricsLogger:
     """Per-iteration logger; use as the engine's ``on_iteration`` hook."""
 

@@ -12,6 +12,10 @@ graph:
 
   A (config-2 stand-in): scale-20 R-MAT (1.05M vertices), 20 iters
   B (config-3 stand-in): scale-23 R-MAT (8.4M vertices),  30 iters
+  C (config-4 PER-CHIP stand-in, not run by default — pass --only C):
+    scale-24 R-MAT (16.8M vertices / 263M edges), 50 iters — the edge
+    count one chip of config 4's v4-8 holds of Twitter-2010
+    (1.47B/8 ~= 184M), at the reference's full 50-iteration count
 
 Gate policy (PERF_NOTES "Reference-mode mass growth"): the 1e-6 gate
 always applies to the MASS-NORMALIZED L1 (the quantity PageRank
@@ -22,7 +26,7 @@ with real error. Each run appends a row to BASELINE.md's "Acceptance
 runs" table (use --no-append to skip).
 
 Usage:
-  PYTHONPATH=. python scripts/acceptance.py [--only A|B] [--no-append]
+  PYTHONPATH=. python scripts/acceptance.py [--only A|B|C] [--no-append]
 """
 
 import argparse
@@ -41,7 +45,12 @@ GATE = 1e-6
 CONFIGS = {
     "A": dict(scale=20, iters=20, label="config-2 stand-in (web-Google class)"),
     "B": dict(scale=23, iters=30, label="config-3 stand-in (LiveJournal class)"),
+    # Not in the default set (the ~15-minute host build + oracle pass
+    # makes it a deliberate run): the per-chip share of config 4.
+    "C": dict(scale=24, iters=50,
+              label="config-4 per-chip stand-in (Twitter class, 50 iters)"),
 }
+DEFAULT_KEYS = ["A", "B"]
 
 
 def run_one(key: str):
@@ -88,16 +97,9 @@ def run_one(key: str):
     r_cpu = ReferenceCpuEngine(cfg_oracle).build(g).run()
     t_oracle = time.perf_counter() - t0
 
-    l1 = float(np.abs(r_tpu - r_cpu).sum())
-    norm = l1 / float(np.abs(r_cpu).sum())
-    # Mass-normalized: reference semantics grows total mass
-    # exponentially, and TPU f64-emulation rounding shows up as a pure
-    # global-scale offset on the raw vectors at high iteration counts
-    # (bench.run_accuracy docstring); the unit-mass vectors carry the
-    # relative structure PageRank defines.
-    mass_norm = float(np.abs(
-        r_tpu / r_tpu.sum() - r_cpu / r_cpu.sum()
-    ).sum())
+    from pagerank_tpu.utils.metrics import oracle_l1
+
+    _, norm, mass_norm = oracle_l1(r_tpu, r_cpu)
     # Raw-L1 gating applies only while mass growth is moderate (module
     # docstring); mass-normalized L1 is always gated.
     growth = float(r_cpu.sum()) / g.n
@@ -133,7 +135,7 @@ def append_baseline(recs) -> None:
     path = os.path.join(REPO, "BASELINE.md")
     with open(path) as f:
         text = f.read()
-    header = "## Acceptance runs (configs 2/3 stand-ins)"
+    header = "## Acceptance runs (configs 2-4 stand-ins)"
     if header not in text:
         text += (
             f"\n{header}\n\n"
@@ -170,7 +172,7 @@ def main(argv=None) -> int:
     from bench import _enable_compile_cache
 
     _enable_compile_cache()
-    keys = [args.only] if args.only else sorted(CONFIGS)
+    keys = [args.only] if args.only else DEFAULT_KEYS
     recs = [run_one(k) for k in keys]
     if not args.no_append:
         append_baseline(recs)

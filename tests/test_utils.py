@@ -72,3 +72,26 @@ def test_tol_validation():
     for bad in (0.0, -1.0, float("inf"), math.nan):
         with pytest.raises(ValueError, match="tol"):
             PageRankConfig(tol=bad).validate()
+
+
+def test_oracle_l1_known_vectors():
+    """oracle_l1 is the single source of the acceptance gate metrics:
+    pin it on hand-computed vectors (incl. the global-scale-offset case
+    the mass normalization exists for)."""
+    import pytest
+
+    from pagerank_tpu.utils.metrics import oracle_l1
+
+    r_ref = np.array([1.0, 2.0, 5.0])
+    # Pure global scale offset: raw L1 sees it, mass-normalized is 0.
+    l1, norm, mass = oracle_l1(r_ref * 1.01, r_ref)
+    assert l1 == pytest.approx(0.08)
+    assert norm == pytest.approx(0.01)
+    assert mass == pytest.approx(0.0, abs=1e-15)
+    # Pure redistribution at constant mass: both see it.
+    l1, norm, mass = oracle_l1(np.array([2.0, 1.0, 5.0]), r_ref)
+    assert l1 == pytest.approx(2.0)
+    assert norm == pytest.approx(0.25)
+    assert mass == pytest.approx(0.25)
+    # Identity.
+    assert oracle_l1(r_ref, r_ref) == (0.0, 0.0, 0.0)
