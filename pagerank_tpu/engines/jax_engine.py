@@ -95,6 +95,7 @@ class JaxTpuEngine(PageRankEngine):
         self._pack: Optional[ell_lib.EllPack] = None
         self._perm: Optional[np.ndarray] = None  # relabeled -> original
         self._ms_stripe = None  # set by _setup_multi_dispatch
+        self._inv_in_args = False  # set by _finalize
 
     # -- build ------------------------------------------------------------
 
@@ -786,7 +787,6 @@ class JaxTpuEngine(PageRankEngine):
                 check_vma=(mode == "ell"),
             )
 
-        inv_out = self._inv_out
         total_z = n_stripes * sz  # >= n_state; prescale zero-fills the tail
 
         # Dekker split of the wide prescale: z = hi + lo exactly, both
@@ -794,22 +794,27 @@ class JaxTpuEngine(PageRankEngine):
         # sentinel pads are appended inside the contrib fn; the pallas
         # kernel instead consumes a gw-padded plain z pinned in VMEM, so
         # the prescale is bound per-kernel after the probe below.
-        def _z(r):
-            z = r.astype(inv_out.dtype) * inv_out
+        # ``inv`` is a runtime ARGUMENT, never a closure: a closed-over
+        # device array lowers as an embedded HLO constant, and at large
+        # scales the 1/out-degree vector alone can blow the
+        # remote-compile request limit (268MB f64 at scale 25 -> HTTP
+        # 413, docs/PERF_NOTES.md "Multi-dispatch stripes").
+        def _z(r, inv):
+            z = r.astype(inv.dtype) * inv
             if total_z > n_state:
                 z = jnp.concatenate(
                     [z, jnp.zeros(total_z - n_state, z.dtype)]
                 )
             return z
 
-        def prescale_pair(r):
-            return _split_pair(_z(r))
+        def prescale_pair(r, inv):
+            return _split_pair(_z(r, inv))
 
-        def prescale_plain(r):
-            return _z(r)
+        def prescale_plain(r, inv):
+            return _z(r, inv)
 
-        def prescale_pallas(r):
-            z = r.astype(inv_out.dtype) * inv_out
+        def prescale_pallas(r, inv):
+            z = r.astype(inv.dtype) * inv
             return jnp.concatenate([z, jnp.zeros(gw, dtype=z.dtype)])
 
         prescale = prescale_pair if pair else prescale_plain
@@ -832,15 +837,16 @@ class JaxTpuEngine(PageRankEngine):
                 candidate = make_contrib(mode)
                 try:
                     probe = jax.jit(
-                        lambda src, rb, fn=candidate: fn(
+                        lambda src, rb, inv, fn=candidate: fn(
                             prescale_pallas(
-                                jnp.zeros(n_state, self._inv_out.dtype)
+                                jnp.zeros(n_state, inv.dtype), inv
                             ),
                             src, rb,
                         )
                     )
                     jax.block_until_ready(
-                        probe(self._src[0], self._row_block[0])
+                        probe(self._src[0], self._row_block[0],
+                              self._inv_out)
                     )
                     contrib_fn = candidate
                     prescale = prescale_pallas
@@ -886,12 +892,12 @@ class JaxTpuEngine(PageRankEngine):
                 n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
                 accum=accum, num_blocks=num_blocks, chunks=ell_chunks,
                 num_present=num_present, prefix_flags=prefix_flags,
-                ids=present_ids, n=n, n_state=n_state,
+                ids=present_ids, n=n, n_state=n_state, prescale=prescale,
             )
 
     def _setup_multi_dispatch(self, *, n_stripes, sz, gw, group, pair,
                               accum, num_blocks, chunks, num_present,
-                              prefix_flags, ids, n, n_state):
+                              prefix_flags, ids, n, n_state, prescale):
         """Fast stepwise path for very-many-stripe layouts: run each
         stripe's contribution as its OWN dispatch (per-stripe compiled
         executable, EXACT per-stripe shapes and a STATIC per-stripe z
@@ -931,20 +937,12 @@ class JaxTpuEngine(PageRankEngine):
         """
         mesh = self._mesh
         axis = self.config.mesh_axis
-        total_z = n_stripes * sz
 
         def ms_prescale(r, inv):
-            # Same math as the _setup_ell prescale closures, but ``inv``
-            # is a runtime ARGUMENT: a closed-over device array lowers
-            # as an embedded HLO constant, and at scale 25 the 268MB f64
-            # inv vector alone blew the remote-compile request limit
-            # (HTTP 413) for this otherwise-tiny program.
-            z = r.astype(inv.dtype) * inv
-            if total_z > n_state:
-                z = jnp.concatenate(
-                    [z, jnp.zeros(total_z - n_state, z.dtype)]
-                )
-            return _split_pair(z) if pair else (z,)
+            # The engine's own (inv-parametric) prescale, normalized to
+            # a tuple of gather planes.
+            z = prescale(r, inv)
+            return z if isinstance(z, tuple) else (z,)
 
         self._ms_prescale = jax.jit(ms_prescale)
 
@@ -1055,11 +1053,22 @@ class JaxTpuEngine(PageRankEngine):
 
         self._update_tail = update_tail
 
-        def step_core(r, dangling, zero_in, valid_m, *c_args):
-            z = r if prescale is None else prescale(r)
-            zs = z if isinstance(z, tuple) else (z,)
-            contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
-            return update_tail(contrib, r, dangling, zero_in, valid_m)
+        # With a prescale, the step takes the 1/out-degree vector as a
+        # runtime argument (see _setup_ell: closed-over device arrays
+        # embed as HLO constants and can blow the remote-compile
+        # request limit at scale). The coo path has no prescale and no
+        # inv argument.
+        self._inv_in_args = prescale is not None
+        if prescale is None:
+            def step_core(r, dangling, zero_in, valid_m, *c_args):
+                contrib = contrib_fn(r, *c_args)[: r.shape[0]]
+                return update_tail(contrib, r, dangling, zero_in, valid_m)
+        else:
+            def step_core(r, inv, dangling, zero_in, valid_m, *c_args):
+                z = prescale(r, inv)
+                zs = z if isinstance(z, tuple) else (z,)
+                contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
+                return update_tail(contrib, r, dangling, zero_in, valid_m)
 
         self._contrib_args = contrib_args
         self._step_core = step_core
@@ -1288,15 +1297,14 @@ class JaxTpuEngine(PageRankEngine):
             core = self._step_core
             acc = self._accum_dtype
 
-            def fused_fn(r, dangling, zero_in, valid_m, *c_args):
+            def fused_fn(r, *rest):
                 def cond(carry):
                     _, i, delta, _ = carry
                     return jnp.logical_and(i < k, delta > tol)
 
                 def body(carry):
                     rr, i, _, _ = carry
-                    r2, delta, m = core(rr, dangling, zero_in, valid_m,
-                                        *c_args)
+                    r2, delta, m = core(rr, *rest)
                     return r2, i + 1, delta, m
 
                 init = (r, jnp.int32(0), jnp.array(jnp.inf, acc),
@@ -1315,10 +1323,9 @@ class JaxTpuEngine(PageRankEngine):
         if fused is None:
             core = self._step_core
 
-            def fused_fn(r, dangling, zero_in, valid_m, *c_args):
+            def fused_fn(r, *rest):
                 def body(rr, _):
-                    r2, delta, m = core(rr, dangling, zero_in, valid_m,
-                                        *c_args)
+                    r2, delta, m = core(rr, *rest)
                     return r2, (delta, m)
 
                 return jax.lax.scan(body, r, None, length=k)
@@ -1331,7 +1338,12 @@ class JaxTpuEngine(PageRankEngine):
 
     def _device_args(self):
         """The step/fused argument tuple — ONE spelling so the
-        AOT-lowered signature and the dispatch call cannot drift."""
+        AOT-lowered signature and the dispatch call cannot drift. The
+        prescaled (ell/pallas) paths carry the 1/out-degree vector as a
+        runtime argument (never an embedded constant)."""
+        if self._inv_in_args:
+            return (self._r, self._inv_out, self._dangling, self._zero_in,
+                    self._valid, *self._contrib_args)
         return (self._r, self._dangling, self._zero_in, self._valid,
                 *self._contrib_args)
 
