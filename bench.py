@@ -13,14 +13,12 @@ them: <60 s for 50 iters on Twitter-2010 AND ranks within 1e-6 L1):
                 "mass_normalized_l1": ...}}
 
 The HEADLINE value is the accuracy-grade config ("pair-f64": f64 rank
-storage with pair-packed f64 accumulation — the one that holds the
-1e-6-grade gate over a full 50-iteration run; f32 STORAGE quantization
-under reference-semantics mass growth measures 1.4e-5 normalized L1 at
-scale-20/50-iters, so f32-storage variants are NOT accuracy-grade at
-the reference iteration counts), not the faster plain-f32 config, which
-is reported alongside. The accuracy field is a standing measurement: a
-scale-20 (1M-vertex / 16.7M-edge) R-MAT run of the SAME pair-f64 config
-diffed against the float64 CPU oracle over the full 50 iterations.
+storage with pair-packed f64 accumulation — matches the f64 CPU oracle
+to ~3e-14 normalized L1 over a full 50-iteration reference-semantics
+run; the faster plain-f32 config, reported alongside, lands ~1.6e-6
+there). The accuracy field is a standing measurement: a scale-20
+(1M-vertex / 16.7M-edge) R-MAT run of the SAME pair-f64 config diffed
+against the float64 CPU oracle over the full 50 iterations.
 
 vs_baseline is measured throughput over the north-star implied rate: the
 BASELINE.md headline (50 iters on Twitter-2010's 1.47B edges in <60 s on
@@ -186,20 +184,14 @@ def run_accuracy(scale: int = 20, iters: int = 50):
     f64 rank storage + pair-packed f64 accumulation) vs the float64 CPU
     oracle on the SAME host-built R-MAT graph, full-run L1.
 
-    Two numbers, both reported, because reference semantics makes them
-    genuinely different (measured, scale 20 / 50 iters, v5e):
-
-    - ``normalized_l1_vs_f64_oracle`` — raw N-scaled vectors. Reference
-      mode grows total mass exponentially (~2.7x/iter, sum 2.3e10 by
-      iteration 50), and TPU f64-emulation rounding injects a GLOBAL
-      SCALE offset (up to ~2e-5 relative) into that growth — the
-      per-iteration trace shows the offset appear in discrete events
-      and then persist, with the vertexwise L1 exactly equal to the
-      total-mass offset (a pure rescale, not redistribution).
-    - ``mass_normalized_l1`` — the same vectors normalized to unit mass:
-      the quantity PageRank actually defines (relative structure). This
-      is the 1e-6-grade gate; measured 1.0e-8, with the top-10k rank
-      order identical to the oracle's.
+    Two numbers, both reported: ``normalized_l1_vs_f64_oracle`` (raw
+    N-scaled vectors; ~3e-14 measured at scale-20/50-iters) and
+    ``mass_normalized_l1`` (unit-mass vectors — the relative structure
+    PageRank defines; ~1.5e-14). They can diverge only through a
+    global-scale error, which is how the f64-vdot lowering bug was
+    found and fixed (docs/PERF_NOTES.md "Reference-mode mass growth and
+    the f64-vdot lowering bug") — keeping both makes any regression of
+    that class immediately visible.
     """
     from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
                               ReferenceCpuEngine, build_graph)

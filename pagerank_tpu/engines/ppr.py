@@ -230,7 +230,10 @@ class PprJaxEngine:
                                        z2.dtype)]
                     )
                 contrib = contrib_fn(z2, *slots)[:n_state].astype(accum)
-                mass = dangling.astype(accum) @ r.astype(accum)
+                # Shared mass reduction: picks multiply+sum for 64-bit
+                # accumulation (the TPU f64-dot lowering is reduced
+                # precision; ops/spmv.py:dangling_mass docstring).
+                mass = spmv.dangling_mass(r, dangling, accum)
                 r_new = ppr_model.apply_ppr_update(
                     contrib, p_onehot.astype(accum), mass, n, damping,
                     dangling_to, jnp,

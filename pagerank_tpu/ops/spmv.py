@@ -325,9 +325,21 @@ def scatter_block_sums(total, part, ids, is_prefix):
 def dangling_mass(r, dangling, accum_dtype=None):
     """m = Σ_{out_degree==0} r — the reference's ``danglingContrib`` loop
     (one distributed lookup per dangling URL per iteration,
-    Sparky.java:219-222) collapsed to a single on-device reduction."""
-    acc = accum_dtype or r.dtype
+    Sparky.java:219-222) collapsed to a single on-device reduction.
+
+    The reduction is a masked elementwise-multiply + sum, NOT a
+    dot/matmul, whenever the accumulation is 64-bit: XLA lowers an f64
+    dot on TPU through reduced-precision dot hardware (measured 9.5e-8
+    relative error at 1M terms vs 2e-14 for multiply+sum), and since
+    ``m/N`` feeds EVERY vertex, that error excites the global scale
+    mode reference semantics amplifies — docs/PERF_NOTES.md
+    "Reference-mode mass growth and the f64-vdot lowering bug". The 2-D
+    (PPR batch) form keeps the matmul ONLY for 32-bit accumulation,
+    where the MXU path is full precision by design."""
+    acc = jnp.dtype(accum_dtype or r.dtype)
     d = dangling.astype(acc)
     if r.ndim == 2:
-        return d @ r.astype(acc)
-    return jnp.vdot(d, r.astype(acc))
+        if acc.itemsize < 8:
+            return d @ r.astype(acc)
+        return jnp.sum(d[:, None] * r.astype(acc), axis=0)
+    return jnp.sum(d * r.astype(acc))

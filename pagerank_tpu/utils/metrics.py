@@ -19,12 +19,13 @@ from pagerank_tpu.utils import fsio
 def oracle_l1(r, r_ref):
     """(raw L1, raw normalized L1, mass-normalized L1) between a rank
     vector and an oracle's — the accuracy numbers bench.py and
-    scripts/acceptance.py report. Raw and mass-normalized both exist
-    because reference-mode mass growth turns TPU f64-emulation rounding
-    into a pure global-scale offset on the raw vectors
-    (docs/PERF_NOTES.md "Reference-mode mass growth"); the
-    mass-normalized number carries the relative structure PageRank
-    defines."""
+    scripts/acceptance.py report. The raw and mass-normalized numbers
+    can diverge only through a GLOBAL-SCALE error — exactly how the
+    (since fixed) reduced-precision f64-vdot dangling-mass reduction
+    was caught (docs/PERF_NOTES.md "Reference-mode mass growth and the
+    f64-vdot lowering bug") — so reporting both keeps that error class
+    visible; the mass-normalized number carries the relative structure
+    PageRank defines."""
     import numpy as np
 
     r = np.asarray(r, dtype=np.float64)
