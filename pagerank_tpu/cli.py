@@ -281,12 +281,12 @@ def load_graph(args):
             probe = expand_seqfile_paths(path)[0]
         with fsio.fopen(probe, "rb") as fb:
             magic = fb.read(4)
-        # Require a binary (non-printable) version byte after 'SEQ' so a
-        # text file that merely *starts* with "SEQ…" falls through to
-        # the text-format detection; real SequenceFiles of any version
-        # (byte < 0x20) still reach the reader and its precise
-        # version/layout errors.
-        if magic[:3] == b"SEQ" and len(magic) == 4 and magic[3] < 0x20:
+        # Require a version byte the reader actually supports (<= 6) so a
+        # text file that merely *starts* with "SEQ" — including "SEQ\n"
+        # (0x0A) or "SEQ\t" (0x09), both control bytes — falls through
+        # to the text-format detection instead of hard-failing in the
+        # SequenceFile reader's version check.
+        if magic[:3] == b"SEQ" and len(magic) == 4 and magic[3] <= 6:
             fmt = "seqfile"
         elif probe != path:
             raise SystemExit(
@@ -454,18 +454,20 @@ def main(argv=None) -> int:
                 # Fused dispatches BETWEEN snapshot points; snapshots at
                 # chunk boundaries ride the same async writer/sink path
                 # as the stepwise loop.
-                def on_chunk(done_iters, dev_ranks, traces):
+                def on_chunk(done_iters, ranks_thunk, traces):
                     # Same absolute cadence as the stepwise loop: no
                     # snapshot at an off-cadence final-remainder
                     # boundary, so both modes write identical file sets.
+                    # (The device-side rank copy is only made when the
+                    # thunk is called — skipped boundaries cost nothing.)
                     if done_iters % args.snapshot_every != 0:
                         return
                     if writer is not None:
-                        writer.submit(done_iters - 1, (True, dev_ranks))
+                        writer.submit(done_iters - 1, (True, ranks_thunk()))
                     else:
                         write_sinks(
                             done_iters - 1,
-                            (True, engine.decode_ranks(dev_ranks)),
+                            (True, engine.decode_ranks(ranks_thunk())),
                         )
 
                 ranks = engine.run_fused_chunked(

@@ -1166,6 +1166,19 @@ class JaxTpuEngine(PageRankEngine):
         Unlike :meth:`run_fused`, per-iteration traces cannot be stacked
         (the trip count is dynamic); ``last_run_metrics`` carries the
         FINAL iteration's (l1_delta, dangling_mass) only.
+
+        On very-many-stripe layouts (``_ms_stripe`` engaged) the
+        single-program while_loop would take the scan-over-stripes body
+        that loses XLA's fast gather (0.91e8 vs 3.3e8 edges/s/chip at
+        scale 24 — docs/PERF_NOTES.md "Scan bodies defeat the fast
+        gather"), so this delegates to :meth:`run_fused_chunked` with a
+        per-iteration tol check: same stopping iteration as the
+        while_loop form (the delta is inspected after every iteration),
+        fast multi-dispatch stripes, at the cost of one host scalar
+        fetch per iteration — noise next to the multi-second iterations
+        these layouts have. There ``last_run_metrics`` keeps FULL
+        per-iteration traces (strictly more than this method's
+        final-only contract).
         """
         tol = self.config.tol if tol is None else tol
         if tol is None:
@@ -1174,6 +1187,8 @@ class JaxTpuEngine(PageRankEngine):
         k = total - self.iteration
         if k <= 0:
             return self.ranks()
+        if self._ms_stripe is not None:
+            return self.run_fused_chunked(num_iters=total, every=1, tol=tol)
         fused = self._get_fused_tol(k, float(tol))
         self._r, i_done, delta, mass = fused(*self._device_args())
         self.iteration += int(jax.device_get(i_done))
@@ -1193,9 +1208,12 @@ class JaxTpuEngine(PageRankEngine):
         """Fused dispatches BETWEEN snapshot points: each chunk of
         ``every`` iterations is one XLA invocation (the same cached scan
         executable every full chunk), and ``on_chunk(iterations_done,
-        device_ranks_copy, (deltas, masses))`` fires at each boundary
-        with a device-side rank copy for the snapshot sinks to decode
-        off-thread. This is the C17 persistence contract
+        ranks_thunk, (deltas, masses))`` fires at each boundary;
+        ``ranks_thunk()`` returns a device-side rank copy for the
+        snapshot sinks to decode off-thread. The copy is made only when
+        the callback calls the thunk, so a boundary the callback skips
+        (the CLI skips off-cadence final-remainder boundaries) costs no
+        device-side copy. This is the C17 persistence contract
         (every-iteration in the reference, Sparky.java:237; every-k
         here) without giving up fused dispatch between snapshot points —
         the fix for fused runs being uncheckpointable.
@@ -1235,7 +1253,7 @@ class JaxTpuEngine(PageRankEngine):
             ds.append(deltas)
             ms.append(masses)
             if on_chunk is not None:
-                on_chunk(self.iteration, self.device_ranks(),
+                on_chunk(self.iteration, self.device_ranks,
                          (deltas, masses))
             if tol is not None and float(jax.device_get(deltas[-1])) <= tol:
                 break
@@ -1263,17 +1281,21 @@ class JaxTpuEngine(PageRankEngine):
         total = self.config.num_iters if num_iters is None else num_iters
         k = total - self.iteration
         if k > 0:
+            if self._ms_stripe is not None and (tol is not None
+                                                or (every and every > 0)):
+                # Both the chunked AND the tol form step the
+                # multi-dispatch path on these layouts (run_fused_tol
+                # delegates to run_fused_chunked): warm ALL its
+                # executables with one throwaway step on a copy of the
+                # state, so the caller's timed region pays no per-stripe
+                # remote compiles. Compiling the while_loop executable
+                # here would pay for a program the delegation never runs.
+                keep = jnp.copy(self._r)
+                self._device_step()
+                self.fence()
+                self._r = keep
+                return k
             if every and every > 0:
-                if self._ms_stripe is not None:
-                    # Chunked runs step the multi-dispatch path there:
-                    # warm ALL its executables with one throwaway step
-                    # on a copy of the state, so the caller's timed
-                    # region pays no per-stripe remote compiles.
-                    keep = jnp.copy(self._r)
-                    self._device_step()
-                    self.fence()
-                    self._r = keep
-                    return k
                 e = int(every)
                 # Chunks align to absolute multiples of ``e`` (see
                 # run_fused_chunked): compile the possibly-short first

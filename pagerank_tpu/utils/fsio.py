@@ -101,7 +101,14 @@ class _MemWriter(io.BytesIO):
     object-store PUT semantics (readers never see a partial object).
     Exiting a ``with`` block on an exception ABORTS the put (a real
     store abandons the upload), so a writer that dies mid-serialization
-    never publishes a torn object."""
+    never publishes a torn object.
+
+    An explicit ``flush()`` ALSO commits the bytes so far: incremental
+    sinks (the JSONL metrics logger) flush after every record precisely
+    so a killed run keeps its records, and that crash behavior must
+    match the local backend. Writers that need torn-object protection
+    get it by never flushing mid-serialization (none in this codebase
+    do) — the atomic rename in the Snapshotter guards the rest."""
 
     def __init__(self, fs: "MemoryFileSystem", path: str, initial: bytes = b""):
         super().__init__()
@@ -112,6 +119,11 @@ class _MemWriter(io.BytesIO):
 
     def abort(self):
         self._aborted = True
+
+    def flush(self):
+        super().flush()
+        if not self.closed and not self._aborted:
+            self._fs._commit(self._path, self.getvalue())
 
     def __exit__(self, exc_type, exc, tb):
         if exc_type is not None:
@@ -269,10 +281,32 @@ def makedirs(path: str, exist_ok: bool = True) -> None:
 
 
 def replace(src: str, dst: str) -> None:
+    """Atomic rename within ONE store. A cross-scheme pair would silently
+    rename inside src's store (creating a key spelled with the other
+    scheme), so it is rejected up front — callers that really mean
+    copy-across-stores must stream bytes explicitly."""
+    if scheme_of(src) != scheme_of(dst):
+        raise ValueError(
+            f"fsio.replace is same-store only: {src!r} -> {dst!r} "
+            f"cross schemes ({scheme_of(src)!r} vs {scheme_of(dst)!r})"
+        )
     get_fs(src).replace(src, dst)
 
 
 def join(base: str, *parts: str) -> str:
-    """Path join that preserves URI schemes (os.path.join handles the
-    forward-slash layout both local posix paths and URIs use)."""
-    return os.path.join(base, *parts)
+    """Path join that preserves URI schemes. Scheme paths are joined with
+    literal '/' — os.path.join would insert the OS separator on Windows
+    and silently discard the scheme/base for a part starting with '/'.
+    Local paths keep os.path.join semantics."""
+    scheme = scheme_of(base)
+    if scheme is None:
+        return os.path.join(base, *parts)
+    # Never strip into the '//' of the scheme authority: a bare root
+    # like 'mock://' must stay a URI ('mock://a', not 'mock:/a' which
+    # would silently resolve to the LOCAL filesystem).
+    root = len(scheme) + 3
+    out = base
+    for part in parts:
+        head = out[:root] + out[root:].rstrip("/")
+        out = head + ("" if head.endswith("/") else "/") + part.lstrip("/")
+    return out

@@ -82,6 +82,17 @@ def test_cli_seq_prefixed_text_is_not_seqfile(tmp_path):
                "--out", out, "--log-every", "0"])
     assert rc == 0
     assert "SEQ://a\t" in open(out).read()
+    # Control bytes after 'SEQ' that are NOT a supported version (<= 6)
+    # also fall through: a crawl row whose url is literally "SEQ" makes
+    # the file start with b"SEQ\t" (0x09) — text, not a SequenceFile
+    # (ADVICE r2).
+    p2 = tmp_path / "crawl2.tsv"
+    p2.write_text(f"SEQ\t{meta}\nhttp://b\t{json.dumps({})}\n")
+    out2 = str(tmp_path / "ranks2.tsv")
+    rc = main(["--input", str(p2), "--iters", "2", "--engine", "cpu",
+               "--out", out2, "--log-every", "0"])
+    assert rc == 0
+    assert "SEQ\t" in open(out2).read()
 
 
 def test_cli_snapshot_resume(tmp_path, edges_file):

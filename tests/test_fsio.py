@@ -58,6 +58,51 @@ def test_memory_fs_basics(mockfs):
     assert fsio.listdir("mock://b/dir") == ["b.txt", "sub"]
 
 
+def test_replace_rejects_cross_scheme(mockfs, tmp_path):
+    # A cross-scheme replace would silently rename INSIDE src's store,
+    # minting a key spelled with the other scheme (ADVICE r2).
+    local = tmp_path / "x.bin"
+    local.write_bytes(b"z")
+    with pytest.raises(ValueError, match="same-store"):
+        fsio.replace(str(local), "mock://b/x.bin")
+    with pytest.raises(ValueError, match="same-store"):
+        fsio.replace("mock://b/x.bin", str(local))
+    assert not fsio.exists("mock://b/x.bin")
+    assert local.exists()
+
+
+def test_join_preserves_schemes(tmp_path):
+    # Scheme paths join with literal '/' regardless of OS separator, and
+    # a leading-'/' part must not discard the base (ADVICE r2).
+    assert fsio.join("mock://b/dir", "a", "b.txt") == "mock://b/dir/a/b.txt"
+    assert fsio.join("mock://b/dir/", "/a.txt") == "mock://b/dir/a.txt"
+    # A bare scheme root keeps its '//' (stripping it would silently
+    # produce a local path).
+    assert fsio.join("mock://", "bucket/key") == "mock://bucket/key"
+    # Local paths keep os.path.join semantics.
+    import os
+
+    assert fsio.join(str(tmp_path), "a") == os.path.join(str(tmp_path), "a")
+
+
+def test_memwriter_commits_on_flush(mockfs):
+    # Incremental sinks (JSONL metrics) flush per record; a killed run
+    # must keep flushed records like the local backend does (ADVICE r2).
+    f = fsio.fopen("mock://b/m.jsonl", "a")
+    f.write('{"iter": 0}\n')
+    f.flush()
+    with fsio.fopen("mock://b/m.jsonl") as r:
+        assert r.read() == '{"iter": 0}\n'
+    f.write('{"iter": 1}\n')
+    # NOT flushed: record 1 is only visible after close (and would be
+    # lost on a kill — matching an unflushed local buffer).
+    with fsio.fopen("mock://b/m.jsonl") as r:
+        assert r.read() == '{"iter": 0}\n'
+    f.close()
+    with fsio.fopen("mock://b/m.jsonl") as r:
+        assert r.read() == '{"iter": 0}\n{"iter": 1}\n'
+
+
 def test_memory_fs_write_is_atomic_on_close(mockfs):
     f = fsio.fopen("mock://b/partial", "wb")
     f.write(b"data")

@@ -128,3 +128,48 @@ def test_scan_stripes_fallback_matches_unstriped(monkeypatch, ndev, accum):
     eng3 = JaxTpuEngine(cfg).build(g)
     r_ck = eng3.run_fused_chunked(every=3)
     np.testing.assert_allclose(r_ck, r_plain, rtol=1e-6, atol=1e-7)
+
+
+def test_fused_tol_routes_to_chunked_on_ms_layouts(monkeypatch):
+    """On very-many-stripe layouts run_fused_tol must take the fast
+    multi-dispatch chunked form, not the scan-over-stripes while_loop
+    (which loses XLA's fast gather — PERF_NOTES "Scan bodies defeat the
+    fast gather"); VERDICT r2 #4. Stopping iteration must match the
+    host-checked stepwise tol run exactly (per-iteration check)."""
+    rng = np.random.default_rng(7)
+    g = _graph(rng)
+    tol = 0.05
+    cfg = PageRankConfig(num_iters=100, dtype="float32",
+                         accum_dtype="float64", tol=tol)
+    ref = JaxTpuEngine(cfg).build(g)
+    ref.run(on_iteration=lambda i, info: None)
+    stop_iter = ref.iteration
+    assert 0 < stop_iter < 100  # tol actually fired mid-run
+
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_max", lambda self: 256)
+    monkeypatch.setattr(JaxTpuEngine, "_stripe_target", lambda self: 256)
+    monkeypatch.setattr(JaxTpuEngine, "SCAN_STRIPE_UNITS", 0)
+    eng = JaxTpuEngine(cfg).build(g)
+    assert eng._ms_stripe is not None
+    # prepare_fused(tol=...) must warm the multi-dispatch executables
+    # (what the delegated path runs), NOT compile the while_loop form
+    # the delegation never executes.
+    assert eng.prepare_fused(tol=tol) == 100
+    assert not any(isinstance(k, tuple) and k[0] == "tol"
+                   for k in eng._fused_cache)
+    assert eng.iteration == 0  # warm-up step did not advance state
+    called = {}
+    orig = JaxTpuEngine.run_fused_chunked
+
+    def spy(self, *a, **kw):
+        called["kw"] = kw
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(JaxTpuEngine, "run_fused_chunked", spy)
+    r = eng.run_fused_tol(tol)
+    assert called["kw"].get("tol") == tol  # routing pinned
+    assert eng.iteration == stop_iter  # identical stopping point
+    np.testing.assert_allclose(r, ref.ranks(), rtol=1e-6, atol=1e-7)
+    # Full per-iteration traces survive (strictly more than the
+    # while_loop form's final-only contract).
+    assert len(np.asarray(eng.last_run_metrics["l1_delta"])) == stop_iter
