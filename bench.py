@@ -53,20 +53,13 @@ NORTH_STAR_EDGES_PER_SEC_PER_CHIP = 1.47e9 * 50 / 60 / 8
 
 def _enable_compile_cache():
     """Persist XLA executables across bench runs — the graph-build and
-    step compiles are ~2 minutes of the wall-clock otherwise.
+    step compiles are ~2 minutes of the wall-clock otherwise (shared
+    helper: utils/compile_cache, also used by the CLI's --device-build)."""
+    from pagerank_tpu.utils.compile_cache import enable_compile_cache
 
-    min_compile_time_secs=0: the device build + engine setup issue ~50
-    small jitted ops, each ~0.6s to compile through the remote-compile
-    service but far under the 1s default cache threshold — caching them
-    cuts the warm scale-21 build from ~49s to ~10s (measured v5e)."""
-    import jax
-
-    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:  # cache is an optimization, never a requirement
-        print(f"bench: compilation cache unavailable ({e})", file=sys.stderr)
+    enable_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    )
 
 
 def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
@@ -83,45 +76,20 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
               file=sys.stderr)
         host_build = True
 
-    # Stripe sources once the gather table outgrows the single-stripe
-    # bound; use the engine's own limits so the two can't diverge (a
-    # 64-bit accumulation runs the pair-packed table on TPU, which
-    # carries 2x lanes/row).
-    n_padded = -(-(1 << args.scale) // 128) * 128
-    pair = np.dtype(accum_dtype).itemsize == 8
-    fast_cap, stripe_target = JaxTpuEngine.stripe_limits(
-        4 if pair else np.dtype(dtype).itemsize, pair
-    )
-    stripe = args.stripe_size or (0 if n_padded <= fast_cap else stripe_target)
-    # Clamp the lane group so packed slot words (src << log2g | sub) fit
-    # int32 at the span the chosen build will actually pack (the host
-    # path ignores --stripe-size; the engine stripes it at stripe_target
-    # when n_padded exceeds fast_cap).
-    span = min(stripe or n_padded, n_padded)
-    if host_build:
-        span = min(stripe_target if n_padded > fast_cap else n_padded,
-                   n_padded)
-    # "striped" must mirror the layout the chosen build actually packs:
-    # the host path ignores --stripe-size (the engine stripes iff
-    # n_padded > fast_cap), and an explicit span >= n_padded still packs
-    # one stripe.
-    if host_build:
-        is_striped = n_padded > fast_cap
-    else:
-        is_striped = bool(stripe) and stripe < n_padded
-    grp_req = args.lane_group or PageRankConfig().effective_lane_group(
-        pair, striped=is_striped
-    )
-    grp = grp_req
-    while grp > 1 and (span + 1) * grp > 2**31 - 1:
-        grp //= 2
-    if grp != grp_req:
-        print(f"bench: lane group clamped to {grp} at scale {args.scale}",
-              file=sys.stderr)
+    # Stripe + lane-group sizing: THE shared planner (ops/device_build.
+    # plan_build) so bench, CLI --device-build, and the engine can't
+    # diverge on layout choices.
+    from pagerank_tpu.ops.device_build import plan_build
+
     cfg = PageRankConfig(
         num_iters=args.iters, dtype=dtype, accum_dtype=accum_dtype,
-        kernel=kernel, lane_group=grp, wide_accum=wide_accum,
+        kernel=kernel, wide_accum=wide_accum,
     ).validate()
+    grp, stripe = plan_build(
+        cfg, 1 << args.scale, stripe_size=args.stripe_size,
+        lane_group=args.lane_group, host=host_build,
+    )
+    cfg = cfg.replace(lane_group=grp)
 
     t0 = time.perf_counter()
     if host_build:

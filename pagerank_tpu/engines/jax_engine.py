@@ -121,11 +121,27 @@ class JaxTpuEngine(PageRankEngine):
         # f64, so the f64 work is confined to one add per slot + the
         # segment-sum. config.wide_accum: "auto" picks pair only on TPU
         # (native f64 gathers elsewhere are exact and fast).
-        wide = self._accum_dtype.itemsize == 8
+        self._pair = self.resolve_pair(cfg)
+
+    @staticmethod
+    def resolve_pair(cfg) -> bool:
+        """Whether this config runs the pair-packed wide accumulation —
+        THE single resolution of ``wide_accum`` (shared with
+        ops/device_build.plan_build so bench/CLI layout planning cannot
+        drift from what the engine actually runs)."""
+        wide = np.dtype(cfg.accum_dtype).itemsize == 8
         mode = cfg.wide_accum
         if mode == "auto":
             mode = "pair" if jax.default_backend() == "tpu" else "native"
-        self._pair = wide and mode == "pair"
+        return wide and mode == "pair"
+
+    @staticmethod
+    def gather_z_item(cfg, pair: bool) -> int:
+        """Bytes per gather-table lane for this config: pair tables
+        carry two f32 planes (4 bytes/lane each), native-wide tables
+        genuinely wide rows. Shared with plan_build (see resolve_pair)."""
+        return max(np.dtype(cfg.dtype).itemsize,
+                   4 if pair else np.dtype(cfg.accum_dtype).itemsize)
 
     def build_device(self, dg) -> "JaxTpuEngine":
         """Build from an on-device blocked-ELL graph
@@ -336,17 +352,11 @@ class JaxTpuEngine(PageRankEngine):
         return smax, max(128, target // 128 * 128)
 
     def _stripe_max(self) -> int:
-        z_item = max(
-            self._dtype.itemsize,
-            self._accum_dtype.itemsize if not self._pair else 4,
-        )
+        z_item = self.gather_z_item(self.config, self._pair)
         return self.stripe_limits(z_item, self._pair)[0]
 
     def _stripe_target(self) -> int:
-        z_item = max(
-            self._dtype.itemsize,
-            self._accum_dtype.itemsize if not self._pair else 4,
-        )
+        z_item = self.gather_z_item(self.config, self._pair)
         return self.stripe_limits(z_item, self._pair)[1]
 
     @staticmethod

@@ -207,3 +207,23 @@ def test_presentinel_build_matches_weighted():
         np.testing.assert_allclose(
             run(False, stripe), run(True, stripe), rtol=0, atol=0
         )
+
+
+def test_device_fingerprint_stable_and_discriminating():
+    """fingerprint() must be identical for identical builds (incl.
+    across the process-global x64 flip — the checksum dtype is pinned),
+    and differ for a different graph."""
+    rng = np.random.default_rng(5)
+    n, e = 300, 2000
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+
+    def build(s, d):
+        return db.build_ell_device(
+            jax.numpy.asarray(s), jax.numpy.asarray(d), n=n, group=4
+        )
+
+    fp1 = build(src, dst).fingerprint()
+    jax.config.update("jax_enable_x64", True)  # one-way within a process
+    fp2 = build(src, dst).fingerprint()
+    assert fp1 == fp2 and fp1.startswith("dev-")
+    assert build(dst, src).fingerprint() != fp1
