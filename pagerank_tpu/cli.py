@@ -116,6 +116,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jsonl", default=None, help="append per-iter metrics to this JSONL file")
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
     p.add_argument("--strict-parse", action="store_true", help="crawl mode: die on bad records")
+    p.add_argument(
+        "--ingest-workers", type=int, default=None,
+        help="parallel parse processes for multi-file SequenceFile "
+        "segments (the reference parses its 301 segment files across "
+        "the cluster, Sparky.java:61). Default: one per core, capped by "
+        "file count; 1 = serial. Record order (and so vertex ids) is "
+        "identical either way",
+    )
     ppr = p.add_argument_group("personalized PageRank (batched SpMM)")
     ppr.add_argument(
         "--ppr-sources",
@@ -354,7 +362,9 @@ def load_graph(args):
     if fmt == "seqfile":
         from pagerank_tpu.ingest import load_crawl_seqfile
 
-        graph, ids = load_crawl_seqfile(path, strict=args.strict_parse)
+        graph, ids = load_crawl_seqfile(
+            path, strict=args.strict_parse, workers=args.ingest_workers
+        )
         return graph, ids
     if fmt == "crawl":
         from pagerank_tpu.ingest import load_crawl_file
@@ -364,6 +374,8 @@ def load_graph(args):
     if fmt == "npz":
         src, dst, n = el.load_binary_edges(path)
         if args.device_build:
+            if n is None:  # optional field; mirror build_graph's max+1
+                n = int(max(src.max(), dst.max())) + 1 if len(src) else 0
             return _device_build_graph(args, src, dst, n), None
         return build_graph(src, dst, n=n), None
     src, dst = el.load_edgelist(path)

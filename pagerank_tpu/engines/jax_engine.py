@@ -204,7 +204,10 @@ class JaxTpuEngine(PageRankEngine):
         # The slot arrays are donated to the engine: _setup_ell derives
         # its sentinel-ized copies, and keeping the originals referenced
         # from dg would pin a second full-size set of [rows, 128] arrays
-        # in HBM for the engine's lifetime.
+        # in HBM for the engine's lifetime. The structural fingerprint
+        # (snapshot validation) hashes those arrays, so capture it
+        # first — it caches on the graph (one cheap reduction pass).
+        dg.fingerprint()
         dg.src = dg.weight = dg.row_block = None
         return self
 

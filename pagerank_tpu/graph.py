@@ -86,7 +86,7 @@ def build_graph(
     dedup: bool = True,
     dangling_mask: Optional[np.ndarray] = None,
     vertex_names: Optional[Sequence[str]] = None,
-    use_native_sort: bool = False,
+    use_native_sort: Optional[bool] = None,
 ) -> Graph:
     """Build a :class:`Graph` from raw (src, dst) edge arrays.
 
@@ -111,8 +111,11 @@ def build_graph(
         ~crawled because the repair pass un-dangles every crawled page
         (see module docstring).
       use_native_sort: route dedup+sort through the C++ radix sorter
-        (native/fast_ingest.cpp). Opt-in: it beats np.unique only on
-        multi-core hosts (this image is single-core, where numpy wins).
+        (native/fast_ingest.cpp). Default None = AUTO: engage when the
+        native library is available, the host has >1 core (the sorter
+        is multithreaded; np.unique wins on single-core hosts — this
+        image's measured case, docs/PERF_NOTES.md "Host ingest"), and
+        the input is large enough to amortize (>= 2^22 edges).
     """
     src = np.ascontiguousarray(src, dtype=np.int64)
     dst = np.ascontiguousarray(dst, dtype=np.int64)
@@ -138,6 +141,12 @@ def build_graph(
     out_degree = in_degree = None
     if len(src) > 0:
         native_out = None
+        if use_native_sort is None:
+            import os
+
+            use_native_sort = (
+                (os.cpu_count() or 1) > 1 and len(src) >= (1 << 22)
+            )
         if dedup and use_native_sort:
             from pagerank_tpu.ingest import native as native_lib
 

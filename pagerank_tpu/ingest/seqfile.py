@@ -45,7 +45,7 @@ from __future__ import annotations
 import io
 import struct
 import zlib
-from typing import Iterable, Iterator, List, Tuple
+from typing import Iterable, Iterator, List, Optional, Tuple
 
 from pagerank_tpu.utils import fsio
 
@@ -266,22 +266,100 @@ def expand_seqfile_paths(spec: str) -> List[str]:
     return paths
 
 
-def load_crawl_seqfile(spec: str, strict: bool = True):
+def _parse_seqfile_worker(args):
+    """One segment file -> parsed (url, targets) records; runs in a
+    forked worker process (module-level so it pickles by reference)."""
+    path, strict = args
+    from pagerank_tpu.ingest.crawljson import parse_metadata_record
+
+    return [
+        parse_metadata_record(url, meta, strict=strict)
+        for url, meta in read_sequence_file(path)
+    ]
+
+
+def iter_segment_records(
+    paths, strict: bool = True, workers: Optional[int] = None
+):
+    """Parsed records from a multi-file segment, optionally in parallel.
+
+    The reference parses its 301 segment files across the cluster
+    (``ctx.sequenceFile``, Sparky.java:61); here the per-file work
+    (VInt/codec decode + JSON anchor extraction, both pure-Python
+    CPU-bound) fans out over a process pool. ``workers=None`` = auto:
+    one per core, capped by the file count (serial on single-core hosts
+    — this image's case, where the pool is pure overhead;
+    docs/PERF_NOTES.md "Host ingest"). Record order — and therefore id
+    assignment and every downstream array — is IDENTICAL to the serial
+    path: files are yielded in input order, records in file order
+    (tests/test_seqfile.py pins this).
+
+    Workers inherit the fsio registry and parsed state by fork, so
+    registered in-memory stores (mock://) keep working; platforms
+    without fork fall back to serial.
+    """
+    import multiprocessing
+    import os
+
+    paths = list(paths)
+    if workers is None:
+        workers = min(len(paths), os.cpu_count() or 1)
+    if (
+        workers <= 1
+        or len(paths) <= 1
+        or "fork" not in multiprocessing.get_all_start_methods()
+    ):
+        from pagerank_tpu.ingest.crawljson import parse_metadata_record
+
+        for path in paths:
+            for url, meta in read_sequence_file(path):
+                yield parse_metadata_record(url, meta, strict=strict)
+        return
+    import collections
+    import concurrent.futures
+
+    ctx = multiprocessing.get_context("fork")
+    with concurrent.futures.ProcessPoolExecutor(
+        max_workers=workers, mp_context=ctx
+    ) as ex:
+        # Bounded in-flight window (2x workers) instead of ex.map: map
+        # submits every file at once, and since the consumer drains in
+        # order, completed per-file record lists would pile up to the
+        # whole parsed segment in RAM. The window keeps the speedup with
+        # a bounded transient. Order is preserved (deque is FIFO over
+        # the input order); a strict-mode parse error in any worker
+        # propagates at its file's position, matching the serial crash.
+        pending = collections.deque()
+        it = iter(paths)
+        for path in it:
+            pending.append(ex.submit(_parse_seqfile_worker, (path, strict)))
+            if len(pending) >= 2 * workers:
+                break
+        while pending:
+            yield from pending.popleft().result()
+            for path in it:
+                pending.append(
+                    ex.submit(_parse_seqfile_worker, (path, strict))
+                )
+                break
+
+
+def load_crawl_seqfile(
+    spec: str, strict: bool = True, workers: Optional[int] = None
+):
     """SequenceFile(s) of (url, crawl-metadata json) -> (Graph, IdMap).
 
     The exact pipeline the reference runs on these files: JSON anchor
     extraction with the Gson rendering quirks (crawljson.py), then the
     dedup/adjacency/dangling graph build (Sparky.java:61-124).
+    Multi-file segments parse in parallel (``workers``; see
+    :func:`iter_segment_records`).
     """
-    from pagerank_tpu.ingest.crawljson import parse_metadata_record
     from pagerank_tpu.ingest.ids import records_to_graph
 
-    def records():
-        for path in expand_seqfile_paths(spec):
-            for url, meta in read_sequence_file(path):
-                yield parse_metadata_record(url, meta, strict=strict)
-
-    return records_to_graph(records())
+    return records_to_graph(
+        iter_segment_records(expand_seqfile_paths(spec), strict, workers)
+    )
 
 
 # -- writing (tests + interop) -------------------------------------------

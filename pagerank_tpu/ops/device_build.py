@@ -77,6 +77,10 @@ class DeviceEllGraph:
     # (inert slots hold stripe_span << log2(group)) — built with
     # with_weights=False, saving two per-slot planes of HBM.
     presentinel: bool = False
+    # Cached fingerprint (set on first call): the engine's build_device
+    # releases the slot arrays after placement, so the hash must be
+    # computable before then and remembered after.
+    _fp: Optional[str] = None
 
     @property
     def num_rows(self) -> int:
@@ -89,27 +93,45 @@ class DeviceEllGraph:
         (utils/snapshot.py), mirroring graph.Graph.fingerprint WITHOUT
         fetching bulk arrays to host (the whole point of a device build
         is that only scalars cross the link): layout statics plus
-        device-side degree/permutation checksums in wrapping uint32
-        arithmetic — deterministic for identical builds."""
+        device-side checksums — degrees, permutation, AND the packed
+        slot/row arrays (the adjacency itself: degree-preserving edge
+        rewires change the slot words, so they cannot collide the way a
+        degree-only checksum would) — in wrapping uint32 arithmetic,
+        deterministic for identical builds. Layout-specific by design
+        (group/stripe/presentinel change the hash): a snapshot resumes
+        against the same build configuration. Cached on first call —
+        the engine's build_device frees the slot arrays afterwards and
+        computes this eagerly beforehand."""
         import hashlib
 
-        od = self.out_degree.astype(jnp.uint32)
-        ix = jnp.arange(od.shape[0], dtype=jnp.uint32)
-        mix = ix * jnp.uint32(2654435761)  # Knuth multiplicative hash
-        # dtype pinned: a bare jnp.sum over uint32 accumulates in uint64
-        # when x64 is on, so the checksum would differ for the SAME
-        # graph across x64 states (e.g. snapshot under f32, resume
-        # under f64) and wrongly refuse the resume.
+        if self._fp is not None:
+            return self._fp
+
+        # dtype pinned everywhere: a bare jnp.sum over uint32
+        # accumulates in uint64 when x64 is on, so the checksum would
+        # differ for the SAME graph across x64 states (e.g. snapshot
+        # under f32, resume under f64) and wrongly refuse the resume.
         u32 = jnp.uint32
-        sums = jax.device_get(
-            (jnp.sum(od, dtype=u32), jnp.sum(od * mix, dtype=u32),
-             jnp.sum(self.perm.astype(u32) * mix, dtype=u32))
-        )
+
+        def _mixsum(a):
+            a = a.reshape(-1).astype(u32)
+            ix = jnp.arange(a.shape[0], dtype=u32)
+            return jnp.sum(a * (ix * u32(2654435761)), dtype=u32)
+
+        parts = [jnp.sum(self.out_degree.astype(u32), dtype=u32),
+                 _mixsum(self.out_degree), _mixsum(self.perm)]
+        srcs = self.src if isinstance(self.src, (list, tuple)) else [self.src]
+        rbs = (self.row_block
+               if isinstance(self.row_block, (list, tuple))
+               else [self.row_block])
+        parts += [_mixsum(s) for s in srcs] + [_mixsum(r) for r in rbs]
+        sums = jax.device_get(jnp.stack(parts))
         h = hashlib.sha256()
         for v in (self.n, self.num_edges, self.group, self.stripe_size,
-                  *(int(s) for s in sums)):
+                  int(self.presentinel), *(int(s) for s in sums)):
             h.update(np.int64(v).tobytes())
-        return "dev-" + h.hexdigest()[:12]
+        self._fp = "dev-" + h.hexdigest()[:12]
+        return self._fp
 
 
 def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,

@@ -8,11 +8,14 @@ SequenceFiles) and every sink (Snapshotter, TextDumper, rank TSV, JSONL
 metrics) opens paths through here, so an object-store backend plugs in
 by registering a :class:`FileSystem` for its scheme — no loader changes.
 
-Scheme-less paths use the local OS filesystem unchanged. This zero-egress
-environment has no real S3 client to register; the contract is exercised
-by :class:`MemoryFileSystem` (an object-store-semantics in-memory store)
-under a ``mock://`` scheme in tests/test_fsio.py, which round-trips
-ingest -> snapshot -> resume through the CLI.
+Scheme-less paths use the local OS filesystem unchanged. Two non-local
+backends exist: :class:`MemoryFileSystem` (an object-store-semantics
+in-memory store) under ``mock://`` in tests/test_fsio.py, and the real
+S3-protocol client (utils/s3.py — stdlib HTTP + SigV4 signing,
+auto-registered for ``s3://``/``s3n://``/``s3a://`` when
+``PAGERANK_TPU_S3_ENDPOINT`` is set; exercised against an in-process
+stub server in tests/test_s3.py, since this environment has zero
+egress). Both round-trip ingest -> snapshot -> resume through the CLI.
 """
 
 from __future__ import annotations
@@ -243,10 +246,25 @@ def get_fs(path: str) -> FileSystem:
     if scheme is None:
         return _LOCAL
     fs = _REGISTRY.get(scheme)
+    if fs is None and scheme in ("s3", "s3n", "s3a"):
+        # Lazy S3 auto-registration from the environment (utils/s3):
+        # with PAGERANK_TPU_S3_ENDPOINT set, s3:// paths work with no
+        # wiring — the reference's inputs are s3n:// URIs
+        # (Sparky.java:44-58). Fills only MISSING schemes, never
+        # replacing an explicit registration.
+        from pagerank_tpu.utils import s3 as s3_mod
+
+        s3_mod.register_s3(only_missing=True)
+        fs = _REGISTRY.get(scheme)
     if fs is None:
+        hint = (
+            "set PAGERANK_TPU_S3_ENDPOINT (and AWS_* credentials "
+            "if the store needs them) or "
+            if scheme in ("s3", "s3n", "s3a") else ""
+        )
         raise ValueError(
             f"no filesystem registered for scheme {scheme!r} "
-            f"(path {path!r}); register one with "
+            f"(path {path!r}); {hint}register one with "
             f"pagerank_tpu.utils.fsio.register({scheme!r}, fs) "
             f"(registered: {sorted(_REGISTRY) or 'none'})"
         )

@@ -1,0 +1,366 @@
+"""Minimal S3-protocol :class:`~pagerank_tpu.utils.fsio.FileSystem`.
+
+The reference's literal inputs are 301 ``s3n://`` Common Crawl URIs and
+its output an S3 bucket (``/root/reference/Sparky.java:44-58,237``),
+resolved by Hadoop's S3 client. This module is the build's concrete
+object-store backend for that seam: a dependency-free (stdlib
+``http.client``) REST client speaking the S3 wire protocol —
+GET/PUT/HEAD/DELETE objects, ListObjectsV2 with prefix/delimiter
+pagination, server-side COPY — against a configurable endpoint, with
+optional AWS Signature V4 request signing when credentials are present
+(anonymous requests otherwise, for stubs and open buckets).
+
+Endpoint/credentials resolve from the environment
+(``PAGERANK_TPU_S3_ENDPOINT``, ``AWS_ACCESS_KEY_ID``,
+``AWS_SECRET_ACCESS_KEY``, ``AWS_REGION``); when the endpoint variable
+is set, ``s3://``/``s3n://``/``s3a://`` paths auto-register through
+:func:`pagerank_tpu.utils.fsio.get_fs` — every loader and sink (edge
+lists, SequenceFile segments, snapshots, text dumps, metrics JSONL)
+then reads and writes S3 URIs with no further wiring. In this
+zero-egress environment the protocol is exercised against an in-process
+HTTP stub server (tests/s3stub.py + tests/test_s3.py); the signer is
+additionally pinned to the published AWS SigV4 test vector.
+
+Addressing is path-style (``endpoint/bucket/key``) — what MinIO/stub
+servers and most private object stores speak.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import io
+import os
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from pagerank_tpu.utils import fsio
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def sign_v4(
+    method: str,
+    host: str,
+    path: str,
+    query: str,
+    headers: Dict[str, str],
+    payload_hash: str,
+    *,
+    region: str,
+    access_key: str,
+    secret_key: str,
+    amzdate: str,
+    service: str = "s3",
+) -> str:
+    """AWS Signature Version 4 ``Authorization`` header value.
+
+    Pure function of its inputs (``amzdate`` = ``YYYYMMDDTHHMMSSZ``) so
+    it can be pinned against AWS's published test vector
+    (tests/test_s3.py::test_sigv4_aws_reference_vector). ``headers``
+    must already include ``host`` and ``x-amz-date``.
+    """
+    datestamp = amzdate[:8]
+    # Canonical request: URI-encoded path (segments only), sorted
+    # canonical query, sorted lowercase headers.
+    canon_path = urllib.parse.quote(path, safe="/") or "/"
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    canon_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(pairs)
+    )
+    items = sorted((k.lower(), " ".join(v.split())) for k, v in headers.items())
+    canon_headers = "".join(f"{k}:{v}\n" for k, v in items)
+    signed = ";".join(k for k, _ in items)
+    canonical = "\n".join(
+        [method, canon_path, canon_query, canon_headers, signed, payload_hash]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join(
+        ["AWS4-HMAC-SHA256", amzdate, scope,
+         hashlib.sha256(canonical.encode()).hexdigest()]
+    )
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    return (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed}, Signature={sig}"
+    )
+
+
+def _split_uri(path: str) -> Tuple[str, str]:
+    """``s3://bucket/key`` -> (bucket, key). Key may be empty."""
+    scheme = fsio.scheme_of(path)
+    if scheme is None:
+        raise ValueError(f"not an object-store URI: {path!r}")
+    rest = path[len(scheme) + 3:]
+    bucket, _, key = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"S3 URI has no bucket: {path!r}")
+    return bucket, key
+
+
+class S3FileSystem(fsio.FileSystem):
+    """S3 REST client bound to one endpoint.
+
+    Thread-compatible: every request opens its own connection (the
+    async snapshot writer commits from a worker thread). Objects are
+    written with single-PUT semantics via the shared buffered writer
+    (:class:`fsio._MemWriter` commits through :meth:`_commit` on
+    flush/close) — readers never observe partial objects, matching the
+    reference's S3 output contract (Sparky.java:237).
+    """
+
+    def __init__(
+        self,
+        endpoint: str,
+        region: str = "us-east-1",
+        access_key: Optional[str] = None,
+        secret_key: Optional[str] = None,
+        timeout: float = 30.0,
+    ):
+        u = urllib.parse.urlsplit(endpoint)
+        if u.scheme not in ("http", "https") or not u.netloc:
+            raise ValueError(
+                f"S3 endpoint must be http(s)://host[:port], got {endpoint!r}"
+            )
+        self._secure = u.scheme == "https"
+        self._netloc = u.netloc
+        self._region = region
+        self._access_key = access_key
+        self._secret_key = secret_key
+        self._timeout = timeout
+
+    # -- wire protocol ----------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        bucket: str,
+        key: str,
+        query: str = "",
+        body: bytes = b"",
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        path = "/" + bucket + (("/" + key) if key else "")
+        payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA256
+        headers = {
+            "host": self._netloc,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%Y%m%dT%H%M%SZ"
+            ),
+        }
+        if extra_headers:
+            headers.update(extra_headers)
+        if self._access_key and self._secret_key:
+            headers["authorization"] = sign_v4(
+                method, self._netloc, path, query, headers, payload_hash,
+                region=self._region, access_key=self._access_key,
+                secret_key=self._secret_key, amzdate=headers["x-amz-date"],
+            )
+        conn_cls = (
+            http.client.HTTPSConnection if self._secure
+            else http.client.HTTPConnection
+        )
+        conn = conn_cls(self._netloc, timeout=self._timeout)
+        try:
+            url = urllib.parse.quote(path, safe="/") + (f"?{query}" if query else "")
+            conn.request(method, url, body=body or None, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+    def _raise(self, status: int, data: bytes, path: str):
+        if status == 404:
+            raise FileNotFoundError(path)
+        raise OSError(
+            f"S3 request failed with HTTP {status} for {path!r}: "
+            f"{data[:200].decode(errors='replace')}"
+        )
+
+    # -- FileSystem interface ---------------------------------------------
+
+    def _commit(self, path: str, data: bytes) -> None:
+        """PUT the full object (the buffered writer's commit hook)."""
+        bucket, key = _split_uri(path)
+        status, _, body = self._request("PUT", bucket, key, body=data)
+        if status not in (200, 201, 204):
+            self._raise(status, body, path)
+
+    def _get(self, path: str) -> bytes:
+        bucket, key = _split_uri(path)
+        status, _, data = self._request("GET", bucket, key)
+        if status != 200:
+            self._raise(status, data, path)
+        return data
+
+    def open(self, path, mode="r", **kwargs):
+        binary = "b" in mode
+        kind = mode.replace("b", "").replace("t", "") or "r"
+        if kind == "r":
+            raw: io.IOBase = io.BytesIO(self._get(path))
+        elif kind in ("w", "x", "a"):
+            if kind == "x" and self.isfile(path):
+                raise FileExistsError(path)
+            initial = b""
+            if kind == "a":
+                try:
+                    initial = self._get(path)
+                except FileNotFoundError:
+                    pass
+            raw = fsio._MemWriter(self, path, initial)
+            if kind == "a":
+                raw.seek(0, io.SEEK_END)
+        else:
+            raise ValueError(f"unsupported mode {mode!r}")
+        if binary:
+            return raw
+        kwargs.pop("newline", None)
+        kwargs.setdefault("encoding", "utf-8")
+        return fsio._MemTextWrapper(raw, **kwargs)
+
+    def isfile(self, path):
+        bucket, key = _split_uri(path)
+        if not key:
+            return False
+        status, _, _ = self._request("HEAD", bucket, key)
+        return status == 200
+
+    def _list(
+        self, bucket: str, prefix: str, delimiter: str = "",
+        max_keys: int = 1000,
+    ) -> Iterator[Tuple[str, bool]]:
+        """Yield (name, is_prefix) from ListObjectsV2, following
+        continuation tokens (the client-side half of S3 pagination)."""
+        token = None
+        while True:
+            q = [("list-type", "2"), ("prefix", prefix),
+                 ("max-keys", str(max_keys))]
+            if delimiter:
+                q.append(("delimiter", delimiter))
+            if token:
+                q.append(("continuation-token", token))
+            query = urllib.parse.urlencode(sorted(q))
+            status, _, data = self._request("GET", bucket, "", query=query)
+            if status != 200:
+                self._raise(status, data, f"s3://{bucket}/{prefix}")
+            root = ET.fromstring(data)
+
+            def _local(tag):  # namespace-agnostic match
+                return tag.rsplit("}", 1)[-1]
+
+            token = None
+            truncated = False
+            for el in root:
+                name = _local(el.tag)
+                if name == "Contents":
+                    for sub in el:
+                        if _local(sub.tag) == "Key":
+                            yield sub.text or "", False
+                elif name == "CommonPrefixes":
+                    for sub in el:
+                        if _local(sub.tag) == "Prefix":
+                            yield sub.text or "", True
+                elif name == "NextContinuationToken":
+                    token = el.text
+                elif name == "IsTruncated":
+                    truncated = (el.text or "").strip().lower() == "true"
+            if not truncated or not token:
+                return
+
+    def isdir(self, path):
+        bucket, key = _split_uri(path.rstrip("/") + "/")
+        if key == "/":  # bucket root
+            key = ""
+        for _ in self._list(bucket, key, max_keys=1):
+            return True
+        return False
+
+    def exists(self, path):
+        return self.isfile(path) or self.isdir(path)
+
+    def listdir(self, path):
+        bucket, key = _split_uri(path.rstrip("/") + "/")
+        if key == "/":
+            key = ""
+        names = set()
+        found = False
+        for name, is_prefix in self._list(bucket, key, delimiter="/"):
+            found = True
+            tail = name[len(key):]
+            if is_prefix:
+                tail = tail.rstrip("/")
+            if tail:
+                names.add(tail)
+        if not found:
+            raise FileNotFoundError(path)
+        return sorted(names)
+
+    def makedirs(self, path, exist_ok=True):
+        # Object stores have no directories; prefixes exist implicitly
+        # once a key is written (mirrors Hadoop-on-S3 behavior).
+        return None
+
+    def replace(self, src, dst):
+        sb, sk = _split_uri(src)
+        db_, dk = _split_uri(dst)
+        status, _, data = self._request(
+            "PUT", db_, dk,
+            extra_headers={
+                "x-amz-copy-source": "/" + sb + "/" + urllib.parse.quote(sk)
+            },
+        )
+        if status != 200:
+            self._raise(status, data, src)
+        status, _, data = self._request("DELETE", sb, sk)
+        if status not in (200, 204):
+            self._raise(status, data, src)
+
+
+S3_SCHEMES = ("s3", "s3n", "s3a")
+ENDPOINT_ENV = "PAGERANK_TPU_S3_ENDPOINT"
+
+
+def from_env() -> Optional[S3FileSystem]:
+    """Build an :class:`S3FileSystem` from the environment, or None when
+    no endpoint is configured."""
+    endpoint = os.environ.get(ENDPOINT_ENV)
+    if not endpoint:
+        return None
+    return S3FileSystem(
+        endpoint,
+        region=os.environ.get("AWS_REGION", "us-east-1"),
+        access_key=os.environ.get("AWS_ACCESS_KEY_ID"),
+        secret_key=os.environ.get("AWS_SECRET_ACCESS_KEY"),
+    )
+
+
+def register_s3(
+    fs: Optional[S3FileSystem] = None, only_missing: bool = False
+) -> Optional[S3FileSystem]:
+    """Register ``fs`` (default: :func:`from_env`) for all S3 schemes —
+    the reference's inputs are spelled ``s3n://`` (Sparky.java:44-58),
+    modern Hadoop uses ``s3a://``, plain ``s3://`` is the native form.
+    ``only_missing`` skips schemes that already have a registration (the
+    lazy get_fs hook must not silently replace an explicitly registered
+    store with the env endpoint)."""
+    fs = fs or from_env()
+    if fs is not None:
+        for scheme in S3_SCHEMES:
+            if only_missing and fsio.registered(scheme):
+                continue
+            fsio.register(scheme, fs)
+    return fs

@@ -1,4 +1,4 @@
-"""Standing acceptance runs — BASELINE.md configs 2/3 stand-ins.
+"""Standing acceptance runs — BASELINE.md configs 2/3/5 + textbook stand-ins.
 
 Config 2 (web-Google, 875K nodes / 5.1M edges, 20 iters, single chip)
 and config 3 (soc-LiveJournal1, 4.8M nodes / 69M edges, 30 iters) gate
@@ -25,8 +25,14 @@ the raw gate binds everywhere; the two columns diverging again would
 signal a regression of the global-scale class). Each run appends a row
 to BASELINE.md's "Acceptance runs" table (use --no-append to skip).
 
+Beyond A/B/C (reference-semantics pair-f64 vs the f64 oracle), the
+default set includes T — the TEXTBOOK-semantics mode under the same
+oracle-diff gate (both modes are the behavioral contract, SURVEY §2a) —
+and P, the config-5 PPR stand-in: device batched-SpMM (f32) vs the f64
+oracle, gated on per-source top-k id overlap and top-k score L1.
+
 Usage:
-  PYTHONPATH=. python scripts/acceptance.py [--only A|B|C] [--no-append]
+  PYTHONPATH=. python scripts/acceptance.py [--only A|B|C|T|P] [--no-append]
 """
 
 import argparse
@@ -49,17 +55,35 @@ CONFIGS = {
     # makes it a deliberate run): the per-chip share of config 4.
     "C": dict(scale=24, iters=50,
               label="config-4 per-chip stand-in (Twitter class, 50 iters)"),
+    # Textbook semantics (SURVEY §2a: BOTH modes are the behavioral
+    # contract; the non-reference mode needs its own standing gate
+    # against drift — VERDICT r2 #7). Same scale/iteration class as A.
+    "T": dict(scale=20, iters=50, semantics="textbook",
+              label="textbook-mode stand-in (scale-20, 50 iters)"),
+    # Config 5 (PPR): mid-scale batched-SpMM run gated on oracle top-k
+    # overlap + score L1 (VERDICT r2 #6).
+    "P": dict(scale=20, iters=20, sources=256, topk=100, kind="ppr",
+              label="config-5 stand-in (PPR, 256 sources)"),
 }
-DEFAULT_KEYS = ["A", "B"]
+DEFAULT_KEYS = ["A", "B", "T", "P"]
+
+# PPR gates. Top-k membership is judged against ORACLE SCORES, not id
+# sets: vertices tied at the k-th score legitimately swap in/out of an
+# id-based top-k (at toy scales the plain id overlap drops to 0.1 on
+# pure ties while every score agrees to ~5e-8), so a device id is
+# "acceptable" iff its oracle score reaches the oracle's k-th score
+# within PPR_TIE_EPS (absolute; columns sum to 1, f32 device scores
+# carry ~3e-7/element — tests/test_ppr.py). Score agreement is gated
+# separately: worst per-source L1 over the rank-sorted top-k scores.
+PPR_TIE_EPS = 1e-6
+PPR_OVERLAP_GATE = 0.999
+PPR_SCORE_L1_GATE = 1e-4
 
 
-def run_one(key: str):
-    from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
-                              ReferenceCpuEngine, build_graph)
+def _make_graph(key: str, scale: int):
+    from pagerank_tpu import build_graph
     from pagerank_tpu.utils.synth import rmat_edges
 
-    spec = CONFIGS[key]
-    scale, iters = spec["scale"], spec["iters"]
     t0 = time.perf_counter()
     src, dst = rmat_edges(scale, 16, seed=11)
     g = build_graph(src, dst, n=1 << scale)
@@ -67,22 +91,112 @@ def run_one(key: str):
     print(f"[{key}] graph: scale {scale}: {g.n:,} vertices, "
           f"{g.num_edges:,} edges ({t_build:.1f}s host build)",
           file=sys.stderr)
+    return g
+
+
+def run_ppr(key: str):
+    """Config-5 standing gate: device batched-SpMM PPR vs the f64 CPU
+    oracle — per-source top-k id overlap and top-k score L1."""
+    from pagerank_tpu import PageRankConfig
+    from pagerank_tpu.engines.ppr import PprJaxEngine, ppr_cpu
+
+    spec = CONFIGS[key]
+    scale, iters = spec["scale"], spec["iters"]
+    n_sources, topk = spec["sources"], spec["topk"]
+    g = _make_graph(key, scale)
+    rng = np.random.default_rng(17)
+    sources = rng.choice(g.n, size=n_sources, replace=False)
+
+    cfg = PageRankConfig(num_iters=iters, dtype="float32",
+                         accum_dtype="float32")
+    t0 = time.perf_counter()
+    eng = PprJaxEngine(cfg).build(g)
+    t_dev_build = time.perf_counter() - t0
+    chips = eng._mesh.devices.size
+    t0 = time.perf_counter()
+    res = eng.run(sources, topk=topk, chunk=64)
+    t_run = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    r_full = ppr_cpu(g, sources, num_iters=iters, damping=cfg.damping)
+    t_oracle = time.perf_counter() - t0
+
+    # Acceptable-membership overlap (see PPR_TIE_EPS comment) + sorted
+    # top-k oracle scores for the L1 column. One O(n) argpartition per
+    # column — a full-column sort (or negated copies of the [n, s]
+    # oracle, ~2 GB each at the default config) is never materialized.
+    cols = np.arange(n_sources)
+    part = np.argpartition(r_full, g.n - topk, axis=0)[g.n - topk:]  # [k, s]
+    top_scores = np.take_along_axis(r_full, part, axis=0)  # [k, s] unsorted
+    kth = top_scores.min(axis=0)  # [s] k-th largest per source
+    dev_scores_true = r_full[res.topk_ids, cols[:, None]]  # [s, k]
+    overlaps = (dev_scores_true >= (kth[:, None] - PPR_TIE_EPS)).mean(axis=1)
+    oracle_topk = np.sort(top_scores, axis=0)[::-1].T  # [s, k] descending
+    score_l1 = np.abs(
+        res.topk_scores.astype(np.float64) - oracle_topk
+    ).sum(axis=1)
+    rate = g.num_edges * n_sources * iters / t_run / chips
+    rec = {
+        "config": key,
+        "kind": "ppr",
+        "label": spec["label"],
+        "scale": scale,
+        "iters": iters,
+        "sources": n_sources,
+        "topk": topk,
+        "num_edges": int(g.num_edges),
+        "min_topk_overlap": float(overlaps.min()),
+        "mean_topk_overlap": float(overlaps.mean()),
+        "max_score_l1": float(score_l1.max()),
+        "overlap_gate": PPR_OVERLAP_GATE,
+        "score_l1_gate": PPR_SCORE_L1_GATE,
+        "passed": bool(
+            overlaps.min() >= PPR_OVERLAP_GATE
+            and score_l1.max() <= PPR_SCORE_L1_GATE
+        ),
+        "tpu_seconds": t_run,
+        "edge_vectors_per_sec_per_chip": rate,
+    }
+    print(
+        f"[{key}] {n_sources} sources x {iters} iters, top-{topk} in "
+        f"{t_run:.2f}s (device build {t_dev_build:.1f}s, oracle "
+        f"{t_oracle:.1f}s): overlap min {overlaps.min():.4f} / mean "
+        f"{overlaps.mean():.4f} (gate {PPR_OVERLAP_GATE}), max score L1 "
+        f"{score_l1.max():.3e} (gate {PPR_SCORE_L1_GATE:g}) -> "
+        f"{'PASS' if rec['passed'] else 'FAIL'}; {rate:.3g} "
+        f"edge-vectors/s/chip",
+        file=sys.stderr,
+    )
+    return rec
+
+
+def run_one(key: str):
+    from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
+                              ReferenceCpuEngine)
+
+    spec = CONFIGS[key]
+    scale, iters = spec["scale"], spec["iters"]
+    semantics = spec.get("semantics", "reference")
+    g = _make_graph(key, scale)
 
     cfg_pair = PageRankConfig(
         num_iters=iters, dtype="float64", accum_dtype="float64",
-        wide_accum="pair",
+        wide_accum="pair", semantics=semantics,
     )
     t0 = time.perf_counter()
     eng = JaxTpuEngine(cfg_pair).build(g)
     t_dev_build = time.perf_counter() - t0
     # Compile outside the timed window, then restore the initial state
-    # (reference semantics: rank 1.0 per vertex, Sparky.java:168). The
-    # timed window covers steps + the honest scalar fence ONLY (bench.py
-    # pattern) — the full rank decode/D2H happens after, so it doesn't
-    # deflate the rate column.
+    # (reference semantics: rank 1.0 per vertex, Sparky.java:168;
+    # textbook: 1/N — models/pagerank.initial_rank). The timed window
+    # covers steps + the honest scalar fence ONLY (bench.py pattern) —
+    # the full rank decode/D2H happens after, so it doesn't deflate the
+    # rate column.
+    from pagerank_tpu.models.pagerank import initial_rank
+
     eng.step()
     eng.fence()
-    eng.set_ranks(np.full(g.n, 1.0), iteration=0)
+    eng.set_ranks(initial_rank(g.n, semantics, np.float64, np), iteration=0)
     chips = eng.mesh.devices.size
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -93,7 +207,7 @@ def run_one(key: str):
 
     t0 = time.perf_counter()
     cfg_oracle = PageRankConfig(num_iters=iters, dtype="float64",
-                                accum_dtype="float64")
+                                accum_dtype="float64", semantics=semantics)
     r_cpu = ReferenceCpuEngine(cfg_oracle).build(g).run()
     t_oracle = time.perf_counter() - t0
 
@@ -104,6 +218,7 @@ def run_one(key: str):
     rec = {
         "config": key,
         "label": spec["label"],
+        "semantics": semantics,
         "scale": scale,
         "iters": iters,
         "num_edges": int(g.num_edges),
@@ -125,33 +240,72 @@ def run_one(key: str):
     return rec
 
 
+def _append_table(text: str, header: str, intro: str, row_strs) -> str:
+    """Append rows under ``header``, creating the table (with ``intro``)
+    on first use. Rows land at the END of the header's section (the
+    next '## ' or EOF), so repeated runs interleave correctly even when
+    other sections follow."""
+    rows = "".join(row_strs)
+    if not rows:
+        return text
+    if header not in text:
+        return text + f"\n{header}\n\n" + intro + rows
+    start = text.index(header)
+    end = text.find("\n## ", start + len(header))
+    if end == -1:
+        return text + rows
+    return text[:end] + rows + text[end:]
+
+
 def append_baseline(recs) -> None:
     path = os.path.join(REPO, "BASELINE.md")
     with open(path) as f:
         text = f.read()
-    header = "## Acceptance runs (configs 2-4 stand-ins)"
-    if header not in text:
-        text += (
-            f"\n{header}\n\n"
-            "Scripted by `scripts/acceptance.py`: accuracy-grade TPU "
-            "config (pair-f64: f64 storage + pair accumulation) vs the "
-            "f64 CPU oracle on the same R-MAT graph. Gate: BOTH raw "
-            "normalized L1 and mass-normalized L1 <= 1e-6. One row "
-            "appended per run.\n\n"
-            "| Stand-in | Workload | Iters | Normalized L1 | "
-            "Mass-normalized L1 | Gate | Result | edges/s/chip |\n"
-            "|---|---|---|---|---|---|---|---|\n"
-        )
-    rows = "".join(
+    global_rows = [
         f"| {r['label']} | R-MAT {r['scale']} ({r['num_edges']:,} edges) "
         f"| {r['iters']} | {r['normalized_l1']:.3e} | "
         f"{r['mass_normalized_l1']:.3e} | {r['gate']:g} | "
         f"{'PASS' if r['passed'] else 'FAIL'} | "
         f"{r['edges_per_sec_per_chip']:.3g} |\n"
-        for r in recs
+        for r in recs if r.get("kind") != "ppr"
+    ]
+    text = _append_table(
+        text,
+        "## Acceptance runs (configs 2-4 stand-ins)",
+        "Scripted by `scripts/acceptance.py`: accuracy-grade TPU "
+        "config (pair-f64: f64 storage + pair accumulation) vs the "
+        "f64 CPU oracle on the same R-MAT graph (reference semantics "
+        "unless the stand-in says textbook). Gate: BOTH raw "
+        "normalized L1 and mass-normalized L1 <= 1e-6. One row "
+        "appended per run.\n\n"
+        "| Stand-in | Workload | Iters | Normalized L1 | "
+        "Mass-normalized L1 | Gate | Result | edges/s/chip |\n"
+        "|---|---|---|---|---|---|---|---|\n",
+        global_rows,
+    )
+    ppr_rows = [
+        f"| {r['label']} | R-MAT {r['scale']} ({r['num_edges']:,} edges), "
+        f"{r['sources']} sources | {r['iters']} | "
+        f"{r['min_topk_overlap']:.4f} / {r['mean_topk_overlap']:.4f} | "
+        f"{r['max_score_l1']:.3e} | >= {r['overlap_gate']}, <= "
+        f"{r['score_l1_gate']:g} | {'PASS' if r['passed'] else 'FAIL'} | "
+        f"{r['edge_vectors_per_sec_per_chip']:.3g} |\n"
+        for r in recs if r.get("kind") == "ppr"
+    ]
+    text = _append_table(
+        text,
+        "## PPR acceptance runs (config-5 stand-in)",
+        "Device batched-SpMM PPR (f32) vs the f64 CPU oracle: "
+        "per-source top-k id overlap (min/mean; ties at the k boundary "
+        "may swap) and worst per-source L1 over top-k scores (columns "
+        "sum to 1, so relative).\n\n"
+        "| Stand-in | Workload | Iters | Top-k overlap min/mean | "
+        "Max score L1 | Gates | Result | edge-vectors/s/chip |\n"
+        "|---|---|---|---|---|---|---|---|\n",
+        ppr_rows,
     )
     with open(path, "w") as f:
-        f.write(text + rows)
+        f.write(text)
     print(f"appended {len(recs)} row(s) to BASELINE.md", file=sys.stderr)
 
 
@@ -165,7 +319,10 @@ def main(argv=None) -> int:
 
     _enable_compile_cache()
     keys = [args.only] if args.only else DEFAULT_KEYS
-    recs = [run_one(k) for k in keys]
+    recs = [
+        run_ppr(k) if CONFIGS[k].get("kind") == "ppr" else run_one(k)
+        for k in keys
+    ]
     if not args.no_append:
         append_baseline(recs)
     print(json.dumps(recs))

@@ -1,0 +1,134 @@
+"""Measured host-ingest costs (VERDICT r2 #2: SURVEY §7 calls
+billion-edge host ingest a hard part, and no measured number existed).
+
+Three measurements, printed as a markdown table for docs/PERF_NOTES.md:
+
+  1. host R-MAT edge generation + build_graph at scale >= 25 (time and
+     peak RSS — the np.unique path's transient is what bounds host
+     capacity);
+  2. np.unique vs the C++ radix sort-dedup (native/fast_ingest.cpp) on
+     the same edges (the auto-enable rule in build_graph keys off this);
+  3. a 300-file synthetic SequenceFile segment (the reference's input
+     shape, Sparky.java:44-58) through load_crawl_seqfile, serial vs
+     process-pool workers.
+
+Run:  python scripts/host_ingest_bench.py [--scale 25] [--files 300]
+"""
+
+import argparse
+import os
+import resource
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_gb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def bench_host_build(scale: int, edge_factor: int):
+    from pagerank_tpu import build_graph
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    t0 = time.perf_counter()
+    src, dst = rmat_edges(scale, edge_factor, seed=0)
+    t_gen = time.perf_counter() - t0
+    raw = len(src)
+    print(f"rmat gen: scale {scale} ef {edge_factor}: {raw:,} raw edges "
+          f"in {t_gen:.1f}s (rss {rss_gb():.1f} GB)", file=sys.stderr)
+
+    rows = []
+    for label, kw in (("np.unique", dict(use_native_sort=False)),
+                      ("C++ radix", dict(use_native_sort=True))):
+        t0 = time.perf_counter()
+        g = build_graph(src, dst, n=1 << scale, **kw)
+        dt = time.perf_counter() - t0
+        rows.append((label, raw, g.num_edges, dt, rss_gb()))
+        print(f"build[{label}]: {g.num_edges:,} unique edges in {dt:.1f}s "
+              f"({raw / dt / 1e6:.1f} M raw edges/s, peak rss "
+              f"{rss_gb():.1f} GB)", file=sys.stderr)
+        del g
+    return t_gen, rows
+
+
+def bench_segment(n_files: int, recs_per_file: int, workers_list):
+    import json
+
+    from pagerank_tpu.ingest import load_crawl_seqfile, write_sequence_file
+
+    rng = np.random.default_rng(0)
+    n_urls = 2000
+    urls = [f"http://site{i}.example/path/page.html" for i in range(n_urls)]
+
+    def meta(targets):
+        return json.dumps({"content": {"links": [
+            {"type": "a", "href": t} for t in targets]}})
+
+    td = tempfile.mkdtemp(prefix="seg")
+    t0 = time.perf_counter()
+    n_records = 0
+    for i in range(n_files):
+        recs = []
+        for _ in range(recs_per_file):
+            u = urls[int(rng.integers(n_urls))]
+            targets = [urls[int(t)] for t in
+                       rng.integers(0, n_urls, 20)]
+            recs.append((u, meta(targets)))
+            n_records += 1
+        write_sequence_file(
+            os.path.join(td, f"metadata-{i:05d}"), recs,
+            compression="block",
+        )
+    print(f"segment: {n_files} files x {recs_per_file} records "
+          f"({n_records:,} records, 20 links each) written in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    rows = []
+    for w in workers_list:
+        t0 = time.perf_counter()
+        g, ids = load_crawl_seqfile(td, workers=w)
+        dt = time.perf_counter() - t0
+        rows.append((w, n_records, g.num_edges, dt))
+        print(f"ingest[workers={w}]: {g.num_edges:,} unique edges, "
+              f"{n_records / dt:,.0f} records/s ({dt:.1f}s)",
+              file=sys.stderr)
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", type=int, default=25)
+    p.add_argument("--edge-factor", type=int, default=16)
+    p.add_argument("--files", type=int, default=300)
+    p.add_argument("--recs-per-file", type=int, default=200)
+    args = p.parse_args()
+
+    cores = os.cpu_count() or 1
+    print(f"host: {cores} core(s)", file=sys.stderr)
+    workers = [1] if cores == 1 else [1, cores]
+
+    seg_rows = bench_segment(args.files, args.recs_per_file, workers)
+    t_gen, build_rows = bench_host_build(args.scale, args.edge_factor)
+
+    print("\n## Host ingest (markdown)\n")
+    print("| measurement | input | result |")
+    print("|---|---|---|")
+    for label, raw, uniq, dt, rss in build_rows:
+        print(f"| host build ({label}) | R-MAT {args.scale} ef "
+              f"{args.edge_factor}: {raw / 1e6:.0f}M raw / {uniq / 1e6:.0f}M "
+              f"unique edges | {dt:.1f}s = {raw / dt / 1e6:.1f} M raw "
+              f"edges/s, peak RSS {rss:.1f} GB |")
+    for w, n_records, uniq, dt in seg_rows:
+        print(f"| segment ingest (workers={w}) | {args.files}-file "
+              f"block-compressed SequenceFile segment, {n_records:,} "
+              f"records | {n_records / dt:,.0f} records/s "
+              f"({uniq / dt / 1e6:.2f}M unique edges/s) |")
+
+
+if __name__ == "__main__":
+    main()

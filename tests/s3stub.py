@@ -1,0 +1,172 @@
+"""In-process S3 stub server for tests (zero-egress environment).
+
+Speaks just enough of the S3 REST dialect to exercise
+pagerank_tpu.utils.s3 end-to-end: object GET/PUT/HEAD/DELETE,
+server-side COPY (x-amz-copy-source), and ListObjectsV2 with
+prefix/delimiter/max-keys/continuation-token pagination. Requests'
+Authorization headers are recorded so tests can assert SigV4 signing
+engaged (cryptographic verification of the signature itself is pinned
+separately against the published AWS test vector in test_s3.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+
+class S3Stub:
+    def __init__(self):
+        self.objects = {}  # "/bucket/key" -> bytes
+        self.lock = threading.RLock()
+        self.auth_headers = []  # recorded Authorization values (or None)
+        self.max_page = 1000  # shrink in tests to force pagination
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _path_query(self):
+                u = urllib.parse.urlsplit(self.path)
+                return urllib.parse.unquote(u.path), urllib.parse.parse_qs(u.query)
+
+            def _record(self):
+                outer.auth_headers.append(self.headers.get("Authorization"))
+
+            def _send(self, status, body=b"", ctype="application/xml",
+                      head_len=None):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header(
+                    "Content-Length",
+                    str(head_len if head_len is not None else len(body)),
+                )
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_PUT(self):
+                self._record()
+                path, _ = self._path_query()
+                src = self.headers.get("x-amz-copy-source")
+                if src:
+                    src = urllib.parse.unquote(src)
+                    with outer.lock:
+                        if src not in outer.objects:
+                            self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
+                            return
+                        outer.objects[path] = outer.objects[src]
+                    self._send(200, b"<CopyObjectResult/>")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(length) if length else b""
+                with outer.lock:
+                    outer.objects[path] = data
+                self._send(200)
+
+            def do_GET(self):
+                self._record()
+                path, q = self._path_query()
+                if q.get("list-type") == ["2"]:
+                    self._do_list(path.strip("/"), q)
+                    return
+                with outer.lock:
+                    data = outer.objects.get(path)
+                if data is None:
+                    self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
+                    return
+                self._send(200, data, ctype="application/octet-stream")
+
+            def do_HEAD(self):
+                self._record()
+                path, _ = self._path_query()
+                with outer.lock:
+                    data = outer.objects.get(path)
+                if data is None:
+                    self._send(404, head_len=0)
+                else:
+                    self._send(200, ctype="application/octet-stream",
+                               head_len=len(data))
+
+            def do_DELETE(self):
+                self._record()
+                path, _ = self._path_query()
+                with outer.lock:
+                    outer.objects.pop(path, None)
+                self._send(204)
+
+            def _do_list(self, bucket, q):
+                prefix = q.get("prefix", [""])[0]
+                delim = q.get("delimiter", [""])[0]
+                max_keys = min(int(q.get("max-keys", ["1000"])[0]),
+                               outer.max_page)
+                token = q.get("continuation-token", [""])[0]
+                base = f"/{bucket}/"
+                with outer.lock:
+                    keys = sorted(
+                        k[len(base):] for k in outer.objects
+                        if k.startswith(base + prefix)
+                    )
+                # Collapse at the delimiter into CommonPrefixes.
+                entries = []  # (sort_key, is_prefix)
+                seen = set()
+                for k in keys:
+                    if delim:
+                        rest = k[len(prefix):]
+                        if delim in rest:
+                            cp = prefix + rest.split(delim, 1)[0] + delim
+                            if cp not in seen:
+                                seen.add(cp)
+                                entries.append((cp, True))
+                            continue
+                    entries.append((k, False))
+                entries.sort()
+                start = 0
+                if token:
+                    start = next(
+                        (i for i, (k, _) in enumerate(entries) if k > token),
+                        len(entries),
+                    )
+                page = entries[start:start + max_keys]
+                truncated = start + max_keys < len(entries)
+                parts = ["<?xml version='1.0'?><ListBucketResult>"]
+                parts.append(f"<IsTruncated>{str(truncated).lower()}</IsTruncated>")
+                for k, is_prefix in page:
+                    if is_prefix:
+                        parts.append(
+                            f"<CommonPrefixes><Prefix>{escape(k)}</Prefix>"
+                            f"</CommonPrefixes>"
+                        )
+                    else:
+                        parts.append(f"<Contents><Key>{escape(k)}</Key></Contents>")
+                if truncated and page:
+                    parts.append(
+                        f"<NextContinuationToken>{escape(page[-1][0])}"
+                        f"</NextContinuationToken>"
+                    )
+                parts.append("</ListBucketResult>")
+                self._send(200, "".join(parts).encode())
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+        return False

@@ -227,3 +227,13 @@ def test_device_fingerprint_stable_and_discriminating():
     fp2 = build(src, dst).fingerprint()
     assert fp1 == fp2 and fp1.startswith("dev-")
     assert build(dst, src).fingerprint() != fp1
+    # Degree-PRESERVING rewire ({0->2, 1->3} vs {0->3, 1->2} shape):
+    # identical degree vectors and perm, different adjacency — only a
+    # slot-array checksum can tell these apart.
+    a = db.build_ell_device(
+        jax.numpy.asarray([0, 1]), jax.numpy.asarray([2, 3]), n=4
+    ).fingerprint()
+    b = db.build_ell_device(
+        jax.numpy.asarray([0, 1]), jax.numpy.asarray([3, 2]), n=4
+    ).fingerprint()
+    assert a != b

@@ -330,3 +330,42 @@ def test_golden_seqfile_to_text_dumps_vs_rdd_oracle(tmp_path):
         assert got.keys() == want.keys(), it
         for u in want:
             assert abs(got[u] - want[u]) < 1e-9, (it, u, got[u], want[u])
+
+
+def test_parallel_segment_parse_identical_to_serial(tmp_path):
+    """A 300-file segment (the reference's input shape: metadata-00000..
+    00300, Sparky.java:44-58) parsed with a process pool must produce
+    byte-identical graph structure AND id assignment to the serial path
+    (record order is the id order). VERDICT r2 #2."""
+    d = tmp_path / "segment"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    n_files, n_urls = 300, 120
+    urls = [f"http://site{i}.example/" for i in range(n_urls)]
+    for i in range(n_files):
+        recs = []
+        for _ in range(3):
+            u = urls[int(rng.integers(n_urls))]
+            targets = [urls[int(t)] for t in
+                       rng.integers(0, n_urls, int(rng.integers(0, 4)))]
+            recs.append((u, meta(u, targets)))
+        write_sequence_file(str(d / f"metadata-{i:05d}"), recs)
+
+    g_ser, ids_ser = load_crawl_seqfile(str(d), workers=1)
+    g_par, ids_par = load_crawl_seqfile(str(d), workers=4)
+    assert ids_par.names == ids_ser.names  # identical id assignment
+    assert g_par.fingerprint() == g_ser.fingerprint()
+    np.testing.assert_array_equal(g_par.dangling_mask, g_ser.dangling_mask)
+
+
+def test_parallel_segment_parse_propagates_strict_errors(tmp_path):
+    d = tmp_path / "segment"
+    d.mkdir()
+    for i in range(4):
+        write_sequence_file(str(d / f"metadata-{i:05d}"), [RECORDS[0]])
+    write_sequence_file(str(d / "metadata-00004"),
+                        [("http://bad.example/", "{not json")])
+    with pytest.raises(Exception):
+        load_crawl_seqfile(str(d), strict=True, workers=4)
+    g, _ = load_crawl_seqfile(str(d), strict=False, workers=4)
+    assert g.n > 0
