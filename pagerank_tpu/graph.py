@@ -112,10 +112,13 @@ def build_graph(
         (see module docstring).
       use_native_sort: route dedup+sort through the C++ radix sorter
         (native/fast_ingest.cpp). Default None = AUTO: engage when the
-        native library is available, the host has >1 core (the sorter
-        is multithreaded; np.unique wins on single-core hosts — this
-        image's measured case, docs/PERF_NOTES.md "Host ingest"), and
-        the input is large enough to amortize (>= 2^22 edges).
+        native library is available and either the host has >1 core
+        and >= 2^22 edges (the sorter is multithreaded), or the input
+        is >= 2^27 edges even single-core — measured end to end on this
+        1-core image (unloaded): ~parity at 16-67M edges, radix 1.40x
+        at 537M (195s -> 139s; the numpy path's int64 key divmod and
+        sort working set blow up past ~100M edges). docs/PERF_NOTES.md
+        "Host ingest".
     """
     src = np.ascontiguousarray(src, dtype=np.int64)
     dst = np.ascontiguousarray(dst, dtype=np.int64)
@@ -145,7 +148,8 @@ def build_graph(
             import os
 
             use_native_sort = (
-                (os.cpu_count() or 1) > 1 and len(src) >= (1 << 22)
+                ((os.cpu_count() or 1) > 1 and len(src) >= (1 << 22))
+                or len(src) >= (1 << 27)
             )
         if dedup and use_native_sort:
             from pagerank_tpu.ingest import native as native_lib

@@ -300,10 +300,19 @@ def iter_segment_records(
     """
     import multiprocessing
     import os
+    import threading
 
     paths = list(paths)
     if workers is None:
         workers = min(len(paths), os.cpu_count() or 1)
+        # Auto mode degrades to serial once the parent is multi-threaded
+        # (e.g. the async snapshot writer, or an engine already built):
+        # forking a threaded process can clone a held lock into the
+        # child and deadlock the pool. An EXPLICIT workers>1 is honored
+        # as the caller's assertion that forking is safe here (the CLI
+        # ingests before any engine/writer exists).
+        if threading.active_count() > 1:
+            workers = 1
     if (
         workers <= 1
         or len(paths) <= 1

@@ -52,7 +52,14 @@ class FileSystem:
     ``replace`` must be atomic within the store (the Snapshotter's
     torn-file guarantee rides on it; a backend without native rename can
     implement copy+delete only if readers never see partial objects,
-    which object stores guarantee per-object)."""
+    which object stores guarantee per-object).
+
+    ``COMMIT_ON_FLUSH``: whether buffered writers publish their bytes
+    on every ``flush()`` (crash durability for incremental sinks) or
+    only at close (real object stores, where a per-flush re-PUT of the
+    whole object is O(records^2) network bytes — see _MemWriter)."""
+
+    COMMIT_ON_FLUSH = True
 
     def open(self, path: str, mode: str = "r", **kwargs):
         raise NotImplementedError
@@ -106,12 +113,17 @@ class _MemWriter(io.BytesIO):
     store abandons the upload), so a writer that dies mid-serialization
     never publishes a torn object.
 
-    An explicit ``flush()`` ALSO commits the bytes so far: incremental
-    sinks (the JSONL metrics logger) flush after every record precisely
-    so a killed run keeps its records, and that crash behavior must
-    match the local backend. Writers that need torn-object protection
-    get it by never flushing mid-serialization (none in this codebase
-    do) — the atomic rename in the Snapshotter guards the rest."""
+    An explicit ``flush()`` ALSO commits the bytes so far when the
+    owning filesystem opts in (``COMMIT_ON_FLUSH``, default True):
+    incremental sinks (the JSONL metrics logger) flush after every
+    record precisely so a killed run keeps its records, and for
+    in-memory stores that crash behavior must match the local backend.
+    A REAL object store sets it False — re-PUTting the whole object
+    per record is O(records^2) network bytes, so there durability
+    arrives at close (utils/s3.py). Writers that need torn-object
+    protection get it by never flushing mid-serialization (none in
+    this codebase do) — the atomic rename in the Snapshotter guards
+    the rest."""
 
     def __init__(self, fs: "MemoryFileSystem", path: str, initial: bytes = b""):
         super().__init__()
@@ -125,7 +137,8 @@ class _MemWriter(io.BytesIO):
 
     def flush(self):
         super().flush()
-        if not self.closed and not self._aborted:
+        if (not self.closed and not self._aborted
+                and getattr(self._fs, "COMMIT_ON_FLUSH", True)):
             self._fs._commit(self._path, self.getvalue())
 
     def __exit__(self, exc_type, exc, tb):

@@ -116,10 +116,17 @@ class S3FileSystem(fsio.FileSystem):
     Thread-compatible: every request opens its own connection (the
     async snapshot writer commits from a worker thread). Objects are
     written with single-PUT semantics via the shared buffered writer
-    (:class:`fsio._MemWriter` commits through :meth:`_commit` on
-    flush/close) — readers never observe partial objects, matching the
-    reference's S3 output contract (Sparky.java:237).
+    (:class:`fsio._MemWriter` commits through :meth:`_commit` at
+    CLOSE; ``COMMIT_ON_FLUSH`` is off because re-uploading the whole
+    accumulated object per ``flush()`` — e.g. the per-record JSONL
+    metrics flush — would be O(records^2) network bytes against a real
+    store). Readers never observe partial objects, matching the
+    reference's S3 output contract (Sparky.java:237); incremental
+    sinks pointed at ``s3://`` get durability at close, not per
+    record.
     """
+
+    COMMIT_ON_FLUSH = False
 
     def __init__(
         self,

@@ -31,8 +31,21 @@ def rss_gb():
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
-def bench_host_build(scale: int, edge_factor: int):
+def _build_child(q, src, dst, n, native):
+    """One build in a FRESH forked process so ru_maxrss is that path's
+    own high-water mark — in-process, the second build would inherit
+    the first's process-lifetime peak and the per-path RSS column would
+    be meaningless."""
     from pagerank_tpu import build_graph
+
+    t0 = time.perf_counter()
+    g = build_graph(src, dst, n=n, use_native_sort=native)
+    q.put((time.perf_counter() - t0, int(g.num_edges), rss_gb()))
+
+
+def bench_host_build(scale: int, edge_factor: int):
+    import multiprocessing
+
     from pagerank_tpu.utils.synth import rmat_edges
 
     t0 = time.perf_counter()
@@ -42,17 +55,29 @@ def bench_host_build(scale: int, edge_factor: int):
     print(f"rmat gen: scale {scale} ef {edge_factor}: {raw:,} raw edges "
           f"in {t_gen:.1f}s (rss {rss_gb():.1f} GB)", file=sys.stderr)
 
+    ctx = multiprocessing.get_context("fork")  # COW: edges not copied
     rows = []
-    for label, kw in (("np.unique", dict(use_native_sort=False)),
-                      ("C++ radix", dict(use_native_sort=True))):
-        t0 = time.perf_counter()
-        g = build_graph(src, dst, n=1 << scale, **kw)
-        dt = time.perf_counter() - t0
-        rows.append((label, raw, g.num_edges, dt, rss_gb()))
-        print(f"build[{label}]: {g.num_edges:,} unique edges in {dt:.1f}s "
-              f"({raw / dt / 1e6:.1f} M raw edges/s, peak rss "
-              f"{rss_gb():.1f} GB)", file=sys.stderr)
-        del g
+    for label, native in (("np.unique", False), ("C++ radix", True)):
+        q = ctx.Queue()
+        p = ctx.Process(target=_build_child,
+                        args=(q, src, dst, 1 << scale, native))
+        p.start()
+        result = None
+        while result is None:
+            try:
+                result = q.get(timeout=30)
+            except Exception:
+                if not p.is_alive():  # died before q.put (e.g. OOM kill)
+                    raise RuntimeError(
+                        f"{label} build child exited with "
+                        f"{p.exitcode} before reporting a result"
+                    )
+        dt, num_edges, rss = result
+        p.join()
+        rows.append((label, raw, num_edges, dt, rss))
+        print(f"build[{label}]: {num_edges:,} unique edges in {dt:.1f}s "
+              f"({raw / dt / 1e6:.1f} M raw edges/s, child peak rss "
+              f"{rss:.1f} GB)", file=sys.stderr)
     return t_gen, rows
 
 

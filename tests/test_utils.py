@@ -38,8 +38,13 @@ def test_metrics_logger_summary(tmp_path):
     s = m.summary()
     m.close()
     assert s["iters"] == 3
+    assert s["timed_iters"] == 2  # compile iteration 0 excluded from means
     assert s["edges_per_sec_per_chip"] > 0
     assert len(open(jsonl).readlines()) == 3
+    # The explicit-args (fused) form: every executed iteration is timed.
+    m2 = MetricsLogger(num_edges=1000, num_chips=2, log_every=0)
+    s2 = m2.summary(iters=5, total_seconds=2.0)
+    assert s2["iters"] == s2["timed_iters"] == 5
 
 
 def test_lane_group_auto_resolution():
