@@ -88,6 +88,8 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
     grp, stripe = plan_build(
         cfg, 1 << args.scale, stripe_size=args.stripe_size,
         lane_group=args.lane_group, host=host_build,
+        num_edges=args.edge_factor << args.scale,  # raw count: the
+        # occupancy rule is a density threshold, dedup loss is noise
     )
     cfg = cfg.replace(lane_group=grp)
 

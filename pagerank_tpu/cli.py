@@ -280,7 +280,9 @@ def _device_build_graph(args, src, dst, n):
     plan_cfg = PageRankConfig(
         dtype=args.dtype, accum_dtype=args.accum_dtype or args.dtype,
     ).validate()
-    grp, stripe = db.plan_build(plan_cfg, n, lane_group=args.lane_group or 0)
+    grp, stripe = db.plan_build(
+        plan_cfg, n, lane_group=args.lane_group or 0, num_edges=len(src),
+    )
     return db.build_ell_device(
         src, dst, n=n, group=grp, stripe_size=stripe,
         with_weights=False,  # presentinel: no per-slot weight plane

@@ -173,3 +173,21 @@ def test_fused_tol_routes_to_chunked_on_ms_layouts(monkeypatch):
     # Full per-iteration traces survive (strictly more than the
     # while_loop form's final-only contract).
     assert len(np.asarray(eng.last_run_metrics["l1_delta"])) == stop_iter
+
+
+def test_occupancy_span_rule():
+    """Sparse pair layouts double the stripe span once (measured +30% at
+    R-MAT 26 ef 8); dense, non-pair, unknown-edge-count, and unstriped
+    layouts keep it (measured regression on dense: PERF_NOTES
+    "Occupancy-aware pair stripes")."""
+    smax = 4194304
+    n26, e26 = 1 << 26, 8 << 26  # ef 8: 64 edges/cell at smax -> double
+    assert JaxTpuEngine.occupancy_span(smax, n26, e26, True) == 2 * smax
+    n25, e25 = 1 << 25, 16 << 25  # ef 16: 256 edges/cell -> keep
+    assert JaxTpuEngine.occupancy_span(smax, n25, e25, True) == smax
+    assert JaxTpuEngine.occupancy_span(smax, n26, e26, False) == smax
+    assert JaxTpuEngine.occupancy_span(smax, n26, None, True) == smax
+    assert JaxTpuEngine.occupancy_span(n26, n26, e26, True) == n26
+    # doubling never exceeds the vertex space
+    assert JaxTpuEngine.occupancy_span(smax, 6 * smax // 4, 10, True) \
+        == 6 * smax // 4
