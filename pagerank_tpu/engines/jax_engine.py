@@ -143,6 +143,26 @@ class JaxTpuEngine(PageRankEngine):
         return max(np.dtype(cfg.dtype).itemsize,
                    4 if pair else np.dtype(cfg.accum_dtype).itemsize)
 
+    @staticmethod
+    def max_gather_lanes(pair: bool, z_item: int) -> int:
+        """Widest fast-regime gather width for the dtype: pair tables
+        fetch (hi|lo) rows so 64 lanes is the 512B-row bound; plain
+        tables cap at 512B/z_item lanes, at most 128. THE single
+        spelling — used for the actual gather width (_setup_ell) and
+        for occupancy_span's 2^17-row span cap, which must stay in
+        lockstep."""
+        return 64 if pair else min(128, 512 // max(1, z_item))
+
+    @staticmethod
+    def clamp_group_for_span(group: int, span: int) -> int:
+        """Largest power-of-two group <= ``group`` whose packed slot
+        words (src << log2(group) | sub) fit int32 at ``span`` —
+        shared by plan_build and the host build so an occupancy-widened
+        span can never make an explicit lane_group raise in the packer."""
+        while group > 1 and (span + 1) * group > np.iinfo(np.int32).max:
+            group //= 2
+        return group
+
     def build_device(self, dg) -> "JaxTpuEngine":
         """Build from an on-device blocked-ELL graph
         (ops/device_build.DeviceEllGraph) — no bulk host->device
@@ -164,7 +184,8 @@ class JaxTpuEngine(PageRankEngine):
             )
         sz = stripe_size or dg.n_padded
         allowed = self.occupancy_span(
-            self._stripe_max(), dg.n_padded, dg.num_edges, self._pair
+            self._stripe_max(), dg.n_padded, dg.num_edges, self._pair,
+            self.gather_z_item(cfg, self._pair),
         )
         if sz > allowed:
             import sys
@@ -254,13 +275,25 @@ class JaxTpuEngine(PageRankEngine):
                 )
             )
             if n_padded > stripe_max:
+                span = self.occupancy_span(
+                    self._stripe_target(), n_padded, graph.num_edges,
+                    self._pair, self.gather_z_item(cfg, self._pair),
+                )
+                # An occupancy-widened span can push an explicit large
+                # lane_group past the packed-word int32 bound; clamp
+                # like plan_build instead of letting the packer raise.
+                grp = self.clamp_group_for_span(group, span)
+                if grp != group:
+                    import sys
+
+                    print(
+                        f"pagerank_tpu: lane group clamped to {grp} "
+                        f"for stripe span {span}",
+                        file=sys.stderr,
+                    )
+                    group = grp
                 pack = ell_lib.ell_pack_striped(
-                    graph,
-                    stripe_size=self.occupancy_span(
-                        self._stripe_target(), n_padded, graph.num_edges,
-                        self._pair,
-                    ),
-                    group=group,
+                    graph, stripe_size=span, group=group,
                 )
                 srcs, weights, rbs = pack.src, pack.weight, pack.row_block
                 stripe_size = pack.stripe_size
@@ -371,42 +404,51 @@ class JaxTpuEngine(PageRankEngine):
         return self.stripe_limits(z_item, self._pair)[1]
 
     # Expected edges per (stripe, 128-dst block) cell below which a
-    # pair stripe span doubles (see occupancy_span): <= 128 means the
-    # typical cell fills at most ONE grouped row about halfway, so
-    # every slot row carries ~2x padding.
+    # stripe span doubles (see occupancy_span): <= 128 means the
+    # typical cell fills at most ONE grouped row, so widening the span
+    # collapses per-cell row floors instead of adding real rows.
     OCC_DOUBLE_CELL_EDGES = 128
 
     @classmethod
     def occupancy_span(cls, span: int, n_padded: int, num_edges,
-                       pair: bool) -> int:
-        """Occupancy-aware pair stripe span for SPARSE graphs (VERDICT
-        r2 #1). Striping multiplies the (stripe, 128-dst block) cell
+                       pair: bool, z_item: int = 4) -> int:
+        """Occupancy-aware stripe span for SPARSE graphs (VERDICT r2
+        #1). Striping multiplies the (stripe, 128-dst block) cell
         count, and every nonempty cell costs at least one 128-slot row
-        — on a sparse graph (low edge factor) that floor dominates:
-        at R-MAT scale 26 / ef 8, 4.2M-span pair stripes average 64
-        edges per cell, i.e. ~2x slot padding.
+        — on a sparse graph (low edge factor) that floor dominates: at
+        R-MAT scale 26 / ef 8, 4.2M-span stripes average 64 edges per
+        cell, i.e. ~2x slot padding.
 
-        Doubling the span once halves the cell count and fits the pair
-        table in the fast gather regime exactly (8.4M span / gw 64 =
-        2^17 rows): measured at scale 26 ef 8 pair, 1.98e8 vs 1.52e8
-        edges/s/chip (+30%). The doubling is conditional on measured
-        sparsity — on DENSE graphs there is no padding to win back and
-        the doubled ~67MB table pays XLA's working-set cliff (scale 25
-        ef 16 pair measured 0.87e8 at 8.4M vs 1.84e8 at 4.2M) — and
-        applied at most ONCE (a 16.8M span is 2^18 gather rows, past
-        the hard 2^17-row cliff: measured 0.78e8). docs/PERF_NOTES.md
-        "Occupancy-aware pair stripes".
+        Rule: DOUBLE the span while the expected edges per cell
+        (``num_edges * span * 128 / n_padded^2``) is <= 128 — the
+        point where a typical cell at most fills one row — and the
+        doubled gather table still fits the fast regime's hard 2^17-row
+        bound at the dtype's widest gather (64 lanes for pair tables,
+        512B/z_item capped at 128 otherwise), i.e. span caps at 8.4M
+        pair / 16.8M f32. Measured at scale 26 ef 8 (10 iters, honest
+        fence): pair 1.52e8 -> 1.98e8 (4.2M -> 8.4M; 16.8M = 2^18 rows
+        collapses to 0.78e8), f32 2.71e8 -> 3.01e8 -> 3.95e8 (4.2M ->
+        8.4M -> 16.8M). On DENSE graphs the rule keeps the measured
+        optima unchanged (scale 25 ef 16: cell edges 253 at 4.2M; the
+        wider pair span measured 0.87e8 vs 1.84e8 there — no padding
+        to win back, pure working-set loss). docs/PERF_NOTES.md
+        "Occupancy-aware stripes".
 
         ``num_edges`` may be the RAW (pre-dedup) count — the rule is a
         threshold on an order-of-magnitude density estimate. None (or
-        a non-striped layout, or non-pair) returns ``span`` unchanged.
+        a non-striped layout) returns ``span`` unchanged.
         """
-        if not pair or num_edges is None or n_padded <= span:
+        if num_edges is None or n_padded <= span or span <= 0:
             return span
-        cell_edges = num_edges * span * 128 / float(n_padded) ** 2
-        if cell_edges <= cls.OCC_DOUBLE_CELL_EDGES:
-            return min(span * 2, n_padded)
-        return span
+        bound = cls.max_gather_lanes(pair, z_item) << 17
+        while (
+            span * 2 <= bound
+            and span < n_padded
+            and num_edges * span * 128 / float(n_padded) ** 2
+                <= cls.OCC_DOUBLE_CELL_EDGES
+        ):
+            span *= 2
+        return min(span, n_padded)
 
     @staticmethod
     def _gather_width(n_state: int, max_width: int = 128) -> int:
@@ -535,7 +577,7 @@ class JaxTpuEngine(PageRankEngine):
         # of 128, and the reshape contract needs gw | sz.
         gw = max(
             self.GATHER_WIDTH,
-            self._gather_width(sz, 64 if pair else min(128, 512 // z_item)),
+            self._gather_width(sz, self.max_gather_lanes(pair, z_item)),
         )
         want_pallas = cfg.kernel == "pallas"
         if want_pallas and n_stripes > 1:
@@ -663,31 +705,24 @@ class JaxTpuEngine(PageRankEngine):
         # the whole chunked-gather program per stripe and its serialized
         # HLO exceeds remote-compile request limits around 8 pair
         # stripes (measured: R-MAT scale-25 f64-pair, HTTP 413). Past
-        # the threshold the stepwise path runs ONE SMALL EXECUTABLE PER
-        # STRIPE — exact per-stripe shapes, dispatched sequentially per
-        # iteration (_setup_multi_dispatch): every compile request is
-        # O(one stripe) and the fast top-level gather lowering is kept,
-        # with async dispatch pipelining hiding the per-dispatch cost.
-        # The fused single-program forms (run_fused / run_fused_tol)
-        # instead pad the same arrays to a shared geometry inside the
-        # program and scan over stripes — the scan body loses the fast
-        # gather (~3.7x slower execution, measured at scale 24;
-        # docs/PERF_NOTES.md), so fused is the slow form here and
-        # run_fast/run_fused_chunked the fast ones.
-        scan_stripes = (
+        # the threshold EVERY public run form routes through the
+        # multi-dispatch machinery (_setup_multi_dispatch) — one small
+        # exact-shape executable per stripe, the fast top-level gather
+        # lowering kept, async dispatch pipelining hiding per-dispatch
+        # cost: _device_step directly, run_fused / run_fused_tol by
+        # delegation to run_fused_chunked. The unrolled single-program
+        # step below is still CONSTRUCTED (it is the nominal definition
+        # the multi-dispatch path is tested against at toy scale) but
+        # never compiled at real scale; an in-program scan-over-stripes
+        # fallback used to exist for the fused forms and was removed in
+        # r3 — it lost the fast gather (0.91e8 vs 3.33e8 edges/s/chip
+        # at scale 24) and its uniform restack exceeded single-chip HBM
+        # at scale-25 pair (docs/PERF_NOTES.md "Scan bodies defeat the
+        # fast gather").
+        multi_dispatch = (
             not want_pallas
             and n_stripes * (2 if pair else 1) > self.SCAN_STRIPE_UNITS
         )
-        if scan_stripes:
-            # Shared geometry for the fused scan form only — resident
-            # arrays keep their EXACT per-stripe shapes (power-law skew
-            # makes uniform rows_max padding multiply real gather work:
-            # measured 2.5s/iter vs ~0.5s expected at scale 22 with 8
-            # pair stripes). The scan form pads transiently in-program.
-            sent_scan = np.int32(sz << log2g)
-            chunk_scan = ell_chunks[int(np.argmax(stripe_rows_dev))]
-            rows_max_dev = -(-max(stripe_rows_dev) // chunk_scan) * chunk_scan
-            P_max = max(num_present)
 
         def make_contrib(mode):
             """mode: 'ell' (XLA path) or a pallas gather strategy name."""
@@ -706,82 +741,6 @@ class JaxTpuEngine(PageRankEngine):
                     return jax.lax.psum(part, axis)
 
                 in_specs = (P(), P(axis, None), P(axis))
-            elif scan_stripes:
-                nz = 2 if pair else 1
-                chunk_s = chunk_scan
-                P_m = P_max
-
-                def sharded_contrib(*args):
-                    zs, rest = args[:nz], args[nz:]
-                    # Pad every stripe to the shared geometry and stack
-                    # for the scan — transient, inside this program
-                    # only; the resident arrays keep exact shapes for
-                    # the multi-dispatch stepwise path. Row padding is
-                    # all-sentinel (adds zero), rb pads to the stripe's
-                    # last present rank, ids pad to the dump row.
-                    src_st = jnp.stack([
-                        _pad_rows(a, rows_max_dev, sent_scan, jnp)
-                        for a in rest[0::3]
-                    ])
-                    rb_st = jnp.stack([
-                        _pad_rows(a, rows_max_dev,
-                                  np.int32(max(0, num_present[i] - 1)), jnp)
-                        for i, a in enumerate(rest[1::3])
-                    ])
-                    ids_st = jnp.stack([
-                        _pad_rows(a, P_max, np.int32(num_blocks), jnp)
-                        for a in rest[2::3]
-                    ])
-                    # Stripe z slices ride the scan's xs (a STATIC
-                    # [S, sz] reshape) — an in-body dynamic_slice of the
-                    # gather table knocks XLA off the fast-gather
-                    # lowering (measured 3.7x slower at scale 24).
-                    z_rows = tuple(z.reshape(n_stripes, sz) for z in zs)
-
-                    def body(total, stripe):
-                        (*z_r, src, rb2, ids2) = stripe
-                        z_s = [
-                            jnp.concatenate([zr, jnp.zeros(gw, zr.dtype)])
-                            for zr in z_r
-                        ]
-                        if pair:
-                            part = spmv.ell_contrib_pair(
-                                z_s[0], z_s[1], src, rb2, num_blocks,
-                                accum_dtype=accum, gather_width=gw,
-                                chunk_rows=chunk_s, group=group,
-                                num_present=P_m,
-                            )
-                        else:
-                            part = spmv.ell_contrib(
-                                z_s[0], src, rb2, num_blocks,
-                                accum_dtype=accum, gather_width=gw,
-                                chunk_rows=chunk_s, group=group,
-                                num_present=P_m,
-                            )
-                        # ids pad with num_blocks -> the dump row;
-                        # sorted (ascending then constant tail) but NOT
-                        # unique, so no unique_indices here.
-                        total = total.at[ids2].add(
-                            part.reshape(P_m, 128), indices_are_sorted=True
-                        )
-                        return total, None
-
-                    # The carry must be device-varying under shard_map
-                    # (the body output depends on the sharded slots).
-                    total0 = jax.lax.pcast(
-                        jnp.zeros((num_blocks + 1, 128), accum),
-                        axis, to="varying",
-                    )
-                    total, _ = jax.lax.scan(
-                        body, total0, (*z_rows, src_st, rb_st, ids_st)
-                    )
-                    return jax.lax.psum(
-                        total[:num_blocks].reshape(-1), axis
-                    )
-
-                in_specs = (P(),) * nz + (
-                    P(axis, None), P(axis), P()
-                ) * n_stripes
             else:
                 nz = 2 if pair else 1
 
@@ -946,7 +905,7 @@ class JaxTpuEngine(PageRankEngine):
             contrib_fn, contrib_args,
             mass_mask, zero_in, valid, n, n_state, prescale=prescale,
         )
-        if scan_stripes:
+        if multi_dispatch:
             self._setup_multi_dispatch(
                 n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
                 accum=accum, num_blocks=num_blocks, chunks=ell_chunks,
@@ -967,11 +926,12 @@ class JaxTpuEngine(PageRankEngine):
         update.
 
         Why: the unrolled single-program form exceeds the remote-compile
-        size limit past SCAN_STRIPE_UNITS, and the in-program
-        scan-over-stripes fallback loses XLA's fast gather lowering
-        (0.91e8 vs 3.33e8 edges/s/chip at scale 24, docs/PERF_NOTES.md
-        "Scan bodies defeat the fast gather"). Per-stripe dispatches get
-        both: each compile request is O(one stripe) — the 413 limit was
+        size limit past SCAN_STRIPE_UNITS, and the (since removed, r3)
+        in-program scan-over-stripes fallback lost XLA's fast gather
+        lowering (0.91e8 vs 3.33e8 edges/s/chip at scale 24,
+        docs/PERF_NOTES.md "Scan bodies defeat the fast gather") and
+        exceeded single-chip HBM at scale-25 pair. Per-stripe dispatches
+        get both: each compile request is O(one stripe) — the 413 limit was
         per-request, so S small requests are fine where one S-stripe
         program was not — and each dispatch is a top-level program whose
         gather table is a (statically sliced) root argument, keeping the
@@ -990,9 +950,9 @@ class JaxTpuEngine(PageRankEngine):
 
         Per-dispatch host latency (~1-5 ms measured) is hidden by async
         dispatch pipelining. Used by ``_device_step`` (run_fast / run /
-        run_fused_chunked). The single-program fused forms (run_fused /
-        run_fused_tol) cannot contain host-driven dispatches and keep
-        the scan body.
+        run_fused_chunked) — and therefore, by delegation, by EVERY
+        public run form on these layouts (run_fused / run_fused_tol
+        route through run_fused_chunked).
         """
         mesh = self._mesh
         axis = self.config.mesh_axis
@@ -1193,11 +1153,16 @@ class JaxTpuEngine(PageRankEngine):
         :meth:`PageRankEngine.run` for those; ``tol`` early-stopping has
         its own fused, on-device form (:meth:`run_fused_tol`).
 
-        NOTE: on very-many-stripe layouts (past ``SCAN_STRIPE_UNITS``)
-        the single-program constraint forces the scan-over-stripes body,
-        which loses XLA's fast gather — there :meth:`run_fast` /
-        :meth:`run_fused_chunked` (multi-dispatch per stripe) are the
-        fast forms; see ``_setup_multi_dispatch``.
+        On very-many-stripe layouts (past ``SCAN_STRIPE_UNITS``) the
+        single-program constraint would force a scan-over-stripes body
+        that loses XLA's fast gather and whose uniform in-program
+        restack exceeded single-chip HBM at scale-25 pair, so this
+        DELEGATES to :meth:`run_fused_chunked` with one chunk: the fast
+        multi-dispatch stripes, pipelined (per-dispatch cost hidden),
+        identical math and identical ``last_run_metrics`` traces — the
+        only difference from a literal single program is dispatch
+        count, which is not a throughput lever on any measured backend
+        (docs/PERF_NOTES.md "Measurement protocol").
         Per-iteration (l1_delta, dangling_mass) traces are kept as device
         arrays in :attr:`last_run_metrics`.
         """
@@ -1206,6 +1171,8 @@ class JaxTpuEngine(PageRankEngine):
         if k <= 0:
             # No-op: a completed prior run's traces are kept.
             return self.ranks()
+        if self._ms_stripe is not None:
+            return self.run_fused_chunked(num_iters=total, every=0)
         fused = self._get_fused(k)
         self._r, (deltas, masses) = fused(*self._device_args())
         self.iteration = total
@@ -1226,12 +1193,12 @@ class JaxTpuEngine(PageRankEngine):
         (the trip count is dynamic); ``last_run_metrics`` carries the
         FINAL iteration's (l1_delta, dangling_mass) only.
 
-        On very-many-stripe layouts (``_ms_stripe`` engaged) the
-        single-program while_loop would take the scan-over-stripes body
-        that loses XLA's fast gather (0.91e8 vs 3.3e8 edges/s/chip at
-        scale 24 — docs/PERF_NOTES.md "Scan bodies defeat the fast
-        gather"), so this delegates to :meth:`run_fused_chunked` with a
-        per-iteration tol check: same stopping iteration as the
+        On very-many-stripe layouts (``_ms_stripe`` engaged) a
+        single-program while_loop is not viable (the unrolled body
+        exceeds remote-compile request limits; the removed
+        scan-over-stripes fallback lost XLA's fast gather — PERF_NOTES
+        "Scan bodies defeat the fast gather"), so this delegates to
+        :meth:`run_fused_chunked` with a per-iteration tol check: same stopping iteration as the
         while_loop form (the delta is inspected after every iteration),
         fast multi-dispatch stripes, at the cost of one host scalar
         fetch per iteration — noise next to the multi-second iterations
@@ -1296,8 +1263,8 @@ class JaxTpuEngine(PageRankEngine):
             k = min(every - self.iteration % every, total - self.iteration)
             if self._ms_stripe is not None:
                 # Very-many-stripe layouts: pipelined multi-dispatch
-                # steps (the fast form there — the fused scan body loses
-                # the fast gather; _setup_multi_dispatch docstring).
+                # steps (the ONLY fused-capable form there;
+                # _setup_multi_dispatch docstring).
                 dl, ml = [], []
                 for _ in range(k):
                     d, m = self._device_step()
@@ -1340,15 +1307,14 @@ class JaxTpuEngine(PageRankEngine):
         total = self.config.num_iters if num_iters is None else num_iters
         k = total - self.iteration
         if k > 0:
-            if self._ms_stripe is not None and (tol is not None
-                                                or (every and every > 0)):
-                # Both the chunked AND the tol form step the
-                # multi-dispatch path on these layouts (run_fused_tol
-                # delegates to run_fused_chunked): warm ALL its
-                # executables with one throwaway step on a copy of the
-                # state, so the caller's timed region pays no per-stripe
-                # remote compiles. Compiling the while_loop executable
-                # here would pay for a program the delegation never runs.
+            if self._ms_stripe is not None:
+                # EVERY fused form steps the multi-dispatch path on
+                # these layouts (run_fused and run_fused_tol delegate
+                # to run_fused_chunked): warm ALL its executables with
+                # one throwaway step on a copy of the state, so the
+                # caller's timed region pays no per-stripe remote
+                # compiles. Compiling a single-program executable here
+                # would pay for a program the delegations never run.
                 keep = jnp.copy(self._r)
                 self._device_step()
                 self.fence()

@@ -174,7 +174,7 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
         stripe = 0  # the host packer stripes internally
         span = min(
             JaxTpuEngine.occupancy_span(
-                stripe_target, n_padded, num_edges, pair
+                stripe_target, n_padded, num_edges, pair, z_item
             ) if n_padded > fast_cap else n_padded,
             n_padded,
         )
@@ -182,16 +182,14 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
     else:
         if not stripe_size and n_padded > fast_cap:
             stripe = JaxTpuEngine.occupancy_span(
-                stripe_target, n_padded, num_edges, pair
+                stripe_target, n_padded, num_edges, pair, z_item
             )
         else:
             stripe = stripe_size
         span = min(stripe or n_padded, n_padded)
         is_striped = bool(stripe) and stripe < n_padded
     grp_req = lane_group or cfg.effective_lane_group(pair, striped=is_striped)
-    grp = grp_req
-    while grp > 1 and (span + 1) * grp > np.iinfo(np.int32).max:
-        grp //= 2
+    grp = JaxTpuEngine.clamp_group_for_span(grp_req, span)
     if grp != grp_req:
         print(f"pagerank_tpu: lane group clamped to {grp} for span {span}",
               file=sys.stderr)

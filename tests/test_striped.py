@@ -97,11 +97,11 @@ def test_bad_stripe_size_rejected():
 @pytest.mark.parametrize("ndev", [1, 2])
 @pytest.mark.parametrize("accum", ["float32", "float64"])
 def test_scan_stripes_fallback_matches_unstriped(monkeypatch, ndev, accum):
-    """Past SCAN_STRIPE_UNITS the engine switches to uniform per-stripe
-    shapes: the stepwise path runs one shared executable per stripe
-    (multi-dispatch, fast gather preserved) and the fused path restacks
-    in-program and scans. Both must produce the same ranks as the
-    unstriped engine (and, transitively through
+    """Past SCAN_STRIPE_UNITS every run form steps the multi-dispatch
+    machinery (one exact-shape executable per stripe; run_fused and
+    run_fused_chunked delegate/pipeline through it — the in-program
+    scan fallback was removed in r3). All must produce the same ranks
+    as the unstriped engine (and, transitively through
     test_striped_engine_matches_unstriped, the unrolled striped form)."""
     rng = np.random.default_rng(5)
     g = _graph(rng)
@@ -120,10 +120,12 @@ def test_scan_stripes_fallback_matches_unstriped(monkeypatch, ndev, accum):
     assert len(eng._ms_stripe_fns) == S  # one executable per stripe shape
     r_md = eng.run_fast()
     np.testing.assert_allclose(r_md, r_plain, rtol=1e-6, atol=1e-7)
-    # The fused single-program form (in-program restack + lax.scan).
+    # run_fused delegates to the one-chunk multi-dispatch form here
+    # (full per-iteration traces, same contract).
     eng2 = JaxTpuEngine(cfg).build(g)
     r_fused = eng2.run_fused()
     np.testing.assert_allclose(r_fused, r_plain, rtol=1e-6, atol=1e-7)
+    assert len(np.asarray(eng2.last_run_metrics["l1_delta"])) == 10
     # And fused-chunked, which steps via the multi-dispatch path.
     eng3 = JaxTpuEngine(cfg).build(g)
     r_ck = eng3.run_fused_chunked(every=3)
@@ -176,18 +178,26 @@ def test_fused_tol_routes_to_chunked_on_ms_layouts(monkeypatch):
 
 
 def test_occupancy_span_rule():
-    """Sparse pair layouts double the stripe span once (measured +30% at
-    R-MAT 26 ef 8); dense, non-pair, unknown-edge-count, and unstriped
-    layouts keep it (measured regression on dense: PERF_NOTES
-    "Occupancy-aware pair stripes")."""
+    """Sparse layouts widen the stripe span while a typical cell at
+    most fills one row, capped by the 2^17-gather-row bound at the
+    dtype's widest gather: pair doubles once (measured 1.52e8 ->
+    1.98e8 at R-MAT 26 ef 8), f32 doubles twice (2.71e8 -> 3.95e8).
+    Dense, unknown-edge-count, and unstriped layouts keep the span
+    (measured regressions otherwise: PERF_NOTES "Occupancy-aware
+    stripes")."""
     smax = 4194304
-    n26, e26 = 1 << 26, 8 << 26  # ef 8: 64 edges/cell at smax -> double
+    n26, e26 = 1 << 26, 8 << 26  # ef 8: 64 edges/cell at smax
+    # pair: gather bound 64 << 17 = 8.4M -> one doubling
     assert JaxTpuEngine.occupancy_span(smax, n26, e26, True) == 2 * smax
-    n25, e25 = 1 << 25, 16 << 25  # ef 16: 256 edges/cell -> keep
+    # f32: bound 128 << 17 = 16.8M -> two doublings
+    assert JaxTpuEngine.occupancy_span(smax, n26, e26, False, 4) == 4 * smax
+    # native f64 rows (z_item 8): 64-lane cap -> one doubling
+    assert JaxTpuEngine.occupancy_span(smax, n26, e26, False, 8) == 2 * smax
+    n25, e25 = 1 << 25, 16 << 25  # ef 16: 253 edges/cell -> keep
     assert JaxTpuEngine.occupancy_span(smax, n25, e25, True) == smax
-    assert JaxTpuEngine.occupancy_span(smax, n26, e26, False) == smax
+    assert JaxTpuEngine.occupancy_span(smax, n25, e25, False, 4) == smax
     assert JaxTpuEngine.occupancy_span(smax, n26, None, True) == smax
     assert JaxTpuEngine.occupancy_span(n26, n26, e26, True) == n26
-    # doubling never exceeds the vertex space
+    # widening never exceeds the vertex space
     assert JaxTpuEngine.occupancy_span(smax, 6 * smax // 4, 10, True) \
         == 6 * smax // 4
