@@ -268,17 +268,22 @@ class JaxTpuEngine(PageRankEngine):
             # The pallas kernel consumes plain source ids; group only on
             # the XLA ell path. Stripedness is known before packing and
             # flips the pair-mode optimum (config.effective_lane_group).
-            group = (
-                1 if kernel == "pallas"
-                else cfg.effective_lane_group(
-                    self._pair, striped=n_padded > stripe_max
-                )
-            )
-            if n_padded > stripe_max:
-                span = self.occupancy_span(
+            striped = n_padded > stripe_max
+            span = (
+                self.occupancy_span(
                     self._stripe_target(), n_padded, graph.num_edges,
                     self._pair, self.gather_z_item(cfg, self._pair),
                 )
+                if striped else None
+            )
+            group = (
+                1 if kernel == "pallas"
+                else cfg.effective_lane_group(
+                    self._pair, striped=striped,
+                    widened=striped and span > self._stripe_target(),
+                )
+            )
+            if striped:
                 # An occupancy-widened span can push an explicit large
                 # lane_group past the packed-word int32 bound; clamp
                 # like plan_build instead of letting the packer raise.

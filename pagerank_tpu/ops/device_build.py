@@ -188,7 +188,10 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
             stripe = stripe_size
         span = min(stripe or n_padded, n_padded)
         is_striped = bool(stripe) and stripe < n_padded
-    grp_req = lane_group or cfg.effective_lane_group(pair, striped=is_striped)
+    grp_req = lane_group or cfg.effective_lane_group(
+        pair, striped=is_striped,
+        widened=is_striped and span > stripe_target,
+    )
     grp = JaxTpuEngine.clamp_group_for_span(grp_req, span)
     if grp != grp_req:
         print(f"pagerank_tpu: lane group clamped to {grp} for span {span}",

@@ -122,14 +122,25 @@ class PageRankConfig:
     def replace(self, **kw) -> "PageRankConfig":
         return dataclasses.replace(self, **kw)
 
-    def effective_lane_group(self, pair: bool, striped: bool = False) -> int:
+    def effective_lane_group(self, pair: bool, striped: bool = False,
+                             widened: bool = False) -> int:
         """Resolve ``lane_group`` (0 = auto) for the chosen accumulation
         mode and layout: 16 for the pair-packed wide path on a
         single-stripe layout, 64 otherwise (v5e-measured optima: the
         pair path's group one-hot runs in the wide dtype, so smaller
         groups win — UNTIL source striping sparsifies the per-(stripe,
         block, group) cells and small-group padding dominates: striped
-        pair at R-MAT scale 23 measured 2.5x FASTER at 64 than at 16)."""
+        pair at R-MAT scale 23 measured 2.5x FASTER at 64 than at 16).
+        ``widened`` marks an occupancy-widened sparse-graph span
+        (engines/jax_engine.occupancy_span), which RE-densifies the
+        cells and pushes the pair optimum all the way down to 8 —
+        measured at R-MAT 26 ef 8, 8.4M pair stripes: group 128
+        1.47e8, 64 1.98e8, 32 2.12e8, 16 2.20e8, 8 2.22e8, 4 2.20e8
+        edges/s/chip. (Single-stripe stays 16: scale-22 measured group
+        8 within noise of 16 and group 4 worse.) docs/PERF_NOTES.md
+        "Occupancy-aware stripes"."""
         if self.lane_group:
             return self.lane_group
+        if pair and striped and widened:
+            return 8
         return 16 if (pair and not striped) else 64

@@ -74,3 +74,22 @@ def test_pair_engine_close_to_oracle(dtype):
 def test_wide_accum_requires_not_narrower_than_dtype():
     with pytest.raises(ValueError):
         PageRankConfig(dtype="float64", accum_dtype="float32").validate()
+
+
+def test_pair_engine_wide_gather_width_matches_oracle(monkeypatch):
+    """The occupancy-widened pair layouts run the gather at width 64
+    (span 8.4M / 2^17 rows — engines/jax_engine.occupancy_span); force
+    that width at toy scale so the wide-row pair gather semantics are
+    pinned against the oracle without a 67M-vertex graph."""
+    monkeypatch.setattr(JaxTpuEngine, "GATHER_WIDTH", 64)
+    rng = np.random.default_rng(6)
+    g = build_graph(rng.integers(0, 3000, 40000),
+                    rng.integers(0, 3000, 40000), n=3000)
+    cfg = PageRankConfig(
+        num_iters=20, dtype="float64", accum_dtype="float64",
+        wide_accum="pair",
+    )
+    eng = JaxTpuEngine(cfg).build(g)
+    r_t = eng.run_fast()
+    r_c = ReferenceCpuEngine(cfg).build(g).run()
+    assert np.abs(r_t - r_c).sum() / np.abs(r_c).sum() < 1e-12

@@ -56,6 +56,9 @@ def test_lane_group_auto_resolution():
     # striping sparsifies lane groups: pair flips back to 64
     assert cfg.effective_lane_group(pair=True, striped=True) == 64
     assert cfg.effective_lane_group(pair=False, striped=True) == 64
+    # ... and an occupancy-WIDENED span re-densifies: pair drops to 8
+    assert cfg.effective_lane_group(pair=True, striped=True, widened=True) == 8
+    assert cfg.effective_lane_group(pair=False, striped=True, widened=True) == 64
     # explicit values pass through untouched
     assert PageRankConfig(lane_group=8).validate().effective_lane_group(
         pair=True
