@@ -154,6 +154,15 @@ class JaxTpuEngine(PageRankEngine):
         return 64 if pair else min(128, 512 // max(1, z_item))
 
     @staticmethod
+    def is_widened_span(span, stripe_target: int, striped: bool) -> bool:
+        """Whether a resolved stripe span is an occupancy-WIDENED one
+        (occupancy_span exceeded the normal target) — the regime whose
+        lane-group optimum differs (config.effective_lane_group). THE
+        single spelling, shared with plan_build so bench/CLI-planned
+        layouts cannot drift from what the engine builds."""
+        return bool(striped and span is not None and span > stripe_target)
+
+    @staticmethod
     def clamp_group_for_span(group: int, span: int) -> int:
         """Largest power-of-two group <= ``group`` whose packed slot
         words (src << log2(group) | sub) fit int32 at ``span`` —
@@ -280,7 +289,9 @@ class JaxTpuEngine(PageRankEngine):
                 1 if kernel == "pallas"
                 else cfg.effective_lane_group(
                     self._pair, striped=striped,
-                    widened=striped and span > self._stripe_target(),
+                    widened=self.is_widened_span(
+                        span, self._stripe_target(), striped
+                    ),
                 )
             )
             if striped:

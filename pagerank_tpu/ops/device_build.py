@@ -190,7 +190,7 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
         is_striped = bool(stripe) and stripe < n_padded
     grp_req = lane_group or cfg.effective_lane_group(
         pair, striped=is_striped,
-        widened=is_striped and span > stripe_target,
+        widened=JaxTpuEngine.is_widened_span(span, stripe_target, is_striped),
     )
     grp = JaxTpuEngine.clamp_group_for_span(grp_req, span)
     if grp != grp_req:

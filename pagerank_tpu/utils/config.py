@@ -125,22 +125,29 @@ class PageRankConfig:
     def effective_lane_group(self, pair: bool, striped: bool = False,
                              widened: bool = False) -> int:
         """Resolve ``lane_group`` (0 = auto) for the chosen accumulation
-        mode and layout: 16 for the pair-packed wide path on a
-        single-stripe layout, 64 otherwise (v5e-measured optima: the
-        pair path's group one-hot runs in the wide dtype, so smaller
-        groups win — UNTIL source striping sparsifies the per-(stripe,
-        block, group) cells and small-group padding dominates: striped
-        pair at R-MAT scale 23 measured 2.5x FASTER at 64 than at 16).
-        ``widened`` marks an occupancy-widened sparse-graph span
-        (engines/jax_engine.occupancy_span), which RE-densifies the
-        cells and pushes the pair optimum all the way down to 8 —
-        measured at R-MAT 26 ef 8, 8.4M pair stripes: group 128
-        1.47e8, 64 1.98e8, 32 2.12e8, 16 2.20e8, 8 2.22e8, 4 2.20e8
-        edges/s/chip. (Single-stripe stays 16: scale-22 measured group
-        8 within noise of 16 and group 4 worse.) docs/PERF_NOTES.md
-        "Occupancy-aware stripes"."""
+        mode and layout — v5e-measured optima (docs/PERF_NOTES.md
+        "Occupancy-aware stripes" and "Accumulation dtypes"):
+
+        - plain (non-pair): 64 everywhere;
+        - pair: 16 — the group one-hot runs in the wide dtype, so
+          small groups win. r3 re-measurement: this now holds for
+          STRIPED pair layouts too (scale 23: group 16 2.16e8 vs 64
+          2.03e8; scale 25: 2.00e8 vs 1.84e8), inverting the r2
+          scale-23 result (2.5x the other way) that had flipped the
+          striped default to 64 — the per-stripe chunk autotune and
+          exact-shape multi-dispatch introduced since are the changed
+          variables;
+        - pair on an occupancy-WIDENED span (``widened``;
+          engines/jax_engine.occupancy_span): 8 — at the ~one-row-per-
+          cell occupancy these spans target, row count is group-
+          insensitive and only the one-hot narrows (measured 128
+          1.47e8, 64 1.98e8, 32 2.12e8, 16 2.20e8, 8 2.22e8, 4
+          2.20e8); group 8 is within noise of 16 on the other pair
+          layouts (scale 25: 2.006 vs 1.997; scale 22 single-stripe:
+          292.8 vs 294.3 ms/iter), so the split keeps each regime at
+          its measured best."""
         if self.lane_group:
             return self.lane_group
         if pair and striped and widened:
             return 8
-        return 16 if (pair and not striped) else 64
+        return 16 if pair else 64

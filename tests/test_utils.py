@@ -53,10 +53,11 @@ def test_lane_group_auto_resolution():
     cfg = PageRankConfig().validate()  # default 0 = auto
     assert cfg.effective_lane_group(pair=False) == 64
     assert cfg.effective_lane_group(pair=True) == 16
-    # striping sparsifies lane groups: pair flips back to 64
-    assert cfg.effective_lane_group(pair=True, striped=True) == 64
+    # r3 re-measurement: striped pair ALSO prefers 16 (the r2 flip to
+    # 64 inverted under the current multi-dispatch + chunk autotune)
+    assert cfg.effective_lane_group(pair=True, striped=True) == 16
     assert cfg.effective_lane_group(pair=False, striped=True) == 64
-    # ... and an occupancy-WIDENED span re-densifies: pair drops to 8
+    # occupancy-WIDENED pair spans drop to 8
     assert cfg.effective_lane_group(pair=True, striped=True, widened=True) == 8
     assert cfg.effective_lane_group(pair=False, striped=True, widened=True) == 64
     # explicit values pass through untouched
