@@ -112,6 +112,12 @@ def build_parser() -> argparse.ArgumentParser:
         "per-iteration saveAsTextFile)",
     )
     p.add_argument("--out", default=None, help="write final ranks (TSV: id/url, rank)")
+    p.add_argument(
+        "--top", type=int, default=0,
+        help="write only the N highest-ranked vertices to --out, sorted "
+        "by rank descending (ties by id ascending); 0 = the full vector "
+        "in id order (the reference's dump shape, Sparky.java:237)",
+    )
     p.add_argument("--log-every", type=int, default=1, help="0 silences per-iter logs")
     p.add_argument("--jsonl", default=None, help="append per-iter metrics to this JSONL file")
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
@@ -614,11 +620,20 @@ def main(argv=None) -> int:
 
     if args.out:
         names = ids.names if ids is not None else None
+        if args.top > 0:
+            # Deterministic total order (rank desc, id asc) BEFORE the
+            # cut, so boundary ties select by id too — PageRank ties
+            # are routine (every zero-in vertex shares a rank). A full
+            # lexsort is O(n log n) but host-side and once per run.
+            k = min(args.top, len(ranks))
+            order = np.lexsort((np.arange(len(ranks)), -ranks))[:k]
+        else:
+            order = range(len(ranks))
         with fsio.fopen(args.out, "w") as f:
-            for i, r in enumerate(ranks):
+            for i in order:
                 key = names[i] if names else i
-                f.write(f"{key}\t{float(r)!r}\n")
-        print(f"wrote {len(ranks):,} ranks to {args.out}", file=sys.stderr)
+                f.write(f"{key}\t{float(ranks[i])!r}\n")
+        print(f"wrote {len(order):,} ranks to {args.out}", file=sys.stderr)
     return 0
 
 

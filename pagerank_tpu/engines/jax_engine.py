@@ -380,8 +380,8 @@ class JaxTpuEngine(PageRankEngine):
     # counts double — two z planes) the serialized program exceeds the
     # remote-compile request limit (measured: 8 pair stripes = 16 units
     # -> HTTP 413; 8 plain stripes = 8 units compile fine). Beyond it
-    # the engine falls back to the scan-over-stripes form (slower
-    # execution, but it runs).
+    # EVERY run form routes through the multi-dispatch machinery
+    # (_setup_multi_dispatch; run_fused/run_fused_tol by delegation).
     SCAN_STRIPE_UNITS = 12
 
     @staticmethod

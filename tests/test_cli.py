@@ -426,3 +426,40 @@ def test_cli_fused_with_tol_stops_early(tmp_path, edges_file, capsys):
     m = re.search(r"done: (\d+) iters", err)
     assert m, err[-300:]
     assert 1 < int(m.group(1)) == recs[0]["iter"] + 1
+
+
+def test_cli_top_n_output(tmp_path, edges_file):
+    path, src, dst = edges_file
+    out_full = str(tmp_path / "full.tsv")
+    out_top = str(tmp_path / "top.tsv")
+    base = ["--input", path, "--iters", "8", "--engine", "cpu",
+            "--log-every", "0"]
+    assert main(base + ["--out", out_full]) == 0
+    assert main(base + ["--out", out_top, "--top", "5"]) == 0
+    full = read_ranks_tsv(out_full, 40)
+    lines = [l.split("\t") for l in open(out_top).read().splitlines()]
+    assert len(lines) == 5
+    got_ids = [int(k) for k, _ in lines]
+    got_ranks = [float(v) for _, v in lines]
+    # descending by rank, and exactly the 5 largest of the full vector
+    assert got_ranks == sorted(got_ranks, reverse=True)
+    assert sorted(got_ranks) == sorted(np.sort(full)[-5:].tolist())
+    for i, r in zip(got_ids, got_ranks):
+        assert full[i] == r
+    # --top larger than n writes everything
+    out_all = str(tmp_path / "all.tsv")
+    assert main(base + ["--out", out_all, "--top", "1000"]) == 0
+    assert len(open(out_all).read().splitlines()) == 40
+
+
+def test_cli_top_boundary_ties_deterministic(tmp_path):
+    # Equal ranks at the --top cutoff must select by ascending id —
+    # a symmetric graph where several vertices tie exactly.
+    p = tmp_path / "edges.txt"
+    # ring of 6: every vertex has identical in/out structure -> all tie
+    p.write_text("\n".join(f"{i} {(i + 1) % 6}" for i in range(6)) + "\n")
+    out = str(tmp_path / "top.tsv")
+    assert main(["--input", str(p), "--iters", "3", "--engine", "cpu",
+                 "--out", out, "--top", "3", "--log-every", "0"]) == 0
+    ids = [int(l.split("\t")[0]) for l in open(out).read().splitlines()]
+    assert ids == [0, 1, 2]
