@@ -312,13 +312,16 @@ def load_graph(args):
             src, dst = synth.rmat_edges(scale)
             return build_graph(src, dst, n=1 << scale), None
         if kind == "uniform":
-            from pagerank_tpu.utils import synth
-
             n_s, _, e_s = rest.partition(":")
             n, e = int(n_s), int(e_s or 16 * int(n_s))
-            src, dst = synth.uniform_edges(n, e)
             if args.device_build:
+                from pagerank_tpu.ops import device_build as db
+
+                src, dst = db.uniform_edges_device(n, e, seed=0)
                 return _device_build_graph(args, src, dst, n), None
+            from pagerank_tpu.utils import synth
+
+            src, dst = synth.uniform_edges(n, e)
             return build_graph(src, dst, n=n), None
         raise SystemExit(f"unknown synthetic spec {args.synthetic!r}")
 

@@ -220,6 +220,20 @@ def _rmat_gen(key, scale, n_edges, ab, a_frac, c_frac):
     return perm[src], perm[dst]
 
 
+def uniform_edges_device(
+    n: int, num_edges: int, seed: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """Uniform random edges generated on device — the uniform analogue
+    of :func:`rmat_edges_device` (only the seed crosses the link; same
+    hardware-friendly ``rbg`` PRNG, so the stream differs from the host
+    generator ``utils/synth.uniform_edges`` for the same seed)."""
+    key = jax.random.key(seed, impl="rbg")
+    k1, k2 = jax.random.split(key)
+    src = jax.random.randint(k1, (num_edges,), 0, n, dtype=jnp.int32)
+    dst = jax.random.randint(k2, (num_edges,), 0, n, dtype=jnp.int32)
+    return src, dst
+
+
 def rmat_edges_device(
     scale: int, edge_factor: int = 16, a: float = 0.57, b: float = 0.19,
     c: float = 0.19, seed: int = 0,

@@ -463,3 +463,16 @@ def test_cli_top_boundary_ties_deterministic(tmp_path):
                  "--out", out, "--top", "3", "--log-every", "0"]) == 0
     ids = [int(l.split("\t")[0]) for l in open(out).read().splitlines()]
     assert ids == [0, 1, 2]
+
+
+def test_cli_device_build_uniform_synthetic(tmp_path):
+    # uniform synthetic on --device-build generates ON device (only a
+    # seed crosses the link) and is deterministic per seed.
+    out1 = str(tmp_path / "u1.tsv")
+    out2 = str(tmp_path / "u2.tsv")
+    base = ["--synthetic", "uniform:300:2000", "--device-build",
+            "--iters", "4", "--log-every", "0"]
+    assert main(base + ["--out", out1]) == 0
+    assert main(base + ["--out", out2]) == 0
+    assert open(out1).read() == open(out2).read()
+    assert len(open(out1).read().splitlines()) == 300
