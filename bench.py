@@ -220,8 +220,10 @@ def main(argv=None):
     p.add_argument("--stripe-size", type=int, default=0,
                    help="source-stripe span in vertices (0 = auto: "
                         "single stripe up to 8.4M f32 vertices / 4.2M "
-                        "f64, stripes of half that above — the measured "
-                        "optimum, see jax_engine._stripe_max)")
+                        "pair, full-bound stripes of the same span "
+                        "above, widened on sparse graphs — the measured "
+                        "optima; see jax_engine.stripe_limits and "
+                        "occupancy_span)")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
     p.add_argument("--accuracy-scale", type=int, default=20,

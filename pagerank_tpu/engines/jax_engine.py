@@ -397,19 +397,22 @@ class JaxTpuEngine(PageRankEngine):
         R-MAT scale 23/25: single stripe beats 4.2M stripes below this
         bound, loses above it.
 
-        stripe_target: span to use once striping IS needed. Plain
-        dtypes: half the bound (~16MB f32 table, 4.2M vertices) — at
-        R-MAT scale 25, 4.2M stripes beat 8.4M (2.09e8 vs 1.64e8
-        edges/s/chip) and 2.1M stripes OOM from per-stripe row padding.
-        Pair tables: the FULL bound — pair padding costs more than the
-        bigger table (scale-23 pair measured 1.77e8 at 4.2M-span stripes
-        vs 1.69e8 at 2.1M), so fewer, larger stripes win.
+        stripe_target: span to use once striping IS needed — the FULL
+        bound for every dtype (r3). Pair always preferred it (fewer,
+        larger stripes amortize pair padding; scale-23 pair measured
+        1.77e8 at 4.2M spans vs 1.69e8 at 2.1M). Plain f32 used HALF
+        the bound on an r2 measurement (4.2M beat 8.4M, 2.09e8 vs
+        1.64e8 at scale 25) that INVERTED under the current code (r3
+        re-sweep: 8.4M spans beat 4.2M — scale 25: 3.38e8 vs 3.14e8,
+        scale 24: 3.49e8 vs 3.32e8), the same lesson as the pair
+        lane-group flip (PERF_NOTES "Accumulation dtypes"): re-sweep
+        layout optima on current code. Occupancy widening on sparse
+        graphs (occupancy_span) composes on top of this target.
 
         Shared by the engine and bench.py so the two can't diverge."""
         lanes = 32 if pair else 256 // z_item
         smax = lanes * (1 << 17)
-        target = smax if pair else smax // 2
-        return smax, max(128, target // 128 * 128)
+        return smax, smax
 
     def _stripe_max(self) -> int:
         z_item = self.gather_z_item(self.config, self._pair)

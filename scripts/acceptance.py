@@ -118,6 +118,10 @@ def run_ppr(key: str):
     # a throwaway step). ONE chunk constant: warm-up and timed run must
     # compile the same shapes or the timed window silently pays compile.
     chunk = 64
+    # A ragged tail would compile a second (tail-shaped) executable
+    # inside the timed window; the warm-up covers exactly one shape
+    # (min(n_sources, chunk) wide), so the config must not mix shapes.
+    assert n_sources % chunk == 0 or n_sources < chunk, (n_sources, chunk)
     eng.run(sources[:chunk], topk=topk, chunk=chunk)
     t0 = time.perf_counter()
     res = eng.run(sources, topk=topk, chunk=chunk)
