@@ -397,21 +397,31 @@ class JaxTpuEngine(PageRankEngine):
         R-MAT scale 23/25: single stripe beats 4.2M stripes below this
         bound, loses above it.
 
-        stripe_target: span to use once striping IS needed — the FULL
-        bound for every dtype (r3). Pair always preferred it (fewer,
-        larger stripes amortize pair padding; scale-23 pair measured
-        1.77e8 at 4.2M spans vs 1.69e8 at 2.1M). Plain f32 used HALF
-        the bound on an r2 measurement (4.2M beat 8.4M, 2.09e8 vs
-        1.64e8 at scale 25) that INVERTED under the current code (r3
-        re-sweep: 8.4M spans beat 4.2M — scale 25: 3.38e8 vs 3.14e8,
-        scale 24: 3.49e8 vs 3.32e8), the same lesson as the pair
-        lane-group flip (PERF_NOTES "Accumulation dtypes"): re-sweep
-        layout optima on current code. Occupancy widening on sparse
-        graphs (occupancy_span) composes on top of this target.
+        stripe_target: span to use once striping IS needed. Plain
+        dtypes: the full bound (8.4M f32) — the r2 half-bound
+        preference (4.2M beat 8.4M, 2.09e8 vs 1.64e8 at scale 25)
+        INVERTED under the current code (r3 re-sweep: 8.4M spans beat
+        4.2M — scale 25: 3.38e8 vs 3.14e8, scale 24: 3.49e8 vs
+        3.32e8). Pair: 4.2M, HALF its single-stripe bound — dense
+        8.4M pair stripes measured 0.87e8 vs 1.84e8 at scale 25, so
+        once striping is unavoidable pair wants narrow spans (the
+        sparse exception is occupancy_span's widening, which composes
+        on top of this target). Same meta-lesson throughout
+        (PERF_NOTES "Accumulation dtypes"): re-sweep layout optima on
+        current code.
 
         Shared by the engine and bench.py so the two can't diverge."""
-        lanes = 32 if pair else 256 // z_item
-        smax = lanes * (1 << 17)
+        if pair:
+            # Single-stripe bound 8.4M (r3): a gw-64 pair table is 2^17
+            # rows at that span — ONE 67MB table measured 19% faster
+            # than 2x4.2M stripes at scale 23 (2.58e8 vs 2.16e8
+            # edges/s/chip; no striping overhead beats the working-set
+            # penalty). The STRIPED target stays 4.2M: dense 8.4M pair
+            # stripes measured 0.87e8 at scale 25 — once striping is
+            # unavoidable, narrow spans win for pair, and the
+            # occupancy_span widening handles the sparse exception.
+            return 64 << 17, 32 << 17
+        smax = (256 // z_item) * (1 << 17)
         return smax, smax
 
     def _stripe_max(self) -> int:
