@@ -11,8 +11,10 @@ separately against the published AWS test vector in test_s3.py).
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import urllib.parse
+import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from xml.sax.saxutils import escape
 
@@ -23,6 +25,10 @@ class S3Stub:
         self.lock = threading.RLock()
         self.auth_headers = []  # recorded Authorization values (or None)
         self.max_page = 1000  # shrink in tests to force pagination
+        self.uploads = {}  # upload_id -> {"path": str, "parts": {num: bytes}}
+        self.completed_multiparts = []  # paths assembled via multipart
+        self.fail_part = None  # part number to reject (fault injection)
+        self._next_upload = 0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -33,7 +39,9 @@ class S3Stub:
 
             def _path_query(self):
                 u = urllib.parse.urlsplit(self.path)
-                return urllib.parse.unquote(u.path), urllib.parse.parse_qs(u.query)
+                return urllib.parse.unquote(u.path), urllib.parse.parse_qs(
+                    u.query, keep_blank_values=True
+                )
 
             def _record(self):
                 outer.auth_headers.append(self.headers.get("Authorization"))
@@ -52,7 +60,7 @@ class S3Stub:
 
             def do_PUT(self):
                 self._record()
-                path, _ = self._path_query()
+                path, q = self._path_query()
                 src = self.headers.get("x-amz-copy-source")
                 if src:
                     src = urllib.parse.unquote(src)
@@ -60,14 +68,110 @@ class S3Stub:
                         if src not in outer.objects:
                             self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
                             return
-                        outer.objects[path] = outer.objects[src]
+                        sdata = outer.objects[src]
+                        if "uploadId" in q:  # UploadPartCopy
+                            rng = self.headers.get("x-amz-copy-source-range")
+                            if rng:  # "bytes=lo-hi", inclusive
+                                lo, hi = rng.split("=", 1)[1].split("-")
+                                sdata = sdata[int(lo):int(hi) + 1]
+                            up = outer.uploads.get(q["uploadId"][0])
+                            if up is None or up["path"] != path:
+                                self._send(
+                                    404,
+                                    b"<Error><Code>NoSuchUpload</Code></Error>",
+                                )
+                                return
+                            num = int(q["partNumber"][0])
+                            up["parts"][num] = sdata
+                            etag = f'"{hashlib.md5(sdata).hexdigest()}"'
+                            self._send(
+                                200,
+                                (f"<?xml version='1.0'?><CopyPartResult>"
+                                 f"<ETag>{etag}</ETag>"
+                                 f"</CopyPartResult>").encode(),
+                            )
+                            return
+                        outer.objects[path] = sdata
                     self._send(200, b"<CopyObjectResult/>")
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 data = self.rfile.read(length) if length else b""
+                if "uploadId" in q:  # UploadPart
+                    num = int(q["partNumber"][0])
+                    uid = q["uploadId"][0]
+                    with outer.lock:
+                        up = outer.uploads.get(uid)
+                        if up is None or up["path"] != path:
+                            self._send(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                            return
+                        if num == outer.fail_part:
+                            self._send(500, b"<Error><Code>InternalError</Code></Error>")
+                            return
+                        up["parts"][num] = data
+                        etag = f'"{hashlib.md5(data).hexdigest()}"'
+                    self.send_response(200)
+                    self.send_header("ETag", etag)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 with outer.lock:
                     outer.objects[path] = data
                 self._send(200)
+
+            def do_POST(self):
+                self._record()
+                path, q = self._path_query()
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                if "uploads" in q:  # InitiateMultipartUpload
+                    with outer.lock:
+                        outer._next_upload += 1
+                        uid = f"upload-{outer._next_upload}"
+                        outer.uploads[uid] = {"path": path, "parts": {}}
+                    self._send(
+                        200,
+                        (f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                         f"<UploadId>{uid}</UploadId>"
+                         f"</InitiateMultipartUploadResult>").encode(),
+                    )
+                    return
+                if "uploadId" in q:  # CompleteMultipartUpload
+                    uid = q["uploadId"][0]
+                    with outer.lock:
+                        up = outer.uploads.pop(uid, None)
+                        if up is None or up["path"] != path:
+                            self._send(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                            return
+                        # Validate the client's part list against what
+                        # was uploaded (number order + ETag match).
+                        want = []
+                        for part in ET.fromstring(body):
+                            fields = {c.tag.rsplit("}", 1)[-1]: c.text for c in part}
+                            want.append(
+                                (int(fields["PartNumber"]), fields["ETag"])
+                            )
+                        have = up["parts"]
+                        ok = (
+                            [n for n, _ in want] == sorted(have)
+                            and all(
+                                t == f'"{hashlib.md5(have[n]).hexdigest()}"'
+                                for n, t in want
+                            )
+                        )
+                        if not ok:
+                            self._send(400, b"<Error><Code>InvalidPart</Code></Error>")
+                            return
+                        outer.objects[path] = b"".join(
+                            have[n] for n, _ in want
+                        )
+                        outer.completed_multiparts.append(path)
+                    self._send(
+                        200,
+                        b"<?xml version='1.0'?><CompleteMultipartUploadResult>"
+                        b"</CompleteMultipartUploadResult>",
+                    )
+                    return
+                self._send(400, b"<Error><Code>BadRequest</Code></Error>")
 
             def do_GET(self):
                 self._record()
@@ -95,9 +199,12 @@ class S3Stub:
 
             def do_DELETE(self):
                 self._record()
-                path, _ = self._path_query()
+                path, q = self._path_query()
                 with outer.lock:
-                    outer.objects.pop(path, None)
+                    if "uploadId" in q:  # AbortMultipartUpload
+                        outer.uploads.pop(q["uploadId"][0], None)
+                    else:
+                        outer.objects.pop(path, None)
                 self._send(204)
 
             def _do_list(self, bucket, q):
