@@ -1,0 +1,1494 @@
+// crawl_ingest — native L1 for pagerank_tpu: SequenceFile + crawl-JSON.
+//
+// The reference's L1 parses 301 Common Crawl SequenceFiles across the
+// cluster (Sparky.java:44-61) and extracts anchor links with Gson
+// (Sparky.java:78-124). The Python path (ingest/seqfile.py +
+// ingest/crawljson.py) is the behavioral spec but is CPU-bound at
+// ~14k records/s/core (docs/PERF_NOTES.md "Host ingest"); this library
+// is the same pipeline in C++ — container decode (uncompressed,
+// record-deflate, block-deflate), Python-json-compatible parsing with
+// the Gson rendering quirks, and string->int32 id interning — behind a
+// C ABI for ctypes (ingest/native.py). The Python reader remains the
+// oracle: tests/test_native_crawl.py differentially checks byte-exact
+// graph/name equality on adversarial inputs.
+//
+// Build: compiled together with fast_ingest.cpp into libfast_ingest.so
+// (ingest/native.py adds -lz -std=c++17).
+
+#include <algorithm>
+#include <charconv>
+#include <cmath>
+#include <deque>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Error categories — the ctypes wrapper maps these back to the exception
+// types the Python path raises, so strict-mode semantics are identical.
+// ---------------------------------------------------------------------------
+enum ErrCat : int64_t {
+  OK = 0,
+  FORMAT = 1,     // malformed container structure -> ValueError
+  JSON = 2,       // malformed JSON -> json.JSONDecodeError
+  KEY = 3,        // link entry missing href/type -> KeyError
+  TYPE = 4,       // link entry / JSONL root of wrong type -> TypeError
+  VALUE = 5,      // other value errors -> ValueError
+  INTERNAL = 6,   // depth/overflow -> RuntimeError (RecursionError class)
+  EOF_ = 7,       // truncated container -> EOFError (Python reader parity)
+  ZLIB = 8,       // corrupt deflate stream -> zlib.error
+  UNSUPPORTED = 9,  // valid for Python, unrepresentable natively (e.g.
+                    // non-string JSONL url) -> wrapper falls back to Python
+};
+
+struct Fail {
+  ErrCat cat;
+  std::string msg;
+};
+
+// ---------------------------------------------------------------------------
+// UTF-8 validate-and-replace (CPython errors="replace" semantics: one
+// U+FFFD per maximal invalid subpart, WHATWG algorithm). Both the
+// SequenceFile Text payloads and TSV files are decoded this way in the
+// Python path before any parsing, so the native path must see the same
+// replaced text.
+// ---------------------------------------------------------------------------
+void utf8_replace(const uint8_t* p, size_t len, std::string& out) {
+  static const char REP[] = "\xef\xbf\xbd";  // U+FFFD
+  out.clear();
+  out.reserve(len);
+  size_t i = 0;
+  while (i < len) {
+    uint8_t b = p[i];
+    if (b < 0x80) {
+      out.push_back((char)b);
+      i++;
+      continue;
+    }
+    int need;
+    uint8_t lo = 0x80, hi = 0xBF;
+    if (b >= 0xC2 && b <= 0xDF) {
+      need = 1;
+    } else if (b == 0xE0) {
+      need = 2; lo = 0xA0;
+    } else if (b >= 0xE1 && b <= 0xEC) {
+      need = 2;
+    } else if (b == 0xED) {
+      need = 2; hi = 0x9F;  // no surrogates
+    } else if (b >= 0xEE && b <= 0xEF) {
+      need = 2;
+    } else if (b == 0xF0) {
+      need = 3; lo = 0x90;
+    } else if (b >= 0xF1 && b <= 0xF3) {
+      need = 3;
+    } else if (b == 0xF4) {
+      need = 3; hi = 0x8F;
+    } else {
+      out.append(REP, 3);  // invalid lead (C0/C1/F5-FF/continuation)
+      i++;
+      continue;
+    }
+    // Consume continuations while they are in range; a maximal subpart
+    // ends at the first out-of-range byte.
+    size_t start = i;
+    i++;
+    int got = 0;
+    while (got < need && i < len) {
+      uint8_t c = p[i];
+      uint8_t clo = (got == 0) ? lo : 0x80;
+      uint8_t chi = (got == 0) ? hi : 0xBF;
+      if (c < clo || c > chi) break;
+      i++;
+      got++;
+    }
+    if (got == need) {
+      out.append((const char*)p + start, (size_t)(need + 1));
+    } else {
+      out.append(REP, 3);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Byte-span reader with the Python reader's error wording category.
+// ---------------------------------------------------------------------------
+struct Span {
+  const uint8_t* p;
+  const uint8_t* end;
+  size_t left() const { return (size_t)(end - p); }
+  bool take(size_t n, const uint8_t** out) {
+    if (left() < n) return false;
+    *out = p;
+    p += n;
+    return true;
+  }
+};
+
+// Hadoop WritableUtils VInt (ingest/seqfile.py:_read_vint).
+bool read_vint(Span& s, int64_t* out) {
+  const uint8_t* b;
+  if (!s.take(1, &b)) return false;
+  int8_t first = (int8_t)b[0];
+  if (first >= -112) {
+    *out = first;
+    return true;
+  }
+  bool negative;
+  int size;
+  if (first >= -120) {
+    size = -(first + 112);
+    negative = false;
+  } else {
+    size = -(first + 120);
+    negative = true;
+  }
+  const uint8_t* d;
+  if (!s.take((size_t)size, &d)) return false;
+  int64_t value = 0;
+  for (int i = 0; i < size; i++) value = (value << 8) | d[i];
+  *out = negative ? ~value : value;
+  return true;
+}
+
+bool read_i32(Span& s, int32_t* out) {
+  const uint8_t* b;
+  if (!s.take(4, &b)) return false;
+  *out = (int32_t)(((uint32_t)b[0] << 24) | ((uint32_t)b[1] << 16) |
+                   ((uint32_t)b[2] << 8) | (uint32_t)b[3]);
+  return true;
+}
+
+// VInt-length-prefixed byte string (Hadoop Text / writeString payload).
+// Distinguishes truncation (Python: EOFError) from a negative length
+// (Python: ValueError) for exception-class parity.
+enum TextRead { TEXT_OK, TEXT_EOF, TEXT_NEG };
+TextRead read_text_raw(Span& s, const uint8_t** out, int64_t* n) {
+  if (!read_vint(s, n)) return TEXT_EOF;
+  if (*n < 0) return TEXT_NEG;
+  return s.take((size_t)*n, out) ? TEXT_OK : TEXT_EOF;
+}
+
+// zlib stream (zlib.decompress default = wbits 15).
+bool inflate_all(const uint8_t* p, size_t len, std::string& out) {
+  out.clear();
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit(&zs) != Z_OK) return false;
+  zs.next_in = const_cast<Bytef*>(p);
+  zs.avail_in = (uInt)len;
+  char buf[1 << 16];
+  int rc;
+  do {
+    zs.next_out = (Bytef*)buf;
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+    out.append(buf, sizeof(buf) - zs.avail_out);
+  } while (rc != Z_STREAM_END);
+  inflateEnd(&zs);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Python-json-compatible parser (json.loads defaults): NaN/Infinity
+// accepted, control chars in strings rejected, duplicate keys keep the
+// LAST value, lone \uXXXX surrogates kept (encoded WTF-8 so they round-
+// trip through Python's surrogatepass). Depth-capped (CPython hits
+// RecursionError there; both map to the INTERNAL category).
+// ---------------------------------------------------------------------------
+struct JValue {
+  enum Kind { Null, True, False, Int, Dbl, Str, Arr, Obj } kind = Null;
+  std::string s;  // Str: decoded text; Int: raw token
+  double d = 0;   // Dbl
+  std::vector<JValue> arr;
+  std::vector<std::pair<std::string, JValue>> obj;
+};
+
+constexpr int MAX_DEPTH = 400;
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  Fail* fail;
+
+  bool err(ErrCat cat, const char* msg) {
+    if (fail->cat == OK) *fail = {cat, msg};
+    return false;
+  }
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool lit(const char* w, size_t n) {
+    if ((size_t)(end - p) < n || std::memcmp(p, w, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  static int hex(char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  }
+
+  static void put_cp(uint32_t cp, std::string& out) {
+    // Encodes any scalar incl. lone surrogates (WTF-8 3-byte form).
+    if (cp < 0x80) {
+      out.push_back((char)cp);
+    } else if (cp < 0x800) {
+      out.push_back((char)(0xC0 | (cp >> 6)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back((char)(0xE0 | (cp >> 12)));
+      out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back((char)(0xF0 | (cp >> 18)));
+      out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back((char)(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool read_u4(uint32_t* out) {
+    if (end - p < 4) return err(JSON, "Invalid \\uXXXX escape");
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) {
+      int h = hex(p[i]);
+      if (h < 0) return err(JSON, "Invalid \\uXXXX escape");
+      v = (v << 4) | (uint32_t)h;
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  bool parse_string(std::string& out) {
+    // Caller consumed the opening quote.
+    out.clear();
+    while (true) {
+      if (p >= end) return err(JSON, "Unterminated string");
+      unsigned char c = (unsigned char)*p;
+      if (c == '"') {
+        p++;
+        return true;
+      }
+      if (c == '\\') {
+        p++;
+        if (p >= end) return err(JSON, "Unterminated string");
+        char e = *p++;
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            uint32_t cp;
+            if (!read_u4(&cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 2 && p[0] == '\\' &&
+                p[1] == 'u') {
+              const char* save = p;
+              p += 2;
+              uint32_t lo;
+              if (!read_u4(&lo)) return false;
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              } else {
+                p = save;  // lone high surrogate; low-part stays literal
+              }
+            }
+            put_cp(cp, out);
+            break;
+          }
+          default:
+            return err(JSON, "Invalid \\escape");
+        }
+        continue;
+      }
+      if (c < 0x20) return err(JSON, "Invalid control character in string");
+      out.push_back((char)c);
+      p++;
+    }
+  }
+
+  bool parse_number(JValue& v) {
+    const char* start = p;
+    if (p < end && *p == '-') p++;
+    if (p >= end) return err(JSON, "Expecting value");
+    if (*p == '0') {
+      p++;
+    } else if (*p >= '1' && *p <= '9') {
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    } else {
+      return err(JSON, "Expecting value");
+    }
+    bool is_float = false;
+    if (p < end && *p == '.') {
+      is_float = true;
+      p++;
+      if (p >= end || *p < '0' || *p > '9')
+        return err(JSON, "Expecting digits after decimal point");
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      is_float = true;
+      p++;
+      if (p < end && (*p == '+' || *p == '-')) p++;
+      if (p >= end || *p < '0' || *p > '9')
+        return err(JSON, "Expecting digits in exponent");
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (is_float) {
+      v.kind = JValue::Dbl;
+      auto res = std::from_chars(start, p, v.d);
+      if (res.ec == std::errc::result_out_of_range) {
+        // Both overflow and underflow land here; strtod resolves them
+        // the way Python float() does (inf vs 0/denormal).
+        std::string tok(start, (size_t)(p - start));
+        v.d = std::strtod(tok.c_str(), nullptr);
+      } else if (res.ec != std::errc()) {
+        return err(JSON, "Invalid number");
+      }
+    } else {
+      v.kind = JValue::Int;
+      v.s.assign(start, (size_t)(p - start));
+      if (v.s == "-0") v.s = "0";  // repr(int("-0")) == "0"
+    }
+    return true;
+  }
+
+  bool parse_value(JValue& v, int depth) {
+    if (depth > MAX_DEPTH)
+      return err(INTERNAL, "maximum JSON nesting depth exceeded");
+    ws();
+    if (p >= end) return err(JSON, "Expecting value");
+    char c = *p;
+    if (c == '"') {
+      p++;
+      v.kind = JValue::Str;
+      return parse_string(v.s);
+    }
+    if (c == '{') {
+      p++;
+      v.kind = JValue::Obj;
+      ws();
+      if (p < end && *p == '}') {
+        p++;
+        return true;
+      }
+      while (true) {
+        ws();
+        if (p >= end || *p != '"')
+          return err(JSON, "Expecting property name in double quotes");
+        p++;
+        std::string key;
+        if (!parse_string(key)) return false;
+        ws();
+        if (p >= end || *p != ':') return err(JSON, "Expecting ':'");
+        p++;
+        JValue child;
+        if (!parse_value(child, depth + 1)) return false;
+        v.obj.emplace_back(std::move(key), std::move(child));
+        ws();
+        if (p < end && *p == ',') {
+          p++;
+          continue;
+        }
+        if (p < end && *p == '}') {
+          p++;
+          return true;
+        }
+        return err(JSON, "Expecting ',' or '}'");
+      }
+    }
+    if (c == '[') {
+      p++;
+      v.kind = JValue::Arr;
+      ws();
+      if (p < end && *p == ']') {
+        p++;
+        return true;
+      }
+      while (true) {
+        JValue child;
+        if (!parse_value(child, depth + 1)) return false;
+        v.arr.push_back(std::move(child));
+        ws();
+        if (p < end && *p == ',') {
+          p++;
+          continue;
+        }
+        if (p < end && *p == ']') {
+          p++;
+          return true;
+        }
+        return err(JSON, "Expecting ',' or ']'");
+      }
+    }
+    if (lit("true", 4)) {
+      v.kind = JValue::True;
+      return true;
+    }
+    if (lit("false", 5)) {
+      v.kind = JValue::False;
+      return true;
+    }
+    if (lit("null", 4)) {
+      v.kind = JValue::Null;
+      return true;
+    }
+    if (lit("NaN", 3)) {
+      v.kind = JValue::Dbl;
+      v.d = NAN;
+      return true;
+    }
+    if (lit("Infinity", 8)) {
+      v.kind = JValue::Dbl;
+      v.d = HUGE_VAL;
+      return true;
+    }
+    if (lit("-Infinity", 9)) {
+      v.kind = JValue::Dbl;
+      v.d = -HUGE_VAL;
+      return true;
+    }
+    if (c == '-' || (c >= '0' && c <= '9')) return parse_number(v);
+    return err(JSON, "Expecting value");
+  }
+
+  bool parse_document(JValue& v) {
+    if (!parse_value(v, 0)) return false;
+    ws();
+    if (p != end) return err(JSON, "Extra data");
+    return true;
+  }
+
+  // -- allocation-free validating skip (records value spans) --------------
+  // The hot path: one skip pass validates the whole document exactly as
+  // parse_value would, then the caller re-walks only the content/links
+  // subtrees it needs (walk_object/walk_array below) and materializes
+  // only matched href values.
+
+  bool skip_string() {
+    while (true) {
+      if (p >= end) return err(JSON, "Unterminated string");
+      unsigned char c = (unsigned char)*p;
+      if (c == '"') {
+        p++;
+        return true;
+      }
+      if (c == '\\') {
+        p++;
+        if (p >= end) return err(JSON, "Unterminated string");
+        char e = *p++;
+        switch (e) {
+          case '"': case '\\': case '/': case 'b': case 'f':
+          case 'n': case 'r': case 't':
+            break;
+          case 'u': {
+            if (end - p < 4) return err(JSON, "Invalid \\uXXXX escape");
+            for (int i = 0; i < 4; i++)
+              if (hex(p[i]) < 0) return err(JSON, "Invalid \\uXXXX escape");
+            p += 4;
+            break;
+          }
+          default:
+            return err(JSON, "Invalid \\escape");
+        }
+        continue;
+      }
+      if (c < 0x20) return err(JSON, "Invalid control character in string");
+      p++;
+    }
+  }
+
+  bool skip_number() {
+    if (p < end && *p == '-') p++;
+    if (p >= end) return err(JSON, "Expecting value");
+    if (*p == '0') {
+      p++;
+    } else if (*p >= '1' && *p <= '9') {
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    } else {
+      return err(JSON, "Expecting value");
+    }
+    if (p < end && *p == '.') {
+      p++;
+      if (p >= end || *p < '0' || *p > '9')
+        return err(JSON, "Expecting digits after decimal point");
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      p++;
+      if (p < end && (*p == '+' || *p == '-')) p++;
+      if (p >= end || *p < '0' || *p > '9')
+        return err(JSON, "Expecting digits in exponent");
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    return true;
+  }
+
+  // Skips one value; *s0/*s1 get the span (first non-ws char .. end).
+  bool skip_value(int depth, const char** s0, const char** s1) {
+    if (depth > MAX_DEPTH)
+      return err(INTERNAL, "maximum JSON nesting depth exceeded");
+    ws();
+    if (p >= end) return err(JSON, "Expecting value");
+    *s0 = p;
+    char c = *p;
+    bool ok;
+    if (c == '"') {
+      p++;
+      ok = skip_string();
+    } else if (c == '{') {
+      p++;
+      ws();
+      if (p < end && *p == '}') {
+        p++;
+        ok = true;
+      } else {
+        ok = false;
+        while (true) {
+          ws();
+          if (p >= end || *p != '"') {
+            err(JSON, "Expecting property name in double quotes");
+            break;
+          }
+          p++;
+          if (!skip_string()) break;
+          ws();
+          if (p >= end || *p != ':') {
+            err(JSON, "Expecting ':'");
+            break;
+          }
+          p++;
+          const char *c0, *c1;
+          if (!skip_value(depth + 1, &c0, &c1)) break;
+          ws();
+          if (p < end && *p == ',') {
+            p++;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            p++;
+            ok = true;
+          } else {
+            err(JSON, "Expecting ',' or '}'");
+          }
+          break;
+        }
+      }
+    } else if (c == '[') {
+      p++;
+      ws();
+      if (p < end && *p == ']') {
+        p++;
+        ok = true;
+      } else {
+        ok = false;
+        while (true) {
+          const char *c0, *c1;
+          if (!skip_value(depth + 1, &c0, &c1)) break;
+          ws();
+          if (p < end && *p == ',') {
+            p++;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            p++;
+            ok = true;
+          } else {
+            err(JSON, "Expecting ',' or ']'");
+          }
+          break;
+        }
+      }
+    } else if (lit("true", 4) || lit("false", 5) || lit("null", 4) ||
+               lit("NaN", 3) || lit("Infinity", 8) || lit("-Infinity", 9)) {
+      ok = true;
+    } else if (c == '-' || (c >= '0' && c <= '9')) {
+      ok = skip_number();
+    } else {
+      ok = err(JSON, "Expecting value");
+    }
+    *s1 = p;
+    return ok;
+  }
+
+  bool skip_document(const char** s0, const char** s1) {
+    if (!skip_value(0, s0, s1)) return false;
+    ws();
+    if (p != end) return err(JSON, "Extra data");
+    return true;
+  }
+};
+
+// Re-walk helpers over ALREADY-VALIDATED spans (skip_document passed):
+// no parse error is possible, so Fail sinks are dummies.
+
+// Last-occurrence member span of `key` in an object span (duplicate
+// keys: last wins, like json.loads -> dict). Returns false if absent.
+bool span_obj_get(const char* s0, const char* s1, const char* key,
+                  std::string& scratch, const char** v0, const char** v1) {
+  Fail dummy{OK, ""};
+  JsonParser jp{s0, s1, &dummy};
+  bool found = false;
+  jp.p++;  // consume '{' (caller checked *s0 == '{')
+  jp.ws();
+  if (jp.p < jp.end && *jp.p == '}') return false;
+  while (true) {
+    jp.ws();
+    jp.p++;  // consume '"'
+    jp.parse_string(scratch);
+    jp.ws();
+    jp.p++;  // consume ':'
+    const char *c0, *c1;
+    jp.skip_value(0, &c0, &c1);
+    if (scratch == key) {
+      *v0 = c0;
+      *v1 = c1;
+      found = true;
+    }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      jp.p++;
+      continue;
+    }
+    return found;  // '}'
+  }
+}
+
+// ---------------------------------------------------------------------------
+// json.dumps(..., ensure_ascii=False) rendering — Gson toString() per the
+// Python spec (crawljson.py:_render): default separators, float repr.
+// ---------------------------------------------------------------------------
+void py_float_repr(double d, std::string& out) {
+  if (std::isnan(d)) {
+    out += "NaN";
+    return;
+  }
+  if (std::isinf(d)) {
+    out += d > 0 ? "Infinity" : "-Infinity";
+    return;
+  }
+  char buf[64];
+  auto res = std::to_chars(buf, buf + sizeof(buf) - 1, d,
+                           std::chars_format::scientific);
+  *res.ptr = '\0';  // strtol on the exponent must stop at the end
+  // "d[.ddd]e±k" with shortest digits; rebuild Python repr rules from
+  // (sign, digits, exp10): fixed form iff -4 <= exp10 < 16.
+  char* q = buf;
+  bool neg = false;
+  if (*q == '-') {
+    neg = true;
+    q++;
+  }
+  std::string digits;
+  int exp10 = 0;
+  for (; q < res.ptr && *q != 'e'; q++) {
+    if (*q != '.') digits.push_back(*q);
+  }
+  if (q < res.ptr) {  // *q == 'e'
+    exp10 = (int)std::strtol(q + 1, nullptr, 10);
+  }
+  int nd = (int)digits.size();
+  if (neg) out.push_back('-');
+  if (exp10 >= -4 && exp10 < 16) {
+    if (exp10 >= nd - 1) {
+      out += digits;
+      out.append((size_t)(exp10 - (nd - 1)), '0');
+      out += ".0";
+    } else if (exp10 >= 0) {
+      out.append(digits, 0, (size_t)(exp10 + 1));
+      out.push_back('.');
+      out.append(digits, (size_t)(exp10 + 1), std::string::npos);
+    } else {
+      out += "0.";
+      out.append((size_t)(-exp10 - 1), '0');
+      out += digits;
+    }
+  } else {
+    out.push_back(digits[0]);
+    if (nd > 1) {
+      out.push_back('.');
+      out.append(digits, 1, std::string::npos);
+    }
+    out.push_back('e');
+    out.push_back(exp10 < 0 ? '-' : '+');
+    int ae = exp10 < 0 ? -exp10 : exp10;
+    char eb[16];
+    int en = std::snprintf(eb, sizeof(eb), "%02d", ae);
+    out.append(eb, (size_t)en);
+  }
+}
+
+void render_string(const std::string& s, std::string& out) {
+  out.push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      default:
+        if (c < 0x20) {
+          char eb[8];
+          std::snprintf(eb, sizeof(eb), "\\u%04x", (int)c);
+          out += eb;
+        } else {
+          out.push_back((char)c);
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+void render(const JValue& v, std::string& out) {
+  switch (v.kind) {
+    case JValue::Null: out += "null"; break;
+    case JValue::True: out += "true"; break;
+    case JValue::False: out += "false"; break;
+    case JValue::Int: out += v.s; break;
+    case JValue::Dbl: py_float_repr(v.d, out); break;
+    case JValue::Str: render_string(v.s, out); break;
+    case JValue::Arr: {
+      out.push_back('[');
+      bool first = true;
+      for (const auto& e : v.arr) {
+        if (!first) out += ", ";
+        first = false;
+        render(e, out);
+      }
+      out.push_back(']');
+      break;
+    }
+    case JValue::Obj: {
+      out.push_back('{');
+      bool first = true;
+      for (const auto& kv : v.obj) {
+        if (!first) out += ", ";
+        first = false;
+        render_string(kv.first, out);
+        out += ": ";
+        render(kv.second, out);
+      }
+      out.push_back('}');
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// String interner: open-addressing map whose keys live in the names blob
+// (insertion-ordered ids, exactly IdMap.get_or_add).
+// ---------------------------------------------------------------------------
+struct Interner {
+  std::string blob;
+  std::vector<int64_t> offsets{0};
+  std::vector<uint64_t> hashes;
+  std::vector<int32_t> table;  // id+1; 0 = empty
+  uint64_t mask = 0;
+
+  Interner() { table.assign(1 << 16, 0), mask = (1 << 16) - 1; }
+
+  static uint64_t hash(const char* s, size_t n) {
+    uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+    for (size_t i = 0; i < n; i++) {
+      h ^= (uint8_t)s[i];
+      h *= 1099511628211ull;
+    }
+    return h ? h : 1;
+  }
+
+  size_t size() const { return hashes.size(); }
+
+  const char* name(int32_t id, int64_t* n) const {
+    *n = offsets[(size_t)id + 1] - offsets[(size_t)id];
+    return blob.data() + offsets[(size_t)id];
+  }
+
+  void grow() {
+    std::vector<int32_t> nt((mask + 1) * 2, 0);
+    uint64_t nm = nt.size() - 1;
+    for (uint64_t i = 0; i <= mask; i++) {
+      int32_t v = table[i];
+      if (!v) continue;
+      uint64_t j = hashes[(size_t)(v - 1)] & nm;
+      while (nt[j]) j = (j + 1) & nm;
+      nt[j] = v;
+    }
+    table.swap(nt);
+    mask = nm;
+  }
+
+  int32_t get_or_add(const char* s, size_t n) {
+    uint64_t h = hash(s, n);
+    uint64_t j = h & mask;
+    while (table[j]) {
+      int32_t id = table[j] - 1;
+      if (hashes[(size_t)id] == h) {
+        int64_t len;
+        const char* nm = name(id, &len);
+        if ((size_t)len == n && std::memcmp(nm, s, n) == 0) return id;
+      }
+      j = (j + 1) & mask;
+    }
+    int32_t id = (int32_t)hashes.size();
+    hashes.push_back(h);
+    blob.append(s, n);
+    offsets.push_back((int64_t)blob.size());
+    table[j] = id + 1;
+    if (hashes.size() * 10 > (mask + 1) * 7) grow();
+    return id;
+  }
+};
+
+// Object-member loop shared by the single-pass extractor: the callback
+// consumes (and validates) each member's value after ``keybuf`` holds
+// the decoded member name.
+template <class F>
+bool walk_object_members(JsonParser& jp, std::string& keybuf,
+                         F consume_value) {
+  jp.p++;  // '{' (caller dispatched on it)
+  jp.ws();
+  if (jp.p < jp.end && *jp.p == '}') {
+    jp.p++;
+    return true;
+  }
+  while (true) {
+    jp.ws();
+    if (jp.p >= jp.end || *jp.p != '"')
+      return jp.err(JSON, "Expecting property name in double quotes");
+    jp.p++;
+    if (!jp.parse_string(keybuf)) return false;
+    jp.ws();
+    if (jp.p >= jp.end || *jp.p != ':') return jp.err(JSON, "Expecting ':'");
+    jp.p++;
+    if (!consume_value(keybuf)) return false;
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') {
+      jp.p++;
+      continue;
+    }
+    if (jp.p < jp.end && *jp.p == '}') {
+      jp.p++;
+      return true;
+    }
+    return jp.err(JSON, "Expecting ',' or '}'");
+  }
+}
+
+// ---------------------------------------------------------------------------
+// The accumulating ingest state (one handle per segment load).
+// ---------------------------------------------------------------------------
+struct CrawlState {
+  Interner ids;
+  std::vector<int32_t> src, dst;
+  std::vector<uint8_t> crawled_by_id;  // grows with ids
+  int64_t num_records = 0;
+  Fail fail{OK, ""};
+  // scratch (reused across records to avoid churn)
+  std::string url_text, val_text, rendered, scratch_key;
+  std::string key_root, key_content, key_entry;
+  // single-pass extractor state (per record). Targets are spans —
+  // either into the record text (escape-free string hrefs, the common
+  // case) or into owned_pool (rendered values) — valid until commit.
+  struct Target {
+    const char* p;
+    size_t n;
+  };
+  std::vector<Target> targets;
+  // deque: growth must not move existing strings — Target spans point
+  // into them (SSO buffers live inside the string object itself)
+  std::deque<std::string> owned_pool;
+  size_t n_owned = 0, n_targets = 0;
+  int content_count = 0, links_count = 0;
+  bool dup_fallback = false, strict_cur = false;
+  Fail pending{OK, ""};  // first strict entry error, deferred to commit
+
+  enum Ctx { CTX_ROOT, CTX_CONTENT, CTX_LINKS };
+
+  void mark_crawled(int32_t id) {
+    if ((size_t)id >= crawled_by_id.size()) crawled_by_id.resize(ids.size(), 0);
+    crawled_by_id[(size_t)id] = 1;
+  }
+
+  bool ingest_record(const std::string& url, const char* json, size_t jlen,
+                     bool strict) {
+    num_records++;
+    int32_t u = ids.get_or_add(url.data(), url.size());
+    mark_crawled(u);
+    Fail jfail{OK, ""};
+    JsonParser jp{json, json + jlen, &jfail};
+    n_targets = n_owned = 0;
+    content_count = links_count = 0;
+    dup_fallback = false;
+    strict_cur = strict;
+    pending = {OK, ""};
+    // Single validating pass that extracts along the way; the walk is
+    // exactly json.loads-then-dict-walk EXCEPT when content/links keys
+    // repeat (dict would keep the last), where it falls back to the
+    // span re-walk.
+    jp.ws();
+    const char* d0 = jp.p;
+    bool ok = xvalue(jp, 0, CTX_ROOT);
+    const char* d1 = jp.p;
+    if (ok) {
+      jp.ws();
+      if (jp.p != jp.end) ok = jp.err(JSON, "Extra data");
+    }
+    if (!ok) {
+      // JSON errors beat deferred entry errors (Python parses first).
+      if (jfail.cat == INTERNAL || strict) {
+        fail = jfail;
+        return false;
+      }
+      return true;  // non-strict: record kept, no targets
+    }
+    if (dup_fallback) {
+      n_targets = 0;
+      return extract_span(d0, d1, u, strict);
+    }
+    if (pending.cat != OK) {  // set only under strict
+      fail = pending;
+      return false;
+    }
+    for (size_t i = 0; i < n_targets; i++) {
+      int32_t tid = ids.get_or_add(targets[i].p, targets[i].n);
+      src.push_back(u);
+      dst.push_back(tid);
+    }
+    return true;
+  }
+
+  bool xvalue(JsonParser& jp, int depth, int ctx) {
+    if (depth > MAX_DEPTH)
+      return jp.err(INTERNAL, "maximum JSON nesting depth exceeded");
+    jp.ws();
+    if (jp.p >= jp.end) return jp.err(JSON, "Expecting value");
+    char c = *jp.p;
+    if (ctx == CTX_ROOT && c == '{') {
+      return walk_object_members(jp, key_root, [&](const std::string& k) {
+        if (k == "content") {
+          if (++content_count > 1) dup_fallback = true;
+          n_targets = n_owned = 0;
+          links_count = 0;
+          return xvalue(jp, depth + 1, CTX_CONTENT);
+        }
+        const char *a, *b;
+        return jp.skip_value(depth + 1, &a, &b);
+      });
+    }
+    if (ctx == CTX_CONTENT && c == '{') {
+      return walk_object_members(jp, key_content, [&](const std::string& k) {
+        if (k == "links") {
+          if (++links_count > 1) dup_fallback = true;
+          n_targets = n_owned = 0;
+          return xvalue(jp, depth + 1, CTX_LINKS);
+        }
+        const char *a, *b;
+        return jp.skip_value(depth + 1, &a, &b);
+      });
+    }
+    if (ctx == CTX_LINKS && c == '[') {
+      jp.p++;
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == ']') {
+        jp.p++;
+        return true;
+      }
+      while (true) {
+        if (!xentry(jp, depth + 1)) return false;
+        jp.ws();
+        if (jp.p < jp.end && *jp.p == ',') {
+          jp.p++;
+          continue;
+        }
+        if (jp.p < jp.end && *jp.p == ']') {
+          jp.p++;
+          return true;
+        }
+        return jp.err(JSON, "Expecting ',' or ']'");
+      }
+    }
+    // Shape didn't match the crawl path at this level: plain skip.
+    const char *a, *b;
+    return jp.skip_value(depth, &a, &b);
+  }
+
+  bool xentry(JsonParser& jp, int depth) {
+    jp.ws();
+    if (jp.p >= jp.end) return jp.err(JSON, "Expecting value");
+    if (*jp.p != '{') {  // entry["href"] on a non-dict -> TypeError
+      const char *a, *b;
+      if (!jp.skip_value(depth, &a, &b)) return false;
+      if (strict_cur && pending.cat == OK)
+        pending = {TYPE, "link entry is not an object"};
+      return true;
+    }
+    const char *h0 = nullptr, *h1 = nullptr, *t0 = nullptr, *t1 = nullptr;
+    bool ok = walk_object_members(jp, key_entry, [&](const std::string& k) {
+      const char *a, *b;
+      if (!jp.skip_value(depth + 1, &a, &b)) return false;
+      if (k == "href") {  // duplicate member: last wins (dict semantics)
+        h0 = a;
+        h1 = b;
+      } else if (k == "type") {
+        t0 = a;
+        t1 = b;
+      }
+      return true;
+    });
+    if (!ok) return false;
+    if (!h0 || !t0) {
+      if (strict_cur && pending.cat == OK)
+        pending = {KEY, !h0 ? "href" : "type"};
+      return true;
+    }
+    // _render(type) == '"a"'  <=>  type is the JSON string "a".
+    if (*t0 != '"') return true;
+    if (t1 - t0 == 3) {  // unescaped token: exactly "a"
+      if (t0[1] != 'a') return true;
+    } else {
+      Fail dummy{OK, ""};
+      JsonParser tp{t0 + 1, t1, &dummy};
+      tp.parse_string(scratch_key);
+      if (scratch_key != "a") return true;
+    }
+    if (n_targets == targets.size()) targets.emplace_back();
+    // Fast path: an escape-free string href re-renders to its own raw
+    // bytes (dumps adds nothing, and it can contain no quote — one
+    // would have ended the token), so the span itself is the target.
+    if (*h0 == '"' &&
+        std::memchr(h0 + 1, '\\', (size_t)(h1 - h0 - 2)) == nullptr) {
+      targets[n_targets++] = {h0 + 1, (size_t)(h1 - h0 - 2)};
+      return true;
+    }
+    // Slow path: materialize + render (commit still deferred — Python
+    // parses the whole document before walking).
+    Fail dummy{OK, ""};
+    JValue href;
+    JsonParser hp{h0, h1, &dummy};
+    hp.parse_value(href, 0);
+    if (n_owned == owned_pool.size()) owned_pool.emplace_back();
+    std::string& out = owned_pool[n_owned++];
+    out.clear();
+    render(href, out);
+    out.erase(std::remove(out.begin(), out.end(), '"'), out.end());
+    targets[n_targets++] = {out.data(), out.size()};
+    return true;
+  }
+
+  // Link extraction over a validated value span — the crawljson.py walk:
+  // root["content"]["links"][i]{"type" == "a"} -> render(href).
+  bool extract_span(const char* s0, const char* s1, int32_t u, bool strict) {
+    if (s0 >= s1 || *s0 != '{') return true;  // root not an object
+    const char *c0, *c1;
+    if (!span_obj_get(s0, s1, "content", scratch_key, &c0, &c1)) return true;
+    if (*c0 != '{') return true;
+    const char *l0, *l1;
+    if (!span_obj_get(c0, c1, "links", scratch_key, &l0, &l1)) return true;
+    if (*l0 != '[') return true;
+    // Walk the links array (validated; no parse errors possible).
+    Fail dummy{OK, ""};
+    JsonParser jp{l0, l1, &dummy};
+    jp.p++;  // '['
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ']') return true;
+    while (true) {
+      const char *e0, *e1;
+      jp.skip_value(0, &e0, &e1);
+      if (!handle_entry(e0, e1, u, strict)) return false;
+      jp.ws();
+      if (jp.p < jp.end && *jp.p == ',') {
+        jp.p++;
+        continue;
+      }
+      return true;  // ']'
+    }
+  }
+
+  bool handle_entry(const char* e0, const char* e1, int32_t u, bool strict) {
+    if (*e0 != '{') {  // entry["href"] on a non-dict -> TypeError
+      if (strict) {
+        fail = {TYPE, "link entry is not an object"};
+        return false;
+      }
+      return true;
+    }
+    const char *h0, *h1, *t0, *t1;
+    bool has_href = span_obj_get(e0, e1, "href", scratch_key, &h0, &h1);
+    bool has_type = span_obj_get(e0, e1, "type", scratch_key, &t0, &t1);
+    if (!has_href || !has_type) {
+      if (strict) {
+        fail = {KEY, !has_href ? "href" : "type"};
+        return false;
+      }
+      return true;
+    }
+    // _render(type) == '"a"'  <=>  type is the JSON string "a".
+    if (*t0 != '"') return true;
+    Fail dummy{OK, ""};
+    JsonParser tp{t0 + 1, t1, &dummy};
+    tp.parse_string(scratch_key);
+    if (scratch_key != "a") return true;
+    // Materialize + render only the matched href (small by construction).
+    JValue href;
+    JsonParser hp{h0, h1, &dummy};
+    hp.parse_value(href, 0);
+    rendered.clear();
+    render(href, rendered);
+    // Sparky.java:105 strips every double quote from the rendering.
+    rendered.erase(std::remove(rendered.begin(), rendered.end(), '"'),
+                   rendered.end());
+    int32_t t = ids.get_or_add(rendered.data(), rendered.size());
+    src.push_back(u);
+    dst.push_back(t);
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// SequenceFile container walk — mirrors ingest/seqfile.py exactly.
+// ---------------------------------------------------------------------------
+const char TEXT_CLASS[] = "org.apache.hadoop.io.Text";
+
+bool is_deflate_codec(const uint8_t* s, int64_t n) {
+  static const char* CODECS[] = {
+      "org.apache.hadoop.io.compress.DefaultCodec",
+      "org.apache.hadoop.io.compress.DeflateCodec",
+  };
+  for (const char* c : CODECS)
+    if ((size_t)n == std::strlen(c) && std::memcmp(s, c, (size_t)n) == 0)
+      return true;
+  return false;
+}
+
+bool seq_fail(CrawlState& st, ErrCat cat, const char* msg) {
+  st.fail = {cat, msg};
+  return false;
+}
+
+bool text_fail(CrawlState& st, TextRead rc, const char* what) {
+  return seq_fail(st, rc == TEXT_NEG ? FORMAT : EOF_, what);
+}
+
+// One decoded (key, value) record -> crawl record.
+bool seq_record(CrawlState& st, const uint8_t* kraw, int64_t kn,
+                const uint8_t* vraw, int64_t vn, bool strict) {
+  // Python ignores trailing bytes after each Text payload.
+  Span ks{kraw, kraw + kn};
+  const uint8_t* kp;
+  int64_t klen;
+  TextRead rc = read_text_raw(ks, &kp, &klen);
+  if (rc != TEXT_OK) return text_fail(st, rc, "truncated record (key Text)");
+  Span vs{vraw, vraw + vn};
+  const uint8_t* vp;
+  int64_t vlen;
+  rc = read_text_raw(vs, &vp, &vlen);
+  if (rc != TEXT_OK) return text_fail(st, rc, "truncated record (value Text)");
+  utf8_replace(kp, (size_t)klen, st.url_text);
+  utf8_replace(vp, (size_t)vlen, st.val_text);
+  return st.ingest_record(st.url_text, st.val_text.data(), st.val_text.size(),
+                          strict);
+}
+
+bool ingest_seqfile(CrawlState& st, const uint8_t* data, int64_t len,
+                    bool strict) {
+  Span s{data, data + len};
+  const uint8_t* magic;
+  if (!s.take(4, &magic) || std::memcmp(magic, "SEQ", 3) != 0)
+    return seq_fail(st, FORMAT, "not a SequenceFile (bad magic)");
+  if (magic[3] != 6)
+    return seq_fail(st, FORMAT, "unsupported SequenceFile version");
+  const uint8_t* cls;
+  int64_t cn;
+  for (int i = 0; i < 2; i++) {
+    TextRead rc = read_text_raw(s, &cls, &cn);
+    if (rc != TEXT_OK) return text_fail(st, rc, "truncated header (class name)");
+    if ((size_t)cn != std::strlen(TEXT_CLASS) ||
+        std::memcmp(cls, TEXT_CLASS, (size_t)cn) != 0)
+      return seq_fail(st, FORMAT, "expected Text/Text classes");
+  }
+  const uint8_t* flags;
+  if (!s.take(2, &flags))
+    return seq_fail(st, EOF_, "truncated header (flags)");
+  bool compressed = flags[0] != 0;
+  bool block_compressed = flags[1] != 0;
+  if (compressed) {
+    const uint8_t* codec;
+    int64_t codn;
+    TextRead rc = read_text_raw(s, &codec, &codn);
+    if (rc != TEXT_OK) return text_fail(st, rc, "truncated header (codec)");
+    if (!is_deflate_codec(codec, codn))
+      return seq_fail(st, FORMAT, "unsupported codec");
+  } else if (block_compressed) {
+    return seq_fail(st, FORMAT, "block-compressed flag set without a codec");
+  }
+  int32_t n_meta;
+  if (!read_i32(s, &n_meta))
+    return seq_fail(st, EOF_, "truncated metadata count");
+  for (int32_t i = 0; i < n_meta * 2; i++) {
+    const uint8_t* m;
+    int64_t mn;
+    TextRead rc = read_text_raw(s, &m, &mn);
+    if (rc != TEXT_OK) return text_fail(st, rc, "truncated metadata");
+  }
+  const uint8_t* sync;
+  if (!s.take(16, &sync))
+    return seq_fail(st, EOF_, "truncated header (sync marker)");
+
+  std::string kinf, vinf, klinf, vlinf, vrecinf;
+  if (block_compressed) {
+    while (s.left() > 0) {
+      if (s.left() < 4) return true;  // clean EOF between blocks
+      int32_t head;
+      read_i32(s, &head);
+      if (head != -1)
+        return seq_fail(st, FORMAT, "expected block sync escape");
+      const uint8_t* marker;
+      if (!s.take(16, &marker))
+        return seq_fail(st, EOF_, "truncated block sync marker");
+      if (std::memcmp(marker, sync, 16) != 0)
+        return seq_fail(st, FORMAT, "sync marker mismatch (corrupt file)");
+      int64_t n_rec;
+      if (!read_vint(s, &n_rec))
+        return seq_fail(st, EOF_, "truncated block record count");
+      if (n_rec < 0) return seq_fail(st, FORMAT, "bad block record count");
+      std::string* bufs[4] = {&klinf, &kinf, &vlinf, &vinf};
+      for (auto* buf : bufs) {
+        const uint8_t* comp;
+        int64_t compn;
+        TextRead rc = read_text_raw(s, &comp, &compn);
+        if (rc == TEXT_NEG)
+          return seq_fail(st, FORMAT, "bad block buffer length");
+        if (rc != TEXT_OK)
+          return seq_fail(st, EOF_, "truncated block buffer");
+        if (!inflate_all(comp, (size_t)compn, *buf))
+          return seq_fail(st, ZLIB, "bad deflate stream in block");
+      }
+      Span kls{(const uint8_t*)klinf.data(),
+               (const uint8_t*)klinf.data() + klinf.size()};
+      Span ks{(const uint8_t*)kinf.data(),
+              (const uint8_t*)kinf.data() + kinf.size()};
+      Span vls{(const uint8_t*)vlinf.data(),
+               (const uint8_t*)vlinf.data() + vlinf.size()};
+      Span vs{(const uint8_t*)vinf.data(),
+              (const uint8_t*)vinf.data() + vinf.size()};
+      for (int64_t i = 0; i < n_rec; i++) {
+        int64_t klen, vlen;
+        const uint8_t *kraw, *vraw;
+        // Python: _read_vint EOF -> EOFError; short payload reads ->
+        // "truncated block record" EOFError; negative -> Text length
+        // ValueError happens inside seq_record's VInt (not here, the
+        // buffer lengths are plain VInts with no sign check in the
+        // Python reader -- a negative reads 0 bytes then fails the
+        // length check as EOFError).
+        if (!read_vint(kls, &klen))
+          return seq_fail(st, EOF_, "truncated block record");
+        if (!ks.take((size_t)(klen < 0 ? 0 : klen), &kraw) || klen < 0)
+          return seq_fail(st, EOF_, "truncated block record");
+        if (!read_vint(vls, &vlen))
+          return seq_fail(st, EOF_, "truncated block record");
+        if (!vs.take((size_t)(vlen < 0 ? 0 : vlen), &vraw) || vlen < 0)
+          return seq_fail(st, EOF_, "truncated block record");
+        if (!seq_record(st, kraw, klen, vraw, vlen, strict)) return false;
+      }
+    }
+    return true;
+  }
+
+  while (true) {
+    if (s.left() < 4) return true;  // clean EOF
+    int32_t rec_len;
+    read_i32(s, &rec_len);
+    if (rec_len == -1) {
+      const uint8_t* marker;
+      if (!s.take(16, &marker))
+        return seq_fail(st, EOF_, "truncated sync marker");
+      if (std::memcmp(marker, sync, 16) != 0)
+        return seq_fail(st, FORMAT, "sync marker mismatch (corrupt file)");
+      continue;
+    }
+    if (rec_len < 0) return seq_fail(st, FORMAT, "bad record length");
+    int32_t key_len;
+    if (!read_i32(s, &key_len))
+      return seq_fail(st, EOF_, "truncated key length");
+    if (key_len < 0 || key_len > rec_len)
+      return seq_fail(st, FORMAT, "bad key length");
+    const uint8_t *kraw, *vraw;
+    if (!s.take((size_t)key_len, &kraw) ||
+        !s.take((size_t)(rec_len - key_len), &vraw))
+      return seq_fail(st, EOF_, "truncated record");
+    int64_t vn = rec_len - key_len;
+    if (compressed) {
+      if (!inflate_all(vraw, (size_t)vn, vrecinf))
+        return seq_fail(st, ZLIB, "bad deflate stream in record");
+      if (!seq_record(st, kraw, key_len, (const uint8_t*)vrecinf.data(),
+                      (int64_t)vrecinf.size(), strict))
+        return false;
+    } else {
+      if (!seq_record(st, kraw, key_len, vraw, vn, strict)) return false;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// TSV / JSONL crawl files (crawljson.py:iter_crawl_records): decoded with
+// utf-8 replace + universal newlines; url<TAB>json lines, or JSONL
+// objects with "url" + "metadata"/"json" members.
+// ---------------------------------------------------------------------------
+bool ingest_tsv(CrawlState& st, const uint8_t* data, int64_t len, bool strict) {
+  std::string text;
+  utf8_replace(data, (size_t)len, text);
+  const char* p = text.data();
+  const char* end = p + text.size();
+  std::string line;
+  while (p < end) {
+    // Universal newlines: \n, \r\n, or \r all end a line.
+    const char* q = p;
+    while (q < end && *q != '\n' && *q != '\r') q++;
+    line.assign(p, (size_t)(q - p));
+    if (q < end) {
+      if (*q == '\r' && q + 1 < end && q[1] == '\n') q++;
+      q++;
+    }
+    p = q;
+    if (line.empty()) continue;
+    size_t tab = line.find('\t');
+    if (tab != std::string::npos) {
+      st.url_text.assign(line, 0, tab);
+      if (!st.ingest_record(st.url_text, line.data() + tab + 1,
+                            line.size() - tab - 1, strict))
+        return false;
+      continue;
+    }
+    // JSONL: json.loads(line) errors ALWAYS raise (outside the strict
+    // try in the Python path), as do a non-object root / missing url.
+    Fail jfail{OK, ""};
+    JsonParser jp{line.data(), line.data() + line.size(), &jfail};
+    const char *d0, *d1;
+    if (!jp.skip_document(&d0, &d1)) {
+      st.fail = jfail;
+      return false;
+    }
+    if (*d0 != '{') {
+      st.fail = {TYPE, "JSONL record is not an object"};
+      return false;
+    }
+    const char *u0, *u1;
+    if (!span_obj_get(d0, d1, "url", st.scratch_key, &u0, &u1)) {
+      st.fail = {KEY, "url"};
+      return false;
+    }
+    if (*u0 != '"') {
+      // Python succeeds here (the parsed value becomes the dict key);
+      // non-string names are unrepresentable in the native interner,
+      // so the wrapper falls back to the Python path for this load.
+      st.fail = {UNSUPPORTED, "JSONL url is not a string"};
+      return false;
+    }
+    Fail dummy{OK, ""};
+    JsonParser up{u0 + 1, u1, &dummy};
+    up.parse_string(st.url_text);
+    const char *m0 = nullptr, *m1 = nullptr;
+    bool has_meta =
+        span_obj_get(d0, d1, "metadata", st.scratch_key, &m0, &m1) ||
+        span_obj_get(d0, d1, "json", st.scratch_key, &m0, &m1);
+    st.num_records++;
+    int32_t u = st.ids.get_or_add(st.url_text.data(), st.url_text.size());
+    st.mark_crawled(u);
+    if (has_meta && !st.extract_span(m0, m1, u, strict)) return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void* crawl_new() { return new CrawlState(); }
+
+void crawl_free(void* h) { delete static_cast<CrawlState*>(h); }
+
+// kind: 0 = SequenceFile bytes, 1 = TSV/JSONL text bytes.
+// Returns the error category (0 = ok); message via crawl_error.
+int64_t crawl_ingest_file(void* h, const uint8_t* data, int64_t len,
+                          int32_t kind, int32_t strict) {
+  auto* st = static_cast<CrawlState*>(h);
+  st->fail = {OK, ""};
+  bool ok = kind == 0 ? ingest_seqfile(*st, data, len, strict != 0)
+                      : ingest_tsv(*st, data, len, strict != 0);
+  if (ok && (st->ids.size() > (size_t)INT32_MAX ||
+             st->src.size() > (size_t)INT32_MAX)) {
+    st->fail = {INTERNAL, "more than 2^31 vertices or edges"};
+    ok = false;
+  }
+  return ok ? OK : st->fail.cat;
+}
+
+const char* crawl_error(void* h) {
+  return static_cast<CrawlState*>(h)->fail.msg.c_str();
+}
+
+int64_t crawl_num_edges(void* h) {
+  return (int64_t)static_cast<CrawlState*>(h)->src.size();
+}
+
+int64_t crawl_num_vertices(void* h) {
+  return (int64_t)static_cast<CrawlState*>(h)->ids.size();
+}
+
+int64_t crawl_num_records(void* h) {
+  return static_cast<CrawlState*>(h)->num_records;
+}
+
+void crawl_copy_edges(void* h, int32_t* src, int32_t* dst) {
+  auto* st = static_cast<CrawlState*>(h);
+  if (!st->src.empty()) {
+    std::memcpy(src, st->src.data(), st->src.size() * sizeof(int32_t));
+    std::memcpy(dst, st->dst.data(), st->dst.size() * sizeof(int32_t));
+  }
+}
+
+void crawl_copy_crawled(void* h, uint8_t* mask) {
+  auto* st = static_cast<CrawlState*>(h);
+  size_t n = st->ids.size();
+  std::memset(mask, 0, n);
+  std::memcpy(mask, st->crawled_by_id.data(),
+              std::min(n, st->crawled_by_id.size()));
+}
+
+int64_t crawl_names_blob_size(void* h) {
+  return (int64_t)static_cast<CrawlState*>(h)->ids.blob.size();
+}
+
+void crawl_copy_names(void* h, char* blob, int64_t* offsets) {
+  auto* st = static_cast<CrawlState*>(h);
+  std::memcpy(blob, st->ids.blob.data(), st->ids.blob.size());
+  std::memcpy(offsets, st->ids.offsets.data(),
+              st->ids.offsets.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

@@ -126,9 +126,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--ingest-workers", type=int, default=None,
         help="parallel parse processes for multi-file SequenceFile "
         "segments (the reference parses its 301 segment files across "
-        "the cluster, Sparky.java:61). Default: one per core, capped by "
-        "file count; 1 = serial. Record order (and so vertex ids) is "
-        "identical either way",
+        "the cluster, Sparky.java:61). Setting this selects the Python "
+        "process-pool path explicitly (default: the native C++ parser "
+        "when available — one per core, capped by file count; 1 = "
+        "serial). Record order (and so vertex ids) is identical on "
+        "every path",
+    )
+    p.add_argument(
+        "--no-native-ingest", action="store_true",
+        help="force the pure-Python crawl/SequenceFile parser instead "
+        "of the native C++ L1 (native/crawl_ingest.cpp)",
     )
     ppr = p.add_argument_group("personalized PageRank (batched SpMM)")
     ppr.add_argument(
@@ -370,17 +377,20 @@ def load_graph(args):
             f"assignment); it applies to --synthetic and integer edge "
             f"inputs (npz/edgelist)"
         )
+    native = "off" if args.no_native_ingest else "auto"
     if fmt == "seqfile":
         from pagerank_tpu.ingest import load_crawl_seqfile
 
         graph, ids = load_crawl_seqfile(
-            path, strict=args.strict_parse, workers=args.ingest_workers
+            path, strict=args.strict_parse, workers=args.ingest_workers,
+            native=native,
         )
         return graph, ids
     if fmt == "crawl":
         from pagerank_tpu.ingest import load_crawl_file
 
-        graph, ids = load_crawl_file(path, strict=args.strict_parse)
+        graph, ids = load_crawl_file(path, strict=args.strict_parse,
+                                     native=native)
         return graph, ids
     if fmt == "npz":
         src, dst, n = el.load_binary_edges(path)

@@ -87,8 +87,21 @@ def iter_crawl_records(
             yield parse_metadata_record(url, meta, strict=strict)
 
 
-def load_crawl_file(path: str, strict: bool = True):
-    """Parse a crawl-metadata file into a Graph (+ IdMap)."""
+def load_crawl_file(path: str, strict: bool = True, native: str = "auto"):
+    """Parse a crawl-metadata file (TSV or JSONL) into a Graph (+ IdMap).
+
+    ``native="auto"`` uses the C++ L1 (ingest/native.py:crawl_load) when
+    available; output parity with this Python path is pinned by
+    tests/test_native_crawl.py."""
+    if native == "auto":
+        from pagerank_tpu.ingest import native as native_mod
+
+        try:
+            result = native_mod.crawl_load([path], "tsv", strict=strict)
+        except native_mod.NativeUnsupported:
+            result = None  # e.g. non-string JSONL url: Python handles it
+        if result is not None:
+            return result
     from pagerank_tpu.ingest.ids import records_to_graph
 
     return records_to_graph(iter_crawl_records(path, strict=strict))

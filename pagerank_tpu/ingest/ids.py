@@ -43,6 +43,15 @@ class IdMap:
     def names(self) -> List[str]:
         return self._names
 
+    @classmethod
+    def from_names(cls, names: List[str]) -> "IdMap":
+        """Rebuild the map from an insertion-ordered name list (the
+        native ingest path returns ids already assigned)."""
+        m = cls()
+        m._names = list(names)
+        m._ids = {name: i for i, name in enumerate(m._names)}
+        return m
+
 
 def records_to_graph(
     records: Iterable[Tuple[str, List[str]]],

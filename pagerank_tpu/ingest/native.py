@@ -34,14 +34,22 @@ class _ParseResult(ctypes.Structure):
     ]
 
 
+_SRC_CRAWL = os.path.join(
+    os.path.dirname(__file__), "..", "..", "native", "crawl_ingest.cpp"
+)
+
+
 def _build() -> Optional[str]:
-    src = os.path.abspath(_SRC)
+    srcs = [os.path.abspath(_SRC), os.path.abspath(_SRC_CRAWL)]
     so = os.path.abspath(_SO)
-    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+    if os.path.exists(so) and all(
+        os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs
+    ):
         return so
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", so, src, "-lpthread"]
+    cmd = ["g++", "-std=c++17", "-O3", "-march=native", "-shared", "-fPIC",
+           "-o", so] + srcs + ["-lpthread", "-lz"]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=240)
         return so
     except Exception:
         return None
@@ -61,6 +69,33 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(so)
             lib.parse_edgelist.restype = _ParseResult
             lib.parse_edgelist.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+            lib.crawl_new.restype = ctypes.c_void_p
+            lib.crawl_free.argtypes = [ctypes.c_void_p]
+            lib.crawl_ingest_file.restype = ctypes.c_int64
+            lib.crawl_ingest_file.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32,
+            ]
+            lib.crawl_error.restype = ctypes.c_char_p
+            lib.crawl_error.argtypes = [ctypes.c_void_p]
+            for fn in ("crawl_num_edges", "crawl_num_vertices",
+                       "crawl_num_records", "crawl_names_blob_size"):
+                getattr(lib, fn).restype = ctypes.c_int64
+                getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            lib.crawl_copy_edges.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ]
+            lib.crawl_copy_crawled.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ]
+            lib.crawl_copy_names.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ]
             lib.free_edges.argtypes = [
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int64),
@@ -108,6 +143,98 @@ def parse_edgelist_native(path: str, num_threads: int = 0):
     dst = np.ctypeslib.as_array(res.dst, shape=(e,)).copy()
     lib.free_edges(res.src, res.dst)
     return src, dst
+
+
+#: crawl_ingest_file error categories -> the exception types the Python
+#: ingest path raises for the same input (crash-class parity, pinned by
+#: tests/test_native_crawl.py).
+_CRAWL_KIND_SEQFILE = 0
+_CRAWL_KIND_TSV = 1
+
+
+class NativeUnsupported(Exception):
+    """Input is valid for the Python path but unrepresentable natively
+    (e.g. a non-string JSONL url, which Python keeps as a non-str dict
+    key). Callers fall back to the Python path."""
+
+
+def _crawl_raise(cat: int, msg: str, path: str):
+    import json as _json
+    import zlib as _zlib
+
+    if cat == 2:
+        raise _json.JSONDecodeError(f"{msg} (in {path})", "", 0)
+    if cat == 3:
+        raise KeyError(msg)
+    if cat == 4:
+        raise TypeError(f"{msg} (in {path})")
+    if cat == 6:
+        raise RuntimeError(f"{msg} (in {path})")
+    if cat == 7:
+        raise EOFError(f"{path}: {msg}")
+    if cat == 8:
+        raise _zlib.error(f"{path}: {msg}")
+    if cat == 9:
+        raise NativeUnsupported(f"{path}: {msg}")
+    raise ValueError(f"{path}: {msg}")
+
+
+def crawl_load(paths, kind: str, strict: bool = True):
+    """Native L1: parse crawl inputs (``kind`` = "seqfile" or "tsv") into
+    a (Graph, IdMap) with the exact record/id order and quirk semantics
+    of the Python path (crawljson.py + seqfile.py — differentially
+    pinned by tests/test_native_crawl.py). Returns None when the native
+    library is unavailable; raises the same exception types as the
+    Python path on malformed input. File bytes are read through the
+    fsio registry, so URI schemes (s3://, mock://) work identically.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    from pagerank_tpu.graph import build_graph
+    from pagerank_tpu.ingest.ids import IdMap
+    from pagerank_tpu.utils import fsio
+
+    kind_code = (
+        _CRAWL_KIND_SEQFILE if kind == "seqfile" else _CRAWL_KIND_TSV
+    )
+    h = lib.crawl_new()
+    try:
+        for path in paths:
+            with fsio.fopen(path, "rb") as f:
+                data = f.read()
+            cat = lib.crawl_ingest_file(h, data, len(data), kind_code,
+                                        1 if strict else 0)
+            if cat != 0:
+                msg = (lib.crawl_error(h) or b"").decode("utf-8", "replace")
+                _crawl_raise(cat, msg, path)
+        n = lib.crawl_num_vertices(h)
+        e = lib.crawl_num_edges(h)
+        src = np.empty(max(e, 1), np.int32)
+        dst = np.empty(max(e, 1), np.int32)
+        lib.crawl_copy_edges(h, src, dst)
+        crawled = np.zeros(max(n, 1), np.uint8)
+        if n:
+            lib.crawl_copy_crawled(h, crawled)
+        blob_size = lib.crawl_names_blob_size(h)
+        blob = ctypes.create_string_buffer(max(blob_size, 1))
+        offsets = np.empty(n + 1, np.int64)
+        lib.crawl_copy_names(h, blob, offsets)
+        raw = blob.raw[:blob_size]
+        # surrogatepass: lone surrogates from \uXXXX escapes round-trip
+        # (the C side stores them WTF-8, matching Python str contents).
+        names = [
+            raw[offsets[i]:offsets[i + 1]].decode("utf-8", "surrogatepass")
+            for i in range(n)
+        ]
+    finally:
+        lib.crawl_free(h)
+    graph = build_graph(
+        src[:e], dst[:e], n=n,
+        dangling_mask=~crawled[:n].astype(bool),
+        vertex_names=names,
+    )
+    return graph, IdMap.from_names(names)
 
 
 def sort_dedup_degrees_native(

@@ -1,0 +1,482 @@
+"""Differential tests: the native C++ L1 (native/crawl_ingest.cpp via
+ingest/native.py:crawl_load) against the pure-Python ingest path — the
+Python reader (ingest/seqfile.py + ingest/crawljson.py) is the
+behavioral spec, quirks included, so the native path must produce the
+EXACT same graph: same ids (insertion order), same names, same edges,
+same dangling/crawled masks, same strict-mode exception classes.
+"""
+
+import json
+import math
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from pagerank_tpu.ingest import native
+from pagerank_tpu.ingest.crawljson import load_crawl_file
+from pagerank_tpu.ingest.seqfile import load_crawl_seqfile, write_sequence_file
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def assert_same(result_py, result_nat):
+    g1, im1 = result_py
+    g2, im2 = result_nat
+    assert im1.names == im2.names
+    assert g1.n == g2.n
+    np.testing.assert_array_equal(g1.src, g2.src)
+    np.testing.assert_array_equal(g1.dst, g2.dst)
+    np.testing.assert_array_equal(g1.out_degree, g2.out_degree)
+    np.testing.assert_array_equal(g1.in_degree, g2.in_degree)
+    np.testing.assert_array_equal(g1.dangling_mask, g2.dangling_mask)
+    np.testing.assert_array_equal(g1.zero_in_mask, g2.zero_in_mask)
+    # IdMap lookups agree
+    for name in im1.names[: min(50, len(im1.names))]:
+        assert im1.get(name) == im2.get(name)
+
+
+def both_seqfile(tmp_path, records, compression="none", strict=True):
+    p = str(tmp_path / f"seg-{compression}")
+    write_sequence_file(p, records, compression=compression, sync_every=3)
+    py = load_crawl_seqfile(p, strict=strict, native="off")
+    nat = load_crawl_seqfile(p, strict=strict, native="auto")
+    return py, nat
+
+
+def both_tsv(tmp_path, lines, strict=True):
+    p = str(tmp_path / "crawl.tsv")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    py = load_crawl_file(p, strict=strict, native="off")
+    nat = load_crawl_file(p, strict=strict, native="auto")
+    return py, nat
+
+
+def meta(targets, types=None):
+    links = [
+        {"type": ("a" if types is None else types[i]), "href": t}
+        for i, t in enumerate(targets)
+    ]
+    return json.dumps({"content": {"links": links}}, ensure_ascii=False)
+
+
+# ---------------------------------------------------------------------------
+# String/value rendering quirks (Gson toString semantics — crawljson.py)
+# ---------------------------------------------------------------------------
+
+
+ADVERSARIAL_HREFS = [
+    "http://plain/",
+    'quo"ted',                      # embedded quote vanishes (strip-all)
+    'back\\slash',                  # dumps doubles it, strip keeps both
+    "tab\there",                    # control chars re-escaped by dumps
+    "new\nline",
+    "bell\x07gamma\x01",            #  /  escapes
+    "unicode: é中\U0001F600",  # non-ASCII passes through
+    "mixed\"\\\"x",
+    "",                             # empty href
+    "sp ace",
+    "\x1f\x7f",                     # 0x1f escaped, DEL not
+]
+
+
+def test_string_quirks_parity(tmp_path):
+    records = [("http://src/", meta(ADVERSARIAL_HREFS))]
+    py, nat = both_seqfile(tmp_path, records)
+    assert_same(py, nat)
+    # and the names really went through the quote-strip + dumps pipeline:
+    # dumps escapes the quote to \" and strip-all-quotes leaves the
+    # backslash (Sparky.java:105 on the Gson rendering)
+    assert 'quo\\ted' in py[1].names
+    assert 'back\\\\slash' in py[1].names
+
+
+def test_nonstring_href_rendering_parity(tmp_path):
+    """Non-string hrefs render via json.dumps (ints, floats, bools,
+    null, nested containers with ', '/': ' separators)."""
+    payload = {
+        "content": {"links": [
+            {"type": "a", "href": 42},
+            {"type": "a", "href": -0},
+            {"type": "a", "href": 123456789012345678901234567890},
+            {"type": "a", "href": True},
+            {"type": "a", "href": False},
+            {"type": "a", "href": None},
+            {"type": "a", "href": [1, "two", {"three": 3.5}]},
+            {"type": "a", "href": {"k": [None, -7], "j": "s"}},
+        ]}
+    }
+    records = [("http://src/", json.dumps(payload))]
+    py, nat = both_seqfile(tmp_path, records)
+    assert_same(py, nat)
+
+
+def test_float_repr_parity(tmp_path):
+    """Python float repr (shortest round-trip, fixed/scientific switch
+    at 1e16 and 1e-4, 2-digit exponent padding) must match to the byte."""
+    floats = [
+        0.0, -0.0, 1.0, 100.0, 1e15, 1e16, 9999999999999998.0,
+        1e-4, 1e-5, 1.5e-5, 123.456, 0.1, 2.675, 1e300, -1e300,
+        5e-324, 1.7976931348623157e308, 3.141592653589793,
+        1e22, 1e23, -7.066e-9,
+    ]
+    rng = np.random.default_rng(7)
+    floats += [
+        float(x)
+        for x in rng.standard_normal(60)
+        * 10.0 ** rng.integers(-30, 30, 60).astype(float)
+    ]
+    # tokens via repr -> valid JSON numbers
+    links = ", ".join(
+        '{"type": "a", "href": %s}' % repr(f) for f in floats
+        if math.isfinite(f)
+    )
+    doc = '{"content": {"links": [%s]}}' % links
+    py, nat = both_tsv(tmp_path, ["http://src/\t" + doc])
+    assert_same(py, nat)
+
+
+def test_escape_and_surrogate_parity(tmp_path):
+    r"""\uXXXX escapes: pairs combine, lone surrogates survive, and the
+    escaped form re-renders through dumps identically."""
+    doc = (
+        '{"content": {"links": ['
+        '{"type": "a", "href": "esc\\u0041\\u00e9\\ud83d\\ude00"},'
+        '{"type": "a", "href": "lone\\ud800tail"},'
+        '{"type": "a", "href": "low\\udc3ax"},'
+        '{"type": "a", "href": "\\/slash\\b\\f\\n\\r\\t"}'
+        ']}}'
+    )
+    py, nat = both_tsv(tmp_path, ["http://src/\t" + doc])
+    assert_same(py, nat)
+
+
+def test_duplicate_keys_last_wins(tmp_path):
+    doc = (
+        '{"content": {"links": ['
+        '{"type": "x", "href": "skipme", "type": "a", "href": "kept"}'
+        ']},'
+        ' "content": {"links": [{"type": "a", "href": "outer-dup"}]}}'
+    )
+    py, nat = both_tsv(tmp_path, ["http://src/\t" + doc])
+    assert_same(py, nat)
+    assert "outer-dup" in py[1].names  # last content wins
+    assert "kept" not in py[1].names
+
+
+def test_structure_tolerance_parity(tmp_path):
+    """content/links absent, null, or of the wrong type -> crawled
+    record with no targets (isinstance checks in crawljson.py)."""
+    docs = [
+        "{}", "null", "[]", '"str"', "7", "true",
+        '{"content": null}', '{"content": 5}', '{"content": []}',
+        '{"content": {"links": null}}', '{"content": {"links": {}}}',
+        '{"content": {"links": "zz"}}',
+        '{"content": {"links": []}}',
+        # type variants that must NOT match "a"
+        '{"content": {"links": [{"type": "A", "href": "x"}]}}',
+        '{"content": {"links": [{"type": "ab", "href": "x"}]}}',
+        '{"content": {"links": [{"type": 1, "href": "x"}]}}',
+        '{"content": {"links": [{"type": null, "href": "x"}]}}',
+        '{"content": {"links": [{"type": true, "href": "x"}]}}',
+    ]
+    records = [(f"http://u{i}/", d) for i, d in enumerate(docs)]
+    py, nat = both_seqfile(tmp_path, records)
+    assert_same(py, nat)
+
+
+def test_json_oddities_accepted(tmp_path):
+    """Python json accepts NaN/Infinity constants and deep whitespace."""
+    docs = [
+        '{"content": {"links": [{"type": "a", "href": NaN}]}}',
+        '{"content": {"links": [{"type": "a", "href": Infinity}]}}',
+        '{"content": {"links": [{"type": "a", "href": -Infinity}]}}',
+        ' \t\n\r{ "content" : { "links" : [ ] } } \n',
+    ]
+    records = [(f"http://u{i}/", d) for i, d in enumerate(docs)]
+    py, nat = both_seqfile(tmp_path, records)
+    assert_same(py, nat)
+
+
+# ---------------------------------------------------------------------------
+# Strict / non-strict error semantics
+# ---------------------------------------------------------------------------
+
+
+BAD_RECORDS = [
+    # (doc, exception type in strict mode)
+    ('{"content": {"links": [{"href": "x"}]}}', KeyError),       # no type
+    ('{"content": {"links": [{"type": "a"}]}}', KeyError),       # no href
+    ('{"content": {"links": ["notdict"]}}', TypeError),
+    ('{"content": {"links": [5]}}', TypeError),
+    ('{"content": {"links": [[1]]}}', TypeError),
+    ('{broken', json.JSONDecodeError),
+    ('{"content": {"links": [{"type": "a", "href": "x"}]}', json.JSONDecodeError),
+    ('{"a": 01}', json.JSONDecodeError),                          # leading zero
+    ('{"a": "un\x01escaped"}', json.JSONDecodeError),             # raw control
+    ("", json.JSONDecodeError),
+]
+
+
+@pytest.mark.parametrize("doc,exc", BAD_RECORDS)
+def test_strict_error_class_parity(tmp_path, doc, exc):
+    p = str(tmp_path / "seg")
+    write_sequence_file(p, [("http://ok/", meta(["http://t/"])),
+                            ("http://bad/", doc)])
+    with pytest.raises(exc):
+        load_crawl_seqfile(p, strict=True, native="off")
+    with pytest.raises(exc):
+        load_crawl_seqfile(p, strict=True, native="auto")
+
+
+def test_nonstrict_skips_parity(tmp_path):
+    """Non-strict mode keeps the record (crawled, no targets on JSON
+    errors; per-entry skip on bad entries) — both paths identically."""
+    records = [("http://ok/", meta(["http://t/"]))]
+    records += [(f"http://bad{i}/", doc) for i, (doc, _) in enumerate(BAD_RECORDS)]
+    records += [("http://mixed/",
+                 '{"content": {"links": [{"type": "a", "href": "good1"}, '
+                 '{"href": "nope"}, "str", {"type": "a", "href": "good2"}]}}')]
+    py, nat = both_seqfile(tmp_path, records, strict=False)
+    assert_same(py, nat)
+    assert "good1" in py[1].names and "good2" in py[1].names
+
+
+def test_jsonl_parity_and_errors(tmp_path):
+    lines = [
+        json.dumps({"url": "http://a/", "metadata":
+                    {"content": {"links": [{"type": "a", "href": "http://b/"}]}}}),
+        json.dumps({"url": "http://c/", "json":
+                    {"content": {"links": [{"type": "a", "href": "http://a/"}]}}}),
+        json.dumps({"url": "http://d/"}),          # no metadata -> {} root
+        json.dumps({"url": "http://e/", "metadata": None}),
+        "http://tsv/\t" + meta(["http://a/"]),     # mixed TSV line
+    ]
+    py, nat = both_tsv(tmp_path, lines)
+    assert_same(py, nat)
+    # JSONL structural errors raise in BOTH modes (outside the strict
+    # try in iter_crawl_records)
+    for bad, exc in [("{notjson", json.JSONDecodeError),
+                     ('{"nourl": 1}', KeyError),
+                     ("[1, 2]", TypeError)]:
+        for strict in (True, False):
+            with pytest.raises(exc):
+                both_tsv(tmp_path, [bad], strict=strict)
+
+
+# ---------------------------------------------------------------------------
+# Container-level coverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("compression", ["none", "record", "block"])
+def test_compression_layouts_parity(tmp_path, compression):
+    rng = np.random.default_rng(3)
+    records = []
+    for i in range(200):
+        targets = [f"http://t{rng.integers(0, 300)}/"
+                   for _ in range(rng.integers(0, 8))]
+        records.append((f"http://u{rng.integers(0, 120)}/", meta(targets)))
+    py, nat = both_seqfile(tmp_path, records, compression=compression)
+    assert_same(py, nat)
+
+
+def test_multifile_segment_order_parity(tmp_path):
+    """Ids depend on record order across files; the native path must
+    walk files in the same listing order as the Python path."""
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    rng = np.random.default_rng(5)
+    for i in range(7):
+        records = [
+            (f"http://u{rng.integers(0, 40)}/",
+             meta([f"http://t{rng.integers(0, 80)}/"
+                   for _ in range(rng.integers(0, 5))]))
+            for _ in range(30)
+        ]
+        write_sequence_file(str(seg / f"metadata-{i:05d}"), records)
+    py = load_crawl_seqfile(str(seg), native="off")
+    nat = load_crawl_seqfile(str(seg), native="auto")
+    assert_same(py, nat)
+
+
+def test_invalid_utf8_replacement_parity(tmp_path):
+    """Text payloads are decoded with errors='replace'; the native
+    decoder must produce CPython's maximal-subpart U+FFFD placement."""
+    bad_urls = [
+        b"http://x/\xff\xfe",          # invalid leads
+        b"http://y/\xc2",              # truncated 2-byte at end
+        b"http://z/\xe0\xa0",          # truncated 3-byte
+        b"http://w/\xe0\x80\x80",      # overlong -> 3 replacements
+        b"http://v/\xed\xa0\x80",      # surrogate bytes -> 3 replacements
+        b"http://u/\xf0\x9f\x98\x80ok",  # valid 4-byte passes
+        b"http://t/\xf4\x90\x80\x80",  # beyond U+10FFFF
+        b"http://s/\x80tail",          # stray continuation
+    ]
+    # Hand-assemble an uncompressed v6 SequenceFile with raw key bytes
+    # (write_sequence_file only takes str).
+    def text_bytes(payload: bytes) -> bytes:
+        assert len(payload) < 112
+        return struct.pack("b", len(payload)) + payload
+
+    cls = b"org.apache.hadoop.io.Text"
+    p = str(tmp_path / "rawseq")
+    sync = bytes(range(16))
+    with open(p, "wb") as f:
+        f.write(b"SEQ\x06")
+        f.write(struct.pack("b", len(cls)) + cls)
+        f.write(struct.pack("b", len(cls)) + cls)
+        f.write(b"\x00\x00")
+        f.write(struct.pack(">i", 0))
+        f.write(sync)
+        for url in bad_urls:
+            k = text_bytes(url)
+            v = text_bytes(json.dumps(
+                {"content": {"links": [{"type": "a", "href": "t"}]}}
+            ).encode())
+            f.write(struct.pack(">i", len(k) + len(v)))
+            f.write(struct.pack(">i", len(k)))
+            f.write(k + v)
+    py = load_crawl_seqfile(p, native="off")
+    nat = load_crawl_seqfile(p, native="auto")
+    assert_same(py, nat)
+    assert any("�" in nm for nm in py[1].names)
+
+
+def test_randomized_fuzz_parity(tmp_path):
+    """Broad randomized differential sweep over value shapes."""
+    rng = np.random.default_rng(11)
+    pool_strings = ADVERSARIAL_HREFS + ["http://t/", "x", "ümläut"]
+
+    def rand_value(depth=0):
+        k = rng.integers(0, 9 if depth < 3 else 6)
+        if k == 0:
+            return pool_strings[rng.integers(0, len(pool_strings))]
+        if k == 1:
+            return int(rng.integers(-10**9, 10**9))
+        if k == 2:
+            return float(rng.standard_normal() * 10.0 ** rng.integers(-20, 20))
+        if k == 3:
+            return bool(rng.integers(0, 2))
+        if k == 4:
+            return None
+        if k == 5:
+            return int(rng.integers(0, 10)) * 10**18  # big ints
+        if k == 6:
+            return [rand_value(depth + 1) for _ in range(rng.integers(0, 4))]
+        return {
+            f"k{rng.integers(0, 5)}": rand_value(depth + 1)
+            for _ in range(rng.integers(0, 4))
+        }
+
+    records = []
+    for i in range(300):
+        links = []
+        for _ in range(rng.integers(0, 6)):
+            entry = {}
+            if rng.random() < 0.9:
+                entry["type"] = "a" if rng.random() < 0.7 else rand_value()
+            if rng.random() < 0.9:
+                entry["href"] = rand_value()
+            links.append(entry if rng.random() < 0.9 else rand_value())
+        doc = {"content": {"links": links}}
+        if rng.random() < 0.1:
+            doc = rand_value()
+        records.append(
+            (f"http://u{rng.integers(0, 100)}/",
+             json.dumps(doc, ensure_ascii=False))
+        )
+    py, nat = both_seqfile(tmp_path, records, strict=False,
+                           compression="block")
+    assert_same(py, nat)
+
+
+def test_container_error_class_parity(tmp_path):
+    """Container-level failures must raise the same exception CLASSES as
+    the Python reader: EOFError for truncation, zlib.error for corrupt
+    deflate, ValueError for structural garbage."""
+    import zlib
+
+    p = str(tmp_path / "seg")
+    write_sequence_file(p, [("http://a/", meta(["http://b/"]))] * 5)
+    whole = open(p, "rb").read()
+    # truncation mid-record -> EOFError on both paths
+    trunc = str(tmp_path / "trunc")
+    with open(trunc, "wb") as f:
+        f.write(whole[:-7])
+    for native_mode in ("off", "auto"):
+        with pytest.raises(EOFError):
+            load_crawl_seqfile(trunc, native=native_mode)
+    # corrupt deflate stream -> zlib.error on both paths
+    pr = str(tmp_path / "rec")
+    write_sequence_file(pr, [("http://a/", meta(["http://b/"]))],
+                        compression="record")
+    data = bytearray(open(pr, "rb").read())
+    data[-3] ^= 0xFF  # flip a byte inside the record's zlib stream
+    bad = str(tmp_path / "badz")
+    with open(bad, "wb") as f:
+        f.write(bytes(data))
+    for native_mode in ("off", "auto"):
+        with pytest.raises(zlib.error):
+            load_crawl_seqfile(bad, native=native_mode)
+    # structural garbage -> ValueError on both paths
+    garb = str(tmp_path / "garb")
+    with open(garb, "wb") as f:
+        f.write(b"SEQ\x07" + whole[4:])
+    for native_mode in ("off", "auto"):
+        with pytest.raises(ValueError):
+            load_crawl_seqfile(garb, native=native_mode)
+
+
+def test_jsonl_nonstring_url_falls_back(tmp_path):
+    """A non-string JSONL url is valid for the Python path (the parsed
+    value becomes the id-map key); the native path can't represent it
+    and must fall back — same result either way."""
+    p = str(tmp_path / "crawl.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"url": 5, "metadata": {"content": {"links": [
+            {"type": "a", "href": "http://t/"}]}}}) + "\n")
+    g1, im1 = load_crawl_file(p, native="off")
+    g2, im2 = load_crawl_file(p, native="auto")
+    assert im1.names == im2.names == [5, "http://t/"]
+    np.testing.assert_array_equal(g1.src, g2.src)
+    np.testing.assert_array_equal(g1.dst, g2.dst)
+
+
+def test_explicit_workers_selects_python_pool(tmp_path, monkeypatch):
+    """An explicit workers= request is a request for the Python pool;
+    the native path must not override it (VERDICT-class regression:
+    --ingest-workers N silently ignored)."""
+    p = str(tmp_path / "seg")
+    write_sequence_file(p, [("http://a/", meta(["http://b/"]))])
+
+    def boom(*a, **k):
+        raise AssertionError("native path used despite explicit workers")
+
+    monkeypatch.setattr(native, "crawl_load", boom)
+    g, im = load_crawl_seqfile(p, workers=1)  # explicit -> python path
+    assert im.names == ["http://a/", "http://b/"]
+
+
+def test_cli_uses_native_path(tmp_path, capsys):
+    """The CLI seqfile route goes through load_crawl_seqfile, which now
+    prefers the native parser — end result identical either way."""
+    from pagerank_tpu.cli import main
+
+    p = str(tmp_path / "seg")
+    write_sequence_file(
+        p,
+        [("http://a/", meta(["http://b/"])),
+         ("http://b/", meta(["http://a/", "http://c/"]))],
+    )
+    out = str(tmp_path / "r.tsv")
+    rc = main(["--input", p, "--iters", "3", "--engine", "cpu",
+               "--out", out, "--log-every", "0"])
+    assert rc == 0
+    with open(out) as f:
+        ranks = dict(line.split("\t") for line in f.read().splitlines())
+    assert set(ranks) == {"http://a/", "http://b/", "http://c/"}
