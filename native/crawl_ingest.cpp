@@ -16,9 +16,11 @@
 // (ingest/native.py adds -lz -std=c++17).
 
 #include <algorithm>
+#include <atomic>
 #include <charconv>
 #include <cmath>
 #include <deque>
+#include <thread>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -898,13 +900,34 @@ bool walk_object_members(JsonParser& jp, std::string& keybuf,
 
 // ---------------------------------------------------------------------------
 // The accumulating ingest state (one handle per segment load).
+//
+// Two sinks: the serial path interns records directly; the threaded
+// path (`crawl_ingest_files` with threads > 1) parses files in worker
+// threads into per-file FileCaptures — url/target TEXT in a private
+// arena, no shared state — and the main thread replays captures in
+// strict file order into the interner, so ids are byte-identical to
+// the serial path (the analogue of the Python process pool's
+// order-identity contract, ingest/seqfile.py:iter_segment_records).
 // ---------------------------------------------------------------------------
+struct FileCapture {
+  std::string arena;  // url + target bytes, concatenated
+  struct Rec {
+    int64_t url_off, url_len, n_targets;
+  };
+  std::vector<Rec> recs;
+  std::vector<std::pair<int64_t, int64_t>> tspans;  // flattened (off, len)
+  Fail fail{OK, ""};
+  bool failed = false;
+};
+
 struct CrawlState {
   Interner ids;
   std::vector<int32_t> src, dst;
   std::vector<uint8_t> crawled_by_id;  // grows with ids
   int64_t num_records = 0;
   Fail fail{OK, ""};
+  int64_t failed_file = -1;  // index within the last multi-file call
+  FileCapture* capture = nullptr;  // non-null: capture instead of intern
   // scratch (reused across records to avoid churn)
   std::string url_text, val_text, rendered, scratch_key;
   std::string key_root, key_content, key_entry;
@@ -931,11 +954,33 @@ struct CrawlState {
     crawled_by_id[(size_t)id] = 1;
   }
 
-  bool ingest_record(const std::string& url, const char* json, size_t jlen,
-                     bool strict) {
+  // Commit the current record (url + collected targets) to the active
+  // sink. Id-assignment order — url first, then targets in order — is
+  // what makes serial, threaded, and Python paths byte-identical.
+  void commit_current(const std::string& url) {
+    if (capture) {
+      capture->recs.push_back({(int64_t)capture->arena.size(),
+                               (int64_t)url.size(), (int64_t)n_targets});
+      capture->arena.append(url);
+      for (size_t i = 0; i < n_targets; i++) {
+        capture->tspans.emplace_back((int64_t)capture->arena.size(),
+                                     (int64_t)targets[i].n);
+        capture->arena.append(targets[i].p, targets[i].n);
+      }
+      return;
+    }
     num_records++;
     int32_t u = ids.get_or_add(url.data(), url.size());
     mark_crawled(u);
+    for (size_t i = 0; i < n_targets; i++) {
+      int32_t tid = ids.get_or_add(targets[i].p, targets[i].n);
+      src.push_back(u);
+      dst.push_back(tid);
+    }
+  }
+
+  bool ingest_record(const std::string& url, const char* json, size_t jlen,
+                     bool strict) {
     Fail jfail{OK, ""};
     JsonParser jp{json, json + jlen, &jfail};
     n_targets = n_owned = 0;
@@ -961,21 +1006,18 @@ struct CrawlState {
         fail = jfail;
         return false;
       }
-      return true;  // non-strict: record kept, no targets
+      n_targets = 0;
+      commit_current(url);  // non-strict: record kept, no targets
+      return true;
     }
     if (dup_fallback) {
-      n_targets = 0;
-      return extract_span(d0, d1, u, strict);
-    }
-    if (pending.cat != OK) {  // set only under strict
+      n_targets = n_owned = 0;
+      if (!extract_span(d0, d1, strict)) return false;
+    } else if (pending.cat != OK) {  // set only under strict
       fail = pending;
       return false;
     }
-    for (size_t i = 0; i < n_targets; i++) {
-      int32_t tid = ids.get_or_add(targets[i].p, targets[i].n);
-      src.push_back(u);
-      dst.push_back(tid);
-    }
+    commit_current(url);
     return true;
   }
 
@@ -1073,6 +1115,13 @@ struct CrawlState {
       tp.parse_string(scratch_key);
       if (scratch_key != "a") return true;
     }
+    push_target_value(h0, h1);
+    return true;
+  }
+
+  // Push a matched href value span onto the per-record target list —
+  // shared tail of the single-pass and span-walk extractors.
+  void push_target_value(const char* h0, const char* h1) {
     if (n_targets == targets.size()) targets.emplace_back();
     // Fast path: an escape-free string href re-renders to its own raw
     // bytes (dumps adds nothing, and it can contain no quote — one
@@ -1080,10 +1129,9 @@ struct CrawlState {
     if (*h0 == '"' &&
         std::memchr(h0 + 1, '\\', (size_t)(h1 - h0 - 2)) == nullptr) {
       targets[n_targets++] = {h0 + 1, (size_t)(h1 - h0 - 2)};
-      return true;
+      return;
     }
-    // Slow path: materialize + render (commit still deferred — Python
-    // parses the whole document before walking).
+    // Slow path: materialize + render.
     Fail dummy{OK, ""};
     JValue href;
     JsonParser hp{h0, h1, &dummy};
@@ -1092,14 +1140,15 @@ struct CrawlState {
     std::string& out = owned_pool[n_owned++];
     out.clear();
     render(href, out);
+    // Sparky.java:105 strips every double quote from the rendering.
     out.erase(std::remove(out.begin(), out.end(), '"'), out.end());
     targets[n_targets++] = {out.data(), out.size()};
-    return true;
   }
 
   // Link extraction over a validated value span — the crawljson.py walk:
-  // root["content"]["links"][i]{"type" == "a"} -> render(href).
-  bool extract_span(const char* s0, const char* s1, int32_t u, bool strict) {
+  // root["content"]["links"][i]{"type" == "a"} -> render(href). Fills
+  // `targets`; the caller commits.
+  bool extract_span(const char* s0, const char* s1, bool strict) {
     if (s0 >= s1 || *s0 != '{') return true;  // root not an object
     const char *c0, *c1;
     if (!span_obj_get(s0, s1, "content", scratch_key, &c0, &c1)) return true;
@@ -1116,7 +1165,7 @@ struct CrawlState {
     while (true) {
       const char *e0, *e1;
       jp.skip_value(0, &e0, &e1);
-      if (!handle_entry(e0, e1, u, strict)) return false;
+      if (!handle_entry(e0, e1, strict)) return false;
       jp.ws();
       if (jp.p < jp.end && *jp.p == ',') {
         jp.p++;
@@ -1126,7 +1175,7 @@ struct CrawlState {
     }
   }
 
-  bool handle_entry(const char* e0, const char* e1, int32_t u, bool strict) {
+  bool handle_entry(const char* e0, const char* e1, bool strict) {
     if (*e0 != '{') {  // entry["href"] on a non-dict -> TypeError
       if (strict) {
         fail = {TYPE, "link entry is not an object"};
@@ -1150,18 +1199,7 @@ struct CrawlState {
     JsonParser tp{t0 + 1, t1, &dummy};
     tp.parse_string(scratch_key);
     if (scratch_key != "a") return true;
-    // Materialize + render only the matched href (small by construction).
-    JValue href;
-    JsonParser hp{h0, h1, &dummy};
-    hp.parse_value(href, 0);
-    rendered.clear();
-    render(href, rendered);
-    // Sparky.java:105 strips every double quote from the rendering.
-    rendered.erase(std::remove(rendered.begin(), rendered.end(), '"'),
-                   rendered.end());
-    int32_t t = ids.get_or_add(rendered.data(), rendered.size());
-    src.push_back(u);
-    dst.push_back(t);
+    push_target_value(h0, h1);
     return true;
   }
 };
@@ -1413,10 +1451,75 @@ bool ingest_tsv(CrawlState& st, const uint8_t* data, int64_t len, bool strict) {
     bool has_meta =
         span_obj_get(d0, d1, "metadata", st.scratch_key, &m0, &m1) ||
         span_obj_get(d0, d1, "json", st.scratch_key, &m0, &m1);
-    st.num_records++;
-    int32_t u = st.ids.get_or_add(st.url_text.data(), st.url_text.size());
-    st.mark_crawled(u);
-    if (has_meta && !st.extract_span(m0, m1, u, strict)) return false;
+    st.n_targets = st.n_owned = 0;
+    if (has_meta && !st.extract_span(m0, m1, strict)) return false;
+    st.commit_current(st.url_text);
+  }
+  return true;
+}
+
+bool ingest_one(CrawlState& st, const uint8_t* data, int64_t len,
+                int32_t kind, bool strict) {
+  return kind == 0 ? ingest_seqfile(st, data, len, strict)
+                   : ingest_tsv(st, data, len, strict);
+}
+
+// Parallel multi-file ingest: worker threads parse files into private
+// FileCaptures (a bounded window of files in flight caps memory), the
+// calling thread replays captures in file order into the interner —
+// ids and edges byte-identical to the serial path. On a strict error
+// the EARLIEST failing file in input order wins, like the serial walk
+// (later files may have been parsed speculatively; their captures are
+// discarded, which is side-effect-free).
+bool ingest_files_threaded(CrawlState& st, int64_t n_files,
+                           const uint8_t* const* datas, const int64_t* lens,
+                           int32_t kind, bool strict, int32_t threads) {
+  int64_t window = (int64_t)threads * 2;
+  for (int64_t w0 = 0; w0 < n_files; w0 += window) {
+    int64_t w1 = std::min(n_files, w0 + window);
+    std::vector<FileCapture> caps((size_t)(w1 - w0));
+    std::atomic<int64_t> next{w0};
+    int nt = (int)std::min<int64_t>(threads, w1 - w0);
+    std::vector<std::thread> ths;
+    for (int t = 0; t < nt; t++) {
+      ths.emplace_back([&] {
+        CrawlState worker;  // scratch only; its interner stays empty
+        while (true) {
+          int64_t i = next.fetch_add(1);
+          if (i >= w1) return;
+          FileCapture& cap = caps[(size_t)(i - w0)];
+          worker.capture = &cap;
+          worker.fail = {OK, ""};
+          if (!ingest_one(worker, datas[i], lens[i], kind, strict)) {
+            cap.failed = true;
+            cap.fail = worker.fail;
+          }
+        }
+      });
+    }
+    for (auto& th : ths) th.join();
+    for (int64_t i = w0; i < w1; i++) {
+      FileCapture& cap = caps[(size_t)(i - w0)];
+      if (cap.failed) {
+        st.fail = cap.fail;
+        st.failed_file = i;
+        return false;
+      }
+      size_t toff = 0;
+      for (const FileCapture::Rec& rec : cap.recs) {
+        st.num_records++;
+        int32_t u = st.ids.get_or_add(cap.arena.data() + rec.url_off,
+                                      (size_t)rec.url_len);
+        st.mark_crawled(u);
+        for (int64_t j = 0; j < rec.n_targets; j++) {
+          const auto& sp = cap.tspans[toff++];
+          int32_t tid = st.ids.get_or_add(cap.arena.data() + sp.first,
+                                          (size_t)sp.second);
+          st.src.push_back(u);
+          st.dst.push_back(tid);
+        }
+      }
+    }
   }
   return true;
 }
@@ -1432,14 +1535,7 @@ void* crawl_new() { return new CrawlState(); }
 
 void crawl_free(void* h) { delete static_cast<CrawlState*>(h); }
 
-// kind: 0 = SequenceFile bytes, 1 = TSV/JSONL text bytes.
-// Returns the error category (0 = ok); message via crawl_error.
-int64_t crawl_ingest_file(void* h, const uint8_t* data, int64_t len,
-                          int32_t kind, int32_t strict) {
-  auto* st = static_cast<CrawlState*>(h);
-  st->fail = {OK, ""};
-  bool ok = kind == 0 ? ingest_seqfile(*st, data, len, strict != 0)
-                      : ingest_tsv(*st, data, len, strict != 0);
+static int64_t finish_ingest(CrawlState* st, bool ok) {
   if (ok && (st->ids.size() > (size_t)INT32_MAX ||
              st->src.size() > (size_t)INT32_MAX)) {
     st->fail = {INTERNAL, "more than 2^31 vertices or edges"};
@@ -1448,8 +1544,44 @@ int64_t crawl_ingest_file(void* h, const uint8_t* data, int64_t len,
   return ok ? OK : st->fail.cat;
 }
 
+// kind: 0 = SequenceFile bytes, 1 = TSV/JSONL text bytes.
+// Returns the error category (0 = ok); message via crawl_error.
+int64_t crawl_ingest_file(void* h, const uint8_t* data, int64_t len,
+                          int32_t kind, int32_t strict) {
+  auto* st = static_cast<CrawlState*>(h);
+  st->fail = {OK, ""};
+  return finish_ingest(st, ingest_one(*st, data, len, kind, strict != 0));
+}
+
+// Batched multi-file form; threads > 1 parses files in parallel with
+// file-ordered interning (see ingest_files_threaded).
+int64_t crawl_ingest_files(void* h, int64_t n_files, const uint8_t** datas,
+                           const int64_t* lens, int32_t kind, int32_t strict,
+                           int32_t threads) {
+  auto* st = static_cast<CrawlState*>(h);
+  st->fail = {OK, ""};
+  st->failed_file = -1;
+  bool ok = true;
+  if (threads <= 1 || n_files <= 1) {
+    for (int64_t i = 0; ok && i < n_files; i++) {
+      ok = ingest_one(*st, datas[i], lens[i], kind, strict != 0);
+      if (!ok) st->failed_file = i;
+    }
+  } else {
+    ok = ingest_files_threaded(*st, n_files, datas, lens, kind, strict != 0,
+                               threads);
+  }
+  return finish_ingest(st, ok);
+}
+
 const char* crawl_error(void* h) {
   return static_cast<CrawlState*>(h)->fail.msg.c_str();
+}
+
+// Index of the failing file within the last crawl_ingest_files call
+// (-1 when it succeeded) — error messages name the actual culprit.
+int64_t crawl_failed_index(void* h) {
+  return static_cast<CrawlState*>(h)->failed_file;
 }
 
 int64_t crawl_num_edges(void* h) {

@@ -42,10 +42,15 @@ _SRC_CRAWL = os.path.join(
 def _build() -> Optional[str]:
     srcs = [os.path.abspath(_SRC), os.path.abspath(_SRC_CRAWL)]
     so = os.path.abspath(_SO)
-    if os.path.exists(so) and all(
-        os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs
-    ):
-        return so
+    try:
+        if os.path.exists(so) and all(
+            os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs
+        ):
+            return so
+    except OSError:
+        # Sources absent (e.g. a deployment shipping only the prebuilt
+        # .so): use the .so if it exists, else no native path.
+        return so if os.path.exists(so) else None
     cmd = ["g++", "-std=c++17", "-O3", "-march=native", "-shared", "-fPIC",
            "-o", so] + srcs + ["-lpthread", "-lz"]
     try:
@@ -76,10 +81,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
                 ctypes.c_int32, ctypes.c_int32,
             ]
+            lib.crawl_ingest_files.restype = ctypes.c_int64
+            lib.crawl_ingest_files.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ]
             lib.crawl_error.restype = ctypes.c_char_p
             lib.crawl_error.argtypes = [ctypes.c_void_p]
             for fn in ("crawl_num_edges", "crawl_num_vertices",
-                       "crawl_num_records", "crawl_names_blob_size"):
+                       "crawl_num_records", "crawl_names_blob_size",
+                       "crawl_failed_index"):
                 getattr(lib, fn).restype = ctypes.c_int64
                 getattr(lib, fn).argtypes = [ctypes.c_void_p]
             lib.crawl_copy_edges.argtypes = [
@@ -179,7 +192,8 @@ def _crawl_raise(cat: int, msg: str, path: str):
     raise ValueError(f"{path}: {msg}")
 
 
-def crawl_load(paths, kind: str, strict: bool = True):
+def crawl_load(paths, kind: str, strict: bool = True,
+               threads: Optional[int] = None):
     """Native L1: parse crawl inputs (``kind`` = "seqfile" or "tsv") into
     a (Graph, IdMap) with the exact record/id order and quirk semantics
     of the Python path (crawljson.py + seqfile.py — differentially
@@ -187,6 +201,12 @@ def crawl_load(paths, kind: str, strict: bool = True):
     library is unavailable; raises the same exception types as the
     Python path on malformed input. File bytes are read through the
     fsio registry, so URI schemes (s3://, mock://) work identically.
+
+    Multi-file inputs parse across ``threads`` C++ worker threads
+    (default: one per core, capped by file count) with file-ordered
+    interning, so the result is byte-identical at any thread count —
+    the in-process analogue of the reference parsing its segment across
+    the cluster (Sparky.java:61).
     """
     lib = get_lib()
     if lib is None:
@@ -198,16 +218,33 @@ def crawl_load(paths, kind: str, strict: bool = True):
     kind_code = (
         _CRAWL_KIND_SEQFILE if kind == "seqfile" else _CRAWL_KIND_TSV
     )
+    paths = list(paths)
+    if threads is None:
+        threads = min(len(paths), os.cpu_count() or 1)
+    threads = max(int(threads), 1)
+    # Feed the C++ side window-sized batches (matching its internal
+    # in-flight window) so peak RSS holds one window of file bytes, not
+    # the whole segment; serial stays one-file-at-a-time.
+    window = max(2 * threads, 1)
     h = lib.crawl_new()
     try:
-        for path in paths:
-            with fsio.fopen(path, "rb") as f:
-                data = f.read()
-            cat = lib.crawl_ingest_file(h, data, len(data), kind_code,
-                                        1 if strict else 0)
+        for w0 in range(0, len(paths), window):
+            batch = paths[w0:w0 + window]
+            datas = []
+            for path in batch:
+                with fsio.fopen(path, "rb") as f:
+                    datas.append(f.read())
+            arr = (ctypes.c_char_p * len(datas))(*datas)
+            lens = (ctypes.c_int64 * len(datas))(*[len(d) for d in datas])
+            cat = lib.crawl_ingest_files(
+                h, len(datas), arr, lens, kind_code, 1 if strict else 0,
+                threads,
+            )
             if cat != 0:
                 msg = (lib.crawl_error(h) or b"").decode("utf-8", "replace")
-                _crawl_raise(cat, msg, path)
+                bad = lib.crawl_failed_index(h)
+                culprit = batch[bad] if 0 <= bad < len(batch) else batch[0]
+                _crawl_raise(cat, msg, culprit)
         n = lib.crawl_num_vertices(h)
         e = lib.crawl_num_edges(h)
         src = np.empty(max(e, 1), np.int32)

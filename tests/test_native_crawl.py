@@ -462,6 +462,111 @@ def test_explicit_workers_selects_python_pool(tmp_path, monkeypatch):
     assert im.names == ["http://a/", "http://b/"]
 
 
+def test_mutation_fuzz_parity(tmp_path):
+    """Random byte mutations of valid records: the canonical-JSON fuzz
+    above never exercises malformed documents, so corrupt the text and
+    require both paths to agree — same graph in non-strict mode, same
+    exception class in strict mode."""
+    rng = np.random.default_rng(23)
+    base = ('{"content": {"links": [{"type": "a", "href": "http://t1/"}, '
+            '{"type": "a", "href": "http://t2/\\u00e9"}, '
+            '{"type": "b", "href": 3.5}]}}')
+    alphabet = list('{}[]",:\\ au0xe9' + "\x01\x1f")
+    for trial in range(120):
+        doc = list(base)
+        for _ in range(int(rng.integers(1, 4))):
+            pos = int(rng.integers(0, len(doc)))
+            op = rng.integers(0, 3)
+            if op == 0:
+                doc[pos] = alphabet[int(rng.integers(0, len(alphabet)))]
+            elif op == 1:
+                doc.insert(pos, alphabet[int(rng.integers(0, len(alphabet)))])
+            else:
+                del doc[pos]
+        mutated = "".join(doc)
+        records = [("http://ok/", meta(["http://x/"])),
+                   ("http://mut/", mutated)]
+        p = str(tmp_path / f"seg{trial}")
+        write_sequence_file(p, records)
+        # non-strict: identical graphs
+        py = load_crawl_seqfile(p, strict=False, native="off")
+        nat = load_crawl_seqfile(p, strict=False, native="auto")
+        assert_same(py, nat)
+        # strict: same outcome (success with identical graphs, or the
+        # same exception class)
+        try:
+            py_s = load_crawl_seqfile(p, strict=True, native="off")
+            py_exc = None
+        except Exception as e:  # noqa: BLE001 - class parity is the point
+            py_s, py_exc = None, type(e)
+        try:
+            nat_s = load_crawl_seqfile(p, strict=True, native="auto")
+            nat_exc = None
+        except Exception as e:  # noqa: BLE001
+            nat_s, nat_exc = None, type(e)
+        assert py_exc == nat_exc, (mutated, py_exc, nat_exc)
+        if py_exc is None:
+            assert_same(py_s, nat_s)
+
+
+def test_threaded_ingest_order_identity(tmp_path):
+    """crawl_load with C++ worker threads must produce byte-identical
+    ids/edges to the serial path at any thread count (file-ordered
+    interning — the same contract the Python process pool keeps)."""
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    rng = np.random.default_rng(17)
+    for i in range(11):  # odd count: exercises partial windows
+        records = []
+        for _ in range(25):
+            targets = [f"http://t{rng.integers(0, 90)}/"
+                       for _ in range(rng.integers(0, 6))]
+            records.append(
+                (f"http://u{rng.integers(0, 50)}/", meta(targets)))
+        write_sequence_file(str(seg / f"metadata-{i:05d}"), records,
+                            compression="block")
+    paths = [str(seg / f"metadata-{i:05d}") for i in range(11)]
+    g1, im1 = native.crawl_load(paths, "seqfile", threads=1)
+    for nthreads in (2, 4, 16):
+        g2, im2 = native.crawl_load(paths, "seqfile", threads=nthreads)
+        assert im1.names == im2.names
+        np.testing.assert_array_equal(g1.src, g2.src)
+        np.testing.assert_array_equal(g1.dst, g2.dst)
+        np.testing.assert_array_equal(g1.dangling_mask, g2.dangling_mask)
+    # and identical to the pure-Python path
+    py_g, py_im = load_crawl_seqfile(str(seg), native="off")
+    assert py_im.names == im1.names
+    np.testing.assert_array_equal(py_g.src, g1.src)
+
+
+def test_threaded_ingest_earliest_error_wins(tmp_path):
+    """With threads, a strict error must surface from the EARLIEST
+    failing file in input order (serial-walk semantics), not whichever
+    worker fails first."""
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    for i in range(8):
+        if i == 3:
+            recs = [("http://bad3/", "{broken")]
+        elif i == 6:
+            recs = [("http://bad6/", '{"content": {"links": [{"href": "x"}]}}')]
+        else:
+            recs = [(f"http://ok{i}/", meta(["http://t/"]))]
+        write_sequence_file(str(seg / f"metadata-{i:05d}"), recs)
+    paths = [str(seg / f"metadata-{i:05d}") for i in range(8)]
+    # file 3 (JSONDecodeError) must win over file 6 (KeyError), and the
+    # error must name the culprit file, not the batch
+    with pytest.raises(json.JSONDecodeError, match="metadata-00003"):
+        native.crawl_load(paths, "seqfile", strict=True, threads=4)
+    with pytest.raises(json.JSONDecodeError, match="metadata-00003"):
+        native.crawl_load(paths, "seqfile", strict=True, threads=1)
+    # non-strict: bad3's record is kept with no targets; bad6 still
+    # raises KeyError?  No — non-strict skips entries, so it loads.
+    g, im = native.crawl_load(paths, "seqfile", strict=False, threads=4)
+    py = load_crawl_seqfile(str(seg), strict=False, native="off")
+    assert im.names == py[1].names
+
+
 def test_cli_uses_native_path(tmp_path, capsys):
     """The CLI seqfile route goes through load_crawl_seqfile, which now
     prefers the native parser — end result identical either way."""
