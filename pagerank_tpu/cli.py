@@ -137,6 +137,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="force the pure-Python crawl/SequenceFile parser instead "
         "of the native C++ L1 (native/crawl_ingest.cpp)",
     )
+    p.add_argument(
+        "--no-compile-cache", action="store_true",
+        help="don't persist XLA executables across runs "
+        "(utils/compile_cache; default: cache under the checkout's "
+        ".jax_cache or ~/.cache/pagerank_tpu)",
+    )
     ppr = p.add_argument_group("personalized PageRank (batched SpMM)")
     ppr.add_argument(
         "--ppr-sources",
@@ -408,6 +414,15 @@ def load_graph(args):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.engine == "jax" and not args.no_compile_cache:
+        # Persist XLA executables across CLI runs: the engine-setup
+        # chain is ~50 small jitted programs (and the device build ~50
+        # more), each ~0.6s through a tunneled remote-compile service —
+        # warm runs then spend seconds, not minutes, before iterating
+        # (bench.py does the same — utils/compile_cache docstring).
+        from pagerank_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
     if args.device_build:
         if args.engine != "jax":
             print("--device-build requires --engine jax", file=sys.stderr)
@@ -417,12 +432,6 @@ def main(argv=None) -> int:
                   "(the PPR engine builds from a host graph)",
                   file=sys.stderr)
             return 2
-        # The device build issues ~50 small jitted programs; persist
-        # their executables so warm builds take seconds, not minutes
-        # (bench.py does the same — utils/compile_cache docstring).
-        from pagerank_tpu.utils.compile_cache import enable_compile_cache
-
-        enable_compile_cache()
     if args.fused:
         # Pure-args validation BEFORE the (potentially minutes-long)
         # graph load and engine build. (--tol IS fused-compatible: the

@@ -222,29 +222,55 @@ def crawl_load(paths, kind: str, strict: bool = True,
     if threads is None:
         threads = min(len(paths), os.cpu_count() or 1)
     threads = max(int(threads), 1)
-    # Feed the C++ side window-sized batches (matching its internal
-    # in-flight window) so peak RSS holds one window of file bytes, not
-    # the whole segment; serial stays one-file-at-a-time.
+    # Feed the C++ side bounded batches: at most 2*threads files AND at
+    # most ~256 MB of raw bytes per batch (the file-count bound alone
+    # would scale peak RSS with the core count), with the NEXT batch
+    # read on a prefetch thread while the native call parses the
+    # current one (ctypes releases the GIL, so reads overlap parse —
+    # this matters for s3://-backed segments where read latency is
+    # network-bound).
+    import concurrent.futures
+
     window = max(2 * threads, 1)
+    byte_cap = 256 << 20
+
+    def read_batches():
+        batch_paths, datas, nbytes = [], [], 0
+        for path in paths:
+            with fsio.fopen(path, "rb") as f:
+                data = f.read()
+            batch_paths.append(path)
+            datas.append(data)
+            nbytes += len(data)
+            if len(datas) >= window or nbytes >= byte_cap:
+                yield batch_paths, datas
+                batch_paths, datas, nbytes = [], [], 0
+        if datas:
+            yield batch_paths, datas
+
     h = lib.crawl_new()
     try:
-        for w0 in range(0, len(paths), window):
-            batch = paths[w0:w0 + window]
-            datas = []
-            for path in batch:
-                with fsio.fopen(path, "rb") as f:
-                    datas.append(f.read())
-            arr = (ctypes.c_char_p * len(datas))(*datas)
-            lens = (ctypes.c_int64 * len(datas))(*[len(d) for d in datas])
-            cat = lib.crawl_ingest_files(
-                h, len(datas), arr, lens, kind_code, 1 if strict else 0,
-                threads,
-            )
-            if cat != 0:
-                msg = (lib.crawl_error(h) or b"").decode("utf-8", "replace")
-                bad = lib.crawl_failed_index(h)
-                culprit = batch[bad] if 0 <= bad < len(batch) else batch[0]
-                _crawl_raise(cat, msg, culprit)
+        gen = read_batches()
+        with concurrent.futures.ThreadPoolExecutor(1) as prefetch:
+            fut = prefetch.submit(next, gen, None)
+            while True:
+                item = fut.result()
+                if item is None:
+                    break
+                fut = prefetch.submit(next, gen, None)
+                batch, datas = item
+                arr = (ctypes.c_char_p * len(datas))(*datas)
+                lens = (ctypes.c_int64 * len(datas))(*[len(d) for d in datas])
+                cat = lib.crawl_ingest_files(
+                    h, len(datas), arr, lens, kind_code,
+                    1 if strict else 0, threads,
+                )
+                if cat != 0:
+                    msg = (lib.crawl_error(h) or b"").decode(
+                        "utf-8", "replace")
+                    bad = lib.crawl_failed_index(h)
+                    culprit = batch[bad] if 0 <= bad < len(batch) else batch[0]
+                    _crawl_raise(cat, msg, culprit)
         n = lib.crawl_num_vertices(h)
         e = lib.crawl_num_edges(h)
         src = np.empty(max(e, 1), np.int32)

@@ -5,8 +5,8 @@ each ~0.6s to compile through the remote-compile service on a tunneled
 TPU but far below the 1s default persistence threshold; caching them
 cuts a warm scale-21 device build from ~49s to ~10s (measured v5e).
 Off by default for library users (a global config flip is the caller's
-call); bench.py always enables it, the CLI enables it for
---device-build runs where the compile chain dominates load time.
+call); bench.py always enables it, and the CLI enables it for every
+jax-engine run (opt out with --no-compile-cache).
 """
 
 from __future__ import annotations
