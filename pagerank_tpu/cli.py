@@ -57,9 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
         "bench's fast path: over a tunneled TPU the host->device "
         "transfer of packed arrays dominates wall-clock, so --synthetic "
         "ships only a PRNG seed and integer edge inputs (npz/edgelist) "
-        "ship 8 bytes/edge instead of the packed layout. Requires "
-        "--engine jax; url-keyed formats (crawl/seqfile) are host-side "
-        "by nature and are rejected. Snapshots taken with --device-build "
+        "ship 8 bytes/edge instead of the packed layout. Crawl/seqfile "
+        "inputs work too: ids are assigned host-side (the url->int map "
+        "is inherently host work), then the dedup/sort/pack runs on "
+        "device with the reference's uncrawled-targets dangling mask. "
+        "Requires --engine jax. Snapshots taken with --device-build "
         "resume only with --device-build (different fingerprint "
         "derivation)",
     )
@@ -288,12 +290,14 @@ def run_ppr(args, graph, ids) -> int:
     return 0
 
 
-def _device_build_graph(args, src, dst, n):
+def _device_build_graph(args, src, dst, n, dangling_mask=None):
     """Pack raw (src, dst) edges on device with the SAME layout planner
     the bench uses (ops/device_build.plan_build), so product users get
     the build performance the bench measures (VERDICT r2 #3). ``src``/
     ``dst`` may be host numpy (uploaded raw: 8 bytes/edge) or already
-    device arrays (synthetic rmat: only a seed crossed the link)."""
+    device arrays (synthetic rmat: only a seed crossed the link).
+    ``dangling_mask`` carries crawl inputs' uncrawled-targets-only
+    dangling semantics into the device build (SURVEY.md §2a.3)."""
     from pagerank_tpu.ops import device_build as db
 
     plan_cfg = PageRankConfig(
@@ -305,6 +309,7 @@ def _device_build_graph(args, src, dst, n):
     return db.build_ell_device(
         src, dst, n=n, group=grp, stripe_size=stripe,
         with_weights=False,  # presentinel: no per-slot weight plane
+        dangling_mask=dangling_mask,
     )
 
 
@@ -377,14 +382,17 @@ def load_graph(args):
                 if len(tokens) == 2 and all(t.lstrip("-").isdigit() for t in tokens)
                 else "crawl"
             )
-    if fmt in ("seqfile", "crawl") and args.device_build:
-        raise SystemExit(
-            f"--device-build: {fmt} inputs are url-keyed (host-side id "
-            f"assignment); it applies to --synthetic and integer edge "
-            f"inputs (npz/edgelist)"
-        )
     native = "off" if args.no_native_ingest else "auto"
     if fmt == "seqfile":
+        if args.device_build:
+            from pagerank_tpu.ingest import load_crawl_seqfile_arrays
+
+            src, dst, crawled, ids = load_crawl_seqfile_arrays(
+                path, strict=args.strict_parse, workers=args.ingest_workers,
+                native=native,
+            )
+            return _device_build_graph(args, src, dst, len(ids),
+                                       dangling_mask=~crawled), ids
         from pagerank_tpu.ingest import load_crawl_seqfile
 
         graph, ids = load_crawl_seqfile(
@@ -393,6 +401,13 @@ def load_graph(args):
         )
         return graph, ids
     if fmt == "crawl":
+        if args.device_build:
+            from pagerank_tpu.ingest import load_crawl_file_arrays
+
+            src, dst, crawled, ids = load_crawl_file_arrays(
+                path, strict=args.strict_parse, native=native)
+            return _device_build_graph(args, src, dst, len(ids),
+                                       dangling_mask=~crawled), ids
         from pagerank_tpu.ingest import load_crawl_file
 
         graph, ids = load_crawl_file(path, strict=args.strict_parse,

@@ -68,13 +68,18 @@ class Graph:
 
     def fingerprint(self) -> str:
         """Stable hash of the graph structure, used to validate that a
-        checkpoint being resumed matches the graph (utils/snapshot.py)."""
+        checkpoint being resumed matches the graph (utils/snapshot.py).
+        Includes the dangling mask: for crawl inputs it is a semantic
+        input in its own right (uncrawled targets, SURVEY §2a.3), so
+        the same edges with different crawled status must not accept
+        each other's snapshots."""
         import hashlib
 
         h = hashlib.sha256()
         h.update(np.int64(self.n).tobytes())
         h.update(self.src.tobytes())
         h.update(self.dst.tobytes())
+        h.update(np.packbits(self.dangling_mask).tobytes())
         return h.hexdigest()[:16]
 
 

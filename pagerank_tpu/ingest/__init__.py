@@ -1,25 +1,33 @@
-from pagerank_tpu.ingest.ids import IdMap, records_to_graph
+from pagerank_tpu.ingest.ids import IdMap, records_to_arrays, records_to_graph
 from pagerank_tpu.ingest.edgelist import (
     load_edgelist,
     load_binary_edges,
     save_binary_edges,
 )
-from pagerank_tpu.ingest.crawljson import parse_metadata_record, load_crawl_file
+from pagerank_tpu.ingest.crawljson import (
+    load_crawl_file,
+    load_crawl_file_arrays,
+    parse_metadata_record,
+)
 from pagerank_tpu.ingest.seqfile import (
     load_crawl_seqfile,
+    load_crawl_seqfile_arrays,
     read_sequence_file,
     write_sequence_file,
 )
 
 __all__ = [
     "IdMap",
+    "records_to_arrays",
     "records_to_graph",
     "load_edgelist",
     "load_binary_edges",
     "save_binary_edges",
     "parse_metadata_record",
     "load_crawl_file",
+    "load_crawl_file_arrays",
     "load_crawl_seqfile",
+    "load_crawl_seqfile_arrays",
     "read_sequence_file",
     "write_sequence_file",
 ]

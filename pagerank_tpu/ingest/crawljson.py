@@ -93,15 +93,26 @@ def load_crawl_file(path: str, strict: bool = True, native: str = "auto"):
     ``native="auto"`` uses the C++ L1 (ingest/native.py:crawl_load) when
     available; output parity with this Python path is pinned by
     tests/test_native_crawl.py."""
+    return _load_crawl_file(path, strict, native, raw=False)
+
+
+def load_crawl_file_arrays(path: str, strict: bool = True,
+                           native: str = "auto"):
+    """Like :func:`load_crawl_file` but stops before the host graph
+    build: raw ``(src, dst, crawled_mask, IdMap)`` for the on-device
+    build (`--device-build` on crawl inputs)."""
+    return _load_crawl_file(path, strict, native, raw=True)
+
+
+def _load_crawl_file(path, strict, native, raw):
     if native == "auto":
         from pagerank_tpu.ingest import native as native_mod
 
-        try:
-            result = native_mod.crawl_load([path], "tsv", strict=strict)
-        except native_mod.NativeUnsupported:
-            result = None  # e.g. non-string JSONL url: Python handles it
+        result = native_mod.try_crawl_load([path], "tsv", strict=strict,
+                                           raw=raw)
         if result is not None:
             return result
-    from pagerank_tpu.ingest.ids import records_to_graph
+    from pagerank_tpu.ingest.ids import records_to_arrays, records_to_graph
 
-    return records_to_graph(iter_crawl_records(path, strict=strict))
+    records = iter_crawl_records(path, strict=strict)
+    return records_to_arrays(records) if raw else records_to_graph(records)

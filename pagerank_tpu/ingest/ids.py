@@ -53,6 +53,36 @@ class IdMap:
         return m
 
 
+def records_to_arrays(
+    records: Iterable[Tuple[str, List[str]]],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, IdMap]:
+    """Crawl records -> raw (src, dst, crawled_mask, ids) arrays —
+    the id-assignment half of :func:`records_to_graph`, exposed so the
+    on-device build can consume integer edges directly."""
+    ids = IdMap()
+    src: List[int] = []
+    dst: List[int] = []
+    crawled: List[int] = []
+    for url, targets in records:
+        u = ids.get_or_add(url)
+        crawled.append(u)
+        for t in targets:
+            src.append(u)
+            dst.append(ids.get_or_add(t))
+    n = len(ids)
+    crawled_mask = np.zeros(n, dtype=bool)
+    if crawled:
+        crawled_mask[np.asarray(crawled)] = True
+    # int32: ids are int32 by construction (IdMap), and the device-build
+    # path ships these over the host->device link — 8 bytes/edge.
+    return (
+        np.asarray(src, dtype=np.int32),
+        np.asarray(dst, dtype=np.int32),
+        crawled_mask,
+        ids,
+    )
+
+
 def records_to_graph(
     records: Iterable[Tuple[str, List[str]]],
 ) -> Tuple[Graph, IdMap]:
@@ -69,24 +99,11 @@ def records_to_graph(
     its lookup value is a non-null Iterable([null]), so the repair pass
     removes it (see graph.py module docstring).
     """
-    ids = IdMap()
-    src: List[int] = []
-    dst: List[int] = []
-    crawled: List[int] = []
-    for url, targets in records:
-        u = ids.get_or_add(url)
-        crawled.append(u)
-        for t in targets:
-            src.append(u)
-            dst.append(ids.get_or_add(t))
-    n = len(ids)
-    crawled_mask = np.zeros(n, dtype=bool)
-    if crawled:
-        crawled_mask[np.asarray(crawled)] = True
+    src, dst, crawled_mask, ids = records_to_arrays(records)
     graph = build_graph(
-        np.asarray(src, dtype=np.int64),
-        np.asarray(dst, dtype=np.int64),
-        n=n,
+        src,
+        dst,
+        n=len(ids),
         dangling_mask=~crawled_mask,
         vertex_names=ids.names,
     )

@@ -192,8 +192,21 @@ def _crawl_raise(cat: int, msg: str, path: str):
     raise ValueError(f"{path}: {msg}")
 
 
+def try_crawl_load(paths, kind: str, strict: bool = True,
+                   threads: Optional[int] = None, raw: bool = False):
+    """:func:`crawl_load` with the standard fallback gating applied:
+    returns None when the native library is unavailable OR the input is
+    valid-but-unrepresentable (NativeUnsupported) — callers then take
+    the Python path. One copy of the rule for every loader."""
+    try:
+        return crawl_load(paths, kind, strict=strict, threads=threads,
+                          raw=raw)
+    except NativeUnsupported:
+        return None
+
+
 def crawl_load(paths, kind: str, strict: bool = True,
-               threads: Optional[int] = None):
+               threads: Optional[int] = None, raw: bool = False):
     """Native L1: parse crawl inputs (``kind`` = "seqfile" or "tsv") into
     a (Graph, IdMap) with the exact record/id order and quirk semantics
     of the Python path (crawljson.py + seqfile.py — differentially
@@ -207,6 +220,11 @@ def crawl_load(paths, kind: str, strict: bool = True,
     interning, so the result is byte-identical at any thread count —
     the in-process analogue of the reference parsing its segment across
     the cluster (Sparky.java:61).
+
+    ``raw=True`` skips the host graph build and returns
+    ``(src, dst, crawled_mask, IdMap)`` int32/bool arrays — what the
+    on-device build consumes (the dedup/sort/pack then runs on the TPU,
+    ops/device_build.build_ell_device).
     """
     lib = get_lib()
     if lib is None:
@@ -283,15 +301,19 @@ def crawl_load(paths, kind: str, strict: bool = True,
         blob = ctypes.create_string_buffer(max(blob_size, 1))
         offsets = np.empty(n + 1, np.int64)
         lib.crawl_copy_names(h, blob, offsets)
-        raw = blob.raw[:blob_size]
+        blob_bytes = blob.raw[:blob_size]
         # surrogatepass: lone surrogates from \uXXXX escapes round-trip
         # (the C side stores them WTF-8, matching Python str contents).
         names = [
-            raw[offsets[i]:offsets[i + 1]].decode("utf-8", "surrogatepass")
+            blob_bytes[offsets[i]:offsets[i + 1]].decode("utf-8",
+                                                         "surrogatepass")
             for i in range(n)
         ]
     finally:
         lib.crawl_free(h)
+    if raw:
+        return (src[:e], dst[:e], crawled[:n].astype(bool),
+                IdMap.from_names(names))
     graph = build_graph(
         src[:e], dst[:e], n=n,
         dangling_mask=~crawled[:n].astype(bool),

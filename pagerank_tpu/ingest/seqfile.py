@@ -372,19 +372,36 @@ def load_crawl_seqfile(
     forces the Python path, where multi-file segments parse in parallel
     (see :func:`iter_segment_records`).
     """
+    return _load_crawl_seqfile(spec, strict, workers, native, raw=False)
+
+
+def load_crawl_seqfile_arrays(
+    spec: str, strict: bool = True, workers: Optional[int] = None,
+    native: str = "auto",
+):
+    """Like :func:`load_crawl_seqfile` but stops before the host graph
+    build: returns raw ``(src, dst, crawled_mask, IdMap)`` integer
+    arrays for the on-device build (`--device-build` on crawl inputs —
+    the dedup/sort/pack then runs on the TPU)."""
+    return _load_crawl_seqfile(spec, strict, workers, native, raw=True)
+
+
+def _load_crawl_seqfile(spec, strict, workers, native, raw):
+    """Shared native-try/Python-fallback gating for both return forms —
+    one copy of the rules (auto + no explicit workers -> native;
+    NativeUnsupported or no library -> Python path)."""
     paths = expand_seqfile_paths(spec)
     if native == "auto" and workers is None:
         from pagerank_tpu.ingest import native as native_mod
 
-        try:
-            result = native_mod.crawl_load(paths, "seqfile", strict=strict)
-        except native_mod.NativeUnsupported:
-            result = None  # valid input the interner can't represent
+        result = native_mod.try_crawl_load(paths, "seqfile", strict=strict,
+                                           raw=raw)
         if result is not None:
             return result
-    from pagerank_tpu.ingest.ids import records_to_graph
+    from pagerank_tpu.ingest.ids import records_to_arrays, records_to_graph
 
-    return records_to_graph(iter_segment_records(paths, strict, workers))
+    records = iter_segment_records(paths, strict, workers)
+    return records_to_arrays(records) if raw else records_to_graph(records)
 
 
 # -- writing (tests + interop) -------------------------------------------

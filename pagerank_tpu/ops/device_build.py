@@ -126,8 +126,13 @@ class DeviceEllGraph:
         if self._fp is not None:
             return self._fp
 
+        # The dangling mask is an independent semantic input since the
+        # crawl override landed (it is no longer derivable from
+        # out_degree): two graphs with identical edges but different
+        # crawled status must NOT accept each other's snapshots.
         parts = [_u32sum(self.out_degree), _mixsum(self.out_degree),
-                 _mixsum(self.perm)]
+                 _mixsum(self.perm),
+                 _mixsum(self.dangling_mask.astype(jnp.int32))]
         srcs = self.src if isinstance(self.src, (list, tuple)) else [self.src]
         rbs = (self.row_block
                if isinstance(self.row_block, (list, tuple))
@@ -415,6 +420,7 @@ def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
 def build_ell_device(
     src: jax.Array, dst: jax.Array, n: int, weight_dtype=jnp.float32,
     group: int = 1, stripe_size: int = 0, with_weights: bool = True,
+    dangling_mask=None,
 ) -> DeviceEllGraph:
     """Full graph build on device from raw (possibly duplicated) edges.
 
@@ -435,6 +441,13 @@ def build_ell_device(
     500M+ edges every per-edge buffer matters); don't reuse them after.
     On backends without donation support this emits a harmless
     "donated buffers were not usable" warning.
+
+    ``dangling_mask`` (bool [n], original id space, host or device)
+    overrides the default ``out_degree == 0`` mass mask — crawl inputs
+    need the reference's post-repair semantics, where only UNCRAWLED
+    targets carry dangling mass and a crawled page with no anchor
+    links does not (SURVEY.md §2a.3; graph.py carries the same
+    override for host builds).
     """
     if group < 1 or group > LANES or (group & (group - 1)):
         raise ValueError(f"group must be a power of two in [1, {LANES}]")
@@ -480,7 +493,8 @@ def build_ell_device(
             n=n, n_padded=n_padded, num_blocks=num_blocks,
             src=empty, weight=empty_w, row_block=empty_rb,
             perm=jnp.arange(n, dtype=jnp.int32),
-            dangling_mask=jnp.ones(n, bool),
+            dangling_mask=(jnp.ones(n, bool) if dangling_mask is None
+                           else jnp.asarray(dangling_mask, bool)),
             zero_in_mask=jnp.ones(n, bool),
             out_degree=jnp.zeros(n, jnp.int32),
             num_edges=0, group=group, stripe_size=stripe_size,
@@ -489,7 +503,8 @@ def build_ell_device(
 
     src_s, dst_s, unique, out_degree, in_degree = _sort_dedup_degrees(src, dst, n)
     num_edges = int(jax.device_get(unique.sum()))
-    mass_mask = out_degree == 0
+    mass_mask = (out_degree == 0 if dangling_mask is None
+                 else jnp.asarray(dangling_mask, bool))
     zero_in = in_degree == 0
     stripe_arg = sz if n_stripes > 1 else 0
     sb_dst, new_src, perm = _relabel_resort(

@@ -138,18 +138,58 @@ def test_cli_device_build_synthetic_snapshot_resume(tmp_path):
 
 
 def test_cli_device_build_rejections(tmp_path):
-    # url-keyed formats are host-side by nature
-    meta = json.dumps({"content": {"links": [{"href": "http://b", "type": "a"}]}})
-    p = tmp_path / "crawl.tsv"
-    p.write_text(f"http://a\t{meta}\nhttp://b\t{json.dumps({})}\n")
-    with pytest.raises(SystemExit, match="device-build"):
-        main(["--input", str(p), "--device-build", "--log-every", "0"])
     # cpu engine has no device path
     assert main(["--synthetic", "rmat:6", "--device-build",
                  "--engine", "cpu"]) == 2
     # PPR builds from a host graph
     assert main(["--synthetic", "rmat:6", "--device-build",
                  "--ppr-sources", "0,1"]) == 2
+
+
+def test_cli_device_build_crawl_matches_host(tmp_path):
+    """Crawl/seqfile inputs compose with --device-build: host-side id
+    assignment, on-device dedup/sort/pack with the reference's
+    uncrawled-targets dangling mask (NOT out_degree==0 — http://c is
+    crawled and linkless, so it must carry no dangling mass), names
+    preserved in the output."""
+    from pagerank_tpu.ingest import write_sequence_file
+
+    def meta(targets):
+        return json.dumps(
+            {"content": {"links": [{"type": "a", "href": t} for t in targets]}}
+        )
+
+    records = [
+        ("http://a/", meta(["http://b/", "http://d/", "http://b/"])),
+        ("http://b/", meta(["http://a/", "http://c/"])),
+        ("http://c/", meta([])),  # crawled, linkless: NOT dangling
+        # http://d/ never crawled: dangling
+    ]
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    write_sequence_file(str(seg / "metadata-00000"), records[:2])
+    write_sequence_file(str(seg / "metadata-00001"), records[2:])
+    outs = []
+    for extra in ([], ["--device-build"]):
+        out = str(tmp_path / f"r{len(outs)}.tsv")
+        assert main(["--input", str(seg), "--iters", "6", "--out", out,
+                     "--log-every", "0"] + extra) == 0
+        with open(out) as f:
+            outs.append(dict(line.split("\t") for line in f))
+    assert set(outs[0]) == set(outs[1]) == {
+        "http://a/", "http://b/", "http://c/", "http://d/"}
+    for k in outs[0]:
+        assert abs(float(outs[0][k]) - float(outs[1][k])) < 1e-5, k
+    # TSV crawl files route the same way
+    p = tmp_path / "crawl.tsv"
+    p.write_text("".join(f"{u}\t{m}\n" for u, m in records))
+    out = str(tmp_path / "tsv.tsv")
+    assert main(["--input", str(p), "--iters", "6", "--out", out,
+                 "--device-build", "--log-every", "0"]) == 0
+    with open(out) as f:
+        tsv_ranks = dict(line.split("\t") for line in f)
+    for k in outs[0]:
+        assert abs(float(outs[0][k]) - float(tsv_ranks[k])) < 1e-5, k
 
 
 def test_cli_snapshot_resume(tmp_path, edges_file):

@@ -63,6 +63,37 @@ def test_engine_from_device_build_matches_oracle(semantics):
     np.testing.assert_allclose(r_dev, r_cpu, rtol=0, atol=1e-12)
 
 
+def test_device_build_dangling_mask_override():
+    """Crawl semantics on the device build: the dangling mask override
+    (uncrawled targets only, SURVEY §2a.3) must reach the engine and
+    change the result exactly as the host build's override does —
+    including a vertex with out_degree == 0 that is NOT dangling."""
+    rng = np.random.default_rng(5)
+    n, e = 130, 700
+    src = rng.integers(0, n // 2, e).astype(np.int32)  # upper half: sinks
+    dst = rng.integers(0, n, e).astype(np.int32)
+    crawled = np.zeros(n, bool)
+    crawled[: n // 2 + 7] = True  # some sinks crawled-but-linkless
+    dangling = ~crawled
+
+    cfg = PageRankConfig(num_iters=10, dtype="float64",
+                         accum_dtype="float64", num_devices=1)
+    dg = db.build_ell_device(src, dst, n, weight_dtype=np.float64,
+                             dangling_mask=dangling)
+    r_dev = JaxTpuEngine(cfg).build_device(dg).run()
+
+    g = build_graph(src, dst, n=n, dangling_mask=dangling)
+    r_host = JaxTpuEngine(cfg).build(g).run()
+    r_cpu = ReferenceCpuEngine(cfg).build(g).run()
+    np.testing.assert_allclose(r_dev, r_host, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(r_dev, r_cpu, rtol=0, atol=1e-12)
+    # the default mask differs (out_degree==0 would include the crawled
+    # linkless sinks) — guard that the override actually changed it
+    dg_default = db.build_ell_device(src, dst, n, weight_dtype=np.float64)
+    r_default = JaxTpuEngine(cfg).build_device(dg_default).run()
+    assert np.abs(r_default - r_dev).max() > 1e-6
+
+
 def test_device_build_sharded_runs():
     rng = np.random.default_rng(5)
     n, e = 512, 4000
@@ -215,7 +246,9 @@ def test_device_fingerprint_stable_and_discriminating():
     and differ for a different graph."""
     rng = np.random.default_rng(5)
     n, e = 300, 2000
-    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    # sources drawn below n-20: the top vertices are guaranteed sinks
+    # (needed for the dangling-override case below)
+    src, dst = rng.integers(0, n - 20, e), rng.integers(0, n, e)
 
     def build(s, d):
         return db.build_ell_device(
@@ -237,3 +270,23 @@ def test_device_fingerprint_stable_and_discriminating():
         jax.numpy.asarray([0, 1]), jax.numpy.asarray([3, 2]), n=4
     ).fingerprint()
     assert a != b
+    # The dangling-mask override is a semantic input in its own right
+    # (crawl inputs: same edges, different crawled status) — snapshots
+    # must not cross-validate between them, on EITHER build path. A
+    # valid override is a SUBSET of the out-degree-0 vertices (a
+    # crawled linkless page is not dangling), so build one that drops
+    # half the default mask.
+    from pagerank_tpu import build_graph
+
+    hg1 = build_graph(src, dst, n=n)
+    sinks = np.flatnonzero(hg1.out_degree == 0)
+    assert len(sinks) >= 2, "test graph needs out-degree-0 vertices"
+    mask = np.zeros(n, bool)
+    mask[sinks[: len(sinks) // 2]] = True  # proper subset of the default
+    fp_mask = db.build_ell_device(
+        jax.numpy.asarray(src), jax.numpy.asarray(dst), n=n, group=4,
+        dangling_mask=mask,
+    ).fingerprint()
+    assert fp_mask != fp1
+    hg2 = build_graph(src, dst, n=n, dangling_mask=mask)
+    assert hg1.fingerprint() != hg2.fingerprint()
