@@ -69,17 +69,21 @@ class Graph:
     def fingerprint(self) -> str:
         """Stable hash of the graph structure, used to validate that a
         checkpoint being resumed matches the graph (utils/snapshot.py).
-        Includes the dangling mask: for crawl inputs it is a semantic
-        input in its own right (uncrawled targets, SURVEY §2a.3), so
-        the same edges with different crawled status must not accept
-        each other's snapshots."""
+
+        The dangling mask is hashed ONLY when it differs from the
+        edge-derivable default (out_degree == 0): for crawl inputs the
+        mask is a semantic input in its own right (uncrawled targets,
+        SURVEY §2a.3) and identical edge sets must not cross-validate —
+        while edge-list graphs keep their pre-override fingerprints, so
+        existing snapshots still resume."""
         import hashlib
 
         h = hashlib.sha256()
         h.update(np.int64(self.n).tobytes())
         h.update(self.src.tobytes())
         h.update(self.dst.tobytes())
-        h.update(np.packbits(self.dangling_mask).tobytes())
+        if not np.array_equal(self.dangling_mask, self.out_degree == 0):
+            h.update(np.packbits(self.dangling_mask).tobytes())
         return h.hexdigest()[:16]
 
 

@@ -126,13 +126,16 @@ class DeviceEllGraph:
         if self._fp is not None:
             return self._fp
 
-        # The dangling mask is an independent semantic input since the
-        # crawl override landed (it is no longer derivable from
-        # out_degree): two graphs with identical edges but different
-        # crawled status must NOT accept each other's snapshots.
+        # The dangling mask joins the hash ONLY when it differs from
+        # the edge-derivable default (out_degree == 0) — the crawl
+        # override makes it an independent semantic input there, while
+        # default-mask builds keep pre-override fingerprints so their
+        # snapshots still resume (mirrors graph.Graph.fingerprint).
         parts = [_u32sum(self.out_degree), _mixsum(self.out_degree),
-                 _mixsum(self.perm),
-                 _mixsum(self.dangling_mask.astype(jnp.int32))]
+                 _mixsum(self.perm)]
+        if bool(jax.device_get(
+                jnp.any(self.dangling_mask != (self.out_degree == 0)))):
+            parts.append(_mixsum(self.dangling_mask.astype(jnp.int32)))
         srcs = self.src if isinstance(self.src, (list, tuple)) else [self.src]
         rbs = (self.row_block
                if isinstance(self.row_block, (list, tuple))
@@ -503,8 +506,15 @@ def build_ell_device(
 
     src_s, dst_s, unique, out_degree, in_degree = _sort_dedup_degrees(src, dst, n)
     num_edges = int(jax.device_get(unique.sum()))
-    mass_mask = (out_degree == 0 if dangling_mask is None
-                 else jnp.asarray(dangling_mask, bool))
+    if dangling_mask is None:
+        mass_mask = out_degree == 0
+    else:
+        mass_mask = jnp.asarray(dangling_mask, bool)
+        # Same invariant the host build enforces (graph.py): a vertex
+        # with out-edges cannot carry dangling mass — silently wrong
+        # ranks otherwise.
+        if bool(jax.device_get(jnp.any(mass_mask & (out_degree > 0)))):
+            raise ValueError("dangling_mask marks a vertex that has out-edges")
     zero_in = in_degree == 0
     stripe_arg = sz if n_stripes > 1 else 0
     sb_dst, new_src, perm = _relabel_resort(

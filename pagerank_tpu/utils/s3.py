@@ -129,6 +129,84 @@ def _split_uri(path: str) -> Tuple[str, str]:
     return bucket, key
 
 
+class _RangedReader(io.RawIOBase):
+    """Seekable read-only stream over one S3 object via Range requests.
+
+    Wrapped in an ``io.BufferedReader`` so sequential consumers fetch
+    ~1 MB chunks and whole-file ``read()`` collapses to a single
+    ranged GET (``readall``); seek+small-read consumers (zipfile /
+    np.load on snapshot .npz) fetch only the regions they touch.
+
+    ``head`` seeds the reader with the object's first bytes (the
+    open() probe request already fetched them). If the server ever
+    answers a range request with the FULL object (200: Range ignored),
+    the body is cached and all further reads are served locally — never
+    re-fetch a whole object per read call."""
+
+    def __init__(self, fs: "S3FileSystem", path: str, size: int,
+                 head: bytes = b""):
+        self._fs = fs
+        self._path = path
+        self._size = size
+        self._pos = 0
+        self._head = head
+        self._full: Optional[bytes] = None
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return True
+
+    def tell(self):
+        return self._pos
+
+    def seek(self, offset, whence=io.SEEK_SET):
+        if whence == io.SEEK_SET:
+            pos = offset
+        elif whence == io.SEEK_CUR:
+            pos = self._pos + offset
+        elif whence == io.SEEK_END:
+            pos = self._size + offset
+        else:
+            raise ValueError(f"bad whence {whence}")
+        if pos < 0:
+            raise OSError("negative seek position")
+        self._pos = pos
+        return self._pos
+
+    def _fetch(self, lo: int, hi: int) -> bytes:
+        """Bytes [lo, hi] from cache/head when possible, else one
+        ranged GET (head-overlapping reads fetch only the tail)."""
+        if self._full is not None:
+            return self._full[lo:hi + 1]
+        nh = len(self._head)
+        if hi < nh:
+            return self._head[lo:hi + 1]
+        prefix = self._head[lo:] if lo < nh else b""
+        data, entire = self._fs._get_range(self._path, max(lo, nh), hi)
+        if entire:  # server ignored Range: cache, serve locally forever
+            self._full = data
+            return data[lo:hi + 1]
+        return prefix + data if prefix else data
+
+    def readinto(self, b):
+        if self._pos >= self._size or not len(b):
+            return 0
+        n = min(len(b), self._size - self._pos)
+        data = self._fetch(self._pos, self._pos + n - 1)
+        b[: len(data)] = data
+        self._pos += len(data)
+        return len(data)
+
+    def readall(self):
+        if self._pos >= self._size:
+            return b""
+        data = self._fetch(self._pos, self._size - 1)
+        self._pos += len(data)
+        return data
+
+
 class S3FileSystem(fsio.FileSystem):
     """S3 REST client bound to one endpoint.
 
@@ -316,11 +394,58 @@ class S3FileSystem(fsio.FileSystem):
             self._raise(status, data, path)
         return data
 
+    def _get_range(self, path: str, lo: int, hi: int) -> Tuple[bytes, bool]:
+        """GET bytes [lo, hi] (inclusive) -> (data, entire): ``entire``
+        flags a server that ignored Range and sent the whole object
+        (200) — callers must then treat ``data`` as the full body."""
+        bucket, key = _split_uri(path)
+        status, _, data = self._request(
+            "GET", bucket, key,
+            extra_headers={"range": f"bytes={lo}-{hi}"},
+        )
+        if status == 206:
+            return data, False
+        if status == 200:
+            return data, True
+        self._raise(status, data, path)
+
+    #: Objects at or below this arrive whole in the open() probe GET;
+    #: larger ones read through a seekable ranged reader (zip-backed
+    #: formats — npz snapshots — then fetch only the members they touch
+    #: instead of the whole object).
+    STREAM_THRESHOLD = 8 * 1024 * 1024
+
     def open(self, path, mode="r", **kwargs):
         binary = "b" in mode
         kind = mode.replace("b", "").replace("t", "") or "r"
         if kind == "r":
-            raw: io.IOBase = io.BytesIO(self._get(path))
+            # ONE probe GET for the first STREAM_THRESHOLD bytes: small
+            # objects arrive complete (no HEAD round-trip — this is the
+            # hot path for multi-file segment ingest over s3://), large
+            # ones seed the ranged reader with their head + total size
+            # from Content-Range.
+            bucket, key = _split_uri(path)
+            status, headers, data = self._request(
+                "GET", bucket, key,
+                extra_headers={"range": f"bytes=0-{self.STREAM_THRESHOLD - 1}"},
+            )
+            if status == 200:  # Range ignored: whole object in hand
+                raw: io.IOBase = io.BytesIO(data)
+            elif status == 206:
+                total = None
+                crange = _header(headers, "content-range")
+                if crange and "/" in crange:
+                    tail = crange.rsplit("/", 1)[1]
+                    if tail.isdigit():
+                        total = int(tail)
+                if total is None or total <= len(data):
+                    raw = io.BytesIO(data)
+                else:
+                    raw = io.BufferedReader(
+                        _RangedReader(self, path, total, head=data), 1 << 20
+                    )
+            else:
+                self._raise(status, data, path)
         elif kind in ("w", "x", "a"):
             if kind == "x" and self.isfile(path):
                 raise FileExistsError(path)

@@ -26,6 +26,7 @@ class S3Stub:
         self.auth_headers = []  # recorded Authorization values (or None)
         self.max_page = 1000  # shrink in tests to force pagination
         self.uploads = {}  # upload_id -> {"path": str, "parts": {num: bytes}}
+        self.range_requests = []  # recorded Range header values
         self.completed_multiparts = []  # paths assembled via multipart
         self.fail_part = None  # part number to reject (fault injection)
         self._next_upload = 0
@@ -183,6 +184,23 @@ class S3Stub:
                     data = outer.objects.get(path)
                 if data is None:
                     self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
+                    return
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    outer.range_requests.append(rng)
+                    lo_s, _, hi_s = rng[6:].partition("-")
+                    lo = int(lo_s)
+                    hi = min(int(hi_s) if hi_s else len(data) - 1,
+                             len(data) - 1)
+                    body = data[lo:hi + 1]
+                    self.send_response(206)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header(
+                        "Content-Range", f"bytes {lo}-{hi}/{len(data)}")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 self._send(200, data, ctype="application/octet-stream")
 

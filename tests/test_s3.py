@@ -173,6 +173,55 @@ def test_s3_multipart_upload(s3fs):
     assert not stub.uploads
 
 
+def test_s3_streaming_ranged_reads(s3fs):
+    """Objects over STREAM_THRESHOLD read through a seekable ranged
+    reader: whole-file read() is ONE ranged GET, seek+partial reads
+    fetch only the touched regions — so np.load on a big .npz snapshot
+    pulls members, not the object (utils/s3._RangedReader)."""
+    import numpy as np
+
+    stub, fs = s3fs
+    fs.STREAM_THRESHOLD = 1024
+    data = bytes(range(256)) * 40  # 10240 B
+    with fsio.fopen("s3://b/big.bin", "wb") as f:
+        f.write(data)
+    stub.auth_headers.clear()
+    stub.range_requests.clear()
+    with fsio.fopen("s3://b/big.bin", "rb") as f:
+        assert f.read() == data
+    # probe GET (first 1 KB) + ONE tail GET, no HEAD round-trip
+    assert len(stub.range_requests) == 2
+    assert len(stub.auth_headers) == 2
+    # seek + partial read fetches only the touched regions
+    stub.range_requests.clear()
+    with fsio.fopen("s3://b/big.bin", "rb") as f:
+        f.seek(5000)
+        assert f.read(16) == data[5000:5016]
+        f.seek(-8, 2)
+        assert f.read() == data[-8:]
+        f.seek(100)  # BufferedReader readahead extends past the head,
+        assert f.read(8) == data[100:108]  # so this fetches the tail
+    assert len(stub.range_requests) == 4  # probe + three region fetches
+    # small objects arrive whole in the single probe request
+    with fsio.fopen("s3://b/small.bin", "wb") as f:
+        f.write(b"tiny")
+    stub.auth_headers.clear()
+    stub.range_requests.clear()
+    with fsio.fopen("s3://b/small.bin", "rb") as f:
+        assert f.read() == b"tiny"
+    assert len(stub.auth_headers) == 1  # exactly one request total
+    # a zip-backed consumer (np.load mirrors the snapshot format) only
+    # touches the central directory + the member it asks for
+    buf = fsio.fopen("s3://b/arr.npz", "wb")
+    np.savez(buf, a=np.arange(4000), b=np.zeros(4000))
+    buf.close()
+    stub.range_requests.clear()
+    with fsio.fopen("s3://b/arr.npz", "rb") as f:
+        loaded = np.load(f)
+        np.testing.assert_array_equal(loaded["a"], np.arange(4000))
+    assert stub.range_requests, "np.load did not stream"
+
+
 def test_s3_multipart_failure_aborts(s3fs):
     """A failed part PUT aborts the multipart upload (no orphan parts
     accruing storage server-side) and surfaces the error."""
