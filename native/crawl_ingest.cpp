@@ -1257,13 +1257,19 @@ bool ingest_seqfile(CrawlState& st, const uint8_t* data, int64_t len,
     return seq_fail(st, FORMAT, "not a SequenceFile (bad magic)");
   if (magic[3] != 6)
     return seq_fail(st, FORMAT, "unsupported SequenceFile version");
-  const uint8_t* cls;
-  int64_t cn;
+  // Read BOTH class names before validating either — the Python
+  // reader does (corrupt headers must fail at the same stage with the
+  // same exception class; the fuzz in tests/test_native_crawl.py
+  // caught the early-validation order).
+  const uint8_t* cls[2];
+  int64_t cn[2];
   for (int i = 0; i < 2; i++) {
-    TextRead rc = read_text_raw(s, &cls, &cn);
+    TextRead rc = read_text_raw(s, &cls[i], &cn[i]);
     if (rc != TEXT_OK) return text_fail(st, rc, "truncated header (class name)");
-    if ((size_t)cn != std::strlen(TEXT_CLASS) ||
-        std::memcmp(cls, TEXT_CLASS, (size_t)cn) != 0)
+  }
+  for (int i = 0; i < 2; i++) {
+    if ((size_t)cn[i] != std::strlen(TEXT_CLASS) ||
+        std::memcmp(cls[i], TEXT_CLASS, (size_t)cn[i]) != 0)
       return seq_fail(st, FORMAT, "expected Text/Text classes");
   }
   const uint8_t* flags;
@@ -1278,13 +1284,13 @@ bool ingest_seqfile(CrawlState& st, const uint8_t* data, int64_t len,
     if (rc != TEXT_OK) return text_fail(st, rc, "truncated header (codec)");
     if (!is_deflate_codec(codec, codn))
       return seq_fail(st, FORMAT, "unsupported codec");
-  } else if (block_compressed) {
-    return seq_fail(st, FORMAT, "block-compressed flag set without a codec");
   }
   int32_t n_meta;
   if (!read_i32(s, &n_meta))
     return seq_fail(st, EOF_, "truncated metadata count");
-  for (int32_t i = 0; i < n_meta * 2; i++) {
+  // 64-bit loop bound: a corrupt count near INT32_MAX must walk (and
+  // fail at EOF) like the Python reader, not overflow n_meta * 2.
+  for (int64_t i = 0; i < (int64_t)n_meta * 2; i++) {
     const uint8_t* m;
     int64_t mn;
     TextRead rc = read_text_raw(s, &m, &mn);
@@ -1296,6 +1302,13 @@ bool ingest_seqfile(CrawlState& st, const uint8_t* data, int64_t len,
 
   std::string kinf, vinf, klinf, vlinf, vrecinf;
   if (block_compressed) {
+    // Checked HERE, not at the flags: the Python reader only rejects a
+    // codec-less block file when it enters the block loop, after the
+    // metadata/sync parse — corrupt headers must fail at the same
+    // stage with the same class.
+    if (!compressed)
+      return seq_fail(st, FORMAT,
+                      "block-compressed flag set without a codec");
     while (s.left() > 0) {
       if (s.left() < 4) return true;  // clean EOF between blocks
       int32_t head;

@@ -102,14 +102,33 @@ def _read_i32(f, what: str) -> int:
     return struct.unpack(">i", data)[0]
 
 
+def _read_exact(f, n: int, what: str) -> bytes:
+    """Read exactly ``n`` bytes or raise EOFError — in bounded chunks,
+    so a corrupt length field (a flipped VInt/int32 can claim 2^60
+    bytes) fails with EOFError instead of a huge upfront allocation
+    blowing up as MemoryError (found by the native-vs-Python container
+    fuzz, tests/test_native_crawl.py)."""
+    if n < (1 << 24):
+        data = f.read(n)
+        if len(data) != n:
+            raise EOFError(f"EOF inside {what}")
+        return data
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = f.read(min(remaining, 1 << 24))
+        if not chunk:
+            raise EOFError(f"EOF inside {what}")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
 def _read_text(f) -> bytes:
     n = _read_vint(f)
     if n < 0:
         raise ValueError(f"negative Text length {n}")
-    data = f.read(n)
-    if len(data) != n:
-        raise EOFError("EOF inside Text payload")
-    return data
+    return _read_exact(f, n, "Text payload")
 
 
 def _text_bytes(s: str) -> bytes:
@@ -186,10 +205,8 @@ def read_sequence_file(path: str) -> Iterator[Tuple[str, str]]:
             key_len = _read_i32(f, "key length")
             if not (0 <= key_len <= rec_len):
                 raise ValueError(f"{path}: bad key length {key_len}")
-            key_raw = f.read(key_len)
-            val_raw = f.read(rec_len - key_len)
-            if len(key_raw) != key_len or len(val_raw) != rec_len - key_len:
-                raise EOFError(f"{path}: truncated record")
+            key_raw = _read_exact(f, key_len, f"record ({path})")
+            val_raw = _read_exact(f, rec_len - key_len, f"record ({path})")
             if decompress is not None:
                 val_raw = decompress(val_raw)
             key = _read_text(io.BytesIO(key_raw)).decode("utf-8", "replace")
@@ -209,9 +226,7 @@ def _read_blocks(f, path: str, sync: bytes, decompress) -> Iterator[Tuple[str, s
         n = _read_vint(f)
         if n < 0:
             raise ValueError(f"{path}: bad {what} buffer length {n}")
-        data = f.read(n)
-        if len(data) != n:
-            raise EOFError(f"{path}: truncated {what} buffer")
+        data = _read_exact(f, n, f"{what} buffer ({path})")
         return io.BytesIO(decompress(data))
 
     while True:

@@ -431,6 +431,12 @@ class S3FileSystem(fsio.FileSystem):
             )
             if status == 200:  # Range ignored: whole object in hand
                 raw: io.IOBase = io.BytesIO(data)
+            elif status == 416:
+                # Real S3 answers 416 InvalidRange when start >= size —
+                # i.e. a zero-byte object (the '_SUCCESS' markers this
+                # codebase writes). Plain GET resolves it (or surfaces
+                # the real error).
+                raw = io.BytesIO(self._get(path))
             elif status == 206:
                 total = None
                 crange = _header(headers, "content-range")
@@ -438,7 +444,15 @@ class S3FileSystem(fsio.FileSystem):
                     tail = crange.rsplit("/", 1)[1]
                     if tail.isdigit():
                         total = int(tail)
-                if total is None or total <= len(data):
+                if total is None:
+                    # A 206 without a parseable Content-Range could hide
+                    # bytes past the probe — refuse rather than silently
+                    # truncate a big object to its first 8 MB.
+                    raise OSError(
+                        f"S3 endpoint returned 206 without a usable "
+                        f"Content-Range for {path!r}; cannot size the object"
+                    )
+                if total <= len(data):
                     raw = io.BytesIO(data)
                 else:
                     raw = io.BufferedReader(

@@ -190,6 +190,10 @@ class S3Stub:
                     outer.range_requests.append(rng)
                     lo_s, _, hi_s = rng[6:].partition("-")
                     lo = int(lo_s)
+                    if lo >= len(data):  # real S3: 416 InvalidRange
+                        self._send(
+                            416, b"<Error><Code>InvalidRange</Code></Error>")
+                        return
                     hi = min(int(hi_s) if hi_s else len(data) - 1,
                              len(data) - 1)
                     body = data[lo:hi + 1]

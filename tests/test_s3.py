@@ -210,6 +210,12 @@ def test_s3_streaming_ranged_reads(s3fs):
     with fsio.fopen("s3://b/small.bin", "rb") as f:
         assert f.read() == b"tiny"
     assert len(stub.auth_headers) == 1  # exactly one request total
+    # zero-byte objects (the '_SUCCESS' markers): real S3 answers the
+    # probe with 416 InvalidRange — must resolve to an empty stream
+    with fsio.fopen("s3://b/empty", "wb") as f:
+        pass
+    with fsio.fopen("s3://b/empty", "rb") as f:
+        assert f.read() == b""
     # a zip-backed consumer (np.load mirrors the snapshot format) only
     # touches the central directory + the member it asks for
     buf = fsio.fopen("s3://b/arr.npz", "wb")
