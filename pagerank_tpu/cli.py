@@ -298,6 +298,11 @@ def _device_build_graph(args, src, dst, n, dangling_mask=None):
     device arrays (synthetic rmat: only a seed crossed the link).
     ``dangling_mask`` carries crawl inputs' uncrawled-targets-only
     dangling semantics into the device build (SURVEY.md §2a.3)."""
+    if n == 0:
+        # Same error as the host path's build_graph, instead of building
+        # an n=0 DeviceEllGraph that fails obscurely downstream; main()
+        # converts it to a clean SystemExit for both paths.
+        raise ValueError("empty graph: no vertices")
     from pagerank_tpu.ops import device_build as db
 
     plan_cfg = PageRankConfig(
@@ -468,7 +473,12 @@ def main(argv=None) -> int:
             print("--fused requires --engine jax", file=sys.stderr)
             return 2
     t0 = time.perf_counter()
-    graph, ids = load_graph(args)
+    try:
+        graph, ids = load_graph(args)
+    except ValueError as e:
+        # e.g. "empty graph: no vertices" (host build_graph and the
+        # device-build guard alike) — a clean CLI error, not a traceback.
+        raise SystemExit(str(e))
     t_load = time.perf_counter() - t0
     print(
         f"graph: {graph.n:,} vertices, {graph.num_edges:,} edges, "

@@ -205,6 +205,32 @@ def try_crawl_load(paths, kind: str, strict: bool = True,
         return None
 
 
+def iter_read_batches(paths, window: int, byte_cap: int):
+    """Yield ``(batch_paths, datas)`` groups of whole-file reads bounded
+    by ``window`` files AND ``byte_cap`` total bytes per batch. The cap
+    is checked BEFORE appending: a file that would push the batch past
+    byte_cap flushes the current batch first, so a batch exceeds the cap
+    only when a SINGLE file does (each file is read whole into memory —
+    see the crawl_load docstring note)."""
+    from pagerank_tpu.utils import fsio
+
+    batch_paths, datas, nbytes = [], [], 0
+    for path in paths:
+        with fsio.fopen(path, "rb") as f:
+            data = f.read()
+        if datas and nbytes + len(data) > byte_cap:
+            yield batch_paths, datas
+            batch_paths, datas, nbytes = [], [], 0
+        batch_paths.append(path)
+        datas.append(data)
+        nbytes += len(data)
+        if len(datas) >= window:
+            yield batch_paths, datas
+            batch_paths, datas, nbytes = [], [], 0
+    if datas:
+        yield batch_paths, datas
+
+
 def crawl_load(paths, kind: str, strict: bool = True,
                threads: Optional[int] = None, raw: bool = False):
     """Native L1: parse crawl inputs (``kind`` = "seqfile" or "tsv") into
@@ -225,6 +251,12 @@ def crawl_load(paths, kind: str, strict: bool = True,
     ``(src, dst, crawled_mask, IdMap)`` int32/bool arrays — what the
     on-device build consumes (the dedup/sort/pack then runs on the TPU,
     ops/device_build.build_ell_device).
+
+    Memory note: unlike the streaming Python reader, each file is read
+    WHOLE into host memory before the native call (the C++ side parses
+    from one contiguous buffer). Batches are bounded at ~256 MB — a
+    batch flushes before a file that would exceed the cap — but one
+    single file larger than the cap still occupies its full size.
     """
     lib = get_lib()
     if lib is None:
@@ -252,23 +284,9 @@ def crawl_load(paths, kind: str, strict: bool = True,
     window = max(2 * threads, 1)
     byte_cap = 256 << 20
 
-    def read_batches():
-        batch_paths, datas, nbytes = [], [], 0
-        for path in paths:
-            with fsio.fopen(path, "rb") as f:
-                data = f.read()
-            batch_paths.append(path)
-            datas.append(data)
-            nbytes += len(data)
-            if len(datas) >= window or nbytes >= byte_cap:
-                yield batch_paths, datas
-                batch_paths, datas, nbytes = [], [], 0
-        if datas:
-            yield batch_paths, datas
-
     h = lib.crawl_new()
     try:
-        gen = read_batches()
+        gen = iter_read_batches(paths, window, byte_cap)
         with concurrent.futures.ThreadPoolExecutor(1) as prefetch:
             fut = prefetch.submit(next, gen, None)
             while True:

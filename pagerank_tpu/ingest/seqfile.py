@@ -153,7 +153,10 @@ def read_sequence_file(path: str) -> Iterator[Tuple[str, str]]:
     """
     with fsio.fopen(path, "rb") as f:
         magic = f.read(4)
-        if magic[:3] != SEQ_MAGIC:
+        # len guard: a file truncated inside the magic (e.g. exactly
+        # b"SEQ") must raise the same FORMAT ValueError as the native
+        # reader (crawl_ingest.cpp), not IndexError on magic[3].
+        if len(magic) < 4 or magic[:3] != SEQ_MAGIC:
             raise ValueError(f"{path}: not a SequenceFile (magic {magic!r})")
         version = magic[3]
         if version != 6:

@@ -195,7 +195,10 @@ def resume_engine(engine, snap: Snapshotter) -> int:
     if meta["fingerprint"] != snap.fingerprint:
         raise ValueError(
             f"snapshot graph fingerprint {meta['fingerprint']} != current "
-            f"{snap.fingerprint}; refusing to resume"
+            f"{snap.fingerprint}; refusing to resume (note: crawl-input "
+            "graphs hash their dangling mask into the fingerprint since "
+            "r3 — older crawl-input snapshots no longer validate; see "
+            "docs/PARITY.md 'Snapshot-compat note')"
         )
     if meta["semantics"] != snap.semantics:
         raise ValueError(

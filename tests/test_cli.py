@@ -516,3 +516,21 @@ def test_cli_device_build_uniform_synthetic(tmp_path):
     assert main(base + ["--out", out2]) == 0
     assert open(out1).read() == open(out2).read()
     assert len(open(out1).read().splitlines()) == 300
+
+
+def test_cli_empty_input_device_build_clean_error(tmp_path):
+    # ADVICE r3: an empty crawl input with --device-build must fail with
+    # the host path's clean 'empty graph' error, not an obscure n=0
+    # device-build failure downstream.
+    p = str(tmp_path / "empty.txt")
+    open(p, "w").close()
+    with pytest.raises(SystemExit, match="empty graph"):
+        main(["--input", p, "--device-build", "--log-every", "0"])
+
+
+def test_cli_empty_input_host_build_clean_error(tmp_path):
+    # The host path raises the same clean error (no raw traceback).
+    p = str(tmp_path / "empty.txt")
+    open(p, "w").close()
+    with pytest.raises(SystemExit, match="empty graph"):
+        main(["--input", p, "--log-every", "0"])

@@ -130,3 +130,26 @@ def test_crawl_tsv_file(tmp_path):
     p.write_text("\n".join(rows) + "\n")
     recs = list(iter_crawl_records(str(p)))
     assert recs == [("http://a", ["http://b"]), ("http://b", [])]
+
+
+from pagerank_tpu.ingest.native import iter_read_batches
+
+
+
+def test_iter_read_batches_cap_checked_before_append(tmp_path):
+    # A file that would push a batch past the byte cap flushes the
+    # current batch FIRST (ADVICE r3): with a 100-byte cap and files of
+    # 60/60/250/10 bytes, batches are [60], [60], [250] (single file may
+    # exceed the cap), [10] — never 60+60 or 250+10 together.
+    sizes = [60, 60, 250, 10]
+    paths = []
+    for i, s in enumerate(sizes):
+        p = str(tmp_path / f"f{i}")
+        open(p, "wb").write(b"x" * s)
+        paths.append(p)
+    batches = list(iter_read_batches(paths, window=8, byte_cap=100))
+    got = [[len(d) for d in datas] for _, datas in batches]
+    assert got == [[60], [60], [250], [10]]
+    # window bound still applies when under the cap
+    batches = list(iter_read_batches(paths[:2], window=1, byte_cap=10**9))
+    assert [[len(d) for d in ds] for _, ds in batches] == [[60], [60]]

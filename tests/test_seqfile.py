@@ -369,3 +369,14 @@ def test_parallel_segment_parse_propagates_strict_errors(tmp_path):
         load_crawl_seqfile(str(d), strict=True, workers=4)
     g, _ = load_crawl_seqfile(str(d), strict=False, workers=4)
     assert g.n > 0
+
+
+def test_truncated_magic_raises_valueerror(tmp_path):
+    # A file of exactly b"SEQ" (3 bytes) must raise the same FORMAT
+    # ValueError as the native reader, not IndexError on magic[3]
+    # (ADVICE r3).
+    for blob in (b"", b"S", b"SE", b"SEQ"):
+        p = str(tmp_path / "trunc.seq")
+        open(p, "wb").write(blob)
+        with pytest.raises(ValueError, match="not a SequenceFile"):
+            list(read_sequence_file(p))
