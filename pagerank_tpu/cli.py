@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--semantics", choices=["reference", "textbook"], default="reference")
     p.add_argument("--engine", choices=["jax", "cpu"], default="jax")
     p.add_argument("--num-devices", type=int, default=None)
+    p.add_argument(
+        "--vertex-sharded", action="store_true",
+        help="partition the per-vertex state (rank vector, masks, "
+             "1/out-degree) over the mesh instead of replicating it — "
+             "the reference's hash-partitioned ranks RDD "
+             "(Sparky.java:165-170); per-chip state memory scales as "
+             "1/num_devices (jax engine, ell kernel)",
+    )
     p.add_argument("--dtype", default="float32")
     p.add_argument("--accum-dtype", default=None, help="defaults to --dtype")
     p.add_argument(
@@ -497,6 +505,7 @@ def main(argv=None) -> int:
         accum_dtype=args.accum_dtype or args.dtype,
         tol=args.tol,
         num_devices=args.num_devices,
+        vertex_sharded=args.vertex_sharded,
         snapshot_dir=args.snapshot_dir,
         snapshot_every=args.snapshot_every,
         log_every=args.log_every,

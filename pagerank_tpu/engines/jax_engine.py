@@ -101,6 +101,11 @@ class JaxTpuEngine(PageRankEngine):
 
     def _begin_build(self):
         cfg = self.config
+        if cfg.vertex_sharded and cfg.kernel not in ("auto", "ell"):
+            raise ValueError(
+                f"vertex_sharded requires the ell kernel, got "
+                f"{cfg.kernel!r}"
+            )
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
         )
@@ -728,7 +733,10 @@ class JaxTpuEngine(PageRankEngine):
         inv_out_rel = xp.asarray(inv_out_rel)
         if inv_out_rel.dtype != z_dtype:
             inv_out_rel = inv_out_rel.astype(z_dtype)
-        self._inv_out = jax.device_put(inv_out_rel, mesh_lib.replicated(mesh))
+        if not cfg.vertex_sharded:
+            self._inv_out = jax.device_put(
+                inv_out_rel, mesh_lib.replicated(mesh)
+            )
 
         # Very-many-stripe layouts: the unrolled Python loop duplicates
         # the whole chunked-gather program per stripe and its serialized
@@ -753,6 +761,71 @@ class JaxTpuEngine(PageRankEngine):
             and n_stripes * (2 if pair else 1) > self.SCAN_STRIPE_UNITS
         )
 
+        def accumulate_stripes(zs, rest):
+            """Per-device stripe loop — THE one spelling of the
+            z-slice + blocked-ELL gather + compact-sum scatter body,
+            shared by the replicated contrib fn and the vertex-sharded
+            step so the two modes cannot drift (their bit-equality is a
+            tested contract). ``rest`` is (src, row_block, ids) per
+            stripe; returns the [num_blocks, 128] partial accumulator
+            (cross-device merge is the caller's: psum or
+            psum_scatter)."""
+            total = None
+            for s in range(n_stripes):
+                src, rb, ids = rest[3 * s : 3 * s + 3]
+                z_s = [
+                    jnp.concatenate(
+                        [z[s * sz : (s + 1) * sz],
+                         jnp.zeros(gw, z.dtype)]
+                    )
+                    for z in zs
+                ]
+                # Arrays built for the pallas kernel carry GLOBAL
+                # block ids (slab's dense-rank contract doesn't
+                # hold) — the probe-failure fallback runs them in
+                # full non-slab mode.
+                Ps = num_present[s] if arrays_slab else None
+                if pair:
+                    part = spmv.ell_contrib_pair(
+                        z_s[0], z_s[1], src, rb, num_blocks,
+                        accum_dtype=accum, gather_width=gw,
+                        chunk_rows=ell_chunks[s], group=group,
+                        num_present=Ps,
+                    )
+                else:
+                    part = spmv.ell_contrib(
+                        z_s[0], src, rb, num_blocks,
+                        accum_dtype=accum, gather_width=gw,
+                        chunk_rows=ell_chunks[s], group=group,
+                        num_present=Ps,
+                    )
+                # Expand the compact (Ps, 128) sums to global
+                # blocks (full-width plain add on the non-slab
+                # fallback).
+                width = Ps if Ps is not None else num_blocks
+                p2 = part.reshape(width, 128)
+                if total is None:
+                    total = jnp.zeros((num_blocks, 128), p2.dtype)
+                if Ps is None:
+                    total = total + p2
+                else:
+                    total = spmv.scatter_block_sums(
+                        total, p2, ids, prefix_flags[s]
+                    )
+            return total
+
+        if cfg.vertex_sharded:
+            self._setup_vertex_sharded(
+                n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
+                accum=accum, num_blocks=num_blocks, chunks=ell_chunks,
+                num_present=num_present, prefix_flags=prefix_flags,
+                ids=present_ids, n=n, n_state=n_state,
+                mass_mask=mass_mask, zero_in=zero_in, valid=valid,
+                inv_out_rel=inv_out_rel, multi_dispatch=multi_dispatch,
+                accumulate_stripes=accumulate_stripes, xp=xp,
+            )
+            return
+
         def make_contrib(mode):
             """mode: 'ell' (XLA path) or a pallas gather strategy name."""
             if mode != "ell":
@@ -775,48 +848,7 @@ class JaxTpuEngine(PageRankEngine):
 
                 def sharded_contrib(*args):
                     zs, rest = args[:nz], args[nz:]
-                    total = None
-                    for s in range(n_stripes):
-                        src, rb, ids = rest[3 * s : 3 * s + 3]
-                        z_s = [
-                            jnp.concatenate(
-                                [z[s * sz : (s + 1) * sz],
-                                 jnp.zeros(gw, z.dtype)]
-                            )
-                            for z in zs
-                        ]
-                        # Arrays built for the pallas kernel carry GLOBAL
-                        # block ids (slab's dense-rank contract doesn't
-                        # hold) — the probe-failure fallback runs them in
-                        # full non-slab mode.
-                        Ps = num_present[s] if arrays_slab else None
-                        if pair:
-                            part = spmv.ell_contrib_pair(
-                                z_s[0], z_s[1], src, rb, num_blocks,
-                                accum_dtype=accum, gather_width=gw,
-                                chunk_rows=ell_chunks[s], group=group,
-                                num_present=Ps,
-                            )
-                        else:
-                            part = spmv.ell_contrib(
-                                z_s[0], src, rb, num_blocks,
-                                accum_dtype=accum, gather_width=gw,
-                                chunk_rows=ell_chunks[s], group=group,
-                                num_present=Ps,
-                            )
-                        # Expand the compact (Ps, 128) sums to global
-                        # blocks (full-width plain add on the non-slab
-                        # fallback).
-                        width = Ps if Ps is not None else num_blocks
-                        p2 = part.reshape(width, 128)
-                        if total is None:
-                            total = jnp.zeros((num_blocks, 128), p2.dtype)
-                        if Ps is None:
-                            total = total + p2
-                        else:
-                            total = spmv.scatter_block_sums(
-                                total, p2, ids, prefix_flags[s]
-                            )
+                    total = accumulate_stripes(zs, rest)
                     return jax.lax.psum(total.reshape(-1), axis)
 
                 in_specs = (P(),) * nz + (
@@ -994,6 +1026,46 @@ class JaxTpuEngine(PageRankEngine):
 
         self._ms_prescale = jax.jit(ms_prescale)
 
+        self._ms_stripe_fns = self._make_ms_stripe_fns(
+            n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
+            accum=accum, num_blocks=num_blocks, chunks=chunks,
+            num_present=num_present,
+        )
+        self._ms_stripe = self._ms_stripe_fns[0]  # engaged-flag + probe
+
+        update_tail = self._update_tail  # set by _finalize, shared
+
+        def final_body(r, *rest):
+            parts = rest[:n_stripes]
+            ids_l = rest[n_stripes : 2 * n_stripes]
+            dangling, zero_in, valid_m = rest[2 * n_stripes :]
+            total = jnp.zeros((num_blocks, 128), accum)
+            for s in range(n_stripes):
+                # .sum(0) collapses the per-device partials (GSPMD turns
+                # it into the cross-device reduce); the scatters stay in
+                # ONE program so XLA keeps one resident accumulator.
+                total = spmv.scatter_block_sums(
+                    total, parts[s].sum(0), ids_l[s], prefix_flags[s]
+                )
+            contrib = total.reshape(-1)[: r.shape[0]]
+            return update_tail(contrib, r, dangling, zero_in, valid_m)
+
+        self._ms_final = jax.jit(final_body, donate_argnums=(0,))
+        self._ms_ids = list(ids)
+        self._ms_n_stripes = n_stripes
+
+    def _make_ms_stripe_fns(self, *, n_stripes, sz, gw, group, pair, accum,
+                            num_blocks, chunks, num_present):
+        """The per-stripe multi-dispatch executables (see
+        _setup_multi_dispatch): each stripe's contribution as its own
+        jitted shard_map with EXACT per-stripe shapes and a static
+        per-stripe z slice, returning compact per-present-block
+        partials. Shared by the replicated and vertex-sharded modes —
+        the stripe fns consume REPLICATED z planes either way (the
+        modes differ only in how z is produced and how partials merge
+        into the rank update)."""
+        mesh = self._mesh
+        axis = self.config.mesh_axis
         nz = 2 if pair else 1
 
         def make_stripe_fn(s, Ps, ck):
@@ -1030,30 +1102,263 @@ class JaxTpuEngine(PageRankEngine):
                 )
             )
 
-        self._ms_stripe_fns = [
+        return [
             make_stripe_fn(s, num_present[s], chunks[s])
             for s in range(n_stripes)
         ]
-        self._ms_stripe = self._ms_stripe_fns[0]  # engaged-flag + probe
 
-        update_tail = self._update_tail  # set by _finalize, shared
+    def _setup_vertex_sharded(self, *, n_stripes, sz, gw, group, pair,
+                              accum, num_blocks, chunks, num_present,
+                              prefix_flags, ids, n, n_state, mass_mask,
+                              zero_in, valid, inv_out_rel, multi_dispatch,
+                              accumulate_stripes, xp):
+        """Partitioned-rank execution (config.vertex_sharded; VERDICT r3
+        #1): per-vertex state — rank vector, masks, 1/out-degree — is
+        SHARDED over the mesh in contiguous vertex blocks, the analogue
+        of the reference's hash-partitioned ``ranks`` RDD
+        (Sparky.java:165-170). The replicated mode's per-chip copy of
+        every per-vertex vector caps the largest representable graph
+        regardless of mesh size; here persistent per-vertex HBM is
+        1/ndev per chip, so adding chips raises the ceiling.
 
-        def final_body(r, *rest):
+        Per-iteration dataflow (one shard_map over the whole step):
+
+          1. z_local = r_local * inv_local          (sharded elementwise)
+          2. z = all_gather(z_local)                (the stripe gathers
+             need arbitrary source entries; gathered z is TRANSIENT —
+             freed after the contribution — unlike the replicated
+             mode's persistent copies)
+          3. per-stripe blocked-ELL gathers into the block accumulator
+             (identical kernels to the replicated mode)
+          4. contrib_local = psum_scatter(flat)     (reduce-scatter:
+             each chip keeps exactly its vertex block of the merged sum)
+          5. rank update on the local block; dangling mass and the L1
+             delta are per-shard partial reductions merged by scalar
+             psums.
+
+        Total per-iteration bytes over ICI equal the replicated mode's
+        single all-reduce (all_gather + reduce_scatter = all-reduce),
+        so this trades no bandwidth for the memory scaling.
+
+        Equality vs the replicated mode (tests/test_vertex_sharded.py):
+        the contribution merge is bit-exact (psum_scatter slices agree
+        with psum bitwise — pinned by the first-step test); the one
+        legitimate divergence is the mass/L1 scalar reductions, whose
+        per-shard regrouping shifts the f64 sum by <= 1 ulp per
+        iteration. f32-storage configs round that away (bit-equal full
+        runs); f64 storage carries it (measured max 4 nulp after 50
+        iterations, no amplification).
+
+        The state length pads from n_state to n_vs (next multiple of
+        128*ndev) so every per-vertex vector shards evenly; the padding
+        is inert (valid=0, inv=0). Layouts past SCAN_STRIPE_UNITS use
+        the same per-stripe multi-dispatch executables as the
+        replicated mode with a sharded prescale/finalize
+        (_setup_multi_dispatch_vs)."""
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        ndev = mesh.devices.size
+        dtype = self._dtype
+        vshard = mesh_lib.vertex_sharding(mesh)
+
+        unit = 128 * ndev
+        n_vs = -(-n_state // unit) * unit
+        padv = n_vs - n_state
+
+        def pad_vs(a):
+            if padv == 0:
+                return xp.asarray(a)
+            a = xp.asarray(a)
+            return xp.concatenate([a, xp.zeros(padv, a.dtype)])
+
+        self._kernel = "ell"
+        self._n_state = n_vs
+        self._state_sharding = vshard
+        self._dangling = jax.device_put(
+            pad_vs(xp.asarray(mass_mask, bool)), vshard
+        )
+        self._zero_in = jax.device_put(
+            pad_vs(xp.asarray(zero_in, bool)), vshard
+        )
+        valid = pad_vs(xp.asarray(valid, bool))
+        self._valid = jax.device_put(valid, vshard)
+        self._inv_out = jax.device_put(pad_vs(inv_out_rel), vshard)
+        r0_value = 1.0 if cfg.semantics == "reference" else 1.0 / n
+        r0 = xp.full(n_vs, r0_value, dtype=dtype) * valid
+        self._r = jax.device_put(jnp.asarray(r0, dtype=dtype), vshard)
+        self.iteration = 0
+
+        total_z = n_stripes * sz
+        damping = cfg.damping
+        semantics = cfg.semantics
+
+        def vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l):
+            """update_tail's semantics on LOCAL vertex blocks: the two
+            scalar reductions (dangling mass, L1 delta) are per-shard
+            partials merged by psum; the elementwise update runs on the
+            shard. Same apply_update spelling as every other form."""
+            m = jax.lax.psum(
+                jnp.sum(dang_l.astype(accum) * r_l.astype(accum)), axis
+            )
+            r_new = pr_model.apply_update(
+                contrib_l, r_l.astype(accum), zin_l.astype(accum), m, n,
+                damping, semantics, jnp,
+            )
+            r_new = (r_new * valid_l.astype(accum)).astype(r_l.dtype)
+            delta = jax.lax.psum(
+                jnp.sum(jnp.abs(r_new.astype(accum) - r_l.astype(accum))),
+                axis,
+            )
+            return r_new, delta, m
+
+        self._vs_tail = vs_tail
+
+        def gather_z(r_l, inv_l):
+            """Steps 1-2: sharded prescale + tiled all_gather; returns
+            the gather plane tuple (split AFTER the gather in pair mode
+            so one f64 vector crosses ICI, not two f32 planes plus a
+            second launch)."""
+            z_l = r_l.astype(inv_l.dtype) * inv_l
+            z = jax.lax.all_gather(z_l, axis, tiled=True)  # [n_vs]
+            if total_z > n_vs:
+                z = jnp.concatenate(
+                    [z, jnp.zeros(total_z - n_vs, z.dtype)]
+                )
+            return _split_pair(z) if pair else (z,)
+
+        # XLA-TPU's X64 rewriter implements f64 all-reduce but NOT f64
+        # reduce-scatter (probed on the current libtpu: "While rewriting
+        # computation to not contain X64 element types ... not
+        # implemented: reduce-scatter f64[...]", even at 1 device), so
+        # 64-bit accumulation on TPU backends merges with psum + a
+        # local slice — same bits as the replicated mode's merge, at
+        # all-reduce bandwidth instead of reduce-scatter's half (the
+        # memory scaling, which is the point of this mode, is
+        # unaffected). Revisit on libtpu upgrades.
+        use_rs = (
+            jnp.dtype(accum).itemsize < 8
+            or jax.default_backend() != "tpu"
+        )
+        blk = n_vs // ndev
+
+        def merge_scatter(total):
+            """Step 4: pad the merged block accumulator to the sharded
+            state length and reduce-scatter it so each chip keeps its
+            own contiguous contribution block (psum + slice where the
+            backend cannot lower a 64-bit reduce-scatter, see above)."""
+            flat = total.reshape(-1)  # [n_state]
+            if padv:
+                flat = jnp.concatenate([flat, jnp.zeros(padv, accum)])
+            if use_rs:
+                return jax.lax.psum_scatter(
+                    flat, axis, scatter_dimension=0, tiled=True
+                )
+            full = jax.lax.psum(flat, axis)
+            i = jax.lax.axis_index(axis)
+            return jax.lax.dynamic_slice_in_dim(full, i * blk, blk)
+
+        def vs_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+            zs = gather_z(r_l, inv_l)
+            # Same stripe body as the replicated contrib fn (ONE
+            # spelling — accumulate_stripes); only the merge differs.
+            total = accumulate_stripes(zs, rest)
+            contrib_l = merge_scatter(total)
+            return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+
+        step_core = shard_map(
+            vs_body,
+            mesh=mesh,
+            in_specs=(P(axis),) * 5
+            + (P(axis, None), P(axis), P()) * n_stripes,
+            out_specs=(P(axis), P(), P()),
+        )
+
+        self._contrib_args = tuple(
+            a for triple in zip(self._src, self._row_block, ids)
+            for a in triple
+        )
+        self._inv_in_args = True
+        self._step_core = step_core
+        self._step_fn = jax.jit(step_core, donate_argnums=(0,))
+        self._fused_cache = {}
+        self.last_run_metrics = {
+            "l1_delta": np.zeros(0, self._accum_dtype),
+            "dangling_mass": np.zeros(0, self._accum_dtype),
+        }
+        if multi_dispatch:
+            self._setup_multi_dispatch_vs(
+                n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
+                accum=accum, num_blocks=num_blocks, chunks=chunks,
+                num_present=num_present, prefix_flags=prefix_flags,
+                ids=ids, n_vs=n_vs, padv=padv, gather_z=gather_z,
+                merge_scatter=merge_scatter,
+            )
+
+    def _setup_multi_dispatch_vs(self, *, n_stripes, sz, gw, group, pair,
+                                 accum, num_blocks, chunks, num_present,
+                                 prefix_flags, ids, n_vs, padv, gather_z,
+                                 merge_scatter):
+        """Vertex-sharded counterpart of _setup_multi_dispatch for
+        layouts past SCAN_STRIPE_UNITS: the SAME per-stripe compiled
+        executables (replicated z planes in, compact per-device partials
+        out — _make_ms_stripe_fns), with the prescale and finalize
+        re-homed to sharded state: the prescale shard_map all_gathers
+        the sharded z, the finalize scatters each device's OWN partials
+        into the block accumulator and reduce-scatters the merge before
+        the local rank update (no .sum(0) cross-device reduce — the
+        psum_scatter IS the reduction)."""
+        mesh = self._mesh
+        axis = self.config.mesh_axis
+        nz = 2 if pair else 1
+
+        pres = shard_map(
+            gather_z,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(),) * nz,
+            # The planes ARE replicated (tiled all_gather output), but
+            # the static varying-mesh-axes checker cannot infer that
+            # through the concat/Dekker-split epilogue.
+            check_vma=False,
+        )
+        self._ms_prescale = jax.jit(pres)
+        self._ms_stripe_fns = self._make_ms_stripe_fns(
+            n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
+            accum=accum, num_blocks=num_blocks, chunks=chunks,
+            num_present=num_present,
+        )
+        self._ms_stripe = self._ms_stripe_fns[0]
+        vs_tail = self._vs_tail
+
+        def final_body(r_l, *rest):
             parts = rest[:n_stripes]
             ids_l = rest[n_stripes : 2 * n_stripes]
-            dangling, zero_in, valid_m = rest[2 * n_stripes :]
+            dang_l, zin_l, valid_l = rest[2 * n_stripes :]
             total = jnp.zeros((num_blocks, 128), accum)
             for s in range(n_stripes):
-                # .sum(0) collapses the per-device partials (GSPMD turns
-                # it into the cross-device reduce); the scatters stay in
-                # ONE program so XLA keeps one resident accumulator.
+                # parts[s] is this device's OWN compact partial
+                # ([1, Ps, 128] under the P(axis, None, None) spec);
+                # the cross-device reduction happens in merge_scatter's
+                # psum_scatter, not here.
                 total = spmv.scatter_block_sums(
-                    total, parts[s].sum(0), ids_l[s], prefix_flags[s]
+                    total, parts[s][0], ids_l[s], prefix_flags[s]
                 )
-            contrib = total.reshape(-1)[: r.shape[0]]
-            return update_tail(contrib, r, dangling, zero_in, valid_m)
+            contrib_l = merge_scatter(total)
+            return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
-        self._ms_final = jax.jit(final_body, donate_argnums=(0,))
+        self._ms_final = jax.jit(
+            shard_map(
+                final_body,
+                mesh=mesh,
+                in_specs=(P(axis),)
+                + (P(axis, None, None),) * n_stripes
+                + (P(),) * n_stripes
+                + (P(axis),) * 3,
+                out_specs=(P(axis), P(), P()),
+            ),
+            donate_argnums=(0,),
+        )
         self._ms_ids = list(ids)
         self._ms_n_stripes = n_stripes
 
@@ -1067,14 +1372,18 @@ class JaxTpuEngine(PageRankEngine):
 
         xp = np if isinstance(mass_mask, np.ndarray) else jnp
         self._n_state = n_state
-        self._dangling = jax.device_put(
-            xp.asarray(mass_mask, bool).astype(dtype), rep
-        )
-        self._zero_in = jax.device_put(
-            xp.asarray(zero_in, bool).astype(dtype), rep
-        )
+        self._state_sharding = rep
+        # Masks live on device as bool (1 byte/vertex) and are cast to
+        # the accumulation dtype INSIDE the step (update_tail), where
+        # XLA fuses the cast into the consuming elementwise ops. Storing
+        # them pre-cast to the rank dtype — f64 in the accuracy config —
+        # tripled the replicated per-vertex footprint for zero speed
+        # (VERDICT r3 weak #2: ~2.7 GB of replicated vectors at
+        # scale-26 f64 before any gather table).
+        self._dangling = jax.device_put(xp.asarray(mass_mask, bool), rep)
+        self._zero_in = jax.device_put(xp.asarray(zero_in, bool), rep)
         valid = xp.asarray(valid, bool)
-        self._valid = jax.device_put(valid.astype(dtype), rep)
+        self._valid = jax.device_put(valid, rep)
 
         # Initial value uses the TRUE n (1/n in textbook mode), laid out
         # over the padded state vector with zeros in padding lanes.
@@ -1457,7 +1766,7 @@ class JaxTpuEngine(PageRankEngine):
             rr = np.zeros(self._n_state, dtype=self._dtype)
             rr[: self.graph.n] = r[self._perm]
             r = rr
-        self._r = jax.device_put(r, mesh_lib.replicated(self._mesh))
+        self._r = jax.device_put(r, self._state_sharding)
         self.iteration = iteration
 
     @property

@@ -177,11 +177,13 @@ class PprJaxEngine:
         inv = graph_lib.inv_out_degree(graph.out_degree, dtype=inv_dtype)
         inv_rel = np.concatenate([inv[pack.perm], np.zeros(pad, inv_dtype)])
         self._inv_out = jax.device_put(inv_rel, rep)
+        # bool on device (1 byte/vertex); cast in-step where consumed —
+        # same rule as jax_engine._finalize.
         dang = (graph.out_degree == 0)[pack.perm]
         self._dangling = jax.device_put(
-            np.concatenate([dang, np.zeros(pad, bool)]).astype(dtype), rep
+            np.concatenate([dang, np.zeros(pad, bool)]), rep
         )
-        valid = np.concatenate([np.ones(n, dtype), np.zeros(pad, dtype)])
+        valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
         self._valid = jax.device_put(valid, rep)
         self._slot_args = tuple(
             a for triple in zip(srcs, rbs, pres_ids) for a in triple

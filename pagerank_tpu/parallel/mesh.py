@@ -43,3 +43,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for rank vectors / masks / scalars: fully replicated —
     the analogue of Spark broadcast variables (Sparky.java:135,162)."""
     return NamedSharding(mesh, P())
+
+
+def vertex_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for PARTITIONED per-vertex state (config.vertex_sharded):
+    contiguous vertex blocks over the mesh axis — the analogue of the
+    reference's hash-partitioned ``ranks`` RDD (Sparky.java:165-170)."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
