@@ -101,11 +101,6 @@ class JaxTpuEngine(PageRankEngine):
 
     def _begin_build(self):
         cfg = self.config
-        if cfg.vertex_sharded and cfg.kernel not in ("auto", "ell"):
-            raise ValueError(
-                f"vertex_sharded requires the ell kernel, got "
-                f"{cfg.kernel!r}"
-            )
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
         )
@@ -527,6 +522,21 @@ class JaxTpuEngine(PageRankEngine):
         import functools
         import time as _time
 
+        from pagerank_tpu.utils import compile_cache
+
+        # The winner is deterministic per (hardware, geometry): persist
+        # it next to the compile cache so repeat builds skip the ~8s of
+        # candidate timing (measured scale 23 — the autotune was the
+        # single largest line in the build breakdown, docs/PERF_NOTES.md
+        # "Device-build cost").
+        tune_key = "chunk:" + ":".join(map(str, (
+            jax.devices()[0].device_kind, sz, z_item, gw, group, pair,
+            jnp.dtype(accum).name, max(stripe_rows_dev), tuple(cands),
+        )))
+        cached = compile_cache.tuning_get(tune_key)
+        if cached in cands:
+            return cached
+
         s_big = int(np.argmax(stripe_rows_dev))
         src_a, rb_a = self._src[s_big], self._row_block[s_big]
         rows = stripe_rows_dev[s_big]
@@ -567,6 +577,8 @@ class JaxTpuEngine(PageRankEngine):
                 continue
             if best_t is None or dt < best_t:
                 best, best_t = c, dt
+        if best_t is not None:
+            compile_cache.tuning_put(tune_key, best)
         return best
 
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,

@@ -30,6 +30,58 @@ def default_cache_dir() -> str:
     )
 
 
+def tuning_get(key: str):
+    """Look up a persisted build-time tuning decision (e.g. the ELL
+    chunk autotune winner) from ``tuning.json`` next to the compile
+    cache. Returns None on any miss/error — tuning persistence is an
+    optimization, never a requirement."""
+    import json
+
+    d = _active_cache_dir()
+    if d is None:
+        return None
+    try:
+        with open(os.path.join(d, "tuning.json")) as f:
+            return json.load(f).get(key)
+    except Exception:
+        return None
+
+
+def tuning_put(key: str, value) -> None:
+    """Persist a tuning decision (atomic replace; best-effort)."""
+    import json
+    import tempfile
+
+    d = _active_cache_dir()
+    if d is None:
+        return
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "tuning.json")
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception:
+            data = {}
+        data[key] = value
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tuning")
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _active_cache_dir():
+    """The persistence root, or None when cross-run persistence is OFF
+    (no enable_compile_cache call / --no-compile-cache): tuning state
+    must not outlive the run when the user opted out of the compile
+    cache — the two are one persistence switch."""
+    import jax
+
+    return jax.config.jax_compilation_cache_dir or None
+
+
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point JAX's persistent compilation cache at ``cache_dir``
     (default: :func:`default_cache_dir`) with a 0s persistence

@@ -32,7 +32,8 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "fast_f32", "accuracy"}
+                        "build_s", "fast_f32", "accuracy"}
+    assert rec["build_s"] > 0 and rec["fast_f32"]["build_s"] > 0
     assert rec["metric"] == "edges_per_sec_per_chip"
     assert rec["unit"] == "edges/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
@@ -57,7 +58,7 @@ def test_bench_json_contract_single_mode(tmp_path):
     json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "build_s"}
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
 
 

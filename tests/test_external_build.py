@@ -1,0 +1,119 @@
+"""Out-of-core host graph build (ingest/external.py): field-identical
+to build_graph under a bounded working-memory cap, across chunkings,
+spill-run counts, and input formats (VERDICT r3 missing #2)."""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import build_graph
+from pagerank_tpu.ingest import external
+
+
+def _assert_graphs_equal(a, b):
+    assert a.n == b.n
+    np.testing.assert_array_equal(a.src, b.src)
+    np.testing.assert_array_equal(a.dst, b.dst)
+    np.testing.assert_array_equal(a.out_degree, b.out_degree)
+    np.testing.assert_array_equal(a.in_degree, b.in_degree)
+    np.testing.assert_array_equal(a.dangling_mask, b.dangling_mask)
+    np.testing.assert_array_equal(a.zero_in_mask, b.zero_in_mask)
+    np.testing.assert_allclose(a.edge_weight, b.edge_weight, rtol=0)
+
+
+def _random_edges(n, e, seed, dup_frac=0.3):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    # Force duplicates so dedup semantics are exercised.
+    ndup = int(e * dup_frac)
+    src[:ndup] = src[e - ndup:]
+    dst[:ndup] = dst[e - ndup:]
+    return src, dst
+
+
+def test_external_matches_build_graph_many_runs(monkeypatch):
+    # A tiny spill-chunk forces MANY sorted runs + a real k-way merge.
+    n, e = 500, 20000
+    src, dst = _random_edges(n, e, 1)
+    ref = build_graph(src, dst, n=n)
+    monkeypatch.setattr(external, "_SPILL_BYTES_PER_EDGE", 40 * 300)
+    g = external.build_graph_external(
+        [(src, dst)], n=n, mem_cap_bytes=64 << 20
+    )
+    _assert_graphs_equal(g, ref)
+
+
+def test_external_matches_across_chunkings():
+    n, e = 300, 5000
+    src, dst = _random_edges(n, e, 2)
+    ref = build_graph(src, dst, n=n)
+    for k in (1, 3, 7):
+        cuts = np.array_split(np.arange(e), k)
+        chunks = [(src[c], dst[c]) for c in cuts]
+        g = external.build_graph_external(chunks, n=n)
+        _assert_graphs_equal(g, ref)
+
+
+def test_external_n_inference_and_bounds():
+    src = np.array([0, 5, 5, 3])
+    dst = np.array([1, 2, 2, 9])
+    g = external.build_graph_external([(src, dst)])
+    assert g.n == 10
+    assert g.num_edges == 3  # one duplicate collapsed
+    with pytest.raises(ValueError, match="out of range"):
+        external.build_graph_external([(src, dst)], n=5)
+    with pytest.raises(ValueError, match="empty graph"):
+        external.build_graph_external([])
+
+
+def test_external_text_streaming(tmp_path, monkeypatch):
+    n, e = 200, 3000
+    src, dst = _random_edges(n, e, 3)
+    p = str(tmp_path / "edges.txt")
+    with open(p, "w") as f:
+        f.write("# comment line\n")
+        for s, d in zip(src, dst):
+            f.write(f"{s} {d}\n")
+    ref = build_graph(src, dst, n=n)
+    monkeypatch.setattr(external, "_SPILL_BYTES_PER_EDGE", 40 * 500)
+    g = external.build_graph_external(p, n=n, mem_cap_bytes=64 << 20)
+    _assert_graphs_equal(g, ref)
+
+
+def test_external_npz_input(tmp_path):
+    from pagerank_tpu.ingest.edgelist import save_binary_edges
+
+    n, e = 150, 2000
+    src, dst = _random_edges(n, e, 4)
+    p = str(tmp_path / "edges.npz")
+    save_binary_edges(p, src, dst, n=n)
+    ref = build_graph(src, dst, n=n)
+    g = external.build_graph_external(p)
+    _assert_graphs_equal(g, ref)
+
+
+def test_external_dangling_mask_override():
+    src = np.array([0, 1])
+    dst = np.array([1, 2])
+    mask = np.array([False, False, True, True])  # 2 uncrawled, 3 extra
+    ref = build_graph(src, dst, n=4, dangling_mask=mask)
+    g = external.build_graph_external([(src, dst)], n=4, dangling_mask=mask)
+    _assert_graphs_equal(g, ref)
+    with pytest.raises(ValueError, match="out-edges"):
+        external.build_graph_external(
+            [(src, dst)], n=4,
+            dangling_mask=np.array([True, False, False, False]),
+        )
+
+
+def test_external_engine_run_matches():
+    """The external build feeds the solver identically."""
+    from pagerank_tpu import JaxTpuEngine, PageRankConfig
+
+    n, e = 400, 6000
+    src, dst = _random_edges(n, e, 5)
+    cfg = PageRankConfig(num_iters=8, dtype="float64", accum_dtype="float64")
+    r_ref = JaxTpuEngine(cfg).build(build_graph(src, dst, n=n)).run()
+    g = external.build_graph_external([(src, dst)], n=n)
+    r_ext = JaxTpuEngine(cfg).build(g).run()
+    np.testing.assert_array_equal(r_ext, r_ref)

@@ -104,3 +104,21 @@ def test_oracle_l1_known_vectors():
     assert mass == pytest.approx(0.25)
     # Identity.
     assert oracle_l1(r_ref, r_ref) == (0.0, 0.0, 0.0)
+
+
+def test_tuning_cache_roundtrip(tmp_path, monkeypatch):
+    # Build-time tuning decisions (e.g. the ELL chunk autotune winner)
+    # persist next to the compile cache and survive junk in the file.
+    from pagerank_tpu.utils import compile_cache as cc
+
+    monkeypatch.setattr(cc, "_active_cache_dir", lambda: str(tmp_path))
+    assert cc.tuning_get("chunk:x") is None
+    cc.tuning_put("chunk:x", 2048)
+    cc.tuning_put("chunk:y", 256)
+    assert cc.tuning_get("chunk:x") == 2048
+    assert cc.tuning_get("chunk:y") == 256
+    # corrupt file: reads degrade to None, writes recover
+    (tmp_path / "tuning.json").write_text("{broken")
+    assert cc.tuning_get("chunk:x") is None
+    cc.tuning_put("chunk:x", 512)
+    assert cc.tuning_get("chunk:x") == 512
