@@ -124,6 +124,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             ]
+            # Newer symbol: guard so a prebuilt .so from older sources
+            # keeps its existing entry points (only the formatter falls
+            # back to Python then).
+            if hasattr(lib, "format_rank_lines"):
+                lib.format_rank_lines.restype = ctypes.c_int64
+                lib.format_rank_lines.argtypes = [
+                    np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                    ctypes.c_int64,
+                    ctypes.c_char_p,  # names blob (or None)
+                    ctypes.c_void_p,  # int64 offsets (or None)
+                    np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+                    ctypes.c_int64,
+                ]
             _LIB = lib
         except Exception:
             _LIB_FAILED = True
@@ -338,6 +351,43 @@ def crawl_load(paths, kind: str, strict: bool = True,
         vertex_names=names,
     )
     return graph, IdMap.from_names(names)
+
+
+def format_rank_lines_native(
+    ranks: np.ndarray,
+    names_blob: Optional[bytes] = None,
+    name_offsets: Optional[np.ndarray] = None,
+) -> Optional[bytes]:
+    """Bulk "(key,repr(value))\\n" text formatting — the native L4 fast
+    path behind utils/snapshot.TextDumper. Byte-identical to the Python
+    per-line formatter (differentially fuzzed in tests/test_snapshot.py);
+    returns None when the native library is unavailable (or predates
+    the symbol, or was built by a toolchain without floating-point
+    charconv — callers take the Python loop)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "format_rank_lines"):
+        return None
+    ranks = np.ascontiguousarray(ranks, dtype=np.float64)
+    n = ranks.shape[0]
+    if names_blob is not None:
+        offs = np.ascontiguousarray(name_offsets, dtype=np.int64)
+        if offs.shape[0] != n + 1:
+            raise ValueError(
+                f"name_offsets must have length n+1={n + 1}, got {offs.shape[0]}"
+            )
+        cap = len(names_blob) + 28 * n + 1
+        offs_p = offs.ctypes.data_as(ctypes.c_void_p)
+    else:
+        offs = None
+        cap = 48 * n + 1
+        offs_p = None
+    out = np.empty(cap, np.uint8)
+    wrote = lib.format_rank_lines(ranks, n, names_blob, offs_p, out, cap)
+    if wrote == -2:  # library built without floating-point charconv
+        return None
+    if wrote < 0:  # cap bound violated — impossible per the line math
+        raise RuntimeError("format_rank_lines overflow")
+    return out[:wrote].tobytes()
 
 
 def sort_dedup_degrees_native(

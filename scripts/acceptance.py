@@ -285,7 +285,7 @@ def run_e2e(key: str):
     from pagerank_tpu.ingest import load_crawl_seqfile_arrays
     from pagerank_tpu.models.pagerank import initial_rank
     from pagerank_tpu.utils.metrics import oracle_l1
-    from pagerank_tpu.utils.snapshot import TextDumper
+    from pagerank_tpu.utils.snapshot import AsyncRankWriter, TextDumper
 
     spec = CONFIGS[key]
     files, per_file, iters = spec["files"], spec["records"], spec["iters"]
@@ -316,16 +316,34 @@ def run_e2e(key: str):
         eng.set_ranks(initial_rank(g.n, "reference", np.float64, np),
                       iteration=0)
 
+        # L4 rides the framework's own async path (VERDICT r4 weak #1):
+        # the worker thread decodes a device-side rank copy and writes
+        # the dump through the native bulk formatter while the next
+        # step computes (utils/snapshot.AsyncRankWriter — C17's build
+        # target, unlike the reference's synchronous saveAsTextFile
+        # barrier, Sparky.java:237). Timing: t_solve is the fenced
+        # per-step device time (run_one protocol); t_l4 is the EXPOSED
+        # L4 wall — everything the loop + final flush spent beyond the
+        # solve — and t_dump_work is the worker's time inside dump()
+        # (formatter + file write), reported as lines/s.
         dumper = TextDumper(os.path.join(work, "out"), names=ids.names)
-        t_solve = t_l4 = 0.0
-        for it in range(iters):
+        t_dump_work = [0.0]
+
+        def dump_sink(i, ranks):
             t0 = time.perf_counter()
-            eng._device_step()
-            eng.fence()
-            t_solve += time.perf_counter() - t0
-            t0 = time.perf_counter()
-            dumper.dump(it, eng.ranks())
-            t_l4 += time.perf_counter() - t0
+            dumper.dump(i, ranks)
+            t_dump_work[0] += time.perf_counter() - t0
+
+        t_solve = 0.0
+        t_loop0 = time.perf_counter()
+        with AsyncRankWriter(eng.decode_ranks, [dump_sink]) as writer:
+            for it in range(iters):
+                t0 = time.perf_counter()
+                eng._device_step()
+                eng.fence()
+                t_solve += time.perf_counter() - t0
+                writer.submit(it, eng.device_ranks())
+        t_l4 = time.perf_counter() - t_loop0 - t_solve
         r_tpu = eng.ranks()
 
         # The dump directories must have the reference's output shape:
@@ -368,6 +386,8 @@ def run_e2e(key: str):
         "engine_build_s": t_eng_build,
         "solve_s": t_solve,
         "dumps_s": t_l4,
+        "dump_work_s": t_dump_work[0],
+        "dump_lines_per_s": iters * int(g.n) / t_dump_work[0],
         "records_per_sec_l1": files * per_file / t_l1,
     }
     print(
@@ -375,7 +395,9 @@ def run_e2e(key: str):
         f"{g.n:,} vertices / {g.num_edges:,} edges; split: gen "
         f"{t_gen:.1f}s (not part of the job), L1 {t_l1:.1f}s, host "
         f"build {t_l2:.1f}s, engine build {t_eng_build:.1f}s, solve "
-        f"{t_solve:.2f}s, dumps {t_l4:.1f}s (oracle {t_oracle:.1f}s); "
+        f"{t_solve:.2f}s, dumps exposed {t_l4:.1f}s (worker dump work "
+        f"{t_dump_work[0]:.1f}s = {rec['dump_lines_per_s']:.3g} "
+        f"lines/s; oracle {t_oracle:.1f}s); "
         f"normalized L1 {norm:.3e} (mass-normalized {mass_norm:.3e}) "
         f"vs gate {GATE:g} -> {'PASS' if rec['passed'] else 'FAIL'}",
         file=sys.stderr,
@@ -522,7 +544,12 @@ def append_baseline(recs) -> None:
         f"-> {r['n']:,} v / {r['num_edges']:,} e | {r['iters']} | "
         f"{r['l1_parse_s']:.1f} | {r['host_build_s']:.1f} | "
         f"{r['engine_build_s']:.1f} | {r['solve_s']:.2f} | "
-        f"{r['dumps_s']:.1f} | {r['normalized_l1']:.3e} | "
+        + (
+            f"{r['dumps_s']:.2f} (async; work {r['dump_work_s']:.2f} @ "
+            f"{r['dump_lines_per_s']:.2g} lines/s)"
+            if "dump_work_s" in r else f"{r['dumps_s']:.1f}"
+        )
+        + f" | {r['normalized_l1']:.3e} | "
         f"{'PASS' if r['passed'] else 'FAIL'} |\n"
         for r in recs if r.get("kind") == "e2e"
     ]
@@ -533,7 +560,10 @@ def append_baseline(recs) -> None:
         "Common-Crawl-style 301-file SequenceFile segment -> native "
         "C++ L1 -> host graph build (post-repair dangling semantics) "
         "-> pair-f64 jax engine, reference semantics, 10 iterations "
-        "-> per-iteration Spark-format `PageRank{i}/` dumps. Gate: "
+        "-> per-iteration Spark-format `PageRank{i}/` dumps "
+        "(AsyncRankWriter + native bulk formatter; the Dumps column "
+        "is the EXPOSED L4 wall beyond solve, with the worker's "
+        "in-dump time and formatter rate in parentheses). Gate: "
         "normalized + mass-normalized L1 vs the f64 oracle <= 1e-6. "
         "All times seconds.\n\n"
         "| Run | Workload | Iters | L1 parse | Host build | "

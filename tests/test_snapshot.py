@@ -22,6 +22,68 @@ def test_text_dumper_reference_format(tmp_path):
     assert open(p2).read() == "(0,2.0)\n"
 
 
+def test_native_formatter_matches_python_repr_bytes():
+    """The native bulk formatter (the L4 fast path) must be BYTE-
+    identical to the Python per-line formatter — shortest-roundtrip
+    digits AND CPython's presentation policy (fixed vs scientific cut,
+    trailing .0, 2-digit exponents, inf/nan/-0.0 spellings) — across
+    boundary values and raw random bit patterns."""
+    import struct
+
+    from pagerank_tpu.ingest import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(3)
+    vals = [
+        0.0, -0.0, 1.0, -1.0, 0.1, 1 / 3, 1e15, 1e16, 1e17, -1e16,
+        9.999999999999999e15, 1e-4, 1e-5, -1e-5, 0.0001, 0.00001,
+        1e100, 1e-100, 5e-324, 1.7976931348623157e308,
+        float("inf"), float("-inf"), float("nan"),
+        2.0, 0.25, 1.5, 123456.789, 9007199254740993.0,
+    ]
+    vals += list(rng.standard_normal(2000))
+    vals += list(rng.standard_normal(1000) * 1e300)
+    vals += list(rng.standard_normal(1000) * 1e-300)
+    bits = rng.integers(0, 1 << 64, 4000, dtype=np.uint64)
+    vals += [struct.unpack("<d", struct.pack("<Q", int(b)))[0] for b in bits]
+    arr = np.array(vals, np.float64)
+    got = native.format_rank_lines_native(arr)
+    want = "".join(f"({i},{float(r)!r})\n" for i, r in enumerate(arr)).encode()
+    assert got == want
+
+    names = ["http://ex.com/a", "b", "日本語", "x" * 100]
+    arr2 = np.array([1.5, 0.25, 1e-7, 3.0])
+    enc = [s.encode() for s in names]
+    offs = np.zeros(5, np.int64)
+    np.cumsum([len(b) for b in enc], out=offs[1:])
+    got2 = native.format_rank_lines_native(arr2, b"".join(enc), offs)
+    want2 = "".join(
+        f"({k},{float(r)!r})\n" for k, r in zip(names, arr2)
+    ).encode()
+    assert got2 == want2
+
+
+def test_text_dumper_native_and_python_paths_agree(tmp_path, monkeypatch):
+    """TextDumper writes the same part-file bytes whether or not the
+    native formatter is available (f32 inputs widen to double first on
+    both paths)."""
+    from pagerank_tpu.ingest import native as native_mod
+
+    if not native_mod.available():
+        pytest.skip("native library unavailable")
+    ranks = np.array([1.5, 0.3333333333333333, 1e-20, 7.0], np.float32)
+    d1 = TextDumper(str(tmp_path / "fast"), names=["a", "b", "c", "d"])
+    p1 = d1.dump(0, ranks)
+    monkeypatch.setattr(
+        "pagerank_tpu.ingest.native.format_rank_lines_native",
+        lambda *a, **k: None,
+    )
+    d2 = TextDumper(str(tmp_path / "slow"), names=["a", "b", "c", "d"])
+    p2 = d2.dump(0, ranks)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
 def toy_graph(seed=0, n=50, e=300):
     rng = np.random.default_rng(seed)
     return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
