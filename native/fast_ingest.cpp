@@ -320,18 +320,22 @@ extern "C" {
 // Formats n "(key,value)\n" lines into out (capacity cap bytes).
 // Keys: when names_blob/name_offsets are non-null, key i is the byte
 // range [name_offsets[i], name_offsets[i+1]) of names_blob; otherwise
-// the decimal integer i. Returns bytes written, -1 if cap would be
-// exceeded (caller sizes cap from the documented per-line bound), or
-// -2 when the toolchain that built this library lacks floating-point
-// charconv (pre-GCC-11) — callers fall back to the Python formatter
-// without losing the rest of the library.
-int64_t format_rank_lines(const double* ranks, int64_t n,
-                          const char* names_blob,
-                          const int64_t* name_offsets, char* out,
-                          int64_t cap) {
+// the decimal integer key_base + i (key_base lets callers format in
+// bounded row chunks without the keys restarting — the symbol carries
+// a "2" so a stale prebuilt .so without the parameter makes the
+// Python binding fall back instead of silently misnumbering keys).
+// Returns bytes written, -1 if cap would be exceeded (caller sizes
+// cap from the documented per-line bound), or -2 when the toolchain
+// that built this library lacks floating-point charconv (pre-GCC-11)
+// — callers fall back to the Python formatter without losing the rest
+// of the library.
+int64_t format_rank_lines2(const double* ranks, int64_t n,
+                           int64_t key_base, const char* names_blob,
+                           const int64_t* name_offsets, char* out,
+                           int64_t cap) {
 #if !defined(__cpp_lib_to_chars)
-  (void)ranks; (void)n; (void)names_blob; (void)name_offsets;
-  (void)out; (void)cap;
+  (void)ranks; (void)n; (void)key_base; (void)names_blob;
+  (void)name_offsets; (void)out; (void)cap;
   return -2;
 #else
   // repr of a double is at most 24 chars ("-1.7976931348623157e+308");
@@ -349,7 +353,7 @@ int64_t format_rank_lines(const double* ranks, int64_t n,
     } else {
       char kbuf[24];
       int nk = 0;
-      int64_t k = i;
+      int64_t k = key_base + i;
       if (k == 0) kbuf[nk++] = '0';
       while (k) { kbuf[nk++] = (char)('0' + k % 10); k /= 10; }
       while (nk) *q++ = kbuf[--nk];

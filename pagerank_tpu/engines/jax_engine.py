@@ -294,6 +294,10 @@ class JaxTpuEngine(PageRankEngine):
                     ),
                 )
             )
+            # vs_bounded: the packer deals dst blocks round-robin across
+            # the mesh's device ranges (ops/ell.deal_block_order) so the
+            # dst-partitioned rows balance (_setup_ell_vs_bounded).
+            deal = ndev if (cfg.vertex_sharded and cfg.vs_bounded) else 0
             if striped:
                 # An occupancy-widened span can push an explicit large
                 # lane_group past the packed-word int32 bound; clamp
@@ -309,12 +313,12 @@ class JaxTpuEngine(PageRankEngine):
                     )
                     group = grp
                 pack = ell_lib.ell_pack_striped(
-                    graph, stripe_size=span, group=group,
+                    graph, stripe_size=span, group=group, block_deal=deal,
                 )
                 srcs, weights, rbs = pack.src, pack.weight, pack.row_block
                 stripe_size = pack.stripe_size
             else:
-                pack = ell_lib.ell_pack(graph, group=group)
+                pack = ell_lib.ell_pack(graph, group=group, block_deal=deal)
                 srcs, weights, rbs = [pack.src], [pack.weight], [pack.row_block]
                 stripe_size = None
             self._pack = pack
@@ -659,6 +663,14 @@ class JaxTpuEngine(PageRankEngine):
             max(256, 32768 * 8 // fetch_lanes),
         })
         cand_max = chunk_cands[-1]
+        if cfg.vertex_sharded and cfg.vs_bounded:
+            self._setup_ell_vs_bounded(
+                src_slots, w_slots, row_block, mass_mask, zero_in, valid,
+                n=n, n_state=n_state, inv_out_rel=inv_out_rel, sz=sz,
+                n_stripes=n_stripes, gw=gw, group=group, z_dtype=z_dtype,
+                z_item=z_item, chunk_cands=chunk_cands,
+            )
+            return
         xp = np if isinstance(src_slots[0], np.ndarray) else jnp
         self._src, self._row_block, stripe_rows_dev = [], [], []
         present_ids, num_present, prefix_flags = [], [], []
@@ -1119,6 +1131,68 @@ class JaxTpuEngine(PageRankEngine):
             for s in range(n_stripes)
         ]
 
+    def _place_vs_state(self, mass_mask, zero_in, valid, inv_out_rel, *,
+                        n, n_vs, xp):
+        """Shard the persistent per-vertex state over the mesh in
+        contiguous vertex blocks (parallel/mesh.vertex_sharding),
+        padding every vector to ``n_vs`` (a multiple of 128*ndev so the
+        shards are even); padding is inert (valid=0, inv=0). Shared by
+        both vertex-sharded modes."""
+        cfg = self.config
+        dtype = self._dtype
+        vshard = mesh_lib.vertex_sharding(self._mesh)
+        n_state = len(mass_mask)
+        padv = n_vs - n_state
+
+        def pad_vs(a):
+            if padv == 0:
+                return xp.asarray(a)
+            a = xp.asarray(a)
+            return xp.concatenate([a, xp.zeros(padv, a.dtype)])
+
+        self._n_state = n_vs
+        self._state_sharding = vshard
+        self._dangling = jax.device_put(
+            pad_vs(xp.asarray(mass_mask, bool)), vshard
+        )
+        self._zero_in = jax.device_put(
+            pad_vs(xp.asarray(zero_in, bool)), vshard
+        )
+        valid = pad_vs(xp.asarray(valid, bool))
+        self._valid = jax.device_put(valid, vshard)
+        self._inv_out = jax.device_put(pad_vs(inv_out_rel), vshard)
+        r0_value = 1.0 if cfg.semantics == "reference" else 1.0 / n
+        r0 = xp.full(n_vs, r0_value, dtype=dtype) * valid
+        self._r = jax.device_put(jnp.asarray(r0, dtype=dtype), vshard)
+        self.iteration = 0
+
+    def _make_vs_tail(self, accum, n):
+        """update_tail's semantics on LOCAL vertex blocks: the two
+        scalar reductions (dangling mass, L1 delta) are per-shard
+        partials merged by psum; the elementwise update runs on the
+        shard. Same apply_update spelling as every other form. Shared
+        by both vertex-sharded modes."""
+        axis = self.config.mesh_axis
+        damping = self.config.damping
+        semantics = self.config.semantics
+
+        def vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l):
+            m = jax.lax.psum(
+                jnp.sum(dang_l.astype(accum) * r_l.astype(accum)), axis
+            )
+            r_new = pr_model.apply_update(
+                contrib_l, r_l.astype(accum), zin_l.astype(accum), m, n,
+                damping, semantics, jnp,
+            )
+            r_new = (r_new * valid_l.astype(accum)).astype(r_l.dtype)
+            delta = jax.lax.psum(
+                jnp.sum(jnp.abs(r_new.astype(accum) - r_l.astype(accum))),
+                axis,
+            )
+            return r_new, delta, m
+
+        return vs_tail
+
     def _setup_vertex_sharded(self, *, n_stripes, sz, gw, group, pair,
                               accum, num_blocks, chunks, num_present,
                               prefix_flags, ids, n, n_state, mass_mask,
@@ -1171,59 +1245,19 @@ class JaxTpuEngine(PageRankEngine):
         mesh = self._mesh
         axis = cfg.mesh_axis
         ndev = mesh.devices.size
-        dtype = self._dtype
-        vshard = mesh_lib.vertex_sharding(mesh)
 
         unit = 128 * ndev
         n_vs = -(-n_state // unit) * unit
         padv = n_vs - n_state
 
-        def pad_vs(a):
-            if padv == 0:
-                return xp.asarray(a)
-            a = xp.asarray(a)
-            return xp.concatenate([a, xp.zeros(padv, a.dtype)])
-
         self._kernel = "ell"
-        self._n_state = n_vs
-        self._state_sharding = vshard
-        self._dangling = jax.device_put(
-            pad_vs(xp.asarray(mass_mask, bool)), vshard
+        self._place_vs_state(
+            mass_mask, zero_in, valid, inv_out_rel, n=n, n_vs=n_vs, xp=xp
         )
-        self._zero_in = jax.device_put(
-            pad_vs(xp.asarray(zero_in, bool)), vshard
-        )
-        valid = pad_vs(xp.asarray(valid, bool))
-        self._valid = jax.device_put(valid, vshard)
-        self._inv_out = jax.device_put(pad_vs(inv_out_rel), vshard)
-        r0_value = 1.0 if cfg.semantics == "reference" else 1.0 / n
-        r0 = xp.full(n_vs, r0_value, dtype=dtype) * valid
-        self._r = jax.device_put(jnp.asarray(r0, dtype=dtype), vshard)
-        self.iteration = 0
 
         total_z = n_stripes * sz
-        damping = cfg.damping
-        semantics = cfg.semantics
 
-        def vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l):
-            """update_tail's semantics on LOCAL vertex blocks: the two
-            scalar reductions (dangling mass, L1 delta) are per-shard
-            partials merged by psum; the elementwise update runs on the
-            shard. Same apply_update spelling as every other form."""
-            m = jax.lax.psum(
-                jnp.sum(dang_l.astype(accum) * r_l.astype(accum)), axis
-            )
-            r_new = pr_model.apply_update(
-                contrib_l, r_l.astype(accum), zin_l.astype(accum), m, n,
-                damping, semantics, jnp,
-            )
-            r_new = (r_new * valid_l.astype(accum)).astype(r_l.dtype)
-            delta = jax.lax.psum(
-                jnp.sum(jnp.abs(r_new.astype(accum) - r_l.astype(accum))),
-                axis,
-            )
-            return r_new, delta, m
-
+        vs_tail = self._make_vs_tail(accum, n)
         self._vs_tail = vs_tail
 
         def gather_z(r_l, inv_l):
@@ -1373,6 +1407,253 @@ class JaxTpuEngine(PageRankEngine):
         )
         self._ms_ids = list(ids)
         self._ms_n_stripes = n_stripes
+
+    def _setup_ell_vs_bounded(self, src_slots, w_slots, row_blocks,
+                              mass_mask, zero_in, valid, *, n, n_state,
+                              inv_out_rel, sz, n_stripes, gw, group,
+                              z_dtype, z_item, chunk_cands):
+        """Destination-partitioned (owner-computes) vertex sharding —
+        config.vs_bounded, VERDICT r4 #1 / ROADMAP "Engine" stages
+        (a)+(b). The plain vertex-sharded mode shards the persistent
+        per-vertex state but each chip still materializes O(N) step
+        transients: the all_gathered z planes and the [num_blocks, 128]
+        accumulator, merged by an O(N)-per-chip psum. Here:
+
+          - dst blocks are DEALT round-robin across contiguous device
+            ranges by in-degree depth (ops/ell.deal_block_order,
+            composed into the relabel by the packer), so each device's
+            range carries a near-equal share of slot rows despite
+            power-law skew;
+          - each device holds exactly the slot rows whose dst block
+            falls in its OWN range (stage b): the contribution
+            accumulator shrinks to the local [num_blocks/ndev, 128]
+            and the cross-device contribution merge disappears — the
+            per-dst sums are computed where they are owned;
+          - the per-stripe z planes are built by one [stripe_span] psum
+            each (stage a): every device zero-extends its local z
+            shard, takes a clamped dynamic-slice at the stripe's
+            offset (non-overlapping devices land wholly in the zero
+            pads), and the psum of those disjoint slices IS the
+            replicated stripe plane — exact, since each element has
+            one nonzero contributor.
+
+        Per-chip per-step transients are O(stripe_span + N/ndev) —
+        never O(N) — and per-iteration ICI traffic is one psum of
+        ~total_z = N elements (the plain mode moves the same N through
+        all_gather + psum). Numerics: a dst block's rows are summed on
+        ONE chip (sequential chunked scan) instead of split across
+        chips and psum-merged, so ranks agree with the other modes to
+        accumulation-dtype rounding, not bitwise (identical at ndev=1,
+        where this mode degenerates to the same row order).
+
+        Every run form executes as pipelined per-stripe dispatches (the
+        multi-dispatch machinery; run_fused/run_fused_tol delegate via
+        run_fused_chunked), regardless of stripe count — one
+        construction, one code path. The analogue in the reference:
+        Spark's reduceByKey delivers each key's sums to the partition
+        that OWNS the key (Sparky.java:229), which is precisely
+        owner-computes; the plain mode's merge-everywhere was the
+        deviation. Requires a host-built graph (the device builder
+        does not deal dst blocks)."""
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        ndev = mesh.devices.size
+        accum = self._accum_dtype
+        pair = self._pair
+        if not isinstance(src_slots[0], np.ndarray):
+            raise ValueError(
+                "vs_bounded requires a host-built graph (build(), not "
+                "build_device: the device builder does not deal dst "
+                "blocks across device ranges)"
+            )
+
+        unit = 128 * ndev
+        n_vs = -(-n_state // unit) * unit
+        blk = n_vs // ndev
+        nbd = blk // 128  # local dst blocks per device
+
+        inv_out_rel = np.asarray(inv_out_rel)
+        if inv_out_rel.dtype != z_dtype:
+            inv_out_rel = inv_out_rel.astype(z_dtype)
+        self._kernel = "ell"
+        self._place_vs_state(
+            mass_mask, zero_in, valid, inv_out_rel, n=n, n_vs=n_vs, xp=np
+        )
+
+        # -- dst-partitioned slot placement --------------------------------
+        log2g = group.bit_length() - 1
+        sent = np.int32(sz << log2g)
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+        e_shard = mesh_lib.edge_sharding(mesh)
+        cand_max = chunk_cands[-1]
+
+        self._src, self._row_block = [], []
+        ids_list, num_present, stripe_rows_dev = [], [], []
+        dev_bounds = np.arange(ndev + 1, dtype=np.int64) * nbd
+        for s in range(n_stripes):
+            if w_slots[s] is None:
+                ss_all = src_slots[s]
+            else:
+                ss_all = np.where(w_slots[s] != 0, src_slots[s], sent)
+            rb_all = row_blocks[s]
+            # row_block is ascending, so each device's rows are one
+            # contiguous run ending at its dst-range boundary.
+            cuts = np.searchsorted(rb_all, dev_bounds)
+            per_dev = []
+            rows_max = 1
+            for d in range(ndev):
+                lo, hi = int(cuts[d]), int(cuts[d + 1])
+                rb_local = (
+                    rb_all[lo:hi].astype(np.int64) - d * nbd
+                ).astype(np.int32)
+                rk, ids_d, pc, _prefix = ell_lib.dense_block_ranks(
+                    rb_local, nbd
+                )
+                per_dev.append((ss_all[lo:hi], rk, ids_d, pc))
+                rows_max = max(rows_max, hi - lo)
+            Ps = max(pc for (_, _, _, pc) in per_dev)
+            if rows_max >= cand_max:
+                chunk_rows = cand_max
+            else:
+                chunk_rows = 1 << (rows_max - 1).bit_length()
+            rows_pad = -(-rows_max // chunk_rows) * chunk_rows
+            ss_parts, rk_parts, ids_parts = [], [], []
+            for ssd, rk, ids_d, pc in per_dev:
+                padr = rows_pad - ssd.shape[0]
+                if padr:
+                    # Pad rows are all-sentinel (zero gather) at the
+                    # LAST rank — kept ascending; their zero sums land
+                    # on a real rank or drop out of the chunk span.
+                    ssd = np.concatenate(
+                        [ssd, np.full((padr, 128), sent, np.int32)]
+                    )
+                    rk = np.concatenate(
+                        [rk, np.full(padr, Ps - 1, np.int32)]
+                    )
+                if ids_d.shape[0] < Ps:
+                    # Repeat the last id: sorted is preserved, so the
+                    # finalize scatter claims sorted (NOT unique), and
+                    # the padded ranks carry zero sums.
+                    ids_d = np.concatenate([
+                        ids_d,
+                        np.full(Ps - ids_d.shape[0], ids_d[-1], np.int32),
+                    ])
+                ss_parts.append(ssd)
+                rk_parts.append(rk)
+                ids_parts.append(ids_d)
+            self._src.append(
+                jax.device_put(np.concatenate(ss_parts), shard2d)
+            )
+            self._row_block.append(
+                jax.device_put(np.concatenate(rk_parts), e_shard)
+            )
+            ids_list.append(jax.device_put(np.stack(ids_parts), shard2d))
+            num_present.append(Ps)
+            stripe_rows_dev.append(rows_pad)
+
+        chosen = self._autotune_chunk(
+            chunk_cands, stripe_rows_dev, sz, z_item, gw, group, pair,
+            accum, num_present, ndev,
+        )
+        ell_chunks = [min(chosen, r) for r in stripe_rows_dev]
+
+        # -- step construction: always the multi-dispatch machinery --------
+        zd = jnp.dtype(z_dtype)
+
+        def pres(r_l, inv_l):
+            return (r_l.astype(zd) * inv_l,)
+
+        self._ms_prescale = jax.jit(shard_map(
+            pres, mesh=mesh,
+            in_specs=(P(axis), P(axis)), out_specs=(P(axis),),
+        ))
+
+        def make_stripe_fn(s, Ps, ck):
+            def stripe_body(z_l, src, rb):
+                # Stage (a): per-stripe z broadcast. The start is
+                # clipped EXPLICITLY: lax.dynamic_slice treats negative
+                # starts as from-the-end (NumPy semantics), so a
+                # no-overlap device's negative offset would wrap into
+                # real data instead of landing in the zero pads. After
+                # the clip, both out-of-range destinations are zero
+                # pads, overlapping devices are in-range (no clip), and
+                # each element of the psum has ONE nonzero contributor
+                # (exact).
+                zeros = jnp.zeros(sz, z_l.dtype)
+                ze = jnp.concatenate([zeros, z_l, zeros])
+                off = jnp.clip(
+                    s * sz + sz - jax.lax.axis_index(axis) * blk,
+                    0, blk + sz,
+                )
+                zp = jax.lax.dynamic_slice_in_dim(ze, off, sz)
+                zp = jax.lax.psum(zp, axis)
+                zp = jnp.concatenate([zp, jnp.zeros(gw, zp.dtype)])
+                if pair:
+                    hi, lo = _split_pair(zp)
+                    part = spmv.ell_contrib_pair(
+                        hi, lo, src, rb, Ps, accum_dtype=accum,
+                        gather_width=gw, chunk_rows=ck, group=group,
+                        num_present=Ps,
+                    )
+                else:
+                    part = spmv.ell_contrib(
+                        zp, src, rb, Ps, accum_dtype=accum,
+                        gather_width=gw, chunk_rows=ck, group=group,
+                        num_present=Ps,
+                    )
+                return part.reshape(1, Ps, 128)
+
+            return jax.jit(shard_map(
+                stripe_body, mesh=mesh,
+                in_specs=(P(axis), P(axis, None), P(axis)),
+                out_specs=P(axis, None, None),
+            ))
+
+        self._ms_stripe_fns = [
+            make_stripe_fn(s, num_present[s], ell_chunks[s])
+            for s in range(n_stripes)
+        ]
+        self._ms_stripe = self._ms_stripe_fns[0]
+
+        vs_tail = self._make_vs_tail(accum, n)
+        S = n_stripes
+
+        def final_body(r_l, *rest):
+            parts = rest[:S]
+            ids_l = rest[S : 2 * S]
+            dang_l, zin_l, valid_l = rest[2 * S :]
+            total = jnp.zeros((nbd, 128), accum)
+            for s in range(S):
+                # Stage (b): each device's partials land ONLY in its
+                # own local dst range — no cross-device merge exists.
+                # Pad ids repeat the last id (zero partials): sorted,
+                # not unique.
+                total = total.at[ids_l[s][0]].add(
+                    parts[s][0], indices_are_sorted=True
+                )
+            return vs_tail(total.reshape(-1), r_l, dang_l, zin_l, valid_l)
+
+        self._ms_final = jax.jit(
+            shard_map(
+                final_body, mesh=mesh,
+                in_specs=(P(axis),)
+                + (P(axis, None, None),) * S
+                + (P(axis, None),) * S
+                + (P(axis),) * 3,
+                out_specs=(P(axis), P(), P()),
+            ),
+            donate_argnums=(0,),
+        )
+        self._ms_ids = ids_list
+        self._ms_n_stripes = S
+        self._inv_in_args = True
+        self._contrib_args = ()
+        self._fused_cache = {}
+        self.last_run_metrics = {
+            "l1_delta": np.zeros(0, self._accum_dtype),
+            "dangling_mass": np.zeros(0, self._accum_dtype),
+        }
 
     def _finalize(self, contrib_fn, contrib_args, mass_mask, zero_in, valid,
                   n, n_state, prescale=None):

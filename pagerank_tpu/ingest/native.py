@@ -127,11 +127,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
             # Newer symbol: guard so a prebuilt .so from older sources
             # keeps its existing entry points (only the formatter falls
             # back to Python then).
-            if hasattr(lib, "format_rank_lines"):
-                lib.format_rank_lines.restype = ctypes.c_int64
-                lib.format_rank_lines.argtypes = [
+            if hasattr(lib, "format_rank_lines2"):
+                lib.format_rank_lines2.restype = ctypes.c_int64
+                lib.format_rank_lines2.argtypes = [
                     np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
                     ctypes.c_int64,
+                    ctypes.c_int64,   # key_base for integer keys
                     ctypes.c_char_p,  # names blob (or None)
                     ctypes.c_void_p,  # int64 offsets (or None)
                     np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
@@ -357,15 +358,18 @@ def format_rank_lines_native(
     ranks: np.ndarray,
     names_blob: Optional[bytes] = None,
     name_offsets: Optional[np.ndarray] = None,
+    key_base: int = 0,
 ) -> Optional[bytes]:
     """Bulk "(key,repr(value))\\n" text formatting — the native L4 fast
     path behind utils/snapshot.TextDumper. Byte-identical to the Python
     per-line formatter (differentially fuzzed in tests/test_snapshot.py);
     returns None when the native library is unavailable (or predates
     the symbol, or was built by a toolchain without floating-point
-    charconv — callers take the Python loop)."""
+    charconv — callers take the Python loop). ``key_base`` offsets the
+    integer keys so callers can format bounded row chunks; with names,
+    pass the chunk's rebased blob/offsets instead."""
     lib = get_lib()
-    if lib is None or not hasattr(lib, "format_rank_lines"):
+    if lib is None or not hasattr(lib, "format_rank_lines2"):
         return None
     ranks = np.ascontiguousarray(ranks, dtype=np.float64)
     n = ranks.shape[0]
@@ -382,7 +386,9 @@ def format_rank_lines_native(
         cap = 48 * n + 1
         offs_p = None
     out = np.empty(cap, np.uint8)
-    wrote = lib.format_rank_lines(ranks, n, names_blob, offs_p, out, cap)
+    wrote = lib.format_rank_lines2(
+        ranks, n, key_base, names_blob, offs_p, out, cap
+    )
     if wrote == -2:  # library built without floating-point charconv
         return None
     if wrote < 0:  # cap bound violated — impossible per the line math

@@ -71,13 +71,14 @@ class EllPack:
         return slots / max(1, self.num_real_edges)
 
 
-def ell_pack(graph: Graph, group: int = 1) -> EllPack:
+def ell_pack(graph: Graph, group: int = 1, block_deal: int = 0) -> EllPack:
     """Pack a dst-sorted COO graph into blocked-ELL form (the
     single-stripe specialization of :func:`ell_pack_striped` — one stripe
     spanning the whole padded vertex range, so stripe-local source ids
     equal relabeled ids)."""
     n_padded = -(-graph.n // LANES) * LANES
-    sp = ell_pack_striped(graph, stripe_size=max(LANES, n_padded), group=group)
+    sp = ell_pack_striped(graph, stripe_size=max(LANES, n_padded), group=group,
+                          block_deal=block_deal)
     if sp.n_stripes == 0:  # n == 0 edge case: no stripes at all
         src = np.zeros((0, LANES), np.int32)
         weight = np.zeros((0, LANES), np.float64)
@@ -136,8 +137,91 @@ class StripedEllPack:
         return self.num_rows * LANES / max(1, self.num_real_edges)
 
 
+def deal_block_order(n: int, n_padded: int, ndev: int,
+                     weights=None) -> np.ndarray:
+    """Block-level deal permutation for destination-partitioned
+    (owner-computes) vertex sharding: dst blocks — 128-vertex groups of
+    the in-degree-DESCENDING relabel, so block index is depth rank —
+    are dealt across ``ndev`` contiguous device ranges of
+    ``ceil(num_blocks/ndev)`` block slots each by capacity-constrained
+    LPT (longest-processing-time greedy: each block, visited in depth
+    order, goes to the least-loaded device with slots left). Each
+    device then owns a near-equal share of slot rows — measured
+    max/mean 1.01 at R-MAT scale 20 vs 1.83 for round-robin (the
+    single hottest block can't be split, so round-robin's fixed stride
+    leaves the ceil-floor skew unbalanced) and 7.3 for undealt
+    contiguous ranges. FILLED slots stay contiguous from 0: the
+    partial block (n % 128 vertices), if any, lands globally last, and
+    virtual padding block slots trail it — so the dealt vertex order
+    is still a dense permutation of [0, n).
+
+    ``weights``: per-filled-block load estimates ([n_padded/128]
+    array; the packer passes exact unstriped row counts). None = equal
+    weights (degenerates to round-robin-with-quotas).
+
+    The greedy loop is a Python heap over the blocks — O(nb log ndev),
+    ~2s at 524k blocks (scale 26), amortized into a build that is
+    minutes at that scale.
+
+    Returns ``new_of_old`` (int64 [n_padded/128]): old block id -> new
+    block id. New block ids b land on device b // ceil(nb/ndev).
+    """
+    import heapq
+
+    nb_fill = n_padded // LANES
+    nb_full = n // LANES
+    partial = nb_fill != nb_full
+    nbd = -(-nb_fill // ndev)
+    devs = np.arange(ndev)
+    # Filled-slot capacity per device: filled slots pack global new ids
+    # 0..nb_fill-1, so trailing devices may be short or empty.
+    cap = np.clip(nb_fill - devs * nbd, 0, nbd)
+    quota = cap.copy()
+    if partial:
+        quota[(nb_fill - 1) // nbd] -= 1  # reserve the LAST filled slot
+    if weights is None:
+        w = np.ones(nb_fill)
+    else:
+        w = np.asarray(weights, dtype=np.float64)
+        if w.shape != (nb_fill,):
+            raise ValueError(
+                f"weights must have shape ({nb_fill},), got {w.shape}"
+            )
+    # LPT with capacities; ties broken by device id for determinism.
+    heap = [(0.0, int(d)) for d in devs]
+    counts = np.zeros(ndev, np.int64)
+    new_of_old = np.empty(nb_fill, np.int64)
+    for j in range(nb_full):
+        while True:
+            load, d = heapq.heappop(heap)
+            if counts[d] < quota[d]:
+                break
+        new_of_old[j] = d * nbd + counts[d]
+        counts[d] += 1
+        heapq.heappush(heap, (load + w[j], d))
+    if partial:
+        new_of_old[nb_full] = nb_fill - 1
+    return new_of_old
+
+
+def block_row_weights(in_degree_sorted: np.ndarray, n_padded: int,
+                      group: int) -> np.ndarray:
+    """Exact unstriped slot-row count per dst block from the in-degree
+    vector in RELABELED (descending) order — the packer's own formula
+    (rows = max over lane groups of ceil(group_edges/group), min 1) —
+    used as the LPT deal weight. Striping adds per-stripe row floors on
+    top; this remains the right relative ordering."""
+    nb = n_padded // LANES
+    pad = n_padded - len(in_degree_sorted)
+    d = np.concatenate([
+        in_degree_sorted.astype(np.int64), np.zeros(pad, np.int64)
+    ])
+    ge = d.reshape(nb, LANES // group, group).sum(axis=2)
+    return np.maximum(1, -(-ge.max(axis=1) // group))
+
+
 def ell_pack_striped(
-    graph: Graph, stripe_size: int, group: int = 1
+    graph: Graph, stripe_size: int, group: int = 1, block_deal: int = 0
 ) -> StripedEllPack:
     """Pack a graph into source-striped blocked-ELL form.
 
@@ -145,6 +229,10 @@ def ell_pack_striped(
     relabeled id in [s*stripe_size, (s+1)*stripe_size) land in stripe s.
     ``group`` (power of two, <= 128) enables the grouped-lane layout:
     slot words become ``(src << log2(group)) | lane_sub``.
+    ``block_deal`` > 1 composes :func:`deal_block_order` over that many
+    device ranges into the relabel (the dst-partitioned vertex-sharded
+    mode); per-block lane composition — and therefore ELL padding — is
+    unchanged, only whole blocks move.
     """
     if stripe_size <= 0 or stripe_size % LANES:
         raise ValueError(f"stripe_size must be a positive multiple of {LANES}")
@@ -156,6 +244,18 @@ def ell_pack_striped(
     n_stripes = -(-n_padded // stripe_size)
 
     order = np.argsort(-graph.in_degree.astype(np.int64), kind="stable")
+    if block_deal > 1 and n:
+        new_of_old = deal_block_order(
+            n, n_padded, block_deal,
+            weights=block_row_weights(
+                graph.in_degree[order], n_padded, group
+            ),
+        )
+        ids = np.arange(n, dtype=np.int64)
+        new_pos = (new_of_old[ids >> 7] << 7) | (ids & 127)
+        dealt = np.empty(n, order.dtype)
+        dealt[new_pos] = order
+        order = dealt
     perm = order.astype(np.int32)
     inv_perm = np.empty(n, dtype=np.int32)
     inv_perm[perm] = np.arange(n, dtype=np.int32)

@@ -113,6 +113,11 @@ class TextDumper:
             self._blob = (b"".join(enc), offs)
         return self._blob
 
+    #: Rows formatted per write: bounds the formatter's transient output
+    #: buffer (48 B/line integer-key cap -> ~50 MB per chunk) so a dump
+    #: at any scale runs in O(chunk) extra RSS, not O(n).
+    CHUNK_ROWS = 1 << 20
+
     def dump(self, iteration: int, ranks: np.ndarray) -> str:
         from pagerank_tpu.ingest.native import format_rank_lines_native
 
@@ -120,24 +125,33 @@ class TextDumper:
         fsio.makedirs(d, exist_ok=True)
         path = fsio.join(d, "part-00000")
         tmp = path + ".tmp"
-        data = None
-        if self.names is None:
-            data = format_rank_lines_native(ranks)
-        else:
-            blob = self._names_blob(len(ranks))
-            if blob is not None:
-                data = format_rank_lines_native(ranks, blob[0], blob[1])
-        if data is None:
-            # Python fallback — encoded to utf-8 bytes explicitly so
-            # the two paths stay byte-identical on any locale/platform
-            # (text mode would use the locale codec and '\n' translation).
-            data = "".join(
-                f"({self.names[i] if self.names is not None else i},"
-                f"{float(r)!r})\n"
-                for i, r in enumerate(ranks)
-            ).encode("utf-8")
+        blob = None if self.names is None else self._names_blob(len(ranks))
         with fsio.fopen(tmp, "wb") as f:
-            f.write(data)
+            for lo in range(0, len(ranks), self.CHUNK_ROWS):
+                hi = min(lo + self.CHUNK_ROWS, len(ranks))
+                chunk = ranks[lo:hi]
+                if self.names is None:
+                    data = format_rank_lines_native(chunk, key_base=lo)
+                elif blob is not None:
+                    offs = blob[1]
+                    data = format_rank_lines_native(
+                        chunk,
+                        blob[0][offs[lo] : offs[hi]],
+                        offs[lo : hi + 1] - offs[lo],
+                    )
+                else:
+                    data = None
+                if data is None:
+                    # Python fallback — encoded to utf-8 bytes
+                    # explicitly so the two paths stay byte-identical
+                    # on any locale/platform (text mode would use the
+                    # locale codec and '\n' translation).
+                    data = "".join(
+                        f"({self.names[i] if self.names is not None else i},"
+                        f"{float(r)!r})\n"
+                        for i, r in enumerate(chunk, start=lo)
+                    ).encode("utf-8")
+                f.write(data)
         fsio.replace(tmp, path)
         # Hadoop job-completion marker (saveAsTextFile writes one per
         # output dir); written LAST so its presence certifies a

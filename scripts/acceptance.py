@@ -71,6 +71,19 @@ CONFIGS = {
     # overlap + score L1 (VERDICT r2 #6).
     "P": dict(scale=20, iters=20, sources=256, topk=100, kind="ppr",
               label="config-5 stand-in (PPR, 256 sources)"),
+    # Vertex-sharded variants on the real chip (VERDICT r4 #3): the
+    # 1e-6 oracle gate through the psum+slice f64 merge (BV/TV) and
+    # the r5 dst-partitioned bounded mode (BB). CV is the config-4
+    # class, opt-in like C.
+    "BV": dict(scale=23, iters=30, vertex_sharded=True,
+               label="config-3 stand-in, VERTEX-SHARDED (psum+slice merge)"),
+    "BB": dict(scale=23, iters=30, vertex_sharded=True, vs_bounded=True,
+               label="config-3 stand-in, VS-BOUNDED (dst-partitioned)"),
+    "TV": dict(scale=20, iters=50, semantics="textbook",
+               vertex_sharded=True,
+               label="textbook-mode stand-in, VERTEX-SHARDED"),
+    "CV": dict(scale=24, iters=50, vertex_sharded=True,
+               label="config-4 per-chip stand-in, VERTEX-SHARDED"),
     # The reference's LITERAL job, end to end (VERDICT r3 weak #3): a
     # multi-file SequenceFile segment of crawl metadata (301 files,
     # the reference's metadata-%05d naming, Sparky.java:44-58) ->
@@ -82,7 +95,7 @@ CONFIGS = {
     "E": dict(kind="e2e", files=301, records=1000, iters=10,
               label="reference-job end-to-end (301-file segment)"),
 }
-DEFAULT_KEYS = ["A", "B", "T", "P", "E"]
+DEFAULT_KEYS = ["A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # PPR gates. Top-k membership is judged against ORACLE SCORES, not id
 # sets: vertices tied at the k-th score legitimately swap in/out of an
@@ -417,6 +430,13 @@ def run_one(key: str):
     cfg_pair = PageRankConfig(
         num_iters=iters, dtype="float64", accum_dtype="float64",
         wide_accum="pair", semantics=semantics,
+        # Sharded variants (VERDICT r4 #3): vertex_sharded=True on the
+        # single real chip exercises the psum+slice f64 contribution
+        # merge (and, with vs_bounded, the dst-partitioned owner-
+        # computes path + per-stripe z psum) under the same oracle
+        # gate as the replicated rows.
+        vertex_sharded=spec.get("vertex_sharded", False),
+        vs_bounded=spec.get("vs_bounded", False),
     )
     t0 = time.perf_counter()
     eng = JaxTpuEngine(cfg_pair).build(g)

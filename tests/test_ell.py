@@ -208,3 +208,73 @@ def test_pallas_probe_failure_falls_back_to_ell(monkeypatch):
                            accum_dtype="float64")
     r_ref = ReferenceCpuEngine(cfg64).build(g).run()
     np.testing.assert_allclose(r, r_ref, rtol=0, atol=1e-4)
+
+
+def test_deal_block_order_properties():
+    """deal_block_order (the vs_bounded dst deal): a valid block
+    permutation with filled slots contiguous from 0, the partial block
+    globally last, and near-equal per-device round-robin shares."""
+    for n, ndev in [(1000, 8), (128 * 7, 4), (128 * 16, 8), (130, 8),
+                    (100, 3), (128, 1)]:
+        n_padded = -(-n // 128) * 128
+        nb_fill = n_padded // 128
+        new_of_old = ell_lib.deal_block_order(n, n_padded, ndev)
+        assert sorted(new_of_old) == sorted(set(new_of_old))  # injective
+        nbd = -(-nb_fill // ndev)
+        assert new_of_old.max() < nbd * ndev
+        # filled slots pack 0..nb_fill-1 (holes all trailing)
+        assert set(new_of_old) == set(range(nb_fill))
+        if n % 128:
+            assert new_of_old[-1] == nb_fill - 1  # partial block last
+        # round-robin: early full blocks spread one per device
+        if nb_fill >= ndev:
+            first_round = new_of_old[:ndev] // nbd
+            assert sorted(first_round) == list(range(ndev))
+
+
+def test_pack_with_deal_matches_undealt_spmv():
+    """A dealt pack computes the same SpMV (in original id space) as
+    the plain pack — only the relabel moves."""
+    g = random_graph(seed=11, n=700, e=6000)
+    rng = np.random.default_rng(2)
+    z = rng.random(g.n)
+    expected = to_csr_transpose(g) @ z
+
+    for deal in (2, 8):
+        pack = ell_lib.ell_pack(g, block_deal=deal)
+        assert sorted(pack.perm) == list(range(g.n))  # still a permutation
+        y_rel = ell_lib.ell_spmv_reference(pack, z[pack.perm])
+        y = np.empty(g.n)
+        y[pack.perm] = y_rel
+        np.testing.assert_allclose(y, expected, rtol=1e-12)
+        # dealing whole blocks preserves slot count (ELL padding)
+        plain = ell_lib.ell_pack(g)
+        assert pack.num_rows == plain.num_rows
+
+
+def test_deal_balances_row_load():
+    """On a power-law graph the dealt (LPT-weighted) block ranges carry
+    near-equal row counts, where contiguous ranges are dominated by
+    device 0 (the in-degree-descending relabel piles every hot block
+    there). The residual imbalance is the single hottest block, which
+    no assignment can split."""
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    src, dst = rmat_edges(14, edge_factor=16, seed=5)
+    g = build_graph(src, dst, n=1 << 14)
+    ndev = 8
+    pack = ell_lib.ell_pack(g, block_deal=ndev, group=16)
+    nb = pack.n_padded // 128
+    nbd = -(-nb // ndev)
+    rows_per_dev = np.bincount(
+        np.minimum(pack.row_block // nbd, ndev - 1), minlength=ndev
+    )
+    plain = ell_lib.ell_pack(g, group=16)
+    plain_rows = np.bincount(
+        np.minimum(plain.row_block // nbd, ndev - 1), minlength=ndev
+    )
+    depths = np.bincount(plain.row_block, minlength=nb)
+    # LPT bound: max load <= mean + the hottest block
+    assert rows_per_dev.max() <= rows_per_dev.mean() + depths.max()
+    assert rows_per_dev.max() < plain_rows.max()
+    assert plain_rows.max() > 2 * plain_rows.mean()

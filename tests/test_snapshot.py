@@ -84,6 +84,29 @@ def test_text_dumper_native_and_python_paths_agree(tmp_path, monkeypatch):
     assert open(p1, "rb").read() == open(p2, "rb").read()
 
 
+def test_text_dumper_chunked_writes_match_unchunked(tmp_path, monkeypatch):
+    """Forcing tiny write chunks (the bounded-RSS path) produces the
+    same bytes as one chunk, integer and named keys alike."""
+    from pagerank_tpu.utils.snapshot import TextDumper as TD
+
+    rng = np.random.default_rng(9)
+    ranks = rng.random(1000)
+    names = [f"http://x/{i}" for i in range(1000)]
+    d_ref = TD(str(tmp_path / "one"), names=names)
+    p_ref = d_ref.dump(0, ranks)
+    monkeypatch.setattr(TD, "CHUNK_ROWS", 37)
+    d_c = TD(str(tmp_path / "chunked"), names=names)
+    p_c = d_c.dump(0, ranks)
+    assert open(p_c, "rb").read() == open(p_ref, "rb").read()
+    di_ref = TD(str(tmp_path / "ione"))
+    monkeypatch.setattr(TD, "CHUNK_ROWS", 1 << 20)
+    pi_ref = di_ref.dump(0, ranks)
+    monkeypatch.setattr(TD, "CHUNK_ROWS", 37)
+    di_c = TD(str(tmp_path / "ichunked"))
+    pi_c = di_c.dump(0, ranks)
+    assert open(pi_c, "rb").read() == open(pi_ref, "rb").read()
+
+
 def toy_graph(seed=0, n=50, e=300):
     rng = np.random.default_rng(seed)
     return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
