@@ -62,9 +62,22 @@ def _enable_compile_cache():
     )
 
 
-def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
+def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
+             measure_warm_build: bool = False):
     """One throughput measurement: build (device by default) + timed
     stepwise loop with the honest scalar fence. Returns the result dict.
+
+    ``measure_warm_build`` (VERDICT r4 weak #4): after the rate loop,
+    rebuild the same graph once more and report it as ``build_warm_s``.
+    The first build's cost depends on the state of the persistent
+    tuning+compile cache (cold on a fresh checkout — .jax_cache is
+    gitignored — warm on repeat runs); the rebuild is warm BY
+    CONSTRUCTION, so the JSON carries one number that reproduces
+    (PERF_NOTES "Device-build cost": 22.8s warm vs 30.4s cold at
+    scale 23) and one that describes this run's actual cache state.
+    DEVICE builds only: the host path's cost is numpy generation +
+    pack + transfer, which no cache affects — a rebuild there would
+    measure nothing and mislabel it.
     """
     from pagerank_tpu import PageRankConfig, build_graph
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
@@ -93,15 +106,13 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
     )
     cfg = cfg.replace(lane_group=grp)
 
-    t0 = time.perf_counter()
-    if host_build:
-        from pagerank_tpu.utils.synth import rmat_edges
+    def do_build():
+        if host_build:
+            from pagerank_tpu.utils.synth import rmat_edges
 
-        src, dst = rmat_edges(args.scale, args.edge_factor, seed=0)
-        graph = build_graph(src, dst, n=1 << args.scale)
-        num_edges = graph.num_edges
-        engine = JaxTpuEngine(cfg).build(graph)
-    else:
+            src, dst = rmat_edges(args.scale, args.edge_factor, seed=0)
+            graph = build_graph(src, dst, n=1 << args.scale)
+            return JaxTpuEngine(cfg).build(graph), graph.num_edges
         from pagerank_tpu.ops import device_build as db
 
         src, dst = db.rmat_edges_device(args.scale, args.edge_factor, seed=0)
@@ -112,8 +123,10 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
             stripe_size=0 if pallas else stripe,
             with_weights=False,  # presentinel: no per-slot weight plane
         )
-        num_edges = dg.num_edges
-        engine = JaxTpuEngine(cfg).build_device(dg)
+        return JaxTpuEngine(cfg).build_device(dg), dg.num_edges
+
+    t0 = time.perf_counter()
+    engine, num_edges = do_build()
     t_build = time.perf_counter() - t0
     label = f"{dtype}" + (f"+{accum_dtype}-accum" if accum_dtype != dtype else "")
     if wide_accum == "pair":
@@ -143,11 +156,22 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto"):
         file=sys.stderr,
     )
     del engine  # free HBM before the next config builds
-    return {
+    out = {
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
         "build_s": t_build,  # graph build wall-clock (VERDICT r3 weak #1)
     }
+    if measure_warm_build and not host_build:
+        t0 = time.perf_counter()
+        engine2, _ = do_build()
+        out["build_warm_s"] = time.perf_counter() - t0
+        print(
+            f"build[{label}]: first {t_build:.1f}s, warm rebuild "
+            f"{out['build_warm_s']:.1f}s (tuning+compile cache)",
+            file=sys.stderr,
+        )
+        del engine2
+    return out
 
 
 def run_accuracy(scale: int = 20, iters: int = 50):
@@ -261,7 +285,8 @@ def main(argv=None):
     # north-star couple. wide_accum is PINNED to pair so the headline
     # measures the same kernel the accuracy probe certifies on every
     # backend ("auto" would resolve to native f64 off-TPU).
-    pair_rate = run_rate(args, "float64", "float64", wide_accum="pair")
+    pair_rate = run_rate(args, "float64", "float64", wide_accum="pair",
+                         measure_warm_build=True)
     f32_rate = run_rate(args, "float32", "float32")
     out = {
         "metric": "edges_per_sec_per_chip",
@@ -271,6 +296,8 @@ def main(argv=None):
         "build_s": pair_rate["build_s"],
         "fast_f32": f32_rate,
     }
+    if "build_warm_s" in pair_rate:  # device builds only (run_rate)
+        out["build_warm_s"] = pair_rate["build_warm_s"]
     if not args.no_accuracy:
         out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
     print(json.dumps(out))
