@@ -1617,6 +1617,23 @@ void crawl_copy_edges(void* h, int32_t* src, int32_t* dst) {
   }
 }
 
+// Copies the edges accumulated since the last drain and RELEASES them
+// (the interner and crawled flags persist) — the out-of-core crawl
+// build's per-batch spill hook (ingest/native.crawl_load_external):
+// edge memory stays bounded by the batch while the vertex table keeps
+// growing file-ordered. Returns the drained count.
+int64_t crawl_drain_edges(void* h, int32_t* src, int32_t* dst) {
+  auto* st = static_cast<CrawlState*>(h);
+  int64_t e = (int64_t)st->src.size();
+  if (e) {
+    std::memcpy(src, st->src.data(), e * sizeof(int32_t));
+    std::memcpy(dst, st->dst.data(), e * sizeof(int32_t));
+  }
+  std::vector<int32_t>().swap(st->src);
+  std::vector<int32_t>().swap(st->dst);
+  return e;
+}
+
 void crawl_copy_crawled(void* h, uint8_t* mask) {
   auto* st = static_cast<CrawlState*>(h);
   size_t n = st->ids.size();

@@ -158,12 +158,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--host-mem-cap-gb", type=float, default=None,
-        help="route integer edge inputs (text/.npz) through the "
-        "out-of-core external-sort build (ingest/external.py) with "
-        "this working-memory cap in GiB — for edge sets whose "
-        "in-memory build would exceed host RAM (the reference streams "
-        "partitions from S3 and never holds the edge set in one "
-        "space, Sparky.java:61,124). Identical Graph output",
+        help="route the host build through the out-of-core "
+        "external-sort (ingest/external.py) with this working-memory "
+        "cap in GiB — for edge sets whose in-memory build would exceed "
+        "host RAM (the reference streams partitions from S3 and never "
+        "holds the edge set in one space, Sparky.java:61,124). "
+        "Integer edge inputs (text/.npz) stream directly; "
+        "crawl/SequenceFile inputs drain the native L1's edges "
+        "per-batch into the same sort (the interner's url table, "
+        "O(vertices), stays in RAM — it IS the product). Identical "
+        "Graph output. Not with --device-build/--synthetic",
     )
     p.add_argument(
         "--no-compile-cache", action="store_true",
@@ -434,15 +438,34 @@ def load_graph(args):
                 if len(tokens) == 2 and all(t.lstrip("-").isdigit() for t in tokens)
                 else "crawl"
             )
-    if args.host_mem_cap_gb and fmt in ("seqfile", "crawl"):
-        # Never silently drop a memory-bound promise (see the
-        # device-build/synthetic guard above).
-        raise SystemExit(
-            "--host-mem-cap-gb applies to integer edge inputs "
-            "(text/.npz); crawl/SequenceFile ingestion streams in "
-            "bounded batches already (ingest/native.py)"
-        )
     native = "off" if args.no_native_ingest else "auto"
+    if args.host_mem_cap_gb and fmt in ("seqfile", "crawl"):
+        # Out-of-core crawl build (VERDICT r4 #4): native L1 batches
+        # drained into the external sort; the edge set is never
+        # resident in one space. Never silently drop a memory-bound
+        # promise: without the native library (or with it disabled),
+        # fail loudly instead of falling back to the in-memory path.
+        from pagerank_tpu.ingest.native import crawl_load_external
+        from pagerank_tpu.ingest.seqfile import expand_seqfile_paths
+
+        if native == "off":
+            raise SystemExit(
+                "--host-mem-cap-gb with crawl/SequenceFile inputs needs "
+                "the native ingest path; drop --no-native-ingest"
+            )
+        paths = expand_seqfile_paths(path) if fmt == "seqfile" else [path]
+        res = crawl_load_external(
+            paths, "seqfile" if fmt == "seqfile" else "tsv",
+            mem_cap_bytes=int(args.host_mem_cap_gb * (1 << 30)),
+            strict=args.strict_parse, threads=args.ingest_workers,
+        )
+        if res is None:
+            raise SystemExit(
+                "--host-mem-cap-gb with crawl/SequenceFile inputs needs "
+                "the native library (g++ toolchain) — it is unavailable "
+                "or predates crawl_drain_edges"
+            )
+        return res
     if fmt == "seqfile":
         if args.device_build:
             from pagerank_tpu.ingest import load_crawl_seqfile_arrays

@@ -49,6 +49,8 @@ from pagerank_tpu.graph import Graph, inv_out_degree
 # so 64 keeps the observed working set within the caller's cap.
 _SPILL_BYTES_PER_EDGE = 64
 _MERGE_FRACTION = 0.25
+_MIN_CHUNK_EDGES = 1 << 16  # spill-chunk floor (module-level so tests
+# can force many tiny runs without gigabyte inputs)
 
 
 def iter_text_chunks(path: str, chunk_edges: int,
@@ -210,27 +212,31 @@ def build_graph_external(
         iterable of (src, dst) int array chunks (any chunking; re-cut
         internally to the cap).
       n: vertex count; discovered as max id + 1 when omitted (ids must
-        fit int32 either way, like build_graph's device contract).
+        fit int32 either way, like build_graph's device contract). May
+        be a CALLABLE resolved after the input is fully consumed — for
+        producers whose vertex count is only known at end of stream
+        (the crawl interner, ingest/native.crawl_load_external).
       mem_cap_bytes: working-memory budget for the build's transients
         (spill chunks, merge windows). The final Graph arrays are
         excluded — they are the caller's product, not working state.
       tmp_dir: where sorted runs spill (default: a fresh tempdir,
         removed on return).
       dangling_mask: explicit mass mask (crawl semantics), as in
-        build_graph.
+        build_graph; may be a callable like ``n``.
 
     Returns a Graph FIELD-IDENTICAL to ``build_graph(src, dst, n=n)``
     on the concatenated input.
     """
     if mem_cap_bytes < (64 << 20):
         raise ValueError("mem_cap_bytes must be at least 64 MiB")
-    chunk_edges = max(1 << 16, mem_cap_bytes // _SPILL_BYTES_PER_EDGE)
+    chunk_edges = max(_MIN_CHUNK_EDGES, mem_cap_bytes // _SPILL_BYTES_PER_EDGE)
     if isinstance(edges, (str, os.PathLike)):
         chunks, n_hint = open_edge_chunks(str(edges), chunk_edges)
         if n is None:
             n = n_hint
     else:
         chunks = iter(edges)
+    n_lazy = n if callable(n) else None
 
     own_tmp = tmp_dir is None
     tmp = tmp_dir or tempfile.mkdtemp(prefix="pagerank_extsort_")
@@ -280,6 +286,8 @@ def build_graph_external(
                     flush_run()
         flush_run()
 
+        if n_lazy is not None:
+            n = n_lazy()  # producer's count, known at end of stream
         if n is None:
             n = max_id + 1 if max_id >= 0 else 0
         n = int(n)
@@ -384,6 +392,8 @@ def build_graph_external(
             except OSError:
                 pass
 
+    if callable(dangling_mask):
+        dangling_mask = dangling_mask()
     if dangling_mask is None:
         dangling_mask = out_degree == 0
     else:

@@ -581,9 +581,19 @@ def test_cli_host_mem_cap_incompatible_combos(tmp_path, edges_file):
     with pytest.raises(SystemExit, match="host-mem-cap-gb"):
         main(["--synthetic", "rmat:8", "--host-mem-cap-gb", "1",
               "--log-every", "0"])
+    # Crawl inputs COMPOSE with the cap since r5 (the out-of-core
+    # native-L1 drain path) — but never silently: with the native path
+    # disabled the memory-bound promise is rejected loudly.
     crawl = str(tmp_path / "c.tsv")
     open(crawl, "w").write(
         'http://a\t{"content":{"links":[{"type":"a","href":"http://b"}]}}\n'
     )
-    with pytest.raises(SystemExit, match="host-mem-cap-gb"):
-        main(["--input", crawl, "--host-mem-cap-gb", "1", "--log-every", "0"])
+    with pytest.raises(SystemExit, match="native"):
+        main(["--input", crawl, "--host-mem-cap-gb", "1",
+              "--no-native-ingest", "--log-every", "0"])
+    from pagerank_tpu.ingest import native as native_mod
+
+    lib = native_mod.get_lib()
+    if lib is not None and hasattr(lib, "crawl_drain_edges"):
+        assert main(["--input", crawl, "--host-mem-cap-gb", "1",
+                     "--log-every", "0"]) == 0

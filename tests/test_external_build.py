@@ -192,3 +192,105 @@ def test_external_engine_run_matches():
     g = external.build_graph_external([(src, dst)], n=n)
     r_ext = JaxTpuEngine(cfg).build(g).run()
     np.testing.assert_array_equal(r_ext, r_ref)
+
+
+def _mini_segment(seg, files=5, per_file=40, seed=7):
+    """Tiny Common-Crawl-style segment with linkless pages and
+    uncrawled targets (the reference's two dangling classes)."""
+    import json
+
+    from pagerank_tpu.ingest.seqfile import write_sequence_file
+
+    rng = np.random.default_rng(seed)
+    n_crawled = files * per_file
+
+    def url(i):
+        return f"http://site{i % 97}.test/p{i}"
+
+    for fi in range(files):
+        pairs = []
+        for ri in range(per_file):
+            u = url(fi * per_file + ri)
+            links = []
+            if rng.random() >= 0.1:
+                for t in rng.integers(0, n_crawled, rng.integers(1, 6)):
+                    links.append(
+                        f"http://uncrawled{int(t)}.test/"
+                        if rng.random() < 0.2 else url(int(t))
+                    )
+            doc = {"content": {"links": [
+                {"type": "a", "href": l} for l in links
+            ]}}
+            pairs.append((u, json.dumps(doc)))
+        write_sequence_file(str(seg / f"metadata-{fi:05d}"), pairs,
+                            sync_every=7)
+
+
+def test_crawl_load_external_matches_in_memory(tmp_path, monkeypatch):
+    """Out-of-core crawl build (VERDICT r4 #4): native L1 batches
+    drained into the external sort — Graph field-identical to the
+    in-memory crawl path, IdMap equal, with a byte-cap small enough to
+    force MANY ingest batches and spill runs."""
+    from pagerank_tpu.ingest import native
+    from pagerank_tpu.ingest.seqfile import expand_seqfile_paths
+
+    if native.get_lib() is None or not hasattr(
+        native.get_lib(), "crawl_drain_edges"
+    ):
+        pytest.skip("native library unavailable")
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    _mini_segment(seg)
+    paths = expand_seqfile_paths(str(seg))
+    ref = native.crawl_load(paths, "seqfile")
+    assert ref is not None
+    g_ref, ids_ref = ref
+
+    # Force BOTH small-granularity regimes: the chunk floor drops so
+    # this ~500-edge segment spills MANY sorted runs (a real k-way
+    # merge — one run would mask merge regressions on the callable-n
+    # route), and iter_read_batches degrades to 1-file batches so the
+    # drain fires per file.
+    monkeypatch.setattr(external, "_MIN_CHUNK_EDGES", 64)
+    monkeypatch.setattr(external, "_SPILL_BYTES_PER_EDGE", 1 << 20)
+    orig = native.iter_read_batches
+    monkeypatch.setattr(
+        native, "iter_read_batches",
+        lambda paths, window, cap: orig(paths, 1, 1),
+    )
+    saves = []
+    orig_save = external.np.save
+    monkeypatch.setattr(
+        external.np, "save",
+        lambda p, a: (saves.append(p), orig_save(p, a))[1],
+    )
+    out = native.crawl_load_external(paths, "seqfile",
+                                     mem_cap_bytes=64 << 20)
+    assert out is not None
+    assert len(saves) > 1, "expected multiple spill runs"
+    g, ids = out
+    _assert_graphs_equal(g, g_ref)
+    assert list(ids.names) == list(ids_ref.names)
+    assert g.vertex_names == g_ref.vertex_names
+
+
+def test_crawl_load_external_cli(tmp_path):
+    """--host-mem-cap-gb now composes with SequenceFile inputs through
+    the CLI (the r4 loud-reject is gone)."""
+    from pagerank_tpu.cli import main
+    from pagerank_tpu.ingest import native
+
+    if native.get_lib() is None or not hasattr(
+        native.get_lib(), "crawl_drain_edges"
+    ):
+        pytest.skip("native library unavailable")
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    _mini_segment(seg, files=3, per_file=20)
+    out_c = str(tmp_path / "capped.tsv")
+    out_u = str(tmp_path / "uncapped.tsv")
+    base = ["--iters", "5", "--log-every", "0", "--dtype", "float64"]
+    assert main(["--input", str(seg), "--host-mem-cap-gb", "0.0625",
+                 *base, "--out", out_c]) == 0
+    assert main(["--input", str(seg), *base, "--out", out_u]) == 0
+    assert open(out_c).read() == open(out_u).read()
