@@ -1079,7 +1079,8 @@ class JaxTpuEngine(PageRankEngine):
         self._ms_n_stripes = n_stripes
 
     def _make_ms_stripe_fns(self, *, n_stripes, sz, gw, group, pair, accum,
-                            num_blocks, chunks, num_present):
+                            num_blocks, chunks, num_present,
+                            local_planes=False):
         """The per-stripe multi-dispatch executables (see
         _setup_multi_dispatch): each stripe's contribution as its own
         jitted shard_map with EXACT per-stripe shapes and a static
@@ -1087,13 +1088,20 @@ class JaxTpuEngine(PageRankEngine):
         partials. Shared by the replicated and vertex-sharded modes —
         the stripe fns consume REPLICATED z planes either way (the
         modes differ only in how z is produced and how partials merge
-        into the rank update)."""
+        into the rank update). ``local_planes``: the planes are
+        per-stripe [sz] (vs_bounded's broadcast dispatches) instead of
+        full-length z, so the static slice starts at 0. Either way the
+        gather table derives from a ROOT argument of the dispatch — a
+        table computed behind a collective in the same program loses
+        XLA's fast gather lowering (measured 2.6x slower end-to-end at
+        scale 23; same failure class as PERF_NOTES "Scan bodies defeat
+        the fast gather")."""
         mesh = self._mesh
         axis = self.config.mesh_axis
         nz = 2 if pair else 1
 
         def make_stripe_fn(s, Ps, ck):
-            lo_ix = s * sz
+            lo_ix = 0 if local_planes else s * sz
 
             def stripe_body(*args):
                 zs, (src, rb) = args[:nz], args[nz:]
@@ -1532,12 +1540,17 @@ class JaxTpuEngine(PageRankEngine):
                         [rk, np.full(padr, Ps - 1, np.int32)]
                     )
                 if ids_d.shape[0] < Ps:
-                    # Repeat the last id: sorted is preserved, so the
-                    # finalize scatter claims sorted (NOT unique), and
-                    # the padded ranks carry zero sums.
+                    # Pad with CONSECUTIVE ids past nbd (a trash band
+                    # on the accumulator): sorted AND unique is
+                    # preserved, so the finalize scatter keeps XLA's
+                    # fast sorted-unique path — a repeated-last-id pad
+                    # forfeits unique_indices and measured 2.8x slower
+                    # end-to-end at scale 23 (the non-unique scatter
+                    # serializes). The padded ranks carry zero sums.
+                    pad_n = Ps - ids_d.shape[0]
                     ids_d = np.concatenate([
                         ids_d,
-                        np.full(Ps - ids_d.shape[0], ids_d[-1], np.int32),
+                        nbd + np.arange(pad_n, dtype=np.int32),
                     ])
                 ss_parts.append(ssd)
                 rk_parts.append(rk)
@@ -1558,9 +1571,108 @@ class JaxTpuEngine(PageRankEngine):
         )
         ell_chunks = [min(chosen, r) for r in stripe_rows_dev]
 
-        # -- step construction: always the multi-dispatch machinery --------
+        # -- step construction --------------------------------------------
+        # Mirrors the replicated architecture (and for the same
+        # measured reason): at or below SCAN_STRIPE_UNITS the whole
+        # step is ONE shard_map program — XLA's cross-op fusion around
+        # the chunked gather is worth 2.3x at the big single-stripe
+        # geometry (scale 23: 662 ms/iter fused vs 1507 through the
+        # dispatch-per-stripe machinery, with the gather dispatch
+        # itself accounting for the difference at identical chunks).
+        # Past the threshold the unrolled program exceeds the
+        # remote-compile limit and the multi-dispatch machinery takes
+        # over: a z-broadcast dispatch per stripe feeding the SAME
+        # per-stripe gather executables as the replicated mode, then a
+        # local finalize.
         zd = jnp.dtype(z_dtype)
+        vs_tail = self._make_vs_tail(accum, n)
+        S = n_stripes
+        multi_dispatch = n_stripes * (2 if pair else 1) > self.SCAN_STRIPE_UNITS
+        # Accumulator with a trash band: pad ids land at nbd..nbd+Ps-1
+        # (zero partials), keeping every scatter sorted AND unique — a
+        # repeated-last-id pad forfeits unique_indices and the scatter
+        # serializes.
+        trash = max(num_present) if n_stripes else 1
 
+        def stripe_plane(z_l, s):
+            """Stage (a): per-stripe z broadcast — replicated [sz]
+            plane from the sharded z. The start is clipped EXPLICITLY:
+            lax.dynamic_slice treats negative starts as from-the-end
+            (NumPy semantics), so a no-overlap device's negative
+            offset would wrap into real data instead of landing in the
+            zero pads. After the clip, both out-of-range destinations
+            are zero pads, overlapping devices are in-range (no clip),
+            and each element of the psum has ONE nonzero contributor
+            (exact)."""
+            zeros = jnp.zeros(sz, z_l.dtype)
+            ze = jnp.concatenate([zeros, z_l, zeros])
+            off = jnp.clip(
+                s * sz + sz - jax.lax.axis_index(axis) * blk,
+                0, blk + sz,
+            )
+            return jax.lax.psum(
+                jax.lax.dynamic_slice_in_dim(ze, off, sz), axis
+            )
+
+        def stripe_part(zp, src_s, rb_s, s):
+            """Gather + compact segment-sum for one stripe; ``zp`` is
+            the [sz] replicated plane."""
+            zp = jnp.concatenate([zp, jnp.zeros(gw, zp.dtype)])
+            Ps = num_present[s]
+            if pair:
+                hi, lo = _split_pair(zp)
+                part = spmv.ell_contrib_pair(
+                    hi, lo, src_s, rb_s, Ps, accum_dtype=accum,
+                    gather_width=gw, chunk_rows=ell_chunks[s],
+                    group=group, num_present=Ps,
+                )
+            else:
+                part = spmv.ell_contrib(
+                    zp, src_s, rb_s, Ps, accum_dtype=accum,
+                    gather_width=gw, chunk_rows=ell_chunks[s],
+                    group=group, num_present=Ps,
+                )
+            return part.reshape(Ps, 128)
+
+        self._inv_in_args = True
+        self._fused_cache = {}
+        self.last_run_metrics = {
+            "l1_delta": np.zeros(0, self._accum_dtype),
+            "dangling_mass": np.zeros(0, self._accum_dtype),
+        }
+        self._contrib_args = tuple(
+            a for triple in zip(self._src, self._row_block, ids_list)
+            for a in triple
+        )
+
+        if not multi_dispatch:
+            def vs_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+                z_l = r_l.astype(zd) * inv_l
+                total = jnp.zeros((nbd + trash, 128), accum)
+                for s in range(S):
+                    src_s, rb_s, ids_s = rest[3 * s : 3 * s + 3]
+                    part = stripe_part(stripe_plane(z_l, s), src_s,
+                                       rb_s, s)
+                    # Stage (b): each device's partials land ONLY in
+                    # its own local dst range — no cross-device merge.
+                    total = total.at[ids_s[0]].add(
+                        part, indices_are_sorted=True,
+                        unique_indices=True,
+                    )
+                contrib_l = total[:nbd].reshape(-1)
+                return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+
+            step_core = shard_map(
+                vs_body, mesh=mesh,
+                in_specs=(P(axis),) * 5
+                + (P(axis, None), P(axis), P(axis, None)) * S,
+                out_specs=(P(axis), P(), P()),
+            )
+            self._step_core = step_core
+            self._step_fn = jax.jit(step_core, donate_argnums=(0,))
+            return
+
+        # -- multi-dispatch form (past SCAN_STRIPE_UNITS) ------------------
         def pres(r_l, inv_l):
             return (r_l.astype(zd) * inv_l,)
 
@@ -1569,70 +1681,54 @@ class JaxTpuEngine(PageRankEngine):
             in_specs=(P(axis), P(axis)), out_specs=(P(axis),),
         ))
 
-        def make_stripe_fn(s, Ps, ck):
-            def stripe_body(z_l, src, rb):
-                # Stage (a): per-stripe z broadcast. The start is
-                # clipped EXPLICITLY: lax.dynamic_slice treats negative
-                # starts as from-the-end (NumPy semantics), so a
-                # no-overlap device's negative offset would wrap into
-                # real data instead of landing in the zero pads. After
-                # the clip, both out-of-range destinations are zero
-                # pads, overlapping devices are in-range (no clip), and
-                # each element of the psum has ONE nonzero contributor
-                # (exact).
-                zeros = jnp.zeros(sz, z_l.dtype)
-                ze = jnp.concatenate([zeros, z_l, zeros])
-                off = jnp.clip(
-                    s * sz + sz - jax.lax.axis_index(axis) * blk,
-                    0, blk + sz,
-                )
-                zp = jax.lax.dynamic_slice_in_dim(ze, off, sz)
-                zp = jax.lax.psum(zp, axis)
-                zp = jnp.concatenate([zp, jnp.zeros(gw, zp.dtype)])
-                if pair:
-                    hi, lo = _split_pair(zp)
-                    part = spmv.ell_contrib_pair(
-                        hi, lo, src, rb, Ps, accum_dtype=accum,
-                        gather_width=gw, chunk_rows=ck, group=group,
-                        num_present=Ps,
-                    )
-                else:
-                    part = spmv.ell_contrib(
-                        zp, src, rb, Ps, accum_dtype=accum,
-                        gather_width=gw, chunk_rows=ck, group=group,
-                        num_present=Ps,
-                    )
-                return part.reshape(1, Ps, 128)
+        gather_fns = self._make_ms_stripe_fns(
+            n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
+            accum=accum, num_blocks=nbd, chunks=ell_chunks,
+            num_present=num_present, local_planes=True,
+        )
+        nz = 2 if pair else 1
+
+        def make_zb_fn(s):
+            def zb_body(z_l):
+                zp = stripe_plane(z_l, s)
+                return _split_pair(zp) if pair else (zp,)
 
             return jax.jit(shard_map(
-                stripe_body, mesh=mesh,
-                in_specs=(P(axis), P(axis, None), P(axis)),
-                out_specs=P(axis, None, None),
+                zb_body, mesh=mesh,
+                in_specs=(P(axis),), out_specs=(P(),) * nz,
+                # The planes ARE replicated (psum output), but the
+                # static varying-mesh-axes checker cannot infer that
+                # through the Dekker-split epilogue.
+                check_vma=False,
             ))
 
+        def make_stripe_fn(s):
+            zb, gf = make_zb_fn(s), gather_fns[s]
+
+            def call(z_l, src, rb):
+                return gf(*zb(z_l), src, rb)
+
+            return call
+
         self._ms_stripe_fns = [
-            make_stripe_fn(s, num_present[s], ell_chunks[s])
-            for s in range(n_stripes)
+            make_stripe_fn(s) for s in range(n_stripes)
         ]
         self._ms_stripe = self._ms_stripe_fns[0]
-
-        vs_tail = self._make_vs_tail(accum, n)
-        S = n_stripes
 
         def final_body(r_l, *rest):
             parts = rest[:S]
             ids_l = rest[S : 2 * S]
             dang_l, zin_l, valid_l = rest[2 * S :]
-            total = jnp.zeros((nbd, 128), accum)
+            total = jnp.zeros((nbd + trash, 128), accum)
             for s in range(S):
                 # Stage (b): each device's partials land ONLY in its
                 # own local dst range — no cross-device merge exists.
-                # Pad ids repeat the last id (zero partials): sorted,
-                # not unique.
                 total = total.at[ids_l[s][0]].add(
-                    parts[s][0], indices_are_sorted=True
+                    parts[s][0], indices_are_sorted=True,
+                    unique_indices=True,
                 )
-            return vs_tail(total.reshape(-1), r_l, dang_l, zin_l, valid_l)
+            contrib_l = total[:nbd].reshape(-1)
+            return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
         self._ms_final = jax.jit(
             shard_map(
@@ -1647,13 +1743,6 @@ class JaxTpuEngine(PageRankEngine):
         )
         self._ms_ids = ids_list
         self._ms_n_stripes = S
-        self._inv_in_args = True
-        self._contrib_args = ()
-        self._fused_cache = {}
-        self.last_run_metrics = {
-            "l1_delta": np.zeros(0, self._accum_dtype),
-            "dangling_mass": np.zeros(0, self._accum_dtype),
-        }
 
     def _finalize(self, contrib_fn, contrib_args, mass_mask, zero_in, valid,
                   n, n_state, prescale=None):

@@ -451,6 +451,15 @@ def crawl_load_external(paths, kind: str, mem_cap_bytes: int = 2 << 30,
     Raises the Python path's exception types on malformed input, like
     crawl_load.
     """
+    # Loud floor, like build_graph_external's 64 MiB: the pipeline
+    # needs 2 x 16 MiB file batches + the sort's 64 MiB minimum, and
+    # silently running OVER a smaller promise would contradict the
+    # flag's contract (the integer-edge path rejects such caps too).
+    if mem_cap_bytes < (128 << 20):
+        raise ValueError(
+            "mem_cap_bytes must be at least 128 MiB for crawl inputs "
+            "(2 file-batch buffers + the external sort's 64 MiB floor)"
+        )
     lib = get_lib()
     if lib is None or not hasattr(lib, "crawl_drain_edges"):
         return None

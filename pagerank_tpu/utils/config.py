@@ -103,10 +103,11 @@ class PageRankConfig:
     # Numerics: block sums regroup (a block's rows are summed on one
     # chip instead of split across chips and psum-merged), so results
     # agree with the replicated/plain-sharded modes to accumulation-
-    # dtype rounding, not bitwise (identical on 1 device). Every run
-    # form executes as pipelined per-stripe dispatches (the
-    # multi-dispatch machinery). Requires vertex_sharded, the ell
-    # kernel, and a host-built graph.
+    # dtype rounding, not bitwise (identical on 1 device). Dispatch
+    # forms mirror the replicated mode: one fused program at or below
+    # SCAN_STRIPE_UNITS, pipelined per-stripe z-broadcast + gather
+    # dispatches past it. Requires vertex_sharded, the ell kernel, and
+    # a host-built graph.
     vs_bounded: bool = False
 
     # Snapshots (the reference writes the full rank vector to S3 after

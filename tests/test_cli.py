@@ -597,3 +597,9 @@ def test_cli_host_mem_cap_incompatible_combos(tmp_path, edges_file):
     if lib is not None and hasattr(lib, "crawl_drain_edges"):
         assert main(["--input", crawl, "--host-mem-cap-gb", "1",
                      "--log-every", "0"]) == 0
+        # sub-floor caps are rejected loudly, mirroring the
+        # integer-edge path's 64 MiB check (main() converts the
+        # loader's ValueError into a clean SystemExit)
+        with pytest.raises(SystemExit, match="128 MiB"):
+            main(["--input", crawl, "--host-mem-cap-gb", "0.0625",
+                  "--log-every", "0"])

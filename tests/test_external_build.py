@@ -264,8 +264,10 @@ def test_crawl_load_external_matches_in_memory(tmp_path, monkeypatch):
         external.np, "save",
         lambda p, a: (saves.append(p), orig_save(p, a))[1],
     )
+    with pytest.raises(ValueError, match="128 MiB"):
+        native.crawl_load_external(paths, "seqfile", mem_cap_bytes=64 << 20)
     out = native.crawl_load_external(paths, "seqfile",
-                                     mem_cap_bytes=64 << 20)
+                                     mem_cap_bytes=128 << 20)
     assert out is not None
     assert len(saves) > 1, "expected multiple spill runs"
     g, ids = out
@@ -290,7 +292,7 @@ def test_crawl_load_external_cli(tmp_path):
     out_c = str(tmp_path / "capped.tsv")
     out_u = str(tmp_path / "uncapped.tsv")
     base = ["--iters", "5", "--log-every", "0", "--dtype", "float64"]
-    assert main(["--input", str(seg), "--host-mem-cap-gb", "0.0625",
+    assert main(["--input", str(seg), "--host-mem-cap-gb", "0.125",
                  *base, "--out", out_c]) == 0
     assert main(["--input", str(seg), *base, "--out", out_u]) == 0
     assert open(out_c).read() == open(out_u).read()

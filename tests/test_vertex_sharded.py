@@ -233,28 +233,40 @@ def test_vs_bounded_state_and_rows_partitioned(graph):
         assert arr.sharding.spec == spec
     ndev = 8
     nbd = eng._n_state // 128 // ndev
-    for s in range(eng._ms_n_stripes):
-        ids = np.asarray(eng._ms_ids[s])  # [ndev, Ps] LOCAL block ids
+    ids_args = eng._contrib_args[2::3]  # (src, rb, ids) per stripe
+    assert len(ids_args) >= 1
+    for ids in ids_args:
+        ids = np.asarray(ids)  # [ndev, Ps] LOCAL block ids
         assert ids.shape[0] == ndev
-        assert ids.min() >= 0 and ids.max() < nbd
-        assert np.all(np.diff(ids, axis=1) >= 0)  # sorted per device
+        assert ids.min() >= 0
+        # real ids < nbd; pads live in the trash band [nbd, nbd+Ps)
+        assert ids.max() < 2 * nbd
+        assert np.all(np.diff(ids, axis=1) > 0)  # sorted AND unique
 
 
-def test_vs_bounded_striped_multi_dispatch(graph):
+def test_vs_bounded_striped_unrolled_and_multi_dispatch(graph):
     cfg = PageRankConfig(
         num_iters=4, dtype="float32", accum_dtype="float64",
         wide_accum="pair", num_devices=8,
     )
     r_rep = _TinyStripes(cfg).build(graph).run_fast()
+    # Below SCAN_STRIPE_UNITS: ONE fused program (the measured-fast
+    # form, like the replicated mode).
     eng = _TinyStripes(
         cfg.replace(vertex_sharded=True, vs_bounded=True)
     ).build(graph)
-    assert eng._ms_stripe is not None  # always the multi-dispatch form
+    assert eng._ms_stripe is None
     assert len(eng._src) > 1  # really striped
     r_b = eng.run_fast()
     err = (np.abs(np.float64(r_b) - np.float64(r_rep)).sum()
            / np.abs(np.float64(r_rep)).sum())
     assert err < 1e-6, err
+    # Past the threshold: z-broadcast + gather dispatches per stripe.
+    ms = _TinyScan(
+        cfg.replace(vertex_sharded=True, vs_bounded=True)
+    ).build(graph)
+    assert ms._ms_stripe is not None
+    np.testing.assert_array_equal(ms.run_fast(), r_b)
 
 
 def test_vs_bounded_fused_forms_match_step(graph):
