@@ -63,21 +63,19 @@ def _enable_compile_cache():
 
 
 def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
-             measure_warm_build: bool = False):
+             build_only: bool = False):
     """One throughput measurement: build (device by default) + timed
     stepwise loop with the honest scalar fence. Returns the result dict.
 
-    ``measure_warm_build`` (VERDICT r4 weak #4): after the rate loop,
-    rebuild the same graph once more and report it as ``build_warm_s``.
-    The first build's cost depends on the state of the persistent
-    tuning+compile cache (cold on a fresh checkout — .jax_cache is
-    gitignored — warm on repeat runs); the rebuild is warm BY
-    CONSTRUCTION, so the JSON carries one number that reproduces
-    (PERF_NOTES "Device-build cost": 22.8s warm vs 30.4s cold at
-    scale 23) and one that describes this run's actual cache state.
-    DEVICE builds only: the host path's cost is numpy generation +
-    pack + transfer, which no cache affects — a rebuild there would
-    measure nothing and mislabel it.
+    ``build_only`` (VERDICT r4 weak #4): build, time it, free, and
+    return only ``build_s`` — couple mode calls this LAST with the
+    pair config, so the number is the WARM tuning+compile-cache build
+    by construction (the same config built earlier in the process) and
+    cannot perturb the rate legs (a mid-couple rebuild once preceded a
+    6x collapse of the following f32 leg). The first build's cost
+    depends on the cache state (cold on a fresh checkout — .jax_cache
+    is gitignored); the warm number reproduces (PERF_NOTES
+    "Device-build cost": 22.8s warm vs 30.4s cold at scale 23).
     """
     from pagerank_tpu import PageRankConfig, build_graph
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
@@ -131,6 +129,11 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
     label = f"{dtype}" + (f"+{accum_dtype}-accum" if accum_dtype != dtype else "")
     if wide_accum == "pair":
         label += "+pair"
+    if build_only:
+        del engine
+        print(f"build[{label}]: warm rebuild {t_build:.1f}s "
+              "(tuning+compile cache)", file=sys.stderr)
+        return {"build_s": t_build}
     print(
         f"graph[{label}]: scale {args.scale}: {1 << args.scale:,} vertices, "
         f"{num_edges:,} unique edges "
@@ -156,22 +159,11 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         file=sys.stderr,
     )
     del engine  # free HBM before the next config builds
-    out = {
+    return {
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
         "build_s": t_build,  # graph build wall-clock (VERDICT r3 weak #1)
     }
-    if measure_warm_build and not host_build:
-        t0 = time.perf_counter()
-        engine2, _ = do_build()
-        out["build_warm_s"] = time.perf_counter() - t0
-        print(
-            f"build[{label}]: first {t_build:.1f}s, warm rebuild "
-            f"{out['build_warm_s']:.1f}s (tuning+compile cache)",
-            file=sys.stderr,
-        )
-        del engine2
-    return out
 
 
 def run_accuracy(scale: int = 20, iters: int = 50):
@@ -285,8 +277,7 @@ def main(argv=None):
     # north-star couple. wide_accum is PINNED to pair so the headline
     # measures the same kernel the accuracy probe certifies on every
     # backend ("auto" would resolve to native f64 off-TPU).
-    pair_rate = run_rate(args, "float64", "float64", wide_accum="pair",
-                         measure_warm_build=True)
+    pair_rate = run_rate(args, "float64", "float64", wide_accum="pair")
     f32_rate = run_rate(args, "float32", "float32")
     out = {
         "metric": "edges_per_sec_per_chip",
@@ -296,8 +287,15 @@ def main(argv=None):
         "build_s": pair_rate["build_s"],
         "fast_f32": f32_rate,
     }
-    if "build_warm_s" in pair_rate:  # device builds only (run_rate)
-        out["build_warm_s"] = pair_rate["build_warm_s"]
+    if not args.host_build and args.kernel != "coo":
+        # LAST, so the rebuild cannot perturb the rate legs; warm by
+        # construction (same config as the first leg). Device builds
+        # only — the host path's cost is numpy gen + pack + transfer,
+        # which no cache affects (and --kernel coo coerces run_rate to
+        # the host path regardless of the flag).
+        out["build_warm_s"] = run_rate(
+            args, "float64", "float64", wide_accum="pair", build_only=True
+        )["build_s"]
     if not args.no_accuracy:
         out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
     print(json.dumps(out))
