@@ -1454,15 +1454,17 @@ class JaxTpuEngine(PageRankEngine):
         accumulation-dtype rounding, not bitwise (identical at ndev=1,
         where this mode degenerates to the same row order).
 
-        Every run form executes as pipelined per-stripe dispatches (the
-        multi-dispatch machinery; run_fused/run_fused_tol delegate via
-        run_fused_chunked), regardless of stripe count — one
-        construction, one code path. The analogue in the reference:
-        Spark's reduceByKey delivers each key's sums to the partition
-        that OWNS the key (Sparky.java:229), which is precisely
-        owner-computes; the plain mode's merge-everywhere was the
-        deviation. Requires a host-built graph (the device builder
-        does not deal dst blocks)."""
+        Dispatch forms mirror the replicated mode: at or below
+        SCAN_STRIPE_UNITS the step is ONE fused shard_map program
+        (measured-fastest; see the step-construction comment), past it
+        pipelined per-stripe z-broadcast + gather dispatches
+        (run_fused/run_fused_tol delegate via run_fused_chunked). The
+        analogue in the reference: Spark's reduceByKey delivers each
+        key's sums to the partition that OWNS the key
+        (Sparky.java:229), which is precisely owner-computes; the
+        plain mode's merge-everywhere was the deviation. Requires a
+        host-built graph (the device builder does not deal dst
+        blocks)."""
         cfg = self.config
         mesh = self._mesh
         axis = cfg.mesh_axis

@@ -296,3 +296,36 @@ def test_crawl_load_external_cli(tmp_path):
                  *base, "--out", out_c]) == 0
     assert main(["--input", str(seg), *base, "--out", out_u]) == 0
     assert open(out_c).read() == open(out_u).read()
+
+
+def test_crawl_load_external_error_parity(tmp_path):
+    """Malformed input mid-stream raises the same exception class as
+    the in-memory native path (the shared _iter_ingest_batches
+    plumbing), and the temp spill dir is cleaned up."""
+    import json as _json
+    import os
+
+    from pagerank_tpu.ingest import native
+    from pagerank_tpu.ingest.seqfile import (expand_seqfile_paths,
+                                             write_sequence_file)
+
+    lib = native.get_lib()
+    if lib is None or not hasattr(lib, "crawl_drain_edges"):
+        pytest.skip("native library unavailable")
+    seg = tmp_path / "seg"
+    seg.mkdir()
+    ok = [("http://a/", _json.dumps(
+        {"content": {"links": [{"type": "a", "href": "http://b/"}]}}))]
+    write_sequence_file(str(seg / "metadata-00000"), ok)
+    write_sequence_file(str(seg / "metadata-00001"),
+                        [("http://c/", "{not json")])
+    paths = expand_seqfile_paths(str(seg))
+    with pytest.raises(_json.JSONDecodeError):
+        native.crawl_load(paths, "seqfile")
+    tmp_spill = tmp_path / "spill"
+    tmp_spill.mkdir()
+    with pytest.raises(_json.JSONDecodeError):
+        native.crawl_load_external(paths, "seqfile",
+                                   mem_cap_bytes=128 << 20,
+                                   tmp_dir=str(tmp_spill))
+    assert os.listdir(tmp_spill) == []  # spill runs removed on error
