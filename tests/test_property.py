@@ -97,3 +97,38 @@ def test_device_build_matches_host_on_random_crawls(recs):
     expected, _, _, _ = sparky_pagerank(records, num_iters=7)
     want = np.array([expected[name] for name in ids.names])
     np.testing.assert_allclose(r_dev, want, rtol=0, atol=1e-9)
+
+
+@given(
+    st.integers(1, 2000),
+    st.integers(1, 16),
+    st.booleans(),
+)
+@settings(max_examples=60, deadline=None)
+def test_deal_block_order_always_valid(n, ndev, weighted):
+    """deal_block_order (vs_bounded's LPT dst deal) yields a valid
+    block permutation for ANY (n, ndev, weights): injective, filled
+    slots contiguous from 0, partial block globally last, per-device
+    assignment within capacity."""
+    from pagerank_tpu.ops import ell as ell_lib
+
+    n_padded = -(-n // 128) * 128
+    nb_fill = n_padded // 128
+    w = None
+    if weighted:
+        rng = np.random.default_rng(n * 31 + ndev)
+        w = rng.integers(1, 1000, nb_fill).astype(float)
+    new_of_old = ell_lib.deal_block_order(n, n_padded, ndev, weights=w)
+    assert len(new_of_old) == nb_fill
+    assert sorted(new_of_old) == list(range(nb_fill))  # bijective+packed
+    nbd = -(-nb_fill // ndev)
+    assert max(new_of_old) < nbd * ndev
+    if n % 128:
+        assert new_of_old[-1] == nb_fill - 1
+    # per-device counts never exceed the slot capacity
+    devs = np.asarray(new_of_old) // nbd
+    assert np.bincount(devs, minlength=ndev).max() <= nbd
+    # the dealt vertex order used by the packer is a dense permutation
+    ids = np.arange(n, dtype=np.int64)
+    new_pos = (np.asarray(new_of_old)[ids >> 7] << 7) | (ids & 127)
+    assert sorted(new_pos) == list(range(n))
