@@ -1,0 +1,24 @@
+"""Static analysis for the TPU hot path — AST lint + jaxpr contracts.
+
+Two passes, one CLI (``python -m pagerank_tpu.analysis``):
+
+- :mod:`pagerank_tpu.analysis.lint` — repo-specific AST rules over the
+  package source (magic lane geometry, implicit dtypes, host syncs
+  inside jit, mutable defaults, stray float64).
+- :mod:`pagerank_tpu.analysis.contracts` — abstract-evals every engine
+  dispatch form and the registered kernels, then asserts the
+  performance invariants nothing else checks mechanically: the
+  per-iteration collective budget, no f64 promotion under f32 configs,
+  donation actually consumed, stable step compilation keys, and no
+  host callbacks inside the step.
+
+Findings carry a stable rule id (``PTLnnn`` lint / ``PTCnnn``
+contracts); deliberate exceptions are waived in ``allowlist.txt`` with
+a reason. Rule catalogue and workflow: ``docs/ANALYSIS.md``.
+"""
+
+from pagerank_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    load_allowlist,
+    split_allowlisted,
+)

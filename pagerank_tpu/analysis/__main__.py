@@ -1,0 +1,157 @@
+"""``python -m pagerank_tpu.analysis`` — run the AST lint and the jaxpr
+contract suite over the repo; nonzero exit on any non-waived finding.
+
+Exit codes: 0 clean, 1 findings, 2 usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _prepare_jax_env() -> None:
+    """The contract pass abstract-evals sharded programs: force the CPU
+    backend (analysis must never squat on — or hang trying to
+    initialize — a TPU) with a small fake mesh, BEFORE any backend
+    initializes. jax is usually ALREADY IMPORTED here (the package
+    import pulls it in), so the platform pin must go through
+    jax.config, which beats the env var (the conftest does the same);
+    the device-count XLA flag is still read at first backend use, so
+    the env write works. An explicit user JAX_PLATFORMS is respected."""
+    user_choice = os.environ.get("JAX_PLATFORMS")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    if user_choice is None:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m pagerank_tpu.analysis",
+        description="AST lint + jaxpr contract checker for the TPU hot "
+        "path (rule catalogue: docs/ANALYSIS.md).",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the installed "
+        "pagerank_tpu package). Paths outside the package are treated "
+        "as fixture space: every rule applies regardless of scope",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (schema version 1)")
+    p.add_argument(
+        "--allowlist", default=None,
+        help="waiver file (default: the checked-in "
+        "pagerank_tpu/analysis/allowlist.txt; 'none' disables)",
+    )
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--lint-only", action="store_true",
+                      help="skip the jaxpr contract suite (no jax import)")
+    mode.add_argument("--contracts-only", action="store_true",
+                      help="skip the AST lint")
+    p.add_argument(
+        "--forms", default=None,
+        help="comma-separated engine dispatch forms for the contract "
+        "suite (default: all; see docs/ANALYSIS.md)",
+    )
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+    from pagerank_tpu.analysis import lint as lint_mod
+
+    if args.list_rules:
+        for rid, (_fn, scope, desc) in sorted(lint_mod.RULES.items()):
+            print(f"{rid}  [{scope:6}] {desc}")
+        for rid, desc in (
+            ("PTC001", "per-iteration collective budget / kernel shapes"),
+            ("PTC002", "no f64 promotion under f32 configs"),
+            ("PTC003", "donation actually consumed"),
+            ("PTC004", "step compilation key independent of num_iters/tol"),
+            ("PTC005", "no host callbacks inside iteration programs"),
+        ):
+            print(f"{rid}  [jaxpr ] {desc}")
+        return 0
+
+    allowlist_path = args.allowlist
+    if allowlist_path is None:
+        allowlist_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "allowlist.txt"
+        )
+    waivers = []
+    if allowlist_path and allowlist_path != "none":
+        try:
+            waivers = load_allowlist(allowlist_path)
+        except (OSError, ValueError) as e:
+            print(f"analysis: bad allowlist: {e}", file=sys.stderr)
+            return 2
+
+    findings = []
+    if not args.contracts_only:
+        if args.paths:
+            pkg = lint_mod.package_root()
+            for path in args.paths:
+                if os.path.isdir(path):
+                    findings.extend(lint_mod.lint_tree(path))
+                    continue
+                # An explicit IN-PACKAGE file keeps package-relative
+                # scoping and reporting (so allowlist globs match and
+                # only in-scope rules run); outside files are fixture
+                # space.
+                ap = os.path.abspath(path)
+                rel = None
+                if ap.startswith(pkg + os.sep):
+                    rel = os.path.relpath(ap, pkg).replace(os.sep, "/")
+                findings.extend(lint_mod.lint_file(path, rel))
+        else:
+            findings.extend(lint_mod.lint_tree())
+
+    if not args.lint_only:
+        _prepare_jax_env()
+        import jax
+
+        jax.config.update("jax_enable_x64", True)  # makes PTC002 real
+        from pagerank_tpu.analysis.contracts import run_contracts
+
+        forms = args.forms.split(",") if args.forms else None
+        findings.extend(run_contracts(forms=forms))
+
+    active, waived = split_allowlisted(findings, waivers)
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "ok": not active,
+            "counts": {"active": len(active), "waived": len(waived)},
+            "findings": [f.to_json() for f in active],
+            "waived": [
+                {"finding": f.to_json(), "reason": w.reason}
+                for f, w in waived
+            ],
+        }, indent=2))
+    else:
+        for f in active:
+            print(f.render())
+        print(
+            f"analysis: {len(active)} finding(s), {len(waived)} waived",
+            file=sys.stderr,
+        )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,534 @@
+"""jaxpr contract checker for the engine dispatch forms and kernels.
+
+The solver's performance story rests on invariants the type system
+cannot see: ONE bulk collective per iteration (the psum that merges
+per-device partials — SURVEY.md §3's "3 shuffles -> 1 psum"), no
+accidental f64 promotion under f32 configs (TPUs emulate f64 ~3.4x
+slower), rank-buffer donation actually consumed (O(1) device memory in
+iterations), a step executable whose compilation key ignores the
+iteration budget, and zero host callbacks inside the hot loop. Each is
+checked here MECHANICALLY by abstract-evaluating every dispatch form on
+a tiny graph (CPU-fake mesh, the tests' own substrate) and walking the
+resulting jaxprs.
+
+Dispatch forms covered (the seven forms of engines/jax_engine.py plus
+the device-build path):
+
+  ell / pair / striped    — replicated, one fused shard_map program
+  multi_dispatch          — per-stripe executables + finalize
+  coo                     — segment-sum baseline
+  device_build            — build_device (presentinel) + ell step
+  vertex_sharded (+ms)    — sharded state, all_gather/reduce_scatter
+  vs_bounded (+ms)        — owner-computes, per-stripe z psums
+
+Rule ids: PTC001 collective budget, PTC002 f64 promotion, PTC003
+donation consumed, PTC004 step-key stability, PTC005 host callbacks.
+Waivers (with the root cause) live in analysis/allowlist.txt.
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pagerank_tpu.analysis.findings import Finding
+
+_ENGINE_PATH = "engines/jax_engine.py"
+
+# Cross-device collective primitives by jaxpr name, normalized across
+# jax versions (psum is rewritten to psum2 under shard_map's
+# replication checker; psum_scatter traces as reduce_scatter).
+_COLLECTIVE_NORM = {
+    "psum": "psum",
+    "psum2": "psum",
+    "all_reduce": "psum",
+    "all_gather": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "psum_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+}
+
+# Host-callback primitives — any of these inside an iteration program
+# breaks the zero-host-round-trips contract.
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback", "host_callback_call"}
+
+_DONATION_MSG = "Some donated buffers were not usable"
+
+
+# -- jaxpr walking ---------------------------------------------------------
+
+
+def _sub_jaxprs(params: dict):
+    for v in params.values():
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for j in vs:
+            if hasattr(j, "eqns"):  # Jaxpr
+                yield j
+            elif hasattr(j, "jaxpr") and hasattr(j.jaxpr, "eqns"):
+                yield j.jaxpr  # ClosedJaxpr
+
+
+def walk_eqns(jaxpr):
+    """Every equation in ``jaxpr`` and its nested sub-jaxprs (pjit,
+    scan, while, shard_map, custom_* ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from walk_eqns(sub)
+
+
+def collectives(closed_jaxpr) -> List[Tuple[str, int]]:
+    """[(normalized primitive, max operand element count)] for every
+    cross-device collective in the program."""
+    out = []
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        norm = _COLLECTIVE_NORM.get(eqn.primitive.name)
+        if norm is None:
+            continue
+        sizes = [
+            int(np.prod(v.aval.shape))
+            for v in eqn.invars
+            if hasattr(v, "aval") and hasattr(v.aval, "shape")
+        ]
+        out.append((norm, max(sizes) if sizes else 0))
+    return out
+
+
+def callback_prims(closed_jaxpr) -> List[str]:
+    return [
+        eqn.primitive.name
+        for eqn in walk_eqns(closed_jaxpr.jaxpr)
+        if eqn.primitive.name in _CALLBACK_PRIMS
+    ]
+
+
+def f64_avals(closed_jaxpr) -> List[str]:
+    """Descriptions of every float64 value in the program (conversion
+    targets and intermediate avals)."""
+    import jax.numpy as jnp
+
+    hits = []
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "convert_element_type":
+            if jnp.dtype(eqn.params.get("new_dtype")) == jnp.float64:
+                hits.append("convert_element_type -> float64")
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and getattr(aval, "dtype", None) is not None:
+                if jnp.dtype(aval.dtype) == jnp.float64:
+                    hits.append(
+                        f"{eqn.primitive.name} produces "
+                        f"f64[{','.join(map(str, aval.shape))}]"
+                    )
+    return hits
+
+
+# -- engine form construction ----------------------------------------------
+
+
+def _tiny_graph(n=512, e=4096, seed=0):
+    from pagerank_tpu import build_graph
+
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+def _classes():
+    """Engine classes that force the striped / multi-dispatch layouts
+    at toy scale (the tests' own pattern)."""
+    from pagerank_tpu import JaxTpuEngine
+
+    class TinyStripes(JaxTpuEngine):
+        def _stripe_max(self):
+            return 256
+
+        def _stripe_target(self):
+            return 256
+
+    class TinyScan(TinyStripes):
+        SCAN_STRIPE_UNITS = 0
+
+    return JaxTpuEngine, TinyStripes, TinyScan
+
+
+@dataclass
+class Form:
+    """One dispatch form: how to build it and what it promises."""
+
+    name: str
+    build: Callable[[], object]  # () -> built engine
+    f32: bool  # config stores AND accumulates in f32 (PTC002 applies)
+
+
+def engine_forms(ndev: int) -> List[Form]:
+    from pagerank_tpu import PageRankConfig
+
+    Eng, Tiny, Scan = _classes()
+    g = _tiny_graph()
+
+    def cfg(**kw):
+        return PageRankConfig(num_iters=2, num_devices=ndev, **kw)
+
+    def dev_build():
+        import jax.numpy as jnp
+
+        from pagerank_tpu.ops import device_build as db
+
+        rng = np.random.default_rng(1)
+        src = jnp.asarray(rng.integers(0, 512, 4096), jnp.int32)
+        dst = jnp.asarray(rng.integers(0, 512, 4096), jnp.int32)
+        dg = db.build_ell_device(src, dst, n=512, with_weights=False)
+        return Eng(cfg()).build_device(dg)
+
+    return [
+        Form("ell", lambda: Eng(cfg()).build(g), True),
+        Form("pair", lambda: Eng(cfg(
+            dtype="float32", accum_dtype="float64", wide_accum="pair",
+        )).build(g), False),
+        Form("striped", lambda: Tiny(cfg()).build(g), True),
+        Form("multi_dispatch", lambda: Scan(cfg()).build(g), True),
+        Form("coo", lambda: Eng(cfg(kernel="coo")).build(g), True),
+        Form("device_build", dev_build, True),
+        Form("vertex_sharded", lambda: Eng(cfg(
+            vertex_sharded=True,
+        )).build(g), True),
+        Form("vs_multi_dispatch", lambda: Scan(cfg(
+            vertex_sharded=True,
+        )).build(g), True),
+        Form("vs_bounded", lambda: Eng(cfg(
+            vertex_sharded=True, vs_bounded=True,
+        )).build(g), True),
+        Form("vsb_multi_dispatch", lambda: Scan(cfg(
+            vertex_sharded=True, vs_bounded=True,
+        )).build(g), True),
+    ]
+
+
+def iteration_programs(engine) -> List[Tuple[str, object]]:
+    """(label, ClosedJaxpr) for every program one iteration dispatches —
+    the fused step, or the prescale/per-stripe/finalize sequence on
+    multi-dispatch layouts. Abstract evaluation only; nothing runs."""
+    import jax
+
+    if engine._ms_stripe is None:
+        jx = jax.make_jaxpr(engine._step_core)(*engine._device_args())
+        return [("step", jx)]
+    progs = [(
+        "prescale",
+        jax.make_jaxpr(engine._ms_prescale)(engine._r, engine._inv_out),
+    )]
+    zs = engine._ms_prescale(engine._r, engine._inv_out)
+    parts = []
+    for s in range(engine._ms_n_stripes):
+        fn = engine._ms_stripe_fns[s]
+        progs.append((
+            f"stripe{s}",
+            jax.make_jaxpr(fn)(*zs, engine._src[s], engine._row_block[s]),
+        ))
+        parts.append(fn(*zs, engine._src[s], engine._row_block[s]))
+    final_args = (engine._r, *parts, *engine._ms_ids,
+                  engine._dangling, engine._zero_in, engine._valid)
+    final = getattr(engine._ms_final, "__wrapped__", engine._ms_final)
+    progs.append(("final", jax.make_jaxpr(final)(*final_args)))
+    return progs
+
+
+def expected_collectives(engine, form: str) -> Dict[str, int]:
+    """The per-iteration BULK-collective budget a form promises (bulk =
+    operand larger than one element; the vertex-sharded tails also psum
+    two scalars, which are excluded here and checked separately)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_stripes = len(engine._src) if getattr(engine, "_src", None) is not None \
+        and isinstance(engine._src, list) else 1
+    if form in ("ell", "pair", "striped", "coo", "device_build"):
+        return {"psum": 1}
+    if form == "multi_dispatch":
+        # The cross-device merge is the finalize's sharded .sum(0)
+        # (GSPMD inserts the all-reduce below jaxpr level): zero
+        # EXPLICIT collectives is the contract.
+        return {}
+    use_rs = (
+        jnp.dtype(engine._accum_dtype).itemsize < 8
+        or jax.default_backend() != "tpu"
+    )
+    merge = {"reduce_scatter": 1} if use_rs else {"psum": 1}
+    if form in ("vertex_sharded", "vs_multi_dispatch"):
+        return {"all_gather": 1, **merge}
+    if form == "vs_bounded":
+        return {"psum": n_stripes}
+    if form == "vsb_multi_dispatch":
+        return {"psum": n_stripes}
+    raise ValueError(f"unknown form {form!r}")
+
+
+# -- checks ----------------------------------------------------------------
+
+
+def _finding(rule, msg, form, path=_ENGINE_PATH):
+    return Finding(rule, path, 0, msg, snippet=f"form={form}")
+
+
+def check_engine_form(form: Form) -> List[Finding]:
+    """Build one dispatch form and run every contract against it."""
+    import jax
+
+    findings: List[Finding] = []
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        engine = form.build()
+        engine._device_step()  # one real step: donation warnings fire
+        engine.fence()
+    for w in wlog:
+        if _DONATION_MSG in str(w.message):
+            findings.append(_finding(
+                "PTC003",
+                f"donation not consumed during build/step: "
+                f"{str(w.message).splitlines()[0][:160]}",
+                form.name,
+            ))
+
+    progs = iteration_programs(engine)
+
+    # PTC001 — bulk collective budget.
+    got: Dict[str, int] = {}
+    scalars = 0
+    for _label, jx in progs:
+        for prim, size in collectives(jx):
+            if size > 1:
+                got[prim] = got.get(prim, 0) + 1
+            else:
+                scalars += 1
+    want = expected_collectives(engine, form.name)
+    if got != want:
+        findings.append(_finding(
+            "PTC001",
+            f"bulk collective budget violated: expected {want or 'none'}, "
+            f"traced {got or 'none'}",
+            form.name,
+        ))
+    # The sharded tails psum exactly two scalars (dangling mass, L1
+    # delta); every other form psums none.
+    want_scalars = 2 if engine.config.vertex_sharded else 0
+    if scalars != want_scalars:
+        findings.append(_finding(
+            "PTC001",
+            f"scalar collective count {scalars} != {want_scalars} "
+            f"(dangling-mass/L1 psums)",
+            form.name,
+        ))
+
+    # PTC002 — no f64 anywhere under an all-f32 config.
+    if form.f32:
+        for label, jx in progs:
+            hits = f64_avals(jx)
+            if hits:
+                findings.append(_finding(
+                    "PTC002",
+                    f"f64 promotion in f32 config ({label}): "
+                    + "; ".join(sorted(set(hits))[:4]),
+                    form.name,
+                ))
+
+    # PTC003 (structural) — the step's donated rank buffer must match
+    # an output aval exactly, or the donation silently no-ops. (On
+    # multi-dispatch layouts the donated buffer lives in the finalize
+    # dispatch; the warning capture above covers it.)
+    if engine._ms_stripe is None:
+        args = engine._device_args()
+        out_avals = jax.tree_util.tree_leaves(
+            jax.eval_shape(engine._step_core, *args)
+        )
+        r_aval = (tuple(args[0].shape), np.dtype(args[0].dtype))
+        if not any(
+            (tuple(o.shape), np.dtype(o.dtype)) == r_aval
+            for o in out_avals
+        ):
+            findings.append(_finding(
+                "PTC003",
+                "donated rank buffer has no matching output aval: "
+                "donation can never be consumed",
+                form.name,
+            ))
+
+    # PTC005 — no host callbacks inside any iteration program.
+    for label, jx in progs:
+        cbs = callback_prims(jx)
+        if cbs:
+            findings.append(_finding(
+                "PTC005",
+                f"host callback(s) {sorted(set(cbs))} inside {label}",
+                form.name,
+            ))
+    return findings
+
+
+def check_step_key_stability(ndev: int) -> List[Finding]:
+    """PTC004: the step executable's compilation key must not depend on
+    the iteration budget (or tol) — a config that only changes
+    ``num_iters`` must lower to byte-identical step HLO, so long runs
+    and resumed runs reuse the cached executable."""
+    import jax
+
+    from pagerank_tpu import JaxTpuEngine, PageRankConfig
+
+    findings: List[Finding] = []
+    g = _tiny_graph()
+    texts = []
+    for iters, tol in ((2, None), (9, 1e-9)):
+        cfg = PageRankConfig(num_iters=iters, tol=tol, num_devices=ndev)
+        eng = JaxTpuEngine(cfg).build(g)
+        lowered = jax.jit(eng._step_core, donate_argnums=(0,)).lower(
+            *eng._device_args()
+        )
+        texts.append(lowered.as_text())
+    if texts[0] != texts[1]:
+        findings.append(_finding(
+            "PTC004",
+            "step lowering differs across num_iters/tol configs: the "
+            "iteration budget leaked into the compilation key",
+            "step_key",
+        ))
+
+    # And the jitted step must hit its cache across repeated dispatches.
+    eng = JaxTpuEngine(PageRankConfig(num_iters=4, num_devices=ndev)).build(g)
+    eng._device_step()
+    eng._device_step()
+    eng.fence()
+    cache_size = getattr(eng._step_fn, "_cache_size", None)
+    if callable(cache_size) and cache_size() > 1:
+        findings.append(_finding(
+            "PTC004",
+            f"step executable recompiled across iterations "
+            f"(cache size {cache_size()})",
+            "step_cache",
+        ))
+    return findings
+
+
+def check_kernels() -> List[Finding]:
+    """Abstract-eval the registered kernels on symbolic shapes: no
+    collectives, no callbacks, no f64 under f32 instantiation, and the
+    documented output shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from pagerank_tpu.ops import LANES, spmv
+
+    findings: List[Finding] = []
+    rows, nb, gw = 8, 4, 8
+    n_pad = nb * LANES
+    S = jax.ShapeDtypeStruct
+
+    def case(path, label, fn, *avals, out_shape=None, f32=True):
+        jx = jax.make_jaxpr(fn)(*avals)
+        for prim, _size in collectives(jx):
+            findings.append(Finding(
+                "PTC001", path, 0,
+                f"kernel emits collective {prim} (kernels must be "
+                f"collective-free; the engine owns the merge)",
+                snippet=f"kernel={label}",
+            ))
+        for cb in callback_prims(jx):
+            findings.append(Finding(
+                "PTC005", path, 0, f"kernel emits host callback {cb}",
+                snippet=f"kernel={label}",
+            ))
+        if f32:
+            hits = f64_avals(jx)
+            if hits:
+                findings.append(Finding(
+                    "PTC002", path, 0,
+                    "f64 promotion in f32 kernel instantiation: "
+                    + "; ".join(sorted(set(hits))[:4]),
+                    snippet=f"kernel={label}",
+                ))
+        if out_shape is not None:
+            got = jax.eval_shape(fn, *avals)
+            if tuple(got.shape) != tuple(out_shape):
+                findings.append(Finding(
+                    "PTC001", path, 0,
+                    f"kernel output shape {tuple(got.shape)} != "
+                    f"documented {tuple(out_shape)}",
+                    snippet=f"kernel={label}",
+                ))
+
+    i32, f4 = jnp.int32, jnp.float32
+    case(
+        "ops/spmv.py", "ell_contrib",
+        lambda z, s, rb: spmv.ell_contrib(z, s, rb, nb, gather_width=gw),
+        S((n_pad + gw,), f4), S((rows, LANES), i32), S((rows,), i32),
+        out_shape=(nb * LANES,),
+    )
+    case(
+        "ops/spmv.py", "ell_contrib_pair",
+        lambda h, lo, s, rb: spmv.ell_contrib_pair(
+            h, lo, s, rb, nb, accum_dtype=jnp.float64, gather_width=gw
+        ),
+        S((n_pad + gw,), f4), S((n_pad + gw,), f4),
+        S((rows, LANES), i32), S((rows,), i32),
+        out_shape=(nb * LANES,), f32=False,
+    )
+    case(
+        "ops/spmv.py", "ell_contrib_spmm",
+        lambda z2, s, rb: spmv.ell_contrib_spmm(z2, s, rb, nb),
+        S((n_pad + 1, 4), f4), S((rows, LANES), i32), S((rows,), i32),
+        out_shape=(nb * LANES, 4),
+    )
+    case(
+        "ops/spmv.py", "edge_contrib_segment_sum",
+        lambda r, s, d, w: spmv.edge_contrib_segment_sum(r, s, d, w, 64),
+        S((64,), f4), S((128,), i32), S((128,), i32), S((128,), f4),
+        out_shape=(64,),
+    )
+    try:
+        from pagerank_tpu.ops import pallas_spmv
+
+        case(
+            "ops/pallas_spmv.py", "ell_contrib_pallas",
+            lambda z, s, rb, rb0: pallas_spmv.ell_contrib_pallas(
+                z, s, rb, rb0, nb, chunk=rows, gather="onehot8",
+                interpret=True,
+            ),
+            S((n_pad + 8,), f4), S((rows, LANES), i32), S((rows,), i32),
+            S((1,), i32), out_shape=(nb * LANES,),
+        )
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        findings.append(Finding(
+            "PTC005", "ops/pallas_spmv.py", 0,
+            f"pallas kernel failed to abstract-eval: "
+            f"{type(e).__name__}: {str(e)[:120]}",
+            snippet="kernel=ell_contrib_pallas",
+        ))
+    return findings
+
+
+def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
+    """Run the full contract suite; returns findings (empty = clean).
+    ``forms`` filters the engine dispatch forms by name."""
+    import jax
+
+    ndev = min(2, len(jax.devices()))
+    findings: List[Finding] = []
+    for form in engine_forms(ndev):
+        if forms is not None and form.name not in forms:
+            continue
+        try:
+            findings.extend(check_engine_form(form))
+        except Exception as e:
+            findings.append(_finding(
+                "PTC001",
+                f"dispatch form failed to build/trace: "
+                f"{type(e).__name__}: {str(e)[:160]}",
+                form.name,
+            ))
+    if forms is None:
+        findings.extend(check_step_key_stability(ndev))
+        findings.extend(check_kernels())
+    return findings

@@ -1,0 +1,332 @@
+"""Repo-specific AST lint for the TPU hot path.
+
+Every rule here exists because a review round caught (or nearly missed)
+the defect class by hand — see docs/ANALYSIS.md for the catalogue with
+``file:line`` provenance. Rules are scoped: lane geometry and dtype
+hygiene police the kernel modules (``ops/``, the jax engines), the
+host-sync and mutable-default rules police the whole package. Files
+OUTSIDE the package tree (test fixtures) get every rule, so seeded
+violations exercise each id.
+
+Rule ids are stable (``PTL001``..); deliberate exceptions live in
+``analysis/allowlist.txt`` with a reason, never as rule carve-outs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from pagerank_tpu.analysis.findings import Finding
+
+# The lane-geometry constants whose literal spelling is banned in ops/:
+# 128 (the lane count), 127 (its mask), and shifts by 7 (its log2). The
+# one allowed spelling is the `LANES = 128` assignment in ops/__init__.
+_LANE_LITERALS = (127, 128)
+_LANE_SHIFT = 7
+
+# jnp constructors whose result dtype silently follows the x64 flag (or
+# a weak-typed fill) unless pinned. Maps name -> index of the positional
+# dtype argument.
+_DTYPE_CTORS = {
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "array": 1,
+    "arange": None,  # dtype is keyword-position-dependent; require kwarg
+}
+
+# Calls that force a device->host sync (or silently materialize on
+# host) when they execute inside a traced/jitted function.
+_HOST_SYNC_NAMES = {"print", "float", "int"}
+_HOST_SYNC_ATTRS = {"item"}  # x.item()
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """@jax.jit / @jit / @functools.partial(jax.jit, ...) /
+    @partial(jit, ...) — including jax.jit called as a factory."""
+
+    def jit_ish(node: ast.expr) -> bool:
+        return (isinstance(node, ast.Name) and node.id == "jit") or (
+            isinstance(node, ast.Attribute) and node.attr == "jit"
+        )
+
+    if jit_ish(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if jit_ish(dec.func):  # @jax.jit(static_argnums=...)
+            return True
+        f = dec.func
+        partial_ish = (isinstance(f, ast.Name) and f.id == "partial") or (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+        )
+        if partial_ish and dec.args and jit_ish(dec.args[0]):
+            return True
+    return False
+
+
+def _snippet(lines: List[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""
+
+
+def _int_const(node: ast.expr) -> Optional[int]:
+    if isinstance(node, ast.Constant) and type(node.value) is int:
+        return node.value
+    return None
+
+
+def _dotted(node: ast.expr) -> str:
+    """'jnp.zeros' for Attribute(Name(jnp), zeros); '' when not a plain
+    dotted name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# -- rules -----------------------------------------------------------------
+
+
+def rule_ptl001(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL001: magic lane-geometry constants in kernel modules. Bans
+    literal 128/127 and ``>> 7``/``<< 7`` outside the canonical
+    ``LANES = 128`` assignment — hardcoded geometry diverges silently
+    when the layout changes (the ell.py deal composition did exactly
+    that; ADVICE r5)."""
+    allowed_lines = set()
+    for node in ast.walk(tree):
+        # The one allowed spelling: `LANES = <int>` at module level.
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "LANES":
+                for sub in ast.walk(node):
+                    allowed_lines.add(getattr(sub, "lineno", node.lineno))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and node.lineno not in allowed_lines:
+            if type(node.value) is int and node.value in _LANE_LITERALS:
+                yield Finding(
+                    "PTL001", path, node.lineno,
+                    f"magic lane constant {node.value}: derive from LANES "
+                    f"(pagerank_tpu.ops.LANES) instead",
+                    _snippet(lines, node.lineno), node.col_offset,
+                )
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.LShift, ast.RShift)
+        ):
+            if _int_const(node.right) == _LANE_SHIFT:
+                yield Finding(
+                    "PTL001", path, node.lineno,
+                    "magic lane shift by 7: use LANES-derived arithmetic "
+                    "(// LANES, % LANES, or LANES.bit_length() - 1)",
+                    _snippet(lines, node.lineno), node.col_offset,
+                )
+
+
+def rule_ptl002(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL002: jnp array constructors without an explicit dtype in
+    kernel modules. The result dtype then follows the process-global
+    x64 flag (which this package flips at runtime for f64 configs) or
+    a weak-typed fill — an accidental widening doubles HBM traffic on
+    the hot path."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name.startswith("jnp."):
+            continue
+        ctor = name[len("jnp."):]
+        if ctor not in _DTYPE_CTORS:
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        pos = _DTYPE_CTORS[ctor]
+        if pos is not None and len(node.args) > pos:
+            continue  # positional dtype argument
+        if ctor == "full" and len(node.args) > 1 and isinstance(
+            node.args[1], ast.Call
+        ):
+            continue  # fill like jnp.int32(x) pins the dtype itself
+        yield Finding(
+            "PTL002", path, node.lineno,
+            f"jnp.{ctor} without an explicit dtype: the result follows "
+            f"the global x64 flag — pin it",
+            _snippet(lines, node.lineno), node.col_offset,
+        )
+
+
+def rule_ptl003(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL003: host-sync calls inside jit-decorated functions. A
+    ``print``/``float()``/``.item()``/``np.asarray``/``jax.device_get``
+    reached under trace either fails or forces a device->host round
+    trip per call — the exact overhead the one-dispatch-per-iteration
+    design exists to remove."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_jit_decorator(d) for d in fn.decorator_list):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            bad = None
+            if name in _HOST_SYNC_NAMES:
+                bad = f"{name}()"
+            elif name.startswith("np.") or name.startswith("numpy."):
+                bad = name
+            elif name == "jax.device_get":
+                bad = name
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_ATTRS
+            ):
+                bad = f".{node.func.attr}()"
+            if bad:
+                yield Finding(
+                    "PTL003", path, node.lineno,
+                    f"host-sync call {bad} inside jit-decorated "
+                    f"'{fn.name}': hoist it out of the traced region",
+                    _snippet(lines, node.lineno), node.col_offset,
+                )
+
+
+def rule_ptl004(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL004: mutable default arguments — shared across calls, a
+    classic aliasing bug; engine builders cache per-instance state and
+    a shared default list/dict corrupts it silently."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and _dotted(d.func) in ("list", "dict", "set")
+            )
+            if mutable:
+                yield Finding(
+                    "PTL004", path, d.lineno,
+                    f"mutable default argument in '{fn.name}': use None "
+                    f"and construct inside",
+                    _snippet(lines, d.lineno), d.col_offset,
+                )
+
+
+def rule_ptl005(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL005: float64 literals in kernel modules outside the
+    config-gated pair-f64 paths. TPUs have no native f64 — a stray
+    float64 constant/dtype string drags a kernel onto the ~3.4x-slower
+    emulated path (or trips the process-global x64 flip); wide
+    accumulation must come from config.accum_dtype, never a literal."""
+    for node in ast.walk(tree):
+        name = _dotted(node) if isinstance(node, ast.Attribute) else ""
+        if name in ("np.float64", "jnp.float64", "numpy.float64"):
+            yield Finding(
+                "PTL005", path, node.lineno,
+                f"{name} literal: route wide precision through "
+                f"config.accum_dtype (pair-f64 path) instead",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+        elif isinstance(node, ast.Constant) and node.value == "float64":
+            yield Finding(
+                "PTL005", path, node.lineno,
+                "'float64' dtype string: route wide precision through "
+                "config.accum_dtype (pair-f64 path) instead",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+
+
+RuleFn = Callable[[ast.AST, str, List[str]], Iterable[Finding]]
+
+# rule id -> (fn, scope, one-line description). Scopes:
+#   ops     — files under ops/
+#   kernel  — ops/ plus the jax engines (the modules that trace device code)
+#   all     — every package file
+RULES: Dict[str, Tuple[RuleFn, str, str]] = {
+    "PTL001": (rule_ptl001, "ops",
+               "magic lane-geometry constants outside LANES"),
+    "PTL002": (rule_ptl002, "kernel",
+               "jnp constructors without an explicit dtype"),
+    "PTL003": (rule_ptl003, "all",
+               "host-sync calls inside jit-decorated functions"),
+    "PTL004": (rule_ptl004, "all", "mutable default arguments"),
+    "PTL005": (rule_ptl005, "kernel",
+               "float64 literals outside config-gated paths"),
+}
+
+_KERNEL_FILES = ("engines/jax_engine.py", "engines/ppr.py")
+
+
+def _scope_match(scope: str, rel: str) -> bool:
+    if scope == "all":
+        return True
+    if scope == "ops":
+        return rel.startswith("ops/")
+    if scope == "kernel":
+        return rel.startswith("ops/") or rel in _KERNEL_FILES
+    raise ValueError(f"unknown rule scope {scope!r}")
+
+
+def package_root() -> str:
+    """The installed pagerank_tpu package directory — the default lint
+    target."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_python_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if not d.startswith((".", "__pycache__"))
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
+    """Run every in-scope rule over one file. ``rel`` is the
+    package-relative posix path used for scoping and reporting; files
+    outside the package pass every scope (fixture mode)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    report_as = rel if rel is not None else path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("PTL000", report_as, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+    for rule_id, (fn, scope, _desc) in RULES.items():
+        if rel is not None and not _scope_match(scope, rel):
+            continue
+        findings.extend(fn(tree, report_as, lines))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_tree(root: Optional[str] = None) -> List[Finding]:
+    """Lint the package tree (default) or an explicit directory. Inside
+    the package, rules apply by scope; an external directory is treated
+    as fixture space (every rule, paths reported relative to it)."""
+    root = os.path.abspath(root or package_root())
+    pkg = package_root()
+    inside = root == pkg or root.startswith(pkg + os.sep)
+    findings: List[Finding] = []
+    for path in iter_python_files(root):
+        rel = os.path.relpath(path, pkg if inside else root).replace(
+            os.sep, "/"
+        )
+        findings.extend(lint_file(path, rel if inside else None))
+    return findings

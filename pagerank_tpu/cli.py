@@ -30,6 +30,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pagerank_tpu",
         description="TPU-native PageRank (reference or textbook semantics).",
+        epilog="Developer tooling: `python -m pagerank_tpu.analysis` "
+        "runs the repo's AST lint + jaxpr contract checker "
+        "(docs/ANALYSIS.md).",
     )
     src = p.add_mutually_exclusive_group(required=True)
     src.add_argument(

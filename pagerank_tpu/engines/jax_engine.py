@@ -54,7 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from pagerank_tpu.utils.jax_compat import shard_map
 
 from pagerank_tpu import graph as graph_mod
 from pagerank_tpu.engine import PageRankEngine, register_engine
@@ -294,9 +295,10 @@ class JaxTpuEngine(PageRankEngine):
                     ),
                 )
             )
-            # vs_bounded: the packer deals dst blocks round-robin across
-            # the mesh's device ranges (ops/ell.deal_block_order) so the
-            # dst-partitioned rows balance (_setup_ell_vs_bounded).
+            # vs_bounded: the packer deals dst blocks across the mesh's
+            # device ranges by capacity-constrained LPT
+            # (ops/ell.deal_block_order) so the dst-partitioned rows
+            # balance (_setup_ell_vs_bounded).
             deal = ndev if (cfg.vertex_sharded and cfg.vs_bounded) else 0
             if striped:
                 # An occupancy-widened span can push an explicit large
@@ -1427,11 +1429,11 @@ class JaxTpuEngine(PageRankEngine):
         transients: the all_gathered z planes and the [num_blocks, 128]
         accumulator, merged by an O(N)-per-chip psum. Here:
 
-          - dst blocks are DEALT round-robin across contiguous device
-            ranges by in-degree depth (ops/ell.deal_block_order,
-            composed into the relabel by the packer), so each device's
-            range carries a near-equal share of slot rows despite
-            power-law skew;
+          - dst blocks are DEALT across contiguous device ranges by
+            capacity-constrained LPT over in-degree depth
+            (ops/ell.deal_block_order, composed into the relabel by the
+            packer), so each device's range carries a near-equal share
+            of slot rows despite power-law skew;
           - each device holds exactly the slot rows whose dst block
             falls in its OWN range (stage b): the contribution
             accumulator shrinks to the local [num_blocks/ndev, 128]
@@ -1598,14 +1600,16 @@ class JaxTpuEngine(PageRankEngine):
 
         def stripe_plane(z_l, s):
             """Stage (a): per-stripe z broadcast — replicated [sz]
-            plane from the sharded z. The start is clipped EXPLICITLY:
-            lax.dynamic_slice treats negative starts as from-the-end
-            (NumPy semantics), so a no-overlap device's negative
-            offset would wrap into real data instead of landing in the
-            zero pads. After the clip, both out-of-range destinations
-            are zero pads, overlapping devices are in-range (no clip),
-            and each element of the psum has ONE nonzero contributor
-            (exact)."""
+            plane from the sharded z. The start is clipped EXPLICITLY
+            not to guard against wraparound — lax.dynamic_slice CLAMPS
+            out-of-bounds starts toward the valid range (it does not
+            wrap NumPy-style) — but to FORCE the intended landing: the
+            clip pins a no-overlap device's slice wholly inside the
+            zero pads (clamping alone would leave the landing implicit
+            in the slice-size arithmetic). After the clip, both
+            out-of-range destinations are zero pads, overlapping
+            devices are in-range (no clip), and each element of the
+            psum has ONE nonzero contributor (exact)."""
             zeros = jnp.zeros(sz, z_l.dtype)
             ze = jnp.concatenate([zeros, z_l, zeros])
             off = jnp.clip(

@@ -103,8 +103,9 @@ class PprJaxEngine:
 
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from pagerank_tpu.utils.jax_compat import shard_map
 
         from pagerank_tpu import graph as graph_lib
         from pagerank_tpu.ops import ell as ell_lib

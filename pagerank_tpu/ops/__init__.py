@@ -1,0 +1,11 @@
+"""TPU-native sparse ops (L3 hot path).
+
+``LANES`` is the ONE spelling of the TPU lane geometry — the 128-lane
+vector register width that sizes every dst block, slot row, and padding
+round in the blocked-ELL layout. Every module under ``ops/`` imports it
+from here; the repo lint (``python -m pagerank_tpu.analysis``, rule
+PTL001) rejects magic ``128``/``127``/``>> 7`` lane arithmetic anywhere
+else under ``ops/`` so the geometry cannot silently fork.
+"""
+
+LANES = 128

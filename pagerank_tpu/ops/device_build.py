@@ -44,8 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pagerank_tpu import graph as graph_lib
-
-LANES = 128
+from pagerank_tpu.ops import LANES
 
 
 @jax.jit
@@ -398,11 +397,20 @@ def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
     return word, w, row_idx, pos, sb_rows, row_offset
 
 
-@functools.partial(
-    jax.jit, static_argnums=(5, 6, 7, 8), donate_argnums=(0, 1, 2, 3)
-)
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
 def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
                    n_stripes=1, fill=0):
+    # NOT donated: the per-edge inputs ([e] int32/int8/weight vectors)
+    # can never alias the (rows_total, 128) slot-plane outputs — the
+    # byte sizes differ by construction, so a donate_argnums here is
+    # unconsumable and XLA warns "Some donated buffers were not usable"
+    # on every build (three/four full per-edge planes at bench scale —
+    # the r5 bench log's int32[134217728] x2 + int8[134217728]). Peak
+    # HBM is identical either way; the caller's `del` after the call
+    # frees the buffers as soon as the scatter consumes them. The
+    # analysis contract checker (pagerank_tpu/analysis/contracts.py)
+    # enforces that every remaining donation in the build chain IS
+    # consumable.
     pos = pos.astype(jnp.int32)  # int8 across the phase boundary saves
     # a per-edge array; JAX indexing needs a type that can hold 128
     src_slots = jnp.full((rows_total, LANES), jnp.int32(fill))

@@ -42,8 +42,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from pagerank_tpu.graph import Graph
-
-LANES = 128
+from pagerank_tpu.ops import LANES
 
 
 @dataclass
@@ -252,7 +251,7 @@ def ell_pack_striped(
             ),
         )
         ids = np.arange(n, dtype=np.int64)
-        new_pos = (new_of_old[ids >> 7] << 7) | (ids & 127)
+        new_pos = new_of_old[ids // LANES] * LANES + (ids % LANES)
         dealt = np.empty(n, order.dtype)
         dealt[new_pos] = order
         order = dealt
@@ -307,7 +306,8 @@ def ell_pack_striped(
         # stripe, so a real max is needed). Only blocks present in the
         # stripe are touched (O(e_s), not O(n)).
         g_rows = -(-cnt // group)
-        gb = grp[gstarts] >> (7 - log2g)  # block id per group run
+        log2_lanes = LANES.bit_length() - 1
+        gb = grp[gstarts] >> (log2_lanes - log2g)  # block id per group run
         bstarts = np.flatnonzero(np.r_[True, gb[1:] != gb[:-1]])
         block_rows = np.zeros(num_blocks, np.int64)
         block_rows[gb[bstarts]] = np.maximum.reduceat(g_rows, bstarts)

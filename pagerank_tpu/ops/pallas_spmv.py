@@ -44,7 +44,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LANES = 128
+from pagerank_tpu.ops import LANES
+from pagerank_tpu.utils import jax_compat
 
 
 def _kernel(rb0_ref, z_ref, src_ref, rb_ref, out_in_ref, out_ref, acc, sem,
@@ -145,7 +146,9 @@ def ell_contrib_pallas(
         out_shape=jax.ShapeDtypeStruct((num_blocks_pad, LANES), z_ext.dtype),
         input_output_aliases={4: 0},  # donated zeros -> output (RMW target)
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=jax_compat.pallas_tpu_compiler_params(
+            has_side_effects=True
+        ),
     )(
         rb0_per_chunk, z_ext, src_slots,
         row_block.reshape(-1, 1), out_init,

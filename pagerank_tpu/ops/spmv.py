@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from pagerank_tpu.ops import LANES
+
 
 def edge_contrib_segment_sum(r, src, dst, w, n, accum_dtype=None):
     """contrib = Aᵀ_norm r over one COO edge shard.
@@ -90,7 +92,7 @@ def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows,
         raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
     nc = n_rows // chunk_rows
 
-    src_c = src_slots.reshape(nc, chunk_rows, 128)
+    src_c = src_slots.reshape(nc, chunk_rows, LANES)
     rb_c = row_block.reshape(nc, chunk_rows)
 
     if not slab:
@@ -307,7 +309,7 @@ def ell_contrib_spmm(z2_ext, src_slots, row_block, num_blocks,
     return _chunked_block_sum(
         chunk_sum, src_slots, row_block, chunk_rows,
         num_present or num_blocks, slab=num_present is not None,
-    ).reshape((num_present or num_blocks) * 128, k)
+    ).reshape((num_present or num_blocks) * LANES, k)
 
 
 def scatter_block_sums(total, part, ids, is_prefix):

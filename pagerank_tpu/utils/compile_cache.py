@@ -86,9 +86,18 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point JAX's persistent compilation cache at ``cache_dir``
     (default: :func:`default_cache_dir`) with a 0s persistence
     threshold. Failures are non-fatal — the cache is an optimization,
-    never a requirement."""
+    never a requirement.
+
+    No-op on the CPU backend: the cache exists to amortize the ~0.6s
+    remote-compile round trips of tunneled TPU runs; CPU compiles of
+    these programs are milliseconds, and on jax 0.4.x the CPU backend
+    SEGFAULTS deserializing warm cache entries (reproduced: a second
+    `bench.py --host-build` run of the same geometry crashes at
+    executable load; first/cold runs are fine)."""
     import jax
 
+    if jax.default_backend() == "cpu":
+        return
     if cache_dir is None:
         cache_dir = default_cache_dir()
     try:

@@ -93,9 +93,11 @@ class PageRankConfig:
     # per-vertex state but each chip still materializes O(N) step
     # transients (the all_gathered z planes and the [num_blocks, 128]
     # contribution accumulator, merged by an O(N) psum). With
-    # vs_bounded, dst blocks are dealt round-robin across device ranges
-    # (ops/ell.deal_block_order — edge-balancing the per-device row
-    # load), each chip owns exactly the slot rows of its OWN dst range,
+    # vs_bounded, dst blocks are dealt across device ranges by
+    # capacity-constrained LPT (ops/ell.deal_block_order —
+    # edge-balancing the per-device row load; measured max/mean 1.01
+    # vs 1.83 for round-robin), each chip owns exactly the slot rows
+    # of its OWN dst range,
     # the accumulator shrinks to [num_blocks/ndev, 128], the
     # contribution merge disappears entirely, and the only per-
     # iteration communication is one [stripe_span] psum per stripe —

