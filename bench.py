@@ -50,6 +50,95 @@ import numpy as np
 
 NORTH_STAR_EDGES_PER_SEC_PER_CHIP = 1.47e9 * 50 / 60 / 8
 
+# The per-stage device-build breakdown schema (--build-only; also
+# checked by scripts/acceptance.py's build smoke). Stage walls include
+# any compile that stage paid; compile_s counts the STAGE-DISPATCH
+# compiles only (utils/compile_cache.stage_call — engine-side compiles,
+# autotune candidates included, land in engine_s undifferentiated), and
+# engine_s includes autotune_s (reported separately so the historically
+# largest engine-side line stays attributable — docs/PERF_NOTES.md
+# "Device-build cost").
+BUILD_STAGE_KEYS = ("gen_s", "relabel_s", "sort_s", "slots_s", "scatter_s",
+                    "autotune_s", "engine_s", "compile_s")
+
+
+def _device_graph(cfg, scale, edge_factor, stripe, seed=0, timings=None):
+    """THE device graph gen + pack sequence — shared by run_rate's
+    bench legs and run_build's --build-only breakdown, so the measured
+    breakdown can never drift from the build the rate legs actually
+    run. ``timings`` engages ops/device_build's per-stage fencing (plus
+    an honest gen fence here); None keeps the pipeline fully async."""
+    import jax
+
+    from pagerank_tpu.ops import device_build as db
+
+    t0 = time.perf_counter()
+    src, dst = db.rmat_edges_device(scale, edge_factor, seed=seed)
+    if timings is not None:
+        jax.device_get((src[:1], dst[:1]))  # honest gen fence
+        timings["gen_s"] = time.perf_counter() - t0
+    pallas = cfg.kernel == "pallas"
+    return db.build_ell_device(
+        src, dst, n=1 << scale,
+        group=1 if pallas else cfg.lane_group,
+        stripe_size=0 if pallas else stripe,
+        with_weights=False,  # presentinel: no per-slot weight plane
+        timings=timings,
+    )
+
+
+def run_build(scale, edge_factor=16, dtype="float32", accum_dtype=None,
+              wide_accum="auto", stripe_size=0, lane_group=0, seed=0,
+              label=None):
+    """One device build of the bench R-MAT geometry with the per-stage
+    breakdown (BUILD_STAGE_KEYS): gen + the builder's four pipeline
+    stages fenced by _device_graph's timing mode, engine setup
+    (placements + autotune + fingerprint) fenced by the engine's own
+    honest fence. Importable — scripts/acceptance.py's build smoke
+    calls it directly. Returns {"build_s", "stages", "num_edges"}."""
+    from pagerank_tpu import PageRankConfig
+    from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+    from pagerank_tpu.ops.device_build import plan_build
+
+    accum_dtype = accum_dtype or dtype
+    cfg = PageRankConfig(
+        num_iters=1, dtype=dtype, accum_dtype=accum_dtype,
+        wide_accum=wide_accum,
+    ).validate()
+    grp, stripe = plan_build(
+        cfg, 1 << scale, stripe_size=stripe_size, lane_group=lane_group,
+        num_edges=edge_factor << scale,
+    )
+    cfg = cfg.replace(lane_group=grp)
+    # Start EMPTY: every key except compile_s must be written by a real
+    # fence/timer below, so a dropped stage fence shows up as a missing
+    # key in the acceptance gate instead of a pre-seeded 0.0.
+    stages = {}
+    t_total = time.perf_counter()
+    dg = _device_graph(cfg, scale, edge_factor, stripe, seed=seed,
+                       timings=stages)
+    t0 = time.perf_counter()
+    engine = JaxTpuEngine(cfg).build_device(dg)
+    engine.fence()
+    stages["engine_s"] = time.perf_counter() - t0
+    stages["autotune_s"] = engine.build_timings.get("autotune_s", 0.0)
+    # Zero compiles is a real value (warm caches), not a missing stage.
+    stages.setdefault("compile_s", 0.0)
+    build_s = time.perf_counter() - t_total
+    num_edges = dg.num_edges
+    del engine, dg
+    if label is None:
+        label = dtype + ("+pair" if wide_accum == "pair" else "")
+    print(
+        f"build[{label}]: scale {scale}: {build_s:.1f}s total — "
+        + " ".join(
+            f"{k[:-2]} {stages[k]:.1f}" for k in BUILD_STAGE_KEYS
+            if k in stages
+        ),
+        file=sys.stderr,
+    )
+    return {"build_s": build_s, "stages": stages, "num_edges": num_edges}
+
 
 def _enable_compile_cache():
     """Persist XLA executables across bench runs — the graph-build and
@@ -111,16 +200,7 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
             src, dst = rmat_edges(args.scale, args.edge_factor, seed=0)
             graph = build_graph(src, dst, n=1 << args.scale)
             return JaxTpuEngine(cfg).build(graph), graph.num_edges
-        from pagerank_tpu.ops import device_build as db
-
-        src, dst = db.rmat_edges_device(args.scale, args.edge_factor, seed=0)
-        pallas = cfg.kernel == "pallas"
-        dg = db.build_ell_device(
-            src, dst, n=1 << args.scale,
-            group=1 if pallas else cfg.lane_group,
-            stripe_size=0 if pallas else stripe,
-            with_weights=False,  # presentinel: no per-slot weight plane
-        )
+        dg = _device_graph(cfg, args.scale, args.edge_factor, stripe)
         return JaxTpuEngine(cfg).build_device(dg), dg.num_edges
 
     t0 = time.perf_counter()
@@ -246,6 +326,11 @@ def main(argv=None):
                         "occupancy_span)")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
+    p.add_argument("--build-only", action="store_true",
+                   help="device builds only, with the per-stage "
+                        "breakdown (BUILD_STAGE_KEYS); couple mode "
+                        "builds pair-f64 then f32 and reports the "
+                        "ratio, --dtype builds one config")
     p.add_argument("--accuracy-scale", type=int, default=20,
                    help="R-MAT scale of the standing accuracy probe")
     p.add_argument("--no-accuracy", action="store_true",
@@ -253,6 +338,48 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     _enable_compile_cache()
+
+    if args.build_only:
+        if args.host_build:
+            p.error("--build-only measures the device build pipeline; "
+                    "drop --host-build")
+        if args.kernel not in ("auto", "ell"):
+            # pallas builds group=1/unstriped and coo coerces the host
+            # path (run_rate) — the breakdown would silently measure a
+            # DIFFERENT build than that config runs.
+            p.error(f"--build-only measures the XLA ell build layout; "
+                    f"--kernel {args.kernel} builds a different one")
+        kw = dict(scale=args.scale, edge_factor=args.edge_factor,
+                  stripe_size=args.stripe_size, lane_group=args.lane_group)
+        if args.dtype is not None:
+            rec = run_build(dtype=args.dtype, **kw)
+            out = {"metric": "build_s", "value": rec["build_s"],
+                   "unit": "s", "scale": args.scale, **rec}
+        else:
+            # Pair FIRST (it flips x64 mid-build): the f32 build then
+            # reuses the 32-bit-pinned stage executables across the
+            # flip (utils/compile_cache.stage_call), which is the
+            # cache's whole point.
+            pair = run_build(dtype="float64", accum_dtype="float64",
+                             wide_accum="pair", **kw)
+            f32 = run_build(dtype="float32", **kw)
+            # Warm pair rebuild: the leg that actually measures the
+            # index-width claim for the 15% couple gate. The cold pair
+            # leg runs first and pays every shared cold compile, so
+            # pair_over_f32 is cache-temperature-biased against pair
+            # on a fresh checkout (.jax_cache is gitignored); both
+            # ratios are reported, gate on the warm one.
+            pair_warm = run_build(dtype="float64", accum_dtype="float64",
+                                  wide_accum="pair",
+                                  label="float64+pair warm", **kw)
+            out = {"metric": "build_s", "value": pair["build_s"],
+                   "unit": "s", "scale": args.scale, "pair": pair,
+                   "f32": f32, "pair_warm": pair_warm,
+                   "pair_over_f32": pair["build_s"] / f32["build_s"],
+                   "pair_warm_over_f32":
+                       pair_warm["build_s"] / f32["build_s"]}
+        print(json.dumps(out))
+        return
 
     if args.dtype is not None:
         # Single-config mode (the original schema).

@@ -83,6 +83,7 @@ def main(argv=None) -> int:
             ("PTC003", "donation actually consumed"),
             ("PTC004", "step compilation key independent of num_iters/tol"),
             ("PTC005", "no host callbacks inside iteration programs"),
+            ("PTC006", "device build chain 32-bit under x64 (no i64/f64 op)"),
         ):
             print(f"{rid}  [jaxpr ] {desc}")
         return 0

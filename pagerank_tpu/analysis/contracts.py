@@ -22,8 +22,13 @@ the device-build path):
   vs_bounded (+ms)        — owner-computes, per-stripe z psums
 
 Rule ids: PTC001 collective budget, PTC002 f64 promotion, PTC003
-donation consumed, PTC004 step-key stability, PTC005 host callbacks.
-Waivers (with the root cause) live in analysis/allowlist.txt.
+donation consumed, PTC004 step-key stability, PTC005 host callbacks,
+PTC006 32-bit build chain (the device graph-build stages must emit no
+64-bit op under x64 — the pair-f64 config flips ``jax_enable_x64``
+process-wide, and a weak-typed promotion in the per-edge path silently
+doubles sort/scatter bytes; it is also what licenses
+utils/compile_cache.stage_call to key executables WITHOUT the x64
+flag). Waivers (with the root cause) live in analysis/allowlist.txt.
 """
 
 from __future__ import annotations
@@ -125,6 +130,32 @@ def f64_avals(closed_jaxpr) -> List[str]:
                         f"{eqn.primitive.name} produces "
                         f"f64[{','.join(map(str, aval.shape))}]"
                     )
+    return hits
+
+
+_WIDE64 = ("int64", "uint64", "float64")
+
+
+def wide64_avals(closed_jaxpr) -> List[str]:
+    """Descriptions of every 64-bit value (int64/uint64/float64) in the
+    program — PTC006's detector (f64_avals stays PTC002's float-only
+    one). Compares dtype NAMES so extended dtypes (PRNG keys) pass
+    through untouched."""
+    hits = []
+    for eqn in walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "convert_element_type":
+            nd = eqn.params.get("new_dtype")
+            if getattr(nd, "name", str(nd)) in _WIDE64:
+                hits.append(f"convert_element_type -> {nd}")
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and getattr(dt, "name", str(dt)) in _WIDE64:
+                hits.append(
+                    f"{eqn.primitive.name} produces "
+                    f"{getattr(dt, 'name', dt)}"
+                    f"[{','.join(map(str, aval.shape))}]"
+                )
     return hits
 
 
@@ -509,6 +540,91 @@ def check_kernels() -> List[Finding]:
     return findings
 
 
+_BUILD_PATH = "ops/device_build.py"
+
+
+def check_build_chain() -> List[Finding]:
+    """PTC006: the device graph-build chain is pinned to 32-bit
+    indices. Abstract-eval every build stage (ops/device_build.py —
+    the restaged single-sort pipeline plus the R-MAT generator) with
+    x64 ENABLED — exactly the process state the pair-f64 config leaves
+    behind — on int32 edge avals, and fail on ANY 64-bit integer or
+    float in the jaxpr. A weak-typed promotion here (an argsort's
+    default iota, a cumsum's default accumulator, a permutation of a
+    default-int arange) silently doubles per-edge sort/scatter bytes;
+    this rule is also what makes utils/compile_cache.stage_call's
+    x64-agnostic executable keying sound. The per-slot weight plane is
+    dtype-contracted (f64 by request is legal), so the checked configs
+    are the 32-bit index paths: presentinel (with_weights=False) and
+    f32 weights."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from pagerank_tpu.ops import LANES
+    from pagerank_tpu.ops import device_build as db
+
+    findings: List[Finding] = []
+    S = jax.ShapeDtypeStruct
+    e, n, n_padded = 4096, 500, 512
+    nb = n_padded // LANES
+    i32, i8, f4 = jnp.int32, jnp.int8, jnp.float32
+
+    def stage_cases():
+        yield ("raw_in_degree", functools.partial(db._raw_in_degree, n=n),
+               (S((e,), i32),))
+        yield ("relabel_perm", db._relabel_perm, (S((n,), i32),))
+        yield ("unrelabel_degree", db._unrelabel_degree,
+               (S((n,), i32), S((n,), i32)))
+        for stripe in (0, 256):  # single-stripe and striped keys
+            ns = 1 if not stripe else n_padded // stripe
+            tag = f":stripe{stripe}" if stripe else ""
+            yield (f"relabel_sort{tag}",
+                   functools.partial(db._relabel_sort, n_padded=n_padded,
+                                     stripe_size=stripe),
+                   (S((e,), i32), S((e,), i32), S((n,), i32)))
+            for group, ww in ((1, True), (8, False)):
+                yield (f"slot_coords:g{group}:w{int(ww)}{tag}",
+                       functools.partial(
+                           db._slot_coords, n=n, n_padded=n_padded,
+                           weight_dtype=jnp.dtype(f4), group=group,
+                           stripe_size=stripe, with_weights=ww),
+                       (S((e,), i32), S((e,), i32)))
+            yield (f"scatter_slots{tag}",
+                   functools.partial(db._scatter_slots, rows_total=64,
+                                     num_blocks=nb, n_stripes=ns, fill=0),
+                   (S((e,), i32), S((e,), i32), S((e,), i8),
+                    S((ns * nb,), i32), S((e,), f4)))
+        yield ("rmat_gen",
+               functools.partial(db._rmat_gen, scale=8, n_edges=1024),
+               (jax.random.key(0, impl="rbg"), jnp.float32(0.76),
+                jnp.float32(0.75), jnp.float32(0.79)))
+
+    with enable_x64():
+        for label, fn, avals in stage_cases():
+            try:
+                jx = jax.make_jaxpr(fn)(*avals)
+            except Exception as ex:
+                findings.append(Finding(
+                    "PTC006", _BUILD_PATH, 0,
+                    f"build stage failed to abstract-eval: "
+                    f"{type(ex).__name__}: {str(ex)[:140]}",
+                    snippet=f"stage={label}",
+                ))
+                continue
+            hits = wide64_avals(jx)
+            if hits:
+                findings.append(Finding(
+                    "PTC006", _BUILD_PATH, 0,
+                    "64-bit op in the 32-bit-pinned build chain under "
+                    "x64: " + "; ".join(sorted(set(hits))[:4]),
+                    snippet=f"stage={label}",
+                ))
+    return findings
+
+
 def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
     """Run the full contract suite; returns findings (empty = clean).
     ``forms`` filters the engine dispatch forms by name."""
@@ -531,4 +647,5 @@ def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
     if forms is None:
         findings.extend(check_step_key_stability(ndev))
         findings.extend(check_kernels())
+        findings.extend(check_build_chain())
     return findings

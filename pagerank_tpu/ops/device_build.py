@@ -26,16 +26,42 @@ tests/test_device_build.py):
   - vertices relabeled by descending in-degree (stable) so ELL blocks
     waste little padding on power-law graphs (ops/ell.py).
 
+Pipeline (ONE full-edge sort): raw in-degrees by unsorted segment-sum,
+relabel, then a single (stripe, new_dst, new_src) composite-key
+``lax.sort``; dedup flags and UNIQUE out-degrees fall out of key
+adjacency post-sort. The original pipeline ran a second full multi-key
+sort first ((dst, src) for dedup-before-degrees); at bench scale the
+two sorts together moved ~25 GB through HBM and were the largest build
+line (docs/PERF_NOTES.md "Device-build cost"). The one observable
+difference: the relabel now orders by RAW in-degree (pre-dedup).
+Duplicate edges cannot create or destroy zero-degree vertices and the
+relabel is pure layout (perm is carried and decoded), so semantics are
+unchanged; on an already-deduplicated edge list — every host-parity
+surface, since graph.py dedups on ingest — raw and unique in-degrees
+coincide and the output is bit-identical to the two-sort pipeline
+(tested in tests/test_device_build.py).
+
+Every stage is pinned to 32-bit indices regardless of the
+process-global ``jax_enable_x64`` flag (the pair-f64 config flips it
+mid-process): a weak-typed promotion in the per-edge path silently
+doubles sort/scatter bytes. The analysis contract PTC006
+(pagerank_tpu/analysis/contracts.py) abstract-evals every stage under
+x64 and fails on any 64-bit op, and the stages dispatch through
+utils/compile_cache.stage_call, whose executable cache deliberately
+ignores the x64 flag (legal precisely because of that pin).
+
 Dynamic shapes note: XLA needs static shapes, but dedup/packing sizes
 are data-dependent. Instead of compacting arrays (dynamic) the build
 keeps duplicate edges in place with weight 0 (they contribute nothing
-and are excluded from degrees); only ``rows_total`` — the ELL row count
-— crosses back to the host as one scalar to size the final buffers.
+and are excluded from degrees); only the per-stripe row bounds and the
+unique-edge count — S + 2 scalars, fetched in ONE device_get — cross
+back to the host to size the final buffers.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -45,6 +71,23 @@ import numpy as np
 
 from pagerank_tpu import graph as graph_lib
 from pagerank_tpu.ops import LANES
+from pagerank_tpu.utils import compile_cache
+
+
+def _stage_fence(timings, key, t0, *arrays):
+    """Timing-mode stage fence: block on a scalar derived from each
+    output (honest on tunneled backends where block_until_ready can
+    lie; the in-order device queue means a one-element sum waits for
+    the whole stage) and charge the elapsed wall to ``timings[key]``.
+    Stage walls INCLUDE any compile that stage paid — the separate
+    ``compile_s`` key (stage_call) says how much. No-op (keeping the
+    build fully async) when ``timings`` is None."""
+    if timings is None:
+        return
+    for a in arrays:
+        if a is not None:
+            jax.device_get(jnp.sum(jnp.reshape(a, (-1,))[:1]))
+    timings[key] = timings.get(key, 0.0) + time.perf_counter() - t0
 
 
 @jax.jit
@@ -206,8 +249,7 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
     return grp, stripe
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _rmat_gen(key, scale, n_edges, ab, a_frac, c_frac):
+def _rmat_gen(key, ab, a_frac, c_frac, *, scale, n_edges):
     def bit_level(carry, key_lvl):
         src, dst = carry
         kr, kc = jax.random.split(key_lvl)
@@ -221,9 +263,17 @@ def _rmat_gen(key, scale, n_edges, ab, a_frac, c_frac):
     keys = jax.random.split(key, scale)
     init = (jnp.zeros(n_edges, jnp.int32), jnp.zeros(n_edges, jnp.int32))
     (src, dst), _ = jax.lax.scan(bit_level, init, keys)
-    # Scramble vertex labels so hubs aren't clustered at id 0
-    # (mirrors the host generator's random permutation).
-    perm = jax.random.permutation(jax.random.fold_in(key, 7), 1 << scale)
+    # Scramble vertex labels so hubs aren't clustered at id 0 (mirrors
+    # the host generator's random permutation). Shuffling an EXPLICIT
+    # int32 iota keeps the label table — and therefore the gathered
+    # per-edge arrays — 32-bit under x64 (PTC006; permutation(key, int)
+    # would shuffle a default-int arange, int64 once the pair-f64
+    # config flips the flag, doubling every downstream sort's bytes).
+    # Same shuffle, same stream: permutation(key, n) IS a shuffle of
+    # arange(n).
+    perm = jax.random.permutation(
+        jax.random.fold_in(key, 7), jax.lax.iota(jnp.int32, 1 << scale)
+    )
     return perm[src], perm[dst]
 
 
@@ -248,60 +298,62 @@ def rmat_edges_device(
     """R-MAT edges generated on device (same recursive-quadrant scheme as
     utils/synth.rmat_edges, different PRNG stream). Only the seed crosses
     the host->device link. Uses the hardware-friendly ``rbg`` PRNG
-    (threefry is ~4x slower on TPU for this volume of bits); the jitted
-    body is module-level so repeat calls reuse the compiled executable."""
+    (threefry is ~4x slower on TPU for this volume of bits); the body
+    dispatches through the build-stage executable cache
+    (utils/compile_cache.stage_call), so repeat calls — including ones
+    across the pair config's x64 flip — reuse the compiled executable."""
     n_edges = edge_factor << scale
     ab = a + b
     key = jax.random.key(seed, impl="rbg")
-    return _rmat_gen(
-        key, scale, n_edges,
-        jnp.float32(ab), jnp.float32(a / ab), jnp.float32(c / (1.0 - ab)),
+    return compile_cache.stage_call(
+        "rmat_gen",
+        functools.partial(_rmat_gen, scale=scale, n_edges=n_edges),
+        (key, jnp.float32(ab), jnp.float32(a / ab),
+         jnp.float32(c / (1.0 - ab))),
+        static_key=(scale, n_edges),
     )
 
 
-@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0, 1))
-def _sort_dedup_degrees(src, dst, n):
-    """Sort edges by (dst, src), mark duplicates, compute unique-edge
-    degrees. Returns (src_s, dst_s, unique, out_degree, in_degree).
-
-    Uses a multi-key lax.sort (no argsort payload indices, no int64
-    keys) and donates the raw edge arrays — at 500M+ edges every 4-byte
-    per-edge temporary is 2GB+ of HBM, and the build's peak live set is
-    what bounds single-chip graph capacity."""
-    dst_s, src_s = jax.lax.sort((dst, src), num_keys=2)
-    same = (src_s[1:] == src_s[:-1]) & (dst_s[1:] == dst_s[:-1])
-    unique = jnp.concatenate([jnp.ones(1, bool), ~same])
-    uniq_i = unique.astype(jnp.int32)
-    out_degree = jax.ops.segment_sum(uniq_i, src_s, num_segments=n)
-    in_degree = jax.ops.segment_sum(
-        uniq_i, dst_s, num_segments=n, indices_are_sorted=True
-    )
-    return src_s, dst_s, unique, out_degree, in_degree
+def _raw_in_degree(dst, *, n):
+    """Raw (pre-dedup) in-degree by UNSORTED segment-sum — the stage
+    that replaced the pipeline's first full-edge sort. The relabel only
+    needs an ordering key, and raw in-degree is that key (module
+    docstring); a scatter-add over the raw edges is one HBM pass where
+    the (dst, src) sort was several."""
+    return jax.ops.segment_sum(jnp.ones_like(dst), dst, num_segments=n)
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5), donate_argnums=(0, 1, 2))
-def _relabel_resort(src_s, dst_s, unique, in_degree, n_padded, stripe_size):
-    """In-degree-descending relabel + re-sort by (stripe, new dst, new
-    src). Returns (sb_dst, new_src, perm): ``sb_dst`` is the composite
-    int32 key stripe * n_padded + relabeled_dst (decodable, so the big
-    dst/stripe arrays aren't carried twice).
-
-    The dedup flags are NOT carried through the sort (a payload operand
-    would cost another per-edge array through the sort's double buffer);
-    duplicates stay adjacent under the new total order, so the caller
-    recomputes them from key adjacency."""
-    del unique  # recomputed post-sort from key adjacency (see docstring)
+def _relabel_perm(in_degree):
+    """Stable in-degree-descending permutation, 32-bit throughout:
+    ``jnp.argsort`` would carry an int64 iota payload under x64
+    (PTC006), so this sorts an explicit int32 iota instead. Returns
+    (perm, inv_perm); perm maps relabeled -> original."""
     n = in_degree.shape[0]
+    iota = jax.lax.iota(jnp.int32, n)
     # in_degree <= num edges < 2^31, so int32 negation cannot overflow
     # (int64 here would be silently truncated anyway when x64 is off,
     # with a noisy warning per build).
-    order = jnp.argsort(-in_degree.astype(jnp.int32), stable=True)
-    perm = order.astype(jnp.int32)  # relabeled -> original
-    inv_perm = jnp.zeros(n, jnp.int32).at[perm].set(
-        jnp.arange(n, dtype=jnp.int32)
-    )
-    new_dst = inv_perm[dst_s]
-    new_src = inv_perm[src_s]
+    _, perm = jax.lax.sort((-in_degree, iota), num_keys=1, is_stable=True)
+    inv_perm = jnp.zeros(n, jnp.int32).at[perm].set(iota)
+    return perm, inv_perm
+
+
+def _relabel_sort(src, dst, inv_perm, *, n_padded, stripe_size):
+    """Relabel the raw edges and run THE one full-edge sort, by the
+    composite key (stripe, new dst) with new src as the tiebreak key.
+    Returns (sb_dst, new_src): ``sb_dst`` is the int32 key
+    stripe * n_padded + relabeled_dst (decodable, so the big dst/stripe
+    arrays aren't carried twice).
+
+    Donates the raw edge arrays — at 500M+ edges every 4-byte per-edge
+    temporary is 2GB+ of HBM, and the build's peak live set is what
+    bounds single-chip graph capacity. Dedup flags don't exist yet
+    (nothing was sorted before this): duplicates land adjacent under
+    this total order — identical (src, dst) means identical (stripe,
+    new_dst, new_src) — so _slot_coords derives them from key
+    adjacency."""
+    new_src = inv_perm[src]
+    new_dst = inv_perm[dst]
     sz = stripe_size or n_padded
     n_stripes = -(-n_padded // sz)
     if n_stripes > 1:
@@ -310,31 +362,37 @@ def _relabel_resort(src_s, dst_s, unique, in_degree, n_padded, stripe_size):
     else:
         sb_dst = new_dst
     sb_dst, new_src = jax.lax.sort((sb_dst, new_src), num_keys=2)
-    return sb_dst, new_src, perm
+    return sb_dst, new_src
 
 
-@functools.partial(
-    jax.jit, static_argnums=(3, 4, 5, 6, 7), donate_argnums=(0, 1)
-)
-def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
+def _slot_coords(sb_dst, new_src, *, n, n_padded, weight_dtype,
                  group, stripe_size, with_weights=True):
     """Per-edge ELL slot coordinates from the (stripe, dst, src)-sorted
-    composite key. Returns everything needed to scatter slots once
-    rows_total is known on host. With striping, the row space is keyed
-    by (stripe, block): stripe s owns the contiguous row range
-    [row_offset[s*num_blocks], row_offset[(s+1)*num_blocks]) and slot
-    words hold STRIPE-LOCAL source ids (ops/ell.py:StripedEllPack)."""
+    composite key, plus the dedup-corrected degrees that used to come
+    from the pre-relabel sort: first-occurrence flags fall out of key
+    adjacency, and the UNIQUE out-degree (``.distinct()`` before
+    degree, Sparky.java:124) is one unsorted segment-sum of those flags
+    over the relabeled sources — all in the same program, so the
+    correction costs no extra HBM pass. Returns everything needed to
+    scatter slots once rows_total is known on host. With striping, the
+    row space is keyed by (stripe, block): stripe s owns the contiguous
+    row range [row_offset[s*num_blocks], row_offset[(s+1)*num_blocks])
+    and slot words hold STRIPE-LOCAL source ids
+    (ops/ell.py:StripedEllPack)."""
     sz = stripe_size or n_padded
     n_stripes = -(-n_padded // sz)
     new_dst = sb_dst % n_padded if n_stripes > 1 else sb_dst
     stripe_of = sb_dst // n_padded if n_stripes > 1 else None
 
     # Duplicate edges are adjacent under the (stripe, dst, src) order;
-    # re-derive first-occurrence flags here (see _relabel_resort).
+    # first-occurrence flags from key adjacency (see _relabel_sort).
     unique2 = jnp.concatenate(
         [jnp.ones(1, bool),
          (sb_dst[1:] != sb_dst[:-1]) | (new_src[1:] != new_src[:-1])]
     )
+    uniq_i = unique2.astype(jnp.int32)
+    out_degree_rel = jax.ops.segment_sum(uniq_i, new_src, num_segments=n)
+    num_edges = jnp.sum(uniq_i, dtype=jnp.int32)
     if with_weights:
         # Weight = 1/out_degree[src] on unique slots, 0 on duplicate
         # slots (they occupy a slot that contributes nothing — the
@@ -385,8 +443,10 @@ def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
         indices_are_sorted=True,
     )
     sb_rows = jnp.maximum(sb_rows, 0)  # empty blocks: segment_max = -inf
+    # dtype pinned: jnp.cumsum follows numpy's int32 -> default-int
+    # promotion, which under x64 is a silent int64 widening (PTC006).
     row_offset = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jnp.cumsum(sb_rows).astype(jnp.int32)]
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(sb_rows, dtype=jnp.int32)]
     )
     row_idx = row_offset[sb] + row
     if not with_weights:
@@ -394,23 +454,30 @@ def _slot_coords(sb_dst, new_src, out_degree_rel, n_padded, weight_dtype,
         # DROPPED at scatter instead: route them out of bounds (the
         # sentinel-initialized buffer keeps their slot inert).
         row_idx = jnp.where(unique2, row_idx, row_offset[-1] + 1)
-    return word, w, row_idx, pos, sb_rows, row_offset
+    return word, w, row_idx, pos, sb_rows, row_offset, out_degree_rel, \
+        num_edges
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
-def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
-                   n_stripes=1, fill=0):
-    # NOT donated: the per-edge inputs ([e] int32/int8/weight vectors)
-    # can never alias the (rows_total, 128) slot-plane outputs — the
-    # byte sizes differ by construction, so a donate_argnums here is
-    # unconsumable and XLA warns "Some donated buffers were not usable"
-    # on every build (three/four full per-edge planes at bench scale —
-    # the r5 bench log's int32[134217728] x2 + int8[134217728]). Peak
-    # HBM is identical either way; the caller's `del` after the call
-    # frees the buffers as soon as the scatter consumes them. The
-    # analysis contract checker (pagerank_tpu/analysis/contracts.py)
-    # enforces that every remaining donation in the build chain IS
-    # consumable.
+def _unrelabel_degree(out_degree_rel, perm):
+    """Unique out-degree back in ORIGINAL id space (one small scatter:
+    original_degree[perm[i]] = relabeled_degree[i])."""
+    n = perm.shape[0]
+    return jnp.zeros(n, jnp.int32).at[perm].set(out_degree_rel)
+
+
+def _scatter_slots(word, row_idx, pos, sb_rows, w=None, *, rows_total,
+                   num_blocks, n_stripes=1, fill=0):
+    # NOT donated (stage_call passes no donate_argnums): the per-edge
+    # inputs ([e] int32/int8/weight vectors) can never alias the
+    # (rows_total, 128) slot-plane outputs — the byte sizes differ by
+    # construction, so a donation here is unconsumable and XLA warns
+    # "Some donated buffers were not usable" on every build (three/four
+    # full per-edge planes at bench scale — the r5 bench log's
+    # int32[134217728] x2 + int8[134217728]). Peak HBM is identical
+    # either way; the caller's `del` after the call frees the buffers
+    # as soon as the scatter consumes them. The analysis contract
+    # checker (pagerank_tpu/analysis/contracts.py) enforces that every
+    # remaining donation in the build chain IS consumable.
     pos = pos.astype(jnp.int32)  # int8 across the phase boundary saves
     # a per-edge array; JAX indexing needs a type that can hold 128
     src_slots = jnp.full((rows_total, LANES), jnp.int32(fill))
@@ -431,7 +498,7 @@ def _scatter_slots(word, w, row_idx, pos, sb_rows, rows_total, num_blocks,
 def build_ell_device(
     src: jax.Array, dst: jax.Array, n: int, weight_dtype=jnp.float32,
     group: int = 1, stripe_size: int = 0, with_weights: bool = True,
-    dangling_mask=None,
+    dangling_mask=None, timings: Optional[dict] = None,
 ) -> DeviceEllGraph:
     """Full graph build on device from raw (possibly duplicated) edges.
 
@@ -459,6 +526,13 @@ def build_ell_device(
     targets carry dangling mass and a crawled page with no anchor
     links does not (SURVEY.md §2a.3; graph.py carries the same
     override for host builds).
+
+    ``timings`` (optional dict) turns on per-stage attribution: each
+    pipeline stage is fenced and its wall-clock recorded under
+    ``relabel_s`` / ``sort_s`` / ``slots_s`` / ``scatter_s`` (plus
+    ``compile_s`` for any compiles paid), at the cost of serializing
+    the stages — leave it None for production builds, which stay fully
+    async between host syncs. bench.py --build-only is the consumer.
     """
     if group < 1 or group > LANES or (group & (group - 1)):
         raise ValueError(f"group must be a power of two in [1, {LANES}]")
@@ -512,40 +586,100 @@ def build_ell_device(
             presentinel=not with_weights,
         )
 
-    src_s, dst_s, unique, out_degree, in_degree = _sort_dedup_degrees(src, dst, n)
-    num_edges = int(jax.device_get(unique.sum()))
+    # Stage 1 (relabel): raw in-degrees by unsorted scatter-add, then
+    # the stable in-degree-descending permutation — no edge sort needed
+    # (module docstring: the pre-relabel (dst, src) sort is gone).
+    t0 = time.perf_counter()
+    in_raw = compile_cache.stage_call(
+        "raw_in_degree", functools.partial(_raw_in_degree, n=n), (dst,),
+        static_key=(n,), timings=timings,
+    )
+    perm, inv_perm = compile_cache.stage_call(
+        "relabel_perm", _relabel_perm, (in_raw,), timings=timings,
+    )
+    # Raw degree == 0 iff unique degree == 0 (a duplicate needs an
+    # edge), so the zero-in mask needs no dedup correction.
+    zero_in = in_raw == 0
+    del in_raw
+    _stage_fence(timings, "relabel_s", t0, perm)
+
+    # Stage 2 (sort): relabel the raw edges and run THE one full-edge
+    # composite-key sort, consuming the raw arrays.
+    stripe_arg = sz if n_stripes > 1 else 0
+    t0 = time.perf_counter()
+    sb_dst, new_src = compile_cache.stage_call(
+        "relabel_sort",
+        functools.partial(_relabel_sort, n_padded=n_padded,
+                          stripe_size=stripe_arg),
+        (src, dst, inv_perm),
+        static_key=(n_padded, stripe_arg), donate_argnums=(0, 1),
+        timings=timings,
+    )
+    del src, dst, inv_perm
+    _stage_fence(timings, "sort_s", t0, sb_dst)
+
+    # Stage 3 (slots): slot coordinates + dedup flags + dedup-corrected
+    # unique out-degrees, all from key adjacency in one program.
+    t0 = time.perf_counter()
+    (word, w, row_idx, pos, sb_rows, row_offset, out_rel,
+     num_edges_dev) = compile_cache.stage_call(
+        "slot_coords",
+        functools.partial(
+            _slot_coords, n=n, n_padded=n_padded, weight_dtype=wdt,
+            group=group, stripe_size=stripe_arg, with_weights=with_weights,
+        ),
+        (sb_dst, new_src),
+        static_key=(n, n_padded, wdt.name, group, stripe_arg, with_weights),
+        donate_argnums=(0, 1),
+        timings=timings,
+    )
+    del sb_dst, new_src
+    out_degree = compile_cache.stage_call(
+        "unrelabel_degree", _unrelabel_degree, (out_rel, perm),
+        timings=timings,
+    )
+    del out_rel
+    # Per-stripe row bounds + the unique-edge count: S + 2 scalars, ONE
+    # device->host transfer (the build's only host sync before the
+    # buffers are sized). row_offset has n_stripes*num_blocks + 1
+    # entries, so the stride-num_blocks slice lands exactly on stripe
+    # starts + the total.
+    bounds_np, num_edges_np = jax.device_get(
+        (row_offset[::num_blocks], num_edges_dev)
+    )
+    stripe_bounds = [int(b) for b in bounds_np]
+    rows_total = stripe_bounds[-1]
+    num_edges = int(num_edges_np)
+    _stage_fence(timings, "slots_s", t0)
+
     if dangling_mask is None:
         mass_mask = out_degree == 0
     else:
         mass_mask = jnp.asarray(dangling_mask, bool)
         # Same invariant the host build enforces (graph.py): a vertex
         # with out-edges cannot carry dangling mass — silently wrong
-        # ranks otherwise.
+        # ranks otherwise. (Checked after the sort now: the unique
+        # out-degree is a by-product of the composite-key order.)
         if bool(jax.device_get(jnp.any(mass_mask & (out_degree > 0)))):
             raise ValueError("dangling_mask marks a vertex that has out-edges")
-    zero_in = in_degree == 0
-    stripe_arg = sz if n_stripes > 1 else 0
-    sb_dst, new_src, perm = _relabel_resort(
-        src_s, dst_s, unique, in_degree, n_padded, stripe_arg
-    )
-    del src_s, dst_s, unique
-    word, w, row_idx, pos, sb_rows, row_offset = _slot_coords(
-        sb_dst, new_src, out_degree[perm], n_padded, wdt, group, stripe_arg,
-        with_weights,
-    )
-    del sb_dst, new_src
-    # Per-stripe row bounds (S + 1 scalars): one small device->host
-    # transfer. row_offset has n_stripes*num_blocks + 1 entries, so the
-    # stride-num_blocks slice lands exactly on stripe starts + the total.
-    stripe_bounds = [int(b) for b in jax.device_get(row_offset[::num_blocks])]
-    rows_total = stripe_bounds[-1]
+
+    # Stage 4 (scatter): place the slot planes.
     log2g = group.bit_length() - 1
     fill = 0 if with_weights else (sz << log2g)  # engine sentinel word
-    src_slots, w_slots, row_block = _scatter_slots(
-        word, w, row_idx, pos, sb_rows, rows_total, num_blocks, n_stripes,
-        fill,
+    t0 = time.perf_counter()
+    scatter_args = (word, row_idx, pos, sb_rows)
+    if w is not None:
+        scatter_args += (w,)
+    src_slots, w_slots, row_block = compile_cache.stage_call(
+        "scatter_slots",
+        functools.partial(_scatter_slots, rows_total=rows_total,
+                          num_blocks=num_blocks, n_stripes=n_stripes,
+                          fill=fill),
+        scatter_args,
+        static_key=(rows_total, num_blocks, n_stripes, fill),
+        timings=timings,
     )
-    del word, w, row_idx, pos  # donated into the scatter
+    del word, w, row_idx, pos  # freed as soon as the scatter consumes them
     if n_stripes > 1 or stripe_size:
         # Slice the concatenated buffers into per-stripe arrays (device
         # copies; the big buffers are dropped one by one as the slices
@@ -563,6 +697,10 @@ def build_ell_device(
         src_out, w_out, rb_out = srcs, ws, rbs
     else:
         src_out, w_out, rb_out = src_slots, w_slots, row_block
+    _stage_fence(
+        timings, "scatter_s", t0,
+        rb_out[-1] if isinstance(rb_out, list) else rb_out,
+    )
     return DeviceEllGraph(
         n=n, n_padded=n_padded, num_blocks=num_blocks,
         src=src_out, weight=w_out, row_block=rb_out,

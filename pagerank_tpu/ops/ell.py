@@ -263,9 +263,12 @@ def ell_pack_striped(
     new_src = inv_perm[graph.src].astype(np.int64)
     stripe_of = new_src // stripe_size
     # Sort edges by (stripe, dst, relabeled src): dst-major slot order
-    # within each stripe, relabeled-src-ascending within a dst (the same
-    # total order the device builder's multi-key sort produces, so the
-    # two packers agree slot-for-slot).
+    # within each stripe, relabeled-src-ascending within a dst — the
+    # same total order as the device builder's single composite-key
+    # sort (ops/device_build.py:_relabel_sort), so the two packers
+    # agree slot-for-slot. (Graph inputs here are pre-deduplicated by
+    # build_graph, so the device builder's raw-in-degree relabel also
+    # matches this packer's unique-in-degree argsort exactly.)
     sort = np.lexsort((new_src, new_dst, stripe_of))
     new_dst = new_dst[sort]
     new_src = new_src[sort]

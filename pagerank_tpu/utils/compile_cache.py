@@ -7,12 +7,29 @@ cuts a warm scale-21 device build from ~49s to ~10s (measured v5e).
 Off by default for library users (a global config flip is the caller's
 call); bench.py always enables it, and the CLI enables it for every
 jax-engine run (opt out with --no-compile-cache).
+
+Besides the cross-process persistent cache this module owns two
+smaller, same-keyed caches:
+
+  - ``tuning_get``/``tuning_put`` — persisted build-time tuning
+    decisions (the ELL chunk autotune winner);
+  - ``stage_call`` — an IN-PROCESS AOT executable cache for the
+    device graph-build stages (ops/device_build.py). Its key is
+    (stage name, device kind, arg avals, statics) — deliberately NOT
+    the process-global ``jax_enable_x64`` flag: the build stages are
+    pinned to 32-bit indices (analysis contract PTC006), so their
+    programs are x64-invariant and the pair-f64 config's mid-process
+    x64 flip must not re-trace or re-compile them. Under plain
+    ``jax.jit`` that flip invalidates every build executable (the jit
+    cache keys on the config context), which is exactly what made the
+    bench couple's second build pay a full compile pass again.
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import time
 
 
 def default_cache_dir() -> str:
@@ -70,6 +87,54 @@ def tuning_put(key: str, value) -> None:
         os.replace(tmp, path)
     except Exception:
         pass
+
+
+# -- build-stage executable cache ------------------------------------------
+
+_STAGE_EXECS: dict = {}
+
+
+def clear_stage_cache() -> None:
+    """Drop the in-process stage executables (tests; a device reset)."""
+    _STAGE_EXECS.clear()
+
+
+def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
+               timings=None):
+    """Run one build-stage program through the AOT executable cache.
+
+    ``fn`` must be a pure function of ``args`` (statics baked in via
+    functools.partial and mirrored in ``static_key``). On the first
+    call for a given (name, device kind, avals, static_key) the stage
+    is lowered and compiled once (hitting the persistent compile cache
+    when enabled — warm TPU builds skip the remote compile); later
+    calls dispatch the cached executable directly, with no re-trace
+    even across a ``jax_enable_x64`` flip (see module docstring — the
+    stages are 32-bit-pinned, so the flag cannot change their program).
+
+    ``timings``: optional dict; compile seconds are accumulated under
+    ``"compile_s"`` so build breakdowns separate compile from execute.
+    """
+    import jax
+
+    dev = jax.devices()[0]
+    aval_key = tuple(
+        (tuple(a.shape), str(a.dtype)) for a in args
+    )
+    key = (name, dev.platform, getattr(dev, "device_kind", ""),
+           tuple(static_key), tuple(donate_argnums), aval_key)
+    exe = _STAGE_EXECS.get(key)
+    if exe is None:
+        t0 = time.perf_counter()
+        exe = jax.jit(fn, donate_argnums=donate_argnums).lower(
+            *args
+        ).compile()
+        _STAGE_EXECS[key] = exe
+        if timings is not None:
+            timings["compile_s"] = (
+                timings.get("compile_s", 0.0) + time.perf_counter() - t0
+            )
+    return exe(*args)
 
 
 def _active_cache_dir():

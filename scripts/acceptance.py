@@ -94,8 +94,23 @@ CONFIGS = {
     # wall-clock split (L1 / build / solve / L4) in BASELINE.md.
     "E": dict(kind="e2e", files=301, records=1000, iters=10,
               label="reference-job end-to-end (301-file segment)"),
+    # Build-pipeline smoke (ISSUE 2): a scale-18 pair-f64 device build
+    # through bench.run_build — gates that the per-stage breakdown
+    # keys exist and build_s stays under the recorded budget, with the
+    # AST lint run over ops/ (the build chain's own modules) in the
+    # same gate. First in the default order: it is the cheapest gate
+    # and a broken build pipeline fails everything after it anyway.
+    "D": dict(kind="build", scale=18,
+              label="build-stage smoke (scale-18 pair-f64 device build)"),
 }
-DEFAULT_KEYS = ["A", "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+
+# Recorded budget for the scale-18 build smoke (seconds): the restaged
+# single-sort pipeline builds this geometry in low single digits warm
+# on v5e (and ~15s on the CPU test substrate); 60s absorbs a cold
+# compile cache while still catching an order-of-magnitude build
+# regression of the r5 class (74.8s at scale 23).
+BUILD_SMOKE_BUDGET_S = 60.0
 
 # PPR gates. Top-k membership is judged against ORACLE SCORES, not id
 # sets: vertices tied at the k-th score legitimately swap in/out of an
@@ -122,6 +137,50 @@ def _make_graph(key: str, scale: int):
           f"{g.num_edges:,} edges ({t_build:.1f}s host build)",
           file=sys.stderr)
     return g
+
+
+def run_build_smoke(key: str):
+    """ISSUE-2 build gate: a scale-18 pair-f64 device build via
+    bench.run_build — the per-stage breakdown keys must all exist, the
+    build must land under the recorded budget, and the AST lint must be
+    clean over ops/ (regressions in the 32-bit pin or the stage
+    restage show up here before the minutes-long accuracy runs)."""
+    import bench
+    from pagerank_tpu.analysis.__main__ import main as analysis_main
+
+    spec = CONFIGS[key]
+    ops_dir = os.path.join(REPO, "pagerank_tpu", "ops")
+    lint_ok = analysis_main(["--lint-only", ops_dir]) == 0
+    if not lint_ok:
+        print(f"[{key}] static analysis over ops/ FAILED (run "
+              "`python -m pagerank_tpu.analysis pagerank_tpu/ops`)",
+              file=sys.stderr)
+    b = bench.run_build(spec["scale"], dtype="float64",
+                        accum_dtype="float64", wide_accum="pair")
+    missing = [k for k in bench.BUILD_STAGE_KEYS if k not in b["stages"]]
+    passed = bool(lint_ok and not missing
+                  and b["build_s"] <= BUILD_SMOKE_BUDGET_S)
+    rec = {
+        "config": key,
+        "kind": "build",
+        "label": spec["label"],
+        "scale": spec["scale"],
+        "build_s": b["build_s"],
+        "stages": b["stages"],
+        "missing_stage_keys": missing,
+        "ops_lint_ok": lint_ok,
+        "budget_s": BUILD_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] pair-f64 device build {b['build_s']:.1f}s vs budget "
+        f"{BUILD_SMOKE_BUDGET_S:g}s; stage keys "
+        f"{'complete' if not missing else 'MISSING ' + repr(missing)}; "
+        f"ops lint {'OK' if lint_ok else 'FAILED'} -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
 
 
 def run_ppr(key: str):
@@ -522,7 +581,7 @@ def append_baseline(recs) -> None:
         f"{r['mass_normalized_l1']:.3e} | {r['gate']:g} | "
         f"{'PASS' if r['passed'] else 'FAIL'} | "
         f"{r['edges_per_sec_per_chip']:.3g} |\n"
-        for r in recs if r.get("kind") not in ("ppr", "e2e")
+        for r in recs if r.get("kind") not in ("ppr", "e2e", "build")
     ]
     text = _append_table(
         text,
@@ -622,7 +681,7 @@ def main(argv=None) -> int:
 
     _enable_compile_cache()
     keys = [args.only] if args.only else DEFAULT_KEYS
-    runners = {"ppr": run_ppr, "e2e": run_e2e}
+    runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

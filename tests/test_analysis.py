@@ -199,7 +199,8 @@ def test_list_rules(capsys):
     text = capsys.readouterr().out
     assert rc == 0
     for rid in ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
-                "PTC001", "PTC002", "PTC003", "PTC004", "PTC005"):
+                "PTC001", "PTC002", "PTC003", "PTC004", "PTC005",
+                "PTC006"):
         assert rid in text
 
 
@@ -222,6 +223,14 @@ def test_step_key_stability():
 
 def test_kernel_contracts():
     findings = contracts_mod.check_kernels()
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_build_chain_contract_clean():
+    """PTC006 on the real build chain: every restaged stage (plus the
+    R-MAT generator) must stay 32-bit when abstract-evaled under
+    x64."""
+    findings = contracts_mod.check_build_chain()
     assert findings == [], [f.render() for f in findings]
 
 
@@ -252,16 +261,42 @@ def test_contract_catches_f64_promotion(monkeypatch):
 
 def test_contract_catches_unconsumable_donation(monkeypatch):
     """Seed the defect PTC003 exists for: the r5 bench log's 'Some
-    donated buffers were not usable' — _scatter_slots donating per-edge
-    buffers that can never alias its slot-plane outputs."""
-    from pagerank_tpu.ops import device_build as db
+    donated buffers were not usable' — the scatter stage donating
+    per-edge buffers that can never alias its slot-plane outputs. The
+    build stages now dispatch through the stage-executable cache, so
+    the bad donation is seeded at the stage_call boundary (a distinct
+    cache key: the poisoned executable can't leak into other tests)."""
+    from pagerank_tpu.utils import compile_cache
 
-    bad = functools.partial(
-        jax.jit, static_argnums=(5, 6, 7, 8), donate_argnums=(0, 1, 2, 3)
-    )(db._scatter_slots.__wrapped__)
-    monkeypatch.setattr(db, "_scatter_slots", bad)
+    orig_call = compile_cache.stage_call
+
+    def bad_call(name, fn, args, **kw):
+        if name == "scatter_slots":
+            kw["donate_argnums"] = (0, 1, 2, 3)
+        return orig_call(name, fn, args, **kw)
+
+    monkeypatch.setattr(compile_cache, "stage_call", bad_call)
     findings = contracts_mod.check_engine_form(_FORMS["device_build"])
     assert "PTC003" in _rules_of(findings), [f.render() for f in findings]
+
+
+def test_contract_catches_x64_widening(monkeypatch):
+    """Seed the defect PTC006 exists for: the pre-restage relabel used
+    ``jnp.argsort``, whose default iota payload silently widens to
+    int64 once the pair-f64 config flips ``jax_enable_x64``."""
+    from pagerank_tpu.ops import device_build as db
+
+    def bad_relabel(in_degree):
+        n = in_degree.shape[0]
+        perm = jnp.argsort(-in_degree, stable=True).astype(jnp.int32)
+        inv = jnp.zeros(n, jnp.int32).at[perm].set(
+            jnp.arange(n, dtype=jnp.int32)
+        )
+        return perm, inv
+
+    monkeypatch.setattr(db, "_relabel_perm", bad_relabel)
+    findings = contracts_mod.check_build_chain()
+    assert "PTC006" in _rules_of(findings), [f.render() for f in findings]
 
 
 def test_contract_catches_host_callback(monkeypatch):

@@ -62,6 +62,36 @@ def test_bench_json_contract_single_mode(tmp_path):
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
 
 
+def test_bench_build_only_reports_stage_breakdown(tmp_path):
+    """--build-only (ISSUE 2): device builds only, ONE JSON line, the
+    per-stage breakdown (bench.BUILD_STAGE_KEYS) present for BOTH
+    couple legs plus the pair/f32 ratio the 15% gate reads."""
+    stage_keys = {"gen_s", "relabel_s", "sort_s", "slots_s", "scatter_s",
+                  "autotune_s", "engine_s", "compile_s"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--scale", "9",
+         "--build-only"],
+        capture_output=True, text=True, env=_env(), timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, r.stdout
+    rec = json.loads(json_lines[0])
+    assert set(rec) == {"metric", "value", "unit", "scale", "pair", "f32",
+                        "pair_warm", "pair_over_f32", "pair_warm_over_f32"}
+    assert rec["metric"] == "build_s" and rec["unit"] == "s"
+    assert rec["value"] == rec["pair"]["build_s"] > 0
+    assert rec["pair_over_f32"] > 0 and rec["pair_warm_over_f32"] > 0
+    # The warm pair leg (the 15% gate's comparator) must have paid no
+    # stage compiles — everything cached from the cold pair leg.
+    assert rec["pair_warm"]["stages"]["compile_s"] == 0.0
+    for leg in ("pair", "f32", "pair_warm"):
+        stages = rec[leg]["stages"]
+        assert set(stages) >= stage_keys, stages
+        assert all(stages[k] >= 0 for k in stage_keys)
+        assert rec[leg]["num_edges"] > 0
+
+
 def test_graft_entry_contract():
     sys.path.insert(0, REPO)
     try:

@@ -122,3 +122,40 @@ def test_tuning_cache_roundtrip(tmp_path, monkeypatch):
     assert cc.tuning_get("chunk:x") is None
     cc.tuning_put("chunk:x", 512)
     assert cc.tuning_get("chunk:x") == 512
+
+
+def test_stage_call_executable_cache_ignores_x64_flip():
+    """The build-stage executable cache (ISSUE 2): a stage compiles
+    once per (name, avals, statics) — NOT per x64 state, which is the
+    point of pinning the build chain to 32-bit (PTC006) — and reports
+    compile seconds only on the miss."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import disable_x64
+
+    from pagerank_tpu.utils import compile_cache as cc
+
+    def inc(x):
+        return x + jnp.int32(1)
+
+    a = jnp.arange(8, dtype=jnp.int32)
+    cc.clear_stage_cache()
+    t1 = {}
+    r1 = cc.stage_call("t_inc", inc, (a,), timings=t1)
+    assert t1.get("compile_s", 0.0) > 0.0  # miss: compile attributed
+    t2 = {}
+    r2 = cc.stage_call("t_inc", inc, (a,), timings=t2)
+    assert "compile_s" not in t2  # in-process hit
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    # The key deliberately ignores the process-global x64 flag (the
+    # conftest runs with it ON): flipping it must still hit.
+    with disable_x64():
+        t3 = {}
+        r3 = cc.stage_call("t_inc", inc, (a,), timings=t3)
+    assert "compile_s" not in t3
+    np.testing.assert_array_equal(np.asarray(r3), np.asarray(r1))
+    # Different avals are a different executable.
+    t4 = {}
+    cc.stage_call("t_inc", inc, (jnp.arange(4, dtype=jnp.int32),),
+                  timings=t4)
+    assert t4.get("compile_s", 0.0) > 0.0
