@@ -15,8 +15,8 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 from pagerank_tpu.graph import Graph, build_graph
-from pagerank_tpu.utils.config import PageRankConfig
-from pagerank_tpu.engine import PageRankEngine, make_engine
+from pagerank_tpu.utils.config import PageRankConfig, RobustnessConfig
+from pagerank_tpu.engine import PageRankEngine, SolverHealthError, make_engine
 from pagerank_tpu.engines.cpu import ReferenceCpuEngine
 from pagerank_tpu.engines.jax_engine import JaxTpuEngine
 
@@ -26,7 +26,9 @@ __all__ = [
     "Graph",
     "build_graph",
     "PageRankConfig",
+    "RobustnessConfig",
     "PageRankEngine",
+    "SolverHealthError",
     "make_engine",
     "ReferenceCpuEngine",
     "JaxTpuEngine",

@@ -246,6 +246,58 @@ def rule_ptl005(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]
             )
 
 
+def rule_ptl006(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL006: exception swallows — the failure mode the fault-
+    tolerance layer exists to prevent (docs/ROBUSTNESS.md): a bare
+    ``except:`` that never re-raises, or a broad ``except Exception``/
+    ``except BaseException`` whose body is only ``pass``/constants,
+    silently discards an error that retry/rollback/dead-letter
+    machinery should have seen. Deliberate best-effort sites carry an
+    allowlist entry with the reason, never a rule carve-out."""
+
+    def broad(t: Optional[ast.expr]) -> bool:
+        if t is None:
+            return True
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        for el in elts:
+            name = (
+                el.id if isinstance(el, ast.Name)
+                else el.attr if isinstance(el, ast.Attribute) else ""
+            )
+            if name in ("Exception", "BaseException"):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        reraises = any(
+            isinstance(sub, ast.Raise)
+            for stmt in node.body for sub in ast.walk(stmt)
+        )
+        swallow = all(
+            isinstance(s, ast.Pass)
+            or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+            for s in node.body
+        )
+        if node.type is None and not reraises:
+            yield Finding(
+                "PTL006", path, node.lineno,
+                "bare 'except:' without re-raise swallows every error "
+                "(including KeyboardInterrupt/SystemExit): name the "
+                "exceptions or re-raise",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+        elif node.type is not None and broad(node.type) and swallow:
+            yield Finding(
+                "PTL006", path, node.lineno,
+                "broad exception swallow ('except Exception: pass'): "
+                "handle, log, or narrow it — silent drops hide the "
+                "faults the robustness layer must surface",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+
+
 RuleFn = Callable[[ast.AST, str, List[str]], Iterable[Finding]]
 
 # rule id -> (fn, scope, one-line description). Scopes:
@@ -262,6 +314,8 @@ RULES: Dict[str, Tuple[RuleFn, str, str]] = {
     "PTL004": (rule_ptl004, "all", "mutable default arguments"),
     "PTL005": (rule_ptl005, "kernel",
                "float64 literals outside config-gated paths"),
+    "PTL006": (rule_ptl006, "all",
+               "bare/broad exception swallows"),
 }
 
 _KERNEL_FILES = ("engines/jax_engine.py", "engines/ppr.py")

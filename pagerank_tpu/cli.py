@@ -140,6 +140,35 @@ def build_parser() -> argparse.ArgumentParser:
         "by rank descending (ties by id ascending); 0 = the full vector "
         "in id order (the reference's dump shape, Sparky.java:237)",
     )
+    ft = p.add_argument_group("fault tolerance (docs/ROBUSTNESS.md)")
+    ft.add_argument(
+        "--write-retries", type=int, default=3,
+        help="total attempts per snapshot/text-dump write before the "
+        "--on-write-failure policy applies (1 disables retries)",
+    )
+    ft.add_argument(
+        "--on-write-failure", choices=["fail", "warn_and_drop"],
+        default="fail",
+        help="when a snapshot/dump write exhausts its retries: 'fail' "
+        "aborts the run (default); 'warn_and_drop' records the dropped "
+        "iteration in a dead_letter.json manifest next to the snapshots "
+        "and keeps solving",
+    )
+    ft.add_argument(
+        "--max-rollbacks", type=int, default=3,
+        help="snapshot rollbacks the self-healing solve loop may "
+        "perform on an unhealthy step (NaN/Inf, mass drift) before "
+        "raising; needs --snapshot-dir to have anything to roll back to",
+    )
+    ft.add_argument(
+        "--mass-tol", type=float, default=None,
+        help="opt-in per-step relative rank-mass drift tolerance for "
+        "the health check (default: NaN/Inf checks only)",
+    )
+    ft.add_argument(
+        "--no-health-checks", action="store_true",
+        help="disable the per-step solver health check entirely",
+    )
     p.add_argument("--log-every", type=int, default=1, help="0 silences per-iter logs")
     p.add_argument("--jsonl", default=None, help="append per-iter metrics to this JSONL file")
     p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
@@ -521,6 +550,26 @@ def load_graph(args):
     return build_graph(src, dst), None
 
 
+def _s3_retry_total(paths) -> int:
+    """Sum of transparent request retries across the distinct
+    S3FileSystem instances serving the given output paths (for the
+    run's robustness summary)."""
+    from pagerank_tpu.utils.s3 import S3FileSystem
+
+    seen, total = set(), 0
+    for p in paths:
+        if not p:
+            continue
+        scheme = fsio.scheme_of(p)
+        if scheme is None or not fsio.registered(scheme):
+            continue
+        fs = fsio.get_fs(p)
+        if isinstance(fs, S3FileSystem) and id(fs) not in seen:
+            seen.add(id(fs))
+            total += fs.retry_stats.retries
+    return total
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.engine == "jax" and not args.no_compile_cache:
@@ -580,6 +629,8 @@ def main(argv=None) -> int:
     if args.ppr_sources:
         return run_ppr(args, graph, ids)
 
+    from pagerank_tpu.utils.config import RobustnessConfig
+
     cfg = PageRankConfig(
         num_iters=args.iters,
         damping=args.damping,
@@ -593,6 +644,13 @@ def main(argv=None) -> int:
         snapshot_dir=args.snapshot_dir,
         snapshot_every=args.snapshot_every,
         log_every=args.log_every,
+        robustness=RobustnessConfig(
+            health_checks=not args.no_health_checks,
+            mass_tol=args.mass_tol,
+            max_rollbacks=args.max_rollbacks,
+            write_attempts=args.write_retries,
+            on_write_failure=args.on_write_failure,
+        ),
     )
     if args.lane_group is not None:
         cfg = cfg.replace(lane_group=args.lane_group)
@@ -638,13 +696,30 @@ def main(argv=None) -> int:
         if dumper is not None:
             dumper.dump(i, ranks)
 
+    # One write-failure policy for BOTH I/O modes (SinkGuard): bounded
+    # retries, then fail or warn-and-drop with a dead-letter manifest
+    # of the dropped iterations (docs/ROBUSTNESS.md).
+    from pagerank_tpu.utils.snapshot import SinkGuard
+
+    dead_letter = None
+    if args.on_write_failure == "warn_and_drop":
+        base = args.snapshot_dir or args.dump_text_dir
+        if base:
+            dead_letter = fsio.join(base, "dead_letter.json")
+    guard = SinkGuard(
+        retry_policy=cfg.robustness.write_retry_policy(),
+        on_failure=args.on_write_failure,
+        dead_letter_path=dead_letter,
+    )
+
     writer = None
     can_write = dumper is not None or (snap and args.snapshot_every)
     if can_write and args.engine == "jax" and not args.sync_io:
         from pagerank_tpu.utils.snapshot import AsyncRankWriter
 
         writer = AsyncRankWriter(
-            lambda p: (p[0], engine.decode_ranks(p[1])), [write_sinks]
+            lambda p: (p[0], engine.decode_ranks(p[1])), [write_sinks],
+            guard=guard,
         )
 
     def on_iteration(i, info):
@@ -658,7 +733,7 @@ def main(argv=None) -> int:
             writer.submit(i, (want_snap, engine.device_ranks()))
         else:
             # one device->host fetch for both sinks
-            write_sinks(i, (want_snap, engine.ranks()))
+            guard(i, lambda: write_sinks(i, (want_snap, engine.ranks())))
 
     profiling = False
     if args.profile_dir:
@@ -693,9 +768,12 @@ def main(argv=None) -> int:
                     if writer is not None:
                         writer.submit(done_iters - 1, (True, ranks_thunk()))
                     else:
-                        write_sinks(
+                        guard(
                             done_iters - 1,
-                            (True, engine.decode_ranks(ranks_thunk())),
+                            lambda: write_sinks(
+                                done_iters - 1,
+                                (True, engine.decode_ranks(ranks_thunk())),
+                            ),
                         )
 
                 ranks = engine.run_fused_chunked(
@@ -725,7 +803,18 @@ def main(argv=None) -> int:
                 )
             fused_summary = dict(iters=done, total_seconds=total)
         else:
-            ranks = engine.run(on_iteration=on_iteration)
+            # snap doubles as the rollback source for the self-healing
+            # loop (unhealthy steps restore the newest valid snapshot
+            # and recompute — engine.run; docs/ROBUSTNESS.md). With the
+            # async writer active, rollback scans must drain its queue
+            # first or they race the snapshots still in flight.
+            roll_snap = snap
+            if snap is not None and writer is not None:
+                from pagerank_tpu.utils.snapshot import WriterSyncedSnapshotter
+
+                roll_snap = WriterSyncedSnapshotter(snap, writer)
+            ranks = engine.run(on_iteration=on_iteration,
+                               snapshotter=roll_snap)
     finally:
         # Capture BEFORE any nested try: inside an except handler,
         # sys.exc_info() would report the just-caught close() error.
@@ -757,6 +846,25 @@ def main(argv=None) -> int:
             f"{summary['edges_per_sec_per_chip']:.4g} edges/s/chip",
             file=sys.stderr,
         )
+    # Robustness summary (docs/ROBUSTNESS.md): rollback/retry/drop
+    # counts, plus transparent S3 request retries for any object-store
+    # outputs. Printed only when something is worth reporting.
+    rollbacks = getattr(engine, "health", {}).get("rollbacks", 0) or 0
+    io_retries = _s3_retry_total(
+        (args.snapshot_dir, args.dump_text_dir, args.out, args.jsonl)
+    )
+    if rollbacks or guard.retries or guard.dropped or io_retries:
+        parts = [f"{rollbacks} rollback(s)", f"{guard.retries} write retr(y/ies)"]
+        if io_retries:
+            parts.append(f"{io_retries} s3 request retr(y/ies)")
+        if guard.dropped:
+            parts.append(
+                f"{len(guard.dropped)} DROPPED write(s) "
+                f"(iterations {[d['iteration'] for d in guard.dropped]}"
+                + (f", manifest {dead_letter}" if dead_letter else "")
+                + ")"
+            )
+        print("robustness: " + ", ".join(parts), file=sys.stderr)
 
     if args.out:
         names = ids.names if ids is not None else None

@@ -10,12 +10,37 @@ the role of the reference's `for (iter = 0; iter < 10; iter++)` block
 from __future__ import annotations
 
 import abc
+import math
 from typing import Callable, Dict, Optional
 
 import numpy as np
 
 from pagerank_tpu.graph import Graph
 from pagerank_tpu.utils.config import PageRankConfig
+
+
+class SolverHealthError(RuntimeError):
+    """The solver state went bad (NaN/Inf step info, rank-mass drift)
+    and could not be healed by snapshot rollback. Carries the FIRST
+    iteration that produced a bad step and the number of rollbacks
+    attempted — the diagnostic a 3am page needs (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, message: str, first_bad_iteration: int,
+                 rollbacks: int):
+        super().__init__(message)
+        self.first_bad_iteration = first_bad_iteration
+        self.rollbacks = rollbacks
+
+
+def _health_reason(info: Dict[str, float]) -> Optional[str]:
+    """Non-finite scalar in the step info, or None when healthy. A NaN
+    rank vector always surfaces here: l1_delta is a sum over every
+    component, so one NaN poisons it."""
+    for k, v in info.items():
+        if isinstance(v, (int, float, np.floating, np.integer)):
+            if not math.isfinite(float(v)):
+                return f"non-finite step info {k}={float(v)!r}"
+    return None
 
 
 class PageRankEngine(abc.ABC):
@@ -27,6 +52,10 @@ class PageRankEngine(abc.ABC):
         self.config = (config or PageRankConfig()).validate()
         self.graph: Optional[Graph] = None
         self.iteration = 0
+        # Self-healing counters (populated by run(); docs/ROBUSTNESS.md)
+        self.health: Dict[str, Optional[int]] = {
+            "rollbacks": 0, "first_bad_iteration": None,
+        }
 
     @abc.abstractmethod
     def build(self, graph: Graph) -> "PageRankEngine":
@@ -45,25 +74,100 @@ class PageRankEngine(abc.ABC):
         """Overwrite solver state — used by checkpoint resume."""
         raise NotImplementedError
 
+    def rank_mass(self) -> float:
+        """sum(ranks) as a host scalar — the mass-drift health probe.
+        Engines override with a cheaper device-side reduction."""
+        return float(np.asarray(self.ranks(), dtype=np.float64).sum())
+
     def run(
         self,
         num_iters: Optional[int] = None,
         on_iteration: Optional[Callable[[int, Dict[str, float]], None]] = None,
+        snapshotter=None,
     ) -> np.ndarray:
         """Drive ``num_iters`` iterations (default: config.num_iters).
 
         ``on_iteration(i, info)`` fires after each step — the hook point
         for metrics logging and per-iteration snapshots (the reference's
         println + saveAsTextFile, Sparky.java:188,237).
+
+        Self-healing (config.robustness; docs/ROBUSTNESS.md): each
+        step's info is health-checked (NaN/Inf always; rank-mass drift
+        when ``mass_tol`` is set — sound because the asynchronous-
+        PageRank literature shows the iteration tolerates rolled-back /
+        stale state, PAPERS.md). On a bad step, when a ``snapshotter``
+        is attached, the engine rolls back to the newest VALID snapshot
+        at or below the bad iteration (corrupt files are skipped) and
+        recomputes, up to ``max_rollbacks`` times; the bad step's
+        ``on_iteration`` never fires, so a poisoned iterate is never
+        snapshotted or logged as good. Exhausting the budget — or
+        having nothing to roll back to — raises
+        :class:`SolverHealthError` naming the first bad iteration.
+        Recomputed steps re-fire ``on_iteration`` (snapshot re-saves
+        are idempotent; metrics may show repeated iterations).
+        Rollback/retry counts land in ``self.health``.
         """
         if self.graph is None:
             raise RuntimeError("call build(graph) before run()")
         total = self.config.num_iters if num_iters is None else num_iters
         tol = self.config.tol
+        rb = self.config.robustness
+        self.health = {"rollbacks": 0, "first_bad_iteration": None}
+        last_mass: Optional[float] = None
         while self.iteration < total:
             info = self.step()
             i = self.iteration
-            self.iteration += 1
+            reason = None
+            if rb.health_checks:
+                reason = _health_reason(info)
+                if reason is None and rb.mass_tol is not None:
+                    mass = info.get("rank_mass")
+                    mass = self.rank_mass() if mass is None else float(mass)
+                    if not math.isfinite(mass):
+                        reason = f"non-finite rank mass {mass!r}"
+                    elif (last_mass is not None
+                          and abs(mass - last_mass)
+                          > rb.mass_tol * max(abs(last_mass), 1e-30)):
+                        reason = (
+                            f"rank mass drifted {last_mass!r} -> {mass!r} "
+                            f"(> mass_tol={rb.mass_tol:g} per step)"
+                        )
+                    else:
+                        last_mass = mass
+            if reason is not None:
+                if self.health["first_bad_iteration"] is None:
+                    self.health["first_bad_iteration"] = i
+                first_bad = self.health["first_bad_iteration"]
+                rolled = None
+                if (snapshotter is not None
+                        and self.health["rollbacks"] < rb.max_rollbacks):
+                    # match=True: never restore a snapshot from another
+                    # graph/semantics (a reused snapshot dir) — skip it
+                    # like corruption rather than solving from it
+                    rolled = snapshotter.load_latest_valid(
+                        max_iteration=i, match=True
+                    )
+                if rolled is None:
+                    if snapshotter is None:
+                        why = "no snapshotter attached"
+                    elif self.health["rollbacks"] >= rb.max_rollbacks:
+                        why = f"rollback budget ({rb.max_rollbacks}) exhausted"
+                    else:
+                        why = "no valid snapshot to roll back to"
+                    raise SolverHealthError(
+                        f"engine {self.name}: unhealthy step at iteration "
+                        f"{i} ({reason}); first bad iteration {first_bad}, "
+                        f"{self.health['rollbacks']} rollback(s) attempted, "
+                        f"{why}",
+                        first_bad_iteration=first_bad,
+                        rollbacks=self.health["rollbacks"],
+                    )
+                it0, ranks, _meta = rolled
+                self.set_ranks(ranks, iteration=it0)
+                self.health["rollbacks"] += 1
+                last_mass = None  # re-baseline the drift check
+                continue
+            self.iteration = i + 1
             if on_iteration is not None:
                 on_iteration(i, info)
             if tol is not None:

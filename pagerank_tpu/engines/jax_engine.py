@@ -2159,6 +2159,13 @@ class JaxTpuEngine(PageRankEngine):
         """Block until all queued steps actually finished on device."""
         jax.device_get(jnp.sum(self._r))
 
+    def rank_mass(self) -> float:
+        """sum(ranks) via one device-side scalar reduction + fetch (the
+        mass-drift health probe, engine.run) — never a full-vector
+        device->host transfer. Padding slots are zero, so the padded
+        sum IS the rank mass."""
+        return float(jax.device_get(jnp.sum(self._r)))
+
     def ranks(self) -> np.ndarray:
         return self.decode_ranks(self._r)
 

@@ -1,0 +1,11 @@
+"""Test-support subpackage: deterministic fault injection
+(:mod:`pagerank_tpu.testing.faults`). Shipped inside the package — not
+under tests/ — so downstream users can chaos-test their own deployments
+against the same schedules (docs/ROBUSTNESS.md)."""
+
+from pagerank_tpu.testing.faults import (  # noqa: F401
+    FaultInjectedError,
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    HttpFaultInjector,
+)

@@ -1,0 +1,279 @@
+"""Deterministic, seed-driven fault injection (docs/ROBUSTNESS.md).
+
+The reference gets chaos testing for free — kill a Spark executor and
+lineage recovery is exercised (SURVEY.md §5). This build's substrate is
+utils/fsio + utils/s3 + utils/snapshot, so faults are injected at those
+seams instead:
+
+- :class:`FaultInjectingFileSystem` wraps ANY :class:`fsio.FileSystem`
+  and injects failures / truncated writes / latency spikes;
+- :class:`HttpFaultInjector` plugs into the S3 stub's wire level
+  (tests/s3stub.S3Stub.fault_hook) to answer 5xx/SlowDown, drop
+  connections mid-body, or lose a multipart-complete response.
+
+Everything is driven by a :class:`FaultSchedule`: decisions are a pure
+function of (seed, call index) — never of wall clock or shared global
+randomness — and every decision is appended to a ``log``, so a chaos
+run is REPRODUCIBLE: the same seed yields the same schedule bit-for-bit
+across two runs (asserted in tests/test_faults.py; the acceptance
+chaos smoke in scripts/acceptance.py gates on it).
+"""
+
+from __future__ import annotations
+
+import io
+import random
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from pagerank_tpu.utils import fsio
+
+
+class FaultInjectedError(OSError):
+    """An injected transient fault. An OSError so the default retry
+    predicate (utils/retry.default_retryable) classifies it as
+    transient — exactly how a real connection reset presents."""
+
+
+class FaultSchedule:
+    """Seeded decision stream for fault injection.
+
+    Each ``decide(op, target)`` call advances a counter and draws a
+    FIXED number of uniforms from the seeded stream (whether or not a
+    fault fires), so the schedule depends only on the seed and the call
+    SEQUENCE — reordering real work reorders faults, but re-running the
+    same work reproduces them exactly.
+
+    Triggers: ``fail_nth`` / ``truncate_nth`` / ``delay_nth`` fire on
+    exact 1-based call indices; ``fail_rate`` / ``truncate_rate`` /
+    ``delay_rate`` fire probabilistically. ``ops`` restricts which
+    operations are eligible (None = all). ``max_faults`` caps the total
+    number of injected faults — a chaos run with a finite fault budget
+    below the consumer's retry budget is GUARANTEED to make progress.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        fail_nth: Iterable[int] = (),
+        fail_rate: float = 0.0,
+        truncate_nth: Iterable[int] = (),
+        truncate_rate: float = 0.0,
+        delay_nth: Iterable[int] = (),
+        delay_rate: float = 0.0,
+        delay_s: float = 0.0,
+        ops: Optional[Iterable[str]] = None,
+        max_faults: Optional[int] = None,
+    ):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._fail_nth = frozenset(fail_nth)
+        self._truncate_nth = frozenset(truncate_nth)
+        self._delay_nth = frozenset(delay_nth)
+        self._fail_rate = fail_rate
+        self._truncate_rate = truncate_rate
+        self._delay_rate = delay_rate
+        self._delay_s = delay_s
+        self._ops = None if ops is None else frozenset(ops)
+        self._max_faults = max_faults
+        self.calls = 0
+        self.faults = 0
+        #: (call_index, op, target, action) — the reproducibility record.
+        self.log: List[Tuple[int, str, str, str]] = []
+
+    def decide(self, op: str, target: str) -> Optional[Tuple]:
+        self.calls += 1
+        n = self.calls
+        # Fixed draw count per call keeps the stream position a pure
+        # function of the call index.
+        u, v = self._rng.random(), self._rng.random()
+        action: Optional[Tuple] = None
+        eligible = (
+            (self._ops is None or op in self._ops)
+            and (self._max_faults is None or self.faults < self._max_faults)
+        )
+        if eligible:
+            if n in self._fail_nth or u < self._fail_rate:
+                action = ("fail",)
+            elif n in self._truncate_nth or u < self._fail_rate + self._truncate_rate:
+                action = ("truncate", v)  # keep this fraction of bytes
+            elif (n in self._delay_nth
+                  or u < self._fail_rate + self._truncate_rate + self._delay_rate):
+                action = ("delay", self._delay_s * (0.5 + v))
+        if action is not None:
+            self.faults += 1
+        self.log.append((n, op, target, action[0] if action else "-"))
+        return action
+
+
+class _FaultWriter(io.BytesIO):
+    """Buffered writer committing through the wrapped store at close —
+    the injection point for truncate-on-write faults (mirrors
+    fsio._MemWriter, including abort-on-exception)."""
+
+    def __init__(self, fs: "FaultInjectingFileSystem", path: str,
+                 initial: bytes = b""):
+        super().__init__()
+        self.write(initial)
+        self._fs = fs
+        self._path = path
+        self._aborted = False
+
+    def abort(self):
+        self._aborted = True
+
+    def flush(self):
+        super().flush()
+        if (not self.closed and not self._aborted
+                and self._fs.COMMIT_ON_FLUSH):
+            self._fs._commit(self._path, self.getvalue(), final=False)
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+    def close(self):
+        if not self.closed and not self._aborted:
+            self._fs._commit(self._path, self.getvalue(), final=True)
+        super().close()
+
+
+class FaultInjectingFileSystem(fsio.FileSystem):
+    """Wrap any :class:`fsio.FileSystem` with schedule-driven faults.
+
+    Operations consult the schedule BEFORE delegating: ``("fail",)``
+    raises :class:`FaultInjectedError` (transient — a retrying caller
+    recovers), ``("delay", s)`` sleeps via the injectable ``sleep``
+    (virtual in tests). Writes buffer in memory and commit at close;
+    a ``("truncate", frac)`` decision at commit time publishes only a
+    prefix of the bytes — the torn-object case checksummed snapshot
+    loads must detect. Ops seen by the schedule: ``open_r``, ``commit``
+    (write close), ``stat``, ``listdir``, ``replace``, ``makedirs``.
+    """
+
+    def __init__(self, inner: fsio.FileSystem, schedule: FaultSchedule,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self.schedule = schedule
+        self._sleep = sleep
+        self.COMMIT_ON_FLUSH = getattr(inner, "COMMIT_ON_FLUSH", True)
+
+    def _hit(self, op: str, path: str) -> Optional[Tuple]:
+        act = self.schedule.decide(op, path)
+        if act is None:
+            return None
+        if act[0] == "fail":
+            raise FaultInjectedError(
+                f"injected fault #{self.schedule.faults} on {op} {path!r} "
+                f"(seed {self.schedule.seed}, call {self.schedule.calls})"
+            )
+        if act[0] == "delay":
+            self._sleep(act[1])
+            return None
+        return act
+
+    def _commit(self, path: str, data: bytes, final: bool = True) -> None:
+        act = self._hit("commit", path) if final else None
+        if act is not None and act[0] == "truncate":
+            data = data[: int(len(data) * act[1])]
+        with self.inner.open(path, "wb") as f:
+            f.write(data)
+
+    def open(self, path, mode="r", **kwargs):
+        binary = "b" in mode
+        kind = mode.replace("b", "").replace("t", "") or "r"
+        if kind == "r":
+            self._hit("open_r", path)
+            return self.inner.open(path, mode, **kwargs)
+        if kind not in ("w", "x", "a"):
+            raise ValueError(f"unsupported mode {mode!r}")
+        if kind == "x" and self.inner.isfile(path):
+            raise FileExistsError(path)
+        initial = b""
+        if kind == "a" and self.inner.isfile(path):
+            with self.inner.open(path, "rb") as f:
+                initial = f.read()
+        raw = _FaultWriter(self, path, initial)
+        if kind == "a":
+            raw.seek(0, io.SEEK_END)
+        if binary:
+            return raw
+        kwargs.pop("newline", None)
+        kwargs.setdefault("encoding", "utf-8")
+        return fsio._MemTextWrapper(raw, **kwargs)
+
+    def exists(self, path):
+        self._hit("stat", path)
+        return self.inner.exists(path)
+
+    def isdir(self, path):
+        self._hit("stat", path)
+        return self.inner.isdir(path)
+
+    def isfile(self, path):
+        self._hit("stat", path)
+        return self.inner.isfile(path)
+
+    def listdir(self, path):
+        self._hit("listdir", path)
+        return self.inner.listdir(path)
+
+    def makedirs(self, path, exist_ok=True):
+        self._hit("makedirs", path)
+        return self.inner.makedirs(path, exist_ok=exist_ok)
+
+    def replace(self, src, dst):
+        self._hit("replace", src)
+        return self.inner.replace(src, dst)
+
+
+class HttpFaultInjector:
+    """Schedule adapter for the S3 stub's wire-level hook
+    (tests/s3stub.S3Stub.fault_hook).
+
+    ``plan`` maps 1-based request indices to stub action tuples —
+    ``("status", 503, "SlowDown")``, ``("reset",)``,
+    ``("truncate", nbytes)``, ``("commit_then_status", 500)`` — and
+    ``fail_rate`` adds seeded probabilistic 5xx answers on top.
+    ``methods`` restricts which HTTP verbs are eligible. Decisions are
+    a pure function of (seed, request index) and are logged, so the
+    wire-fault schedule reproduces bit-for-bit per seed."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        plan: Optional[Dict[int, Tuple]] = None,
+        fail_rate: float = 0.0,
+        fail_status: Tuple = ("status", 503, "SlowDown"),
+        methods: Optional[Iterable[str]] = None,
+        max_faults: Optional[int] = None,
+    ):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._plan = dict(plan or {})
+        self._fail_rate = fail_rate
+        self._fail_status = fail_status
+        self._methods = None if methods is None else frozenset(methods)
+        self._max_faults = max_faults
+        self.calls = 0
+        self.faults = 0
+        self.log: List[Tuple[int, str, str, str]] = []
+
+    def __call__(self, method: str, path: str) -> Optional[Tuple]:
+        self.calls += 1
+        n = self.calls
+        u = self._rng.random()
+        action: Optional[Tuple] = None
+        eligible = (
+            (self._methods is None or method in self._methods)
+            and (self._max_faults is None or self.faults < self._max_faults)
+        )
+        if eligible:
+            action = self._plan.get(n)
+            if action is None and u < self._fail_rate:
+                action = self._fail_status
+        if action is not None:
+            self.faults += 1
+        self.log.append((n, method, path, action[0] if action else "-"))
+        return action

@@ -6,14 +6,74 @@ hardcoded: iterations `Sparky.java:187`, damping `:233`, input paths
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional, Tuple
+
+from pagerank_tpu.utils.retry import RetryPolicy
 
 # Semantics modes (SURVEY.md §2a): "reference" reproduces the Spark
 # program's local-mode behavior bit-for-bit in exact arithmetic;
 # "textbook" is the standard normalized PageRank.
 SEMANTICS_REFERENCE = "reference"
 SEMANTICS_TEXTBOOK = "textbook"
+
+
+@dataclass
+class RobustnessConfig:
+    """Fault-tolerance knobs (docs/ROBUSTNESS.md). The reference
+    inherited all of this from Spark (task retry, lineage recovery —
+    SURVEY.md §5); here it is explicit: per-step solver health checks
+    with snapshot rollback, bounded I/O retries, and the write-failure
+    policy for the async snapshot/dump path."""
+
+    #: Per-step health check in the driver loop (engine.run): any
+    #: non-finite value in the step info (l1_delta, dangling_mass)
+    #: triggers rollback-or-raise. Costs nothing — the scalars are
+    #: already on host.
+    health_checks: bool = True
+
+    #: Opt-in rank-mass drift check: relative change of sum(ranks)
+    #: allowed per step before the step is declared unhealthy. None
+    #: disables (the default — reference semantics legitimately moves
+    #: mass early on; see docs/ROBUSTNESS.md for calibration).
+    mass_tol: Optional[float] = None
+
+    #: Total snapshot rollbacks engine.run may perform before raising a
+    #: diagnostic SolverHealthError naming the first bad iteration.
+    max_rollbacks: int = 3
+
+    #: Sink-write retry budget for snapshots/text dumps (total
+    #: attempts; 1 disables) and what to do when it is exhausted:
+    #: 'fail' aborts the run, 'warn_and_drop' records the iteration in
+    #: the dead-letter manifest and keeps solving.
+    write_attempts: int = 3
+    on_write_failure: str = "fail"
+
+    def validate(self) -> "RobustnessConfig":
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if self.write_attempts < 1:
+            raise ValueError(
+                f"write_attempts must be >= 1, got {self.write_attempts}"
+            )
+        if self.on_write_failure not in ("fail", "warn_and_drop"):
+            raise ValueError(
+                f"on_write_failure must be 'fail' or 'warn_and_drop', "
+                f"got {self.on_write_failure!r}"
+            )
+        if self.mass_tol is not None and not (0.0 < self.mass_tol):
+            raise ValueError(
+                f"mass_tol must be positive, got {self.mass_tol}"
+            )
+        return self
+
+    def write_retry_policy(self) -> Optional[RetryPolicy]:
+        """RetryPolicy for sink writes, or None when retries are off."""
+        if self.write_attempts <= 1:
+            return None
+        return RetryPolicy(max_attempts=self.write_attempts)
 
 
 @dataclass
@@ -122,7 +182,12 @@ class PageRankConfig:
     log_every: int = 1
     profile_dir: Optional[str] = None
 
+    # Fault tolerance (docs/ROBUSTNESS.md): solver health checks +
+    # rollback budget + sink-write failure policy.
+    robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
+
     def validate(self) -> "PageRankConfig":
+        self.robustness.validate()
         if self.semantics not in (SEMANTICS_REFERENCE, SEMANTICS_TEXTBOOK):
             raise ValueError(f"unknown semantics mode: {self.semantics!r}")
         if not (0.0 < self.damping < 1.0):

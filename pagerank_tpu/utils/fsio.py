@@ -20,6 +20,7 @@ egress). Both round-trip ingest -> snapshot -> resume through the CLI.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import os
 import re
@@ -322,6 +323,23 @@ def replace(src: str, dst: str) -> None:
             f"cross schemes ({scheme_of(src)!r} vs {scheme_of(dst)!r})"
         )
     get_fs(src).replace(src, dst)
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb", suffix: str = ".tmp", **kwargs):
+    """Write-then-rename: bytes land at ``path`` only when the writer
+    body completes — THE one torn-file guard for every sink that must
+    never publish a parseable-looking partial file (Snapshotter.save and
+    TextDumper.dump both ride this path; docs/ROBUSTNESS.md). A kill or
+    exception mid-write leaves at worst a ``path + suffix`` temp the
+    consumers' name patterns never match (object-store backends abort
+    the upload outright — nothing is published at all)."""
+    if any(c in mode for c in "ra+"):
+        raise ValueError(f"atomic_write is write-only, got mode {mode!r}")
+    tmp = path + suffix
+    with fopen(tmp, mode, **kwargs) as f:
+        yield f
+    replace(tmp, path)
 
 
 def join(base: str, *parts: str) -> str:

@@ -27,6 +27,7 @@ servers and most private object stores speak.
 
 from __future__ import annotations
 
+import binascii
 import datetime
 import hashlib
 import hmac
@@ -39,8 +40,39 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from xml.sax.saxutils import escape as ET_escape
 
 from pagerank_tpu.utils import fsio
+from pagerank_tpu.utils.retry import RetryPolicy, RetryStats
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+#: HTTP statuses that retry (AWS transient classes): 500 InternalError,
+#: 502, 503 SlowDown/ServiceUnavailable, 504. Everything else is
+#: semantic (404 NoSuchKey, 403, 400 InvalidPart, ...) and must surface
+#: immediately — retrying a permission error only hides it.
+RETRYABLE_STATUSES = (500, 502, 503, 504)
+
+
+class _TransientStatus(Exception):
+    """Internal: a response whose status is in RETRYABLE_STATUSES,
+    raised inside the retry loop so the policy re-attempts it; when the
+    budget runs out the LAST response is returned to the caller, whose
+    normal error path (_raise) then reports it."""
+
+    def __init__(self, result):
+        super().__init__(f"transient HTTP {result[0]}")
+        self.result = result
+
+
+def _s3_retryable(exc: BaseException) -> bool:
+    """The S3 retry matrix's exception half (docs/ROBUSTNESS.md):
+    transient statuses, connection reset / refused / broken pipe
+    (ConnectionError), timeouts, truncated or malformed responses
+    (http.client.HTTPException covers IncompleteRead, BadStatusLine,
+    RemoteDisconnected), and socket-level OSErrors. Inside one HTTP
+    transaction no semantic OSError (FileNotFoundError etc.) can arise
+    — those are raised AFTER the response, outside the retry scope."""
+    return isinstance(
+        exc, (_TransientStatus, http.client.HTTPException, OSError)
+    )
 
 
 def sign_v4(
@@ -207,6 +239,11 @@ class _RangedReader(io.RawIOBase):
         return data
 
 
+#: Sentinel for "use the default retry policy" — distinct from an
+#: explicit ``retry_policy=None``, which DISABLES retries.
+_DEFAULT_RETRY = object()
+
+
 class S3FileSystem(fsio.FileSystem):
     """S3 REST client bound to one endpoint.
 
@@ -232,6 +269,7 @@ class S3FileSystem(fsio.FileSystem):
         access_key: Optional[str] = None,
         secret_key: Optional[str] = None,
         timeout: float = 30.0,
+        retry_policy=_DEFAULT_RETRY,
     ):
         u = urllib.parse.urlsplit(endpoint)
         if u.scheme not in ("http", "https") or not u.netloc:
@@ -244,10 +282,51 @@ class S3FileSystem(fsio.FileSystem):
         self._access_key = access_key
         self._secret_key = secret_key
         self._timeout = timeout
+        #: Transient-failure policy for idempotent requests (GET / PUT /
+        #: HEAD / DELETE / initiate are all safe to repeat; multipart
+        #: COMPLETE is not — see _multipart). Default: 5 jittered
+        #: attempts; pass ``retry_policy=None`` to disable retries.
+        self.retry: Optional[RetryPolicy] = (
+            RetryPolicy(retryable=_s3_retryable)
+            if retry_policy is _DEFAULT_RETRY else retry_policy
+        )
+        #: Counters the CLI surfaces in its robustness summary.
+        self.retry_stats = RetryStats()
 
     # -- wire protocol ----------------------------------------------------
 
     def _request(
+        self,
+        method: str,
+        bucket: str,
+        key: str,
+        query: str = "",
+        body: bytes = b"",
+        extra_headers: Optional[Dict[str, str]] = None,
+        idempotent: bool = True,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """One S3 request, retried under ``self.retry`` when
+        ``idempotent`` (each attempt re-signs with a fresh x-amz-date).
+        A transient status that survives the whole budget is RETURNED
+        (not raised) so callers' normal error paths report it; network
+        exceptions that survive the budget propagate."""
+        if not idempotent or self.retry is None:
+            return self._transact(method, bucket, key, query, body,
+                                  extra_headers)
+
+        def once():
+            result = self._transact(method, bucket, key, query, body,
+                                    extra_headers)
+            if result[0] in RETRYABLE_STATUSES:
+                raise _TransientStatus(result)
+            return result
+
+        try:
+            return self.retry.call(once, stats=self.retry_stats)
+        except _TransientStatus as e:
+            return e.result
+
+    def _transact(
         self,
         method: str,
         bucket: str,
@@ -347,12 +426,16 @@ class S3FileSystem(fsio.FileSystem):
             return etag
 
         nparts = -(-len(data) // self.MULTIPART_PART_SIZE)
-        self._multipart(bucket, key, path, nparts, put_part)
+        self._multipart(bucket, key, path, nparts, put_part,
+                        expected_size=len(data))
 
-    def _multipart(self, bucket, key, path, nparts, put_part) -> None:
+    def _multipart(self, bucket, key, path, nparts, put_part,
+                   expected_size=None) -> None:
         """The multipart skeleton: initiate, ``put_part(num, uid) ->
         etag`` per part, complete — abort on any failure so no orphan
-        upload accrues storage."""
+        upload accrues storage. Initiate and part PUTs are idempotent
+        and ride the standard retry; COMPLETE is not (see
+        _complete_multipart)."""
         status, _, body = self._request("POST", bucket, key, query="uploads")
         if status != 200:
             self._raise(status, body, path)
@@ -362,30 +445,156 @@ class S3FileSystem(fsio.FileSystem):
         uid = urllib.parse.quote(upload_id, safe="-_.~")
         try:
             etags = [put_part(num, uid) for num in range(1, nparts + 1)]
-            complete = "".join(
-                f"<Part><PartNumber>{n}</PartNumber><ETag>{ET_escape(t)}</ETag></Part>"
-                for n, t in enumerate(etags, start=1)
-            )
-            status, _, body = self._request(
-                "POST", bucket, key, query=f"uploadId={uid}",
-                body=(
-                    "<CompleteMultipartUpload>" + complete
-                    + "</CompleteMultipartUpload>"
-                ).encode(),
-            )
-            # Complete may return 200 and stream an <Error> document
-            # after keep-alive whitespace; only a
-            # CompleteMultipartUploadResult root is success.
-            root = self._xml_root(body) if status == 200 else None
-            if root is None or _local(root.tag) != "CompleteMultipartUploadResult":
-                self._raise(status, body, path)
+            self._complete_multipart(bucket, key, path, uid, etags,
+                                     expected_size=expected_size)
         except BaseException:
-            # Best-effort abort: leave no billable orphan parts behind.
+            # Best-effort abort: leave no billable orphan parts behind
+            # (AbortMultipartUpload is a no-op once a complete landed,
+            # so the committed-but-response-lost path is never undone).
             try:
                 self._request("DELETE", bucket, key, query=f"uploadId={uid}")
             except Exception:
                 pass
             raise
+
+    def _list_parts(
+        self, bucket: str, key: str, uid: str, path: str
+    ) -> Optional[Dict[int, str]]:
+        """ListParts for an in-flight upload: ``{part_number: etag}``,
+        or None when the upload no longer exists (NoSuchUpload — the
+        complete may have landed server-side)."""
+        status, _, body = self._request(
+            "GET", bucket, key, query=f"uploadId={uid}"
+        )
+        if status == 404:
+            return None
+        if status != 200:
+            self._raise(status, body, path)
+        parts: Dict[int, str] = {}
+        root = self._xml_root(body)
+        for el in root.iter() if root is not None else ():
+            if _local(el.tag) != "Part":
+                continue
+            num = etag = None
+            for sub in el:
+                if _local(sub.tag) == "PartNumber":
+                    num = int(sub.text or 0)
+                elif _local(sub.tag) == "ETag":
+                    etag = sub.text
+            if num is not None and etag is not None:
+                parts[num] = etag
+        return parts
+
+    @staticmethod
+    def _multipart_etag(etags: List[str]) -> Optional[str]:
+        """The ETag S3 assigns a multipart object: md5 over the
+        concatenated BINARY part MD5s, suffixed ``-nparts``. None when
+        any part ETag is not a plain hex md5 (e.g. SSE-KMS stores) —
+        verification then falls back to size."""
+        bins = []
+        for t in etags:
+            t = (t or "").strip().strip('"')
+            if len(t) != 32:
+                return None
+            try:
+                bins.append(binascii.unhexlify(t))
+            except (binascii.Error, ValueError):
+                return None
+        digest = hashlib.md5(b"".join(bins)).hexdigest()
+        return f'"{digest}-{len(bins)}"'
+
+    def _object_matches_upload(
+        self, bucket: str, key: str, etags: List[str],
+        expected_size: Optional[int],
+    ) -> bool:
+        """Did the lost/failed COMPLETE actually commit OUR upload?
+        Mere key existence proves nothing — a previous version of the
+        same key (the snapshot overwrite pattern) would pass. Verify
+        the object's ETag against the multipart ETag computed from the
+        part ETags we just uploaded; when either side is unverifiable,
+        fall back to an exact size match; with neither, refuse."""
+        status, headers, _ = self._request("HEAD", bucket, key)
+        if status != 200:
+            return False
+        etag = _header(headers, "etag")
+        want = self._multipart_etag(etags)
+        if etag and want:
+            return etag.strip() == want
+        if expected_size is not None:
+            cl = _header(headers, "content-length")
+            return cl is not None and cl.isdigit() and int(cl) == expected_size
+        return False
+
+    def _complete_multipart(
+        self, bucket: str, key: str, path: str, uid: str, etags: List[str],
+        expected_size: Optional[int] = None,
+    ) -> None:
+        """CompleteMultipartUpload with NON-BLIND recovery. Complete is
+        not idempotent (the first attempt may commit server-side while
+        its response is lost), so a transient failure is never simply
+        re-POSTed: re-LIST the parts first — upload gone + object
+        present means the commit already landed (success); parts intact
+        and matching means a re-complete is safe; anything else is a
+        real error. Attempts/backoff share ``self.retry``'s budget."""
+        complete_xml = (
+            "<CompleteMultipartUpload>" + "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{ET_escape(t)}</ETag></Part>"
+                for n, t in enumerate(etags, start=1)
+            ) + "</CompleteMultipartUpload>"
+        ).encode()
+        expected = {n: t for n, t in enumerate(etags, start=1)}
+        attempts = self.retry.max_attempts if self.retry is not None else 1
+        failures = 0
+        while True:
+            transient: Optional[BaseException] = None
+            status, body = 0, b""
+            try:
+                status, _, body = self._request(
+                    "POST", bucket, key, query=f"uploadId={uid}",
+                    body=complete_xml, idempotent=False,
+                )
+            except BaseException as e:
+                if not _s3_retryable(e):
+                    raise
+                transient = e
+            if transient is None:
+                # Complete may return 200 and stream an <Error> document
+                # after keep-alive whitespace; only a
+                # CompleteMultipartUploadResult root is success.
+                root = self._xml_root(body) if status == 200 else None
+                if root is not None and _local(root.tag) == "CompleteMultipartUploadResult":
+                    return
+                if status not in RETRYABLE_STATUSES:
+                    self._raise(status, body, path)  # semantic: surface now
+            failures += 1
+            # Recovery probe (idempotent, internally retried): did the
+            # lost/failed complete actually commit?
+            listed = self._list_parts(bucket, key, uid, path)
+            if listed is None:
+                if self._object_matches_upload(bucket, key, etags,
+                                               expected_size):
+                    return  # committed server-side; response was lost
+                raise OSError(
+                    f"S3 multipart upload for {path!r} disappeared without "
+                    f"a verifiable commit — the key's current object does "
+                    f"not match the uploaded parts (complete failed with "
+                    f"{transient or ('HTTP %d' % status)})"
+                )
+            if listed != expected:
+                raise OSError(
+                    f"S3 multipart parts for {path!r} no longer match what "
+                    f"was uploaded ({len(listed)}/{len(expected)} parts "
+                    f"listed); refusing to re-complete"
+                )
+            if failures >= attempts:
+                if transient is not None:
+                    raise transient
+                self._raise(status, body, path)
+            if self.retry is not None:
+                delay = self.retry.backoff(failures)
+                self.retry_stats.retries += 1
+                self.retry_stats.slept += delay
+                self.retry.sleep(delay)
 
     def _get(self, path: str) -> bytes:
         bucket, key = _split_uri(path)
@@ -589,7 +798,8 @@ class S3FileSystem(fsio.FileSystem):
                 return etag
 
             nparts = -(-size // self.MULTIPART_PART_SIZE)
-            self._multipart(db_, dk, dst, nparts, copy_part)
+            self._multipart(db_, dk, dst, nparts, copy_part,
+                            expected_size=size)
         else:
             status, _, data = self._request(
                 "PUT", db_, dk,
@@ -611,15 +821,27 @@ ENDPOINT_ENV = "PAGERANK_TPU_S3_ENDPOINT"
 
 def from_env() -> Optional[S3FileSystem]:
     """Build an :class:`S3FileSystem` from the environment, or None when
-    no endpoint is configured."""
+    no endpoint is configured. ``PAGERANK_TPU_S3_RETRIES`` (total
+    attempts; 1 disables) overrides the default retry budget."""
     endpoint = os.environ.get(ENDPOINT_ENV)
     if not endpoint:
         return None
+    policy = _DEFAULT_RETRY
+    attempts = os.environ.get("PAGERANK_TPU_S3_RETRIES")
+    if attempts:
+        n = max(1, int(attempts))
+        # 1 total attempt = retries off (None; _DEFAULT_RETRY means
+        # "use the default policy", an explicit None disables)
+        policy = (
+            RetryPolicy(max_attempts=n, retryable=_s3_retryable)
+            if n > 1 else None
+        )
     return S3FileSystem(
         endpoint,
         region=os.environ.get("AWS_REGION", "us-east-1"),
         access_key=os.environ.get("AWS_ACCESS_KEY_ID"),
         secret_key=os.environ.get("AWS_SECRET_ACCESS_KEY"),
+        retry_policy=policy,
     )
 
 

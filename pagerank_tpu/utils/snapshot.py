@@ -3,24 +3,55 @@
 The reference's de-facto checkpoint is ``saveAsTextFile`` of the full
 rank vector after every iteration (Sparky.java:237) with no resume logic.
 Here snapshots are first-class: (ranks, iteration, graph fingerprint,
-semantics) per file, a ``latest()`` scan, and ``resume_engine`` that
-validates the fingerprint before restoring — restart-from-latest is the
-failure-recovery story (kill-and-resume is tested in
-tests/test_snapshot.py).
+semantics, content checksum) per file, a ``latest()`` scan, and
+``resume_engine`` that validates the fingerprint before restoring —
+restart-from-latest is the failure-recovery story (kill-and-resume is
+tested in tests/test_snapshot.py). Every save is atomic
+(fsio.atomic_write: tmp + rename) and every load verifies the sha256
+sidecar, so a torn, truncated, or bit-flipped snapshot is DETECTED and
+skipped (``load_latest_valid``) rather than resumed into — the rollback
+substrate for the self-healing solve loop (engine.run;
+docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import queue
 import re
 import threading
-from typing import Callable, Dict, Iterable, Optional, Tuple
+import warnings
+import zipfile
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from pagerank_tpu.utils import fsio
+from pagerank_tpu.utils.retry import RetryPolicy
 
 _PAT = re.compile(r"^ranks_iter(\d+)\.npz$")
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A snapshot file exists but cannot be trusted: unreadable npz,
+    missing members, or checksum mismatch. Distinct from
+    FileNotFoundError (no snapshot) and ValueError (valid snapshot,
+    wrong graph/semantics) so recovery code can skip-and-fall-back on
+    corruption while still failing loudly on real mismatches."""
+
+
+def _digest(ranks: np.ndarray, iteration: int, fingerprint: str,
+            semantics: str) -> str:
+    """sha256 over the rank payload AND its identifying metadata — a
+    corrupt header is as fatal as corrupt ranks."""
+    h = hashlib.sha256()
+    h.update(
+        f"{iteration}|{fingerprint}|{semantics}|"
+        f"{ranks.dtype.str}|{ranks.shape}|".encode()
+    )
+    h.update(np.ascontiguousarray(ranks).tobytes())
+    return h.hexdigest()
 
 
 class Snapshotter:
@@ -39,39 +70,123 @@ class Snapshotter:
 
     def save(self, iteration: int, ranks: np.ndarray) -> str:
         p = self.path(iteration)
-        tmp = p + ".tmp.npz"
-        with fsio.fopen(tmp, "wb") as f:
+        # atomic: a killed run never leaves a torn file under the
+        # consumers' name pattern (suffix keeps the historical
+        # *.tmp.npz spelling tests/test_hardening.py filters on)
+        with fsio.atomic_write(p, "wb", suffix=".tmp.npz") as f:
             np.savez(
                 f,
                 ranks=ranks,
                 iteration=np.int64(iteration),
                 fingerprint=np.bytes_(self.fingerprint.encode()),
                 semantics=np.bytes_(self.semantics.encode()),
+                checksum=np.bytes_(
+                    _digest(ranks, iteration, self.fingerprint,
+                            self.semantics).encode()
+                ),
             )
-        fsio.replace(tmp, p)  # atomic: a killed run never leaves a torn file
         return p
 
-    def latest(self) -> Optional[int]:
-        best = None
+    def iterations(self) -> List[int]:
+        """All snapshot iterations present, ascending (by NAME only —
+        no validity check; load_latest_valid does that)."""
         try:
             entries = fsio.listdir(self.directory)
         except FileNotFoundError:
-            return None
+            return []
+        out = []
         for name in entries:
             m = _PAT.match(name)
             if m:
-                i = int(m.group(1))
-                best = i if best is None else max(best, i)
-        return best
+                out.append(int(m.group(1)))
+        return sorted(out)
 
-    def load(self, iteration: int) -> Tuple[np.ndarray, Dict[str, str]]:
-        with fsio.fopen(self.path(iteration), "rb") as f, np.load(f) as z:
-            meta = {
-                "fingerprint": bytes(z["fingerprint"]).decode(),
-                "semantics": bytes(z["semantics"]).decode(),
-                "iteration": int(z["iteration"]),
-            }
-            return z["ranks"].copy(), meta
+    def latest(self) -> Optional[int]:
+        its = self.iterations()
+        return its[-1] if its else None
+
+    def load(self, iteration: int, verify: bool = True
+             ) -> Tuple[np.ndarray, Dict[str, str]]:
+        """Load one snapshot. Raises FileNotFoundError when absent and
+        :class:`SnapshotCorruptError` when present but unreadable or
+        failing its checksum. Pre-checksum snapshots (no ``checksum``
+        member) load with a warning — their integrity is unverifiable."""
+        path = self.path(iteration)
+        try:
+            with fsio.fopen(path, "rb") as f, np.load(f) as z:
+                meta = {
+                    "fingerprint": bytes(z["fingerprint"]).decode(),
+                    "semantics": bytes(z["semantics"]).decode(),
+                    "iteration": int(z["iteration"]),
+                }
+                ranks = z["ranks"].copy()
+                stored = (
+                    bytes(z["checksum"]).decode()
+                    if "checksum" in z.files else None
+                )
+        except FileNotFoundError:
+            raise
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile) as e:
+            raise SnapshotCorruptError(
+                f"snapshot {path} is unreadable: {e!r}"
+            ) from e
+        if verify:
+            if stored is None:
+                warnings.warn(
+                    f"snapshot {path} predates content checksums; "
+                    f"integrity not verifiable", RuntimeWarning,
+                )
+            else:
+                want = _digest(ranks, meta["iteration"],
+                               meta["fingerprint"], meta["semantics"])
+                if stored != want:
+                    raise SnapshotCorruptError(
+                        f"snapshot {path} failed its checksum "
+                        f"(stored {stored[:12]}…, computed {want[:12]}…)"
+                    )
+        return ranks, meta
+
+    def load_latest_valid(
+        self, max_iteration: Optional[int] = None, match: bool = False
+    ) -> Optional[Tuple[int, np.ndarray, Dict[str, str]]]:
+        """Newest loadable, checksum-valid snapshot (optionally at or
+        below ``max_iteration``): ``(iteration, ranks, meta)`` or None.
+        Corrupt/truncated files are skipped WITH A WARNING and the scan
+        falls back to the next older one — a damaged snapshot directory
+        degrades recovery granularity, never crashes it.
+
+        ``match=True`` additionally skips (with a warning) snapshots
+        whose fingerprint/semantics differ from this Snapshotter's —
+        the ROLLBACK contract (engine.run must never restore another
+        graph's ranks, e.g. from a reused --snapshot-dir). The resume
+        path keeps ``match=False`` so a mismatch RAISES there
+        (resume_engine) instead of silently starting over."""
+        for it in reversed(self.iterations()):
+            if max_iteration is not None and it > max_iteration:
+                continue
+            try:
+                ranks, meta = self.load(it)
+            except FileNotFoundError:
+                continue  # raced with cleanup
+            except SnapshotCorruptError as e:
+                warnings.warn(
+                    f"skipping corrupt snapshot for iteration {it}: {e}",
+                    RuntimeWarning,
+                )
+                continue
+            if match and (meta["fingerprint"] != self.fingerprint
+                          or meta["semantics"] != self.semantics):
+                warnings.warn(
+                    f"skipping snapshot for iteration {it}: taken on a "
+                    f"different graph/semantics "
+                    f"({meta['fingerprint'][:12]}…/{meta['semantics']} vs "
+                    f"{self.fingerprint[:12]}…/{self.semantics})",
+                    RuntimeWarning,
+                )
+                continue
+            return it, ranks, meta
+        return None
 
 class TextDumper:
     """Per-iteration plain-text rank dumps mirroring the reference's
@@ -123,10 +238,13 @@ class TextDumper:
 
         d = fsio.join(self.directory, f"PageRank{iteration}")
         fsio.makedirs(d, exist_ok=True)
+        # Same atomic tmp+rename path as Snapshotter.save
+        # (fsio.atomic_write): a mid-dump kill leaves at worst a
+        # part-00000.tmp no Hadoop-convention consumer matches — never
+        # a half-written, parseable-looking part file.
         path = fsio.join(d, "part-00000")
-        tmp = path + ".tmp"
         blob = None if self.names is None else self._names_blob(len(ranks))
-        with fsio.fopen(tmp, "wb") as f:
+        with fsio.atomic_write(path, "wb") as f:
             for lo in range(0, len(ranks), self.CHUNK_ROWS):
                 hi = min(lo + self.CHUNK_ROWS, len(ranks))
                 chunk = ranks[lo:hi]
@@ -152,13 +270,91 @@ class TextDumper:
                         for i, r in enumerate(chunk, start=lo)
                     ).encode("utf-8")
                 f.write(data)
-        fsio.replace(tmp, path)
         # Hadoop job-completion marker (saveAsTextFile writes one per
         # output dir); written LAST so its presence certifies a
         # complete, untorn dump to downstream Hadoop-convention tooling.
         with fsio.fopen(fsio.join(d, "_SUCCESS"), "w"):
             pass
         return path
+
+
+class SinkGuard:
+    """Bounded-retry + write-failure policy for rank sinks, shared by
+    :class:`AsyncRankWriter`'s worker and the synchronous ``--sync-io``
+    path (cli.py) so both modes have identical failure semantics
+    (docs/ROBUSTNESS.md).
+
+    ``on_failure='fail'`` (default) re-raises after the retry budget —
+    a lost snapshot fails the run. ``'warn_and_drop'`` keeps the run
+    alive: the iteration is recorded in ``dropped`` (and appended to the
+    ``dead_letter_path`` JSON manifest when set), a RuntimeWarning is
+    emitted, and the caller moves on — the side-channel sink never
+    outranks result correctness, but what was dropped is never silent.
+    """
+
+    ON_FAILURE = ("fail", "warn_and_drop")
+
+    def __init__(
+        self,
+        retry_policy: Optional[RetryPolicy] = None,
+        on_failure: str = "fail",
+        dead_letter_path: Optional[str] = None,
+        label: str = "rank writer",
+    ):
+        if on_failure not in self.ON_FAILURE:
+            raise ValueError(
+                f"on_failure must be one of {self.ON_FAILURE}, "
+                f"got {on_failure!r}"
+            )
+        self._policy = retry_policy
+        self.on_failure = on_failure
+        self.dead_letter_path = dead_letter_path
+        self.label = label
+        self.retries = 0
+        self.dropped: List[Dict[str, object]] = []
+
+    def __call__(self, iteration: int, fn: Callable[[], object]) -> bool:
+        """Run ``fn()`` under the policy; True when it ran, False when
+        it was dropped (warn_and_drop). Raises in 'fail' mode."""
+
+        def on_retry(failures, delay, exc):
+            self.retries += 1
+
+        try:
+            if self._policy is not None:
+                self._policy.call(fn, on_retry=on_retry)
+            else:
+                fn()
+            return True
+        except BaseException as e:
+            # KeyboardInterrupt/SystemExit are never "write failures"
+            # to drop — swallowing them is the PTL006 failure mode.
+            if self.on_failure == "fail" or not isinstance(e, Exception):
+                raise
+            self.dropped.append(
+                {"iteration": int(iteration), "error": repr(e)}
+            )
+            self._flush_dead_letter()
+            warnings.warn(
+                f"{self.label}: dropped iteration {iteration} after "
+                f"{self.retries} retr{'y' if self.retries == 1 else 'ies'}: "
+                f"{e!r}",
+                RuntimeWarning,
+            )
+            return False
+
+    def _flush_dead_letter(self) -> None:
+        if not self.dead_letter_path:
+            return
+        try:
+            with fsio.fopen(self.dead_letter_path, "w") as f:
+                json.dump({"dropped": self.dropped}, f, indent=2)
+        except OSError as e:
+            warnings.warn(
+                f"{self.label}: could not write dead-letter manifest "
+                f"{self.dead_letter_path!r}: {e!r}",
+                RuntimeWarning,
+            )
 
 
 class AsyncRankWriter:
@@ -188,15 +384,24 @@ class AsyncRankWriter:
         decode: Callable[[object], np.ndarray],
         sinks: Iterable[Callable[[int, np.ndarray], object]],
         max_pending: int = 4,
+        guard: Optional[SinkGuard] = None,
     ):
         self._decode = decode
         self._sinks = list(sinks)
+        self._guard = guard if guard is not None else SinkGuard()
         self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
         self._err: Optional[BaseException] = None
+        self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="rank-writer", daemon=True
         )
         self._thread.start()
+
+    @property
+    def guard(self) -> SinkGuard:
+        """The write-failure policy in effect (retry/drop counters live
+        here — the CLI's robustness summary reads them)."""
+        return self._guard
 
     def _run(self):
         while True:
@@ -207,9 +412,13 @@ class AsyncRankWriter:
                 if self._err is not None:
                     continue  # drain after failure
                 iteration, payload = item
-                ranks = self._decode(payload)
-                for sink in self._sinks:
-                    sink(iteration, ranks)
+
+                def work():
+                    ranks = self._decode(payload)
+                    for sink in self._sinks:
+                        sink(iteration, ranks)
+
+                self._guard(iteration, work)
             except BaseException as e:  # surfaced to the submitter
                 self._err = e
             finally:
@@ -222,17 +431,34 @@ class AsyncRankWriter:
             ) from self._err
 
     def submit(self, iteration: int, payload) -> None:
+        if self._closed:
+            raise RuntimeError("submit() after close()")
         self._check()
         self._q.put((iteration, payload))
         # Re-check: if the worker failed while the put above blocked on a
         # full queue, fail now rather than queueing more device copies.
         self._check()
 
+    def flush(self) -> None:
+        """Block until every already-submitted write has been processed
+        (written, retried, or dropped per the guard's policy), keeping
+        the worker alive; raises if a write failed in 'fail' mode. The
+        rollback path drains through this so load_latest_valid never
+        races snapshots still sitting in the queue."""
+        self._q.join()
+        self._check()
+
     def close(self) -> None:
-        """Flush all pending writes and stop the worker; raises if any
-        write failed."""
-        self._q.put(None)
-        self._thread.join()
+        """Flush all pending writes and stop the worker; raises if ANY
+        write failed — including one raised by the background thread
+        after the final ``submit``, which is only observable here.
+        Idempotent: every call (first or repeated, e.g. an explicit
+        close inside a ``with`` block) re-raises a recorded failure, so
+        no caller path can exit cleanly over a lost write."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._thread.join()
         self._check()
 
     def __enter__(self):
@@ -242,14 +468,46 @@ class AsyncRankWriter:
         self.close()
 
 
+class WriterSyncedSnapshotter:
+    """Rollback view of a :class:`Snapshotter` that drains an
+    :class:`AsyncRankWriter` before every scan: without the flush, a
+    mid-run rollback could scan the directory while the most recent
+    healthy snapshots still sit in the writer's queue — burning
+    rollback budget on a stale restore point (or finding nothing at
+    all early in a run). The CLI hands THIS to ``engine.run`` whenever
+    the async writer is active."""
+
+    def __init__(self, snap: Snapshotter, writer: AsyncRankWriter):
+        self._snap = snap
+        self._writer = writer
+
+    @property
+    def fingerprint(self) -> str:
+        return self._snap.fingerprint
+
+    @property
+    def semantics(self) -> str:
+        return self._snap.semantics
+
+    def load_latest_valid(self, max_iteration=None, match=False):
+        self._writer.flush()
+        return self._snap.load_latest_valid(
+            max_iteration=max_iteration, match=match
+        )
+
+
 def resume_engine(engine, snap: Snapshotter) -> int:
-    """Restore the latest snapshot into ``engine``; returns the iteration
-    resumed from (0 if none found). Refuses a snapshot taken on a
-    different graph or semantics mode."""
-    it = snap.latest()
-    if it is None:
+    """Restore the latest VALID snapshot into ``engine``; returns the
+    iteration resumed from (0 if none found). Corrupt or truncated
+    snapshots are skipped (warning) and the scan falls back to the
+    newest valid one — a damaged snapshot directory costs recovery
+    granularity, never the resume. Refuses a snapshot taken on a
+    different graph or semantics mode (that is a configuration error,
+    not corruption)."""
+    found = snap.load_latest_valid()
+    if found is None:
         return 0
-    ranks, meta = snap.load(it)
+    _it, ranks, meta = found
     if meta["fingerprint"] != snap.fingerprint:
         raise ValueError(
             f"snapshot graph fingerprint {meta['fingerprint']} != current "

@@ -94,6 +94,15 @@ CONFIGS = {
     # wall-clock split (L1 / build / solve / L4) in BASELINE.md.
     "E": dict(kind="e2e", files=301, records=1000, iters=10,
               label="reference-job end-to-end (301-file segment)"),
+    # Fault-injection smoke (ISSUE 3): a seeded chaos run at small
+    # scale (<30 s) — per-iteration snapshots through a deterministic
+    # FaultInjectingFileSystem, a mid-run NaN poisoning + snapshot
+    # corruption healed by rollback, gated on oracle ranks AND on the
+    # same seed reproducing the same fault schedule bit-for-bit across
+    # two runs (docs/ROBUSTNESS.md). Early in the default order: it is
+    # cheap and the robustness layer underpins every snapshotting run.
+    "F": dict(kind="faults", seed=23, iters=12,
+              label="fault-injection smoke (seeded chaos, rollback+retry)"),
     # Build-pipeline smoke (ISSUE 2): a scale-18 pair-f64 device build
     # through bench.run_build — gates that the per-stage breakdown
     # keys exist and build_s stays under the recorded budget, with the
@@ -103,7 +112,7 @@ CONFIGS = {
     "D": dict(kind="build", scale=18,
               label="build-stage smoke (scale-18 pair-f64 device build)"),
 }
-DEFAULT_KEYS = ["D", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -177,6 +186,109 @@ def run_build_smoke(key: str):
         f"{BUILD_SMOKE_BUDGET_S:g}s; stage keys "
         f"{'complete' if not missing else 'MISSING ' + repr(missing)}; "
         f"ops lint {'OK' if lint_ok else 'FAILED'} -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+def run_fault_smoke(key: str):
+    """ISSUE-3 robustness gate, in seconds not minutes: a full solve
+    with per-iteration snapshots through a seeded fault-injecting
+    filesystem (transient failures + truncated writes), a mid-run NaN
+    poisoning plus snapshot-directory corruption healed by checksum-
+    verified rollback — run TWICE with the same seed. Gates: final
+    ranks match the f64 CPU oracle (atol 1e-6), at least one fault and
+    one rollback actually happened, and the two runs' fault schedules
+    (and ranks) are bit-for-bit identical."""
+    import warnings
+
+    from pagerank_tpu import (PageRankConfig, ReferenceCpuEngine,
+                              build_graph)
+    from pagerank_tpu.testing.faults import (FaultInjectingFileSystem,
+                                             FaultSchedule)
+    from pagerank_tpu.utils import fsio
+    from pagerank_tpu.utils.retry import RetryPolicy
+    from pagerank_tpu.utils.snapshot import SinkGuard, Snapshotter
+
+    spec = CONFIGS[key]
+    seed, iters = spec["seed"], spec["iters"]
+    rng = np.random.default_rng(3)
+    n, e = 1500, 12000
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    cfg = PageRankConfig(num_iters=iters, dtype="float64",
+                         accum_dtype="float64")
+
+    def chaos_run():
+        g = build_graph(src, dst, n=n)
+        inner = fsio.MemoryFileSystem()
+        sched = FaultSchedule(seed=seed, fail_rate=0.08, truncate_rate=0.04,
+                              max_faults=8)
+        fsio.register("chaos", FaultInjectingFileSystem(
+            inner, sched, sleep=lambda s: None))
+        try:
+            snap = Snapshotter("chaos://ck", g.fingerprint(), "reference")
+            guard = SinkGuard(retry_policy=RetryPolicy(
+                max_attempts=6, base_delay=0.0, seed=seed))
+            eng = ReferenceCpuEngine(cfg).build(g)
+            orig, state = eng.step, {"fired": False}
+
+            def step():
+                info = orig()
+                if eng.iteration == iters // 2 and not state["fired"]:
+                    state["fired"] = True
+                    with fsio.fopen(snap.path(iters // 2), "wb") as f:
+                        f.write(b"corrupted mid-run")
+                    eng._r = eng._r * np.nan
+                    return {k: float("nan") for k in info}
+                return info
+
+            eng.step = step
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                ranks = eng.run(
+                    on_iteration=lambda i, info: guard(
+                        i, lambda: snap.save(i + 1, eng.ranks())),
+                    snapshotter=snap,
+                )
+            return ranks, list(sched.log), dict(eng.health), guard.retries
+        finally:
+            fsio.unregister("chaos")
+
+    t0 = time.perf_counter()
+    r1, log1, health1, retries1 = chaos_run()
+    r2, log2, _, _ = chaos_run()
+    oracle = ReferenceCpuEngine(cfg).build(build_graph(src, dst, n=n)).run()
+    t_run = time.perf_counter() - t0
+    l1 = float(np.abs(r1 - oracle).sum()) / float(np.abs(oracle).sum())
+    faults = sum(1 for _, _, _, a in log1 if a != "-")
+    passed = bool(
+        log1 == log2
+        and np.array_equal(r1, r2)
+        and l1 <= GATE
+        and faults > 0
+        and health1["rollbacks"] >= 1
+    )
+    rec = {
+        "config": key,
+        "kind": "faults",
+        "label": spec["label"],
+        "seed": seed,
+        "iters": iters,
+        "faults_injected": faults,
+        "write_retries": retries1,
+        "rollbacks": health1["rollbacks"],
+        "schedule_reproducible": bool(log1 == log2),
+        "normalized_l1": l1,
+        "gate": GATE,
+        "seconds": t_run,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] seed {seed}: {faults} fault(s) injected, {retries1} "
+        f"write retr(y/ies), {health1['rollbacks']} rollback(s); schedule "
+        f"{'reproducible' if rec['schedule_reproducible'] else 'DIVERGED'}; "
+        f"oracle L1 {l1:.3e} vs gate {GATE:g} ({t_run:.1f}s) -> "
         f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
@@ -581,7 +693,7 @@ def append_baseline(recs) -> None:
         f"{r['mass_normalized_l1']:.3e} | {r['gate']:g} | "
         f"{'PASS' if r['passed'] else 'FAIL'} | "
         f"{r['edges_per_sec_per_chip']:.3g} |\n"
-        for r in recs if r.get("kind") not in ("ppr", "e2e", "build")
+        for r in recs if r.get("kind") not in ("ppr", "e2e", "build", "faults")
     ]
     text = _append_table(
         text,
@@ -681,7 +793,8 @@ def main(argv=None) -> int:
 
     _enable_compile_cache()
     keys = [args.only] if args.only else DEFAULT_KEYS
-    runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke}
+    runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
+               "faults": run_fault_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

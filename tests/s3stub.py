@@ -22,6 +22,7 @@ from xml.sax.saxutils import escape
 class S3Stub:
     def __init__(self):
         self.objects = {}  # "/bucket/key" -> bytes
+        self.etags = {}  # "/bucket/key" -> quoted ETag (md5 / multipart)
         self.lock = threading.RLock()
         self.auth_headers = []  # recorded Authorization values (or None)
         self.max_page = 1000  # shrink in tests to force pagination
@@ -29,6 +30,17 @@ class S3Stub:
         self.range_requests = []  # recorded Range header values
         self.completed_multiparts = []  # paths assembled via multipart
         self.fail_part = None  # part number to reject (fault injection)
+        # HTTP-level fault hook (pagerank_tpu.testing.faults.
+        # HttpFaultInjector): callable(method, path) -> None or an
+        # action tuple — ("status", code[, code_str]) answer an error,
+        # ("reset",) drop the connection without a response (client
+        # sees RemoteDisconnected), ("truncate", nbytes) send a GET
+        # body short of its Content-Length (client sees
+        # IncompleteRead), ("commit_then_status", code) apply a
+        # multipart COMPLETE server-side but answer an error — the
+        # committed-but-response-lost case a non-idempotent complete
+        # must recover from.
+        self.fault_hook = None
         self._next_upload = 0
         outer = self
 
@@ -37,6 +49,36 @@ class S3Stub:
 
             def log_message(self, *a):  # quiet
                 pass
+
+            def _fault(self, method):
+                """Consult the fault hook; returns True when the fault
+                fully handled (or dropped) the response, or the action
+                tuple for handler-specific kinds."""
+                if outer.fault_hook is None:
+                    return None
+                act = outer.fault_hook(method, self.path)
+                if not act:
+                    return None
+                kind = act[0]
+                if kind == "status":
+                    code_str = act[2] if len(act) > 2 else "InternalError"
+                    # consume the request body first: an unread body +
+                    # error response can surface as a broken pipe on
+                    # the client instead of the intended status
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)
+                    self._send(
+                        act[1],
+                        f"<Error><Code>{code_str}</Code></Error>".encode(),
+                    )
+                    return True
+                if kind == "reset":
+                    # No response at all + connection close: the client
+                    # observes RemoteDisconnected (a ConnectionError).
+                    self.close_connection = True
+                    return True
+                return act  # handler-specific ("truncate", "commit_then_status")
 
             def _path_query(self):
                 u = urllib.parse.urlsplit(self.path)
@@ -48,9 +90,11 @@ class S3Stub:
                 outer.auth_headers.append(self.headers.get("Authorization"))
 
             def _send(self, status, body=b"", ctype="application/xml",
-                      head_len=None):
+                      head_len=None, etag=None):
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
+                if etag:
+                    self.send_header("ETag", etag)
                 self.send_header(
                     "Content-Length",
                     str(head_len if head_len is not None else len(body)),
@@ -61,6 +105,8 @@ class S3Stub:
 
             def do_PUT(self):
                 self._record()
+                if self._fault("PUT") is True:
+                    return
                 path, q = self._path_query()
                 src = self.headers.get("x-amz-copy-source")
                 if src:
@@ -93,6 +139,9 @@ class S3Stub:
                             )
                             return
                         outer.objects[path] = sdata
+                        outer.etags[path] = (
+                            f'"{hashlib.md5(sdata).hexdigest()}"'
+                        )
                     self._send(200, b"<CopyObjectResult/>")
                     return
                 length = int(self.headers.get("Content-Length", 0))
@@ -117,10 +166,17 @@ class S3Stub:
                     return
                 with outer.lock:
                     outer.objects[path] = data
+                    outer.etags[path] = f'"{hashlib.md5(data).hexdigest()}"'
                 self._send(200)
 
             def do_POST(self):
                 self._record()
+                act = self._fault("POST")
+                if act is True:
+                    return
+                commit_then_status = (
+                    act[1] if act and act[0] == "commit_then_status" else None
+                )
                 path, q = self._path_query()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
@@ -165,7 +221,23 @@ class S3Stub:
                         outer.objects[path] = b"".join(
                             have[n] for n, _ in want
                         )
+                        # the real S3 multipart ETag form:
+                        # md5(concat(binary part md5s))-<nparts>
+                        bins = b"".join(
+                            hashlib.md5(have[n]).digest() for n, _ in want
+                        )
+                        outer.etags[path] = (
+                            f'"{hashlib.md5(bins).hexdigest()}-{len(want)}"'
+                        )
                         outer.completed_multiparts.append(path)
+                    if commit_then_status is not None:
+                        # committed server-side, response "lost": the
+                        # client must recover via ListParts + HEAD
+                        self._send(
+                            commit_then_status,
+                            b"<Error><Code>InternalError</Code></Error>",
+                        )
+                        return
                     self._send(
                         200,
                         b"<?xml version='1.0'?><CompleteMultipartUploadResult>"
@@ -176,14 +248,51 @@ class S3Stub:
 
             def do_GET(self):
                 self._record()
+                act = self._fault("GET")
+                if act is True:
+                    return
+                truncate_at = act[1] if act and act[0] == "truncate" else None
                 path, q = self._path_query()
                 if q.get("list-type") == ["2"]:
                     self._do_list(path.strip("/"), q)
+                    return
+                if "uploadId" in q:  # ListParts
+                    with outer.lock:
+                        up = outer.uploads.get(q["uploadId"][0])
+                        if up is None or up["path"] != path:
+                            self._send(
+                                404,
+                                b"<Error><Code>NoSuchUpload</Code></Error>",
+                            )
+                            return
+                        parts = "".join(
+                            f"<Part><PartNumber>{n}</PartNumber>"
+                            f'<ETag>"{hashlib.md5(d).hexdigest()}"</ETag>'
+                            f"</Part>"
+                            for n, d in sorted(up["parts"].items())
+                        )
+                    self._send(
+                        200,
+                        (f"<?xml version='1.0'?><ListPartsResult>{parts}"
+                         f"</ListPartsResult>").encode(),
+                    )
                     return
                 with outer.lock:
                     data = outer.objects.get(path)
                 if data is None:
                     self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
+                    return
+                if truncate_at is not None:
+                    # Full Content-Length, short body, dropped
+                    # connection: the client's read raises
+                    # IncompleteRead — a mid-body connection reset.
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data[:truncate_at])
+                    self.close_connection = True
                     return
                 rng = self.headers.get("Range")
                 if rng and rng.startswith("bytes="):
@@ -210,6 +319,8 @@ class S3Stub:
 
             def do_HEAD(self):
                 self._record()
+                if self._fault("HEAD") is True:
+                    return
                 path, _ = self._path_query()
                 with outer.lock:
                     data = outer.objects.get(path)
@@ -217,16 +328,20 @@ class S3Stub:
                     self._send(404, head_len=0)
                 else:
                     self._send(200, ctype="application/octet-stream",
-                               head_len=len(data))
+                               head_len=len(data),
+                               etag=outer.etags.get(path))
 
             def do_DELETE(self):
                 self._record()
+                if self._fault("DELETE") is True:
+                    return
                 path, q = self._path_query()
                 with outer.lock:
                     if "uploadId" in q:  # AbortMultipartUpload
                         outer.uploads.pop(q["uploadId"][0], None)
                     else:
                         outer.objects.pop(path, None)
+                        outer.etags.pop(path, None)
                 self._send(204)
 
             def _do_list(self, bucket, q):

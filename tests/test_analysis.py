@@ -60,6 +60,13 @@ FIXTURES = {
         def f(x):
             return np.asarray(x, dtype=np.float64)
     """,
+    "PTL006": """
+        def f(x):
+            try:
+                return x()
+            except Exception:
+                pass
+    """,
 }
 
 
@@ -109,6 +116,50 @@ def test_ell_deal_regression_fixture(tmp_path):
             return dealt
     """)
     assert lint_mod.lint_file(fixed) == []
+
+
+def test_ptl006_swallow_semantics(tmp_path):
+    """PTL006 boundaries: bare except ALWAYS flags unless it re-raises;
+    broad except flags only when the body is a pure swallow; narrow
+    handlers and real handling never flag (the allowlist — not rule
+    carve-outs — covers deliberate best-effort sites)."""
+    flagged = _write(tmp_path, "swallows.py", """
+        def a(x):
+            try:
+                return x()
+            except:            # bare, no re-raise -> flag
+                return None
+
+        def b(x):
+            try:
+                return x()
+            except BaseException:
+                ...            # pure swallow -> flag
+    """)
+    findings = [f for f in lint_mod.lint_file(flagged) if f.rule == "PTL006"]
+    assert len(findings) == 2, findings
+
+    clean = _write(tmp_path, "handled.py", """
+        def a(x):
+            try:
+                return x()
+            except:            # bare but re-raises -> clean
+                raise
+
+        def b(x, log):
+            try:
+                return x()
+            except Exception as e:   # broad but handled -> clean
+                log(e)
+                return None
+
+        def c(x):
+            try:
+                return x()
+            except KeyError:   # narrow swallow -> clean (deliberate)
+                pass
+    """)
+    assert [f for f in lint_mod.lint_file(clean) if f.rule == "PTL006"] == []
 
 
 def test_lanes_assignment_is_the_one_allowed_spelling(tmp_path):
@@ -199,6 +250,7 @@ def test_list_rules(capsys):
     text = capsys.readouterr().out
     assert rc == 0
     for rid in ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
+                "PTL006",
                 "PTC001", "PTC002", "PTC003", "PTC004", "PTC005",
                 "PTC006"):
         assert rid in text

@@ -1,0 +1,807 @@
+"""The fault-tolerance layer (ISSUE 3 / docs/ROBUSTNESS.md): retry
+policy in virtual time, deterministic seeded fault injection, snapshot
+checksums + corrupt-skip fallback, S3 wire-level retries against the
+stub (503-then-success, connection reset mid-body, non-blind multipart
+complete recovery), the async writer's retry / warn-and-drop / close
+semantics, and the end-to-end seeded chaos runs: same seed -> same
+fault schedule bit-for-bit, and a faulted run either matches the CPU
+oracle or fails loudly — never a silent drop or corruption.
+"""
+
+import json
+import random
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import PageRankConfig, ReferenceCpuEngine, build_graph
+from pagerank_tpu.engine import SolverHealthError
+from pagerank_tpu.testing.faults import (
+    FaultInjectedError,
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    HttpFaultInjector,
+)
+from pagerank_tpu.utils import fsio
+from pagerank_tpu.utils.config import RobustnessConfig
+from pagerank_tpu.utils.retry import RetryPolicy, RetryStats
+from pagerank_tpu.utils.s3 import S3FileSystem, _s3_retryable
+from pagerank_tpu.utils.snapshot import (
+    AsyncRankWriter,
+    SinkGuard,
+    SnapshotCorruptError,
+    Snapshotter,
+    TextDumper,
+    resume_engine,
+)
+
+from tests.s3stub import S3Stub
+
+
+class VirtualTime:
+    """Injectable clock/sleep: the whole backoff schedule runs in zero
+    wall-clock and every requested delay is recorded."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.now
+
+    def sleep(self, d):
+        self.sleeps.append(d)
+        self.now += d
+
+
+# -- RetryPolicy in virtual time -------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    vt = VirtualTime()
+    pol = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=1.0,
+                      seed=7, sleep=vt.sleep, clock=vt.clock)
+    stats = RetryStats()
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 4:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    assert pol.call(flaky, stats=stats) == "ok"
+    assert state["n"] == 4
+    assert stats.attempts == 4 and stats.retries == 3
+    assert len(vt.sleeps) == 3 and vt.now == pytest.approx(stats.slept)
+
+
+def test_retry_backoff_is_seeded_full_jitter():
+    """The jitter stream is a pure function of the seed: delays are
+    uniform(0, min(max_delay, base * 2**k)) drawn from random.Random —
+    reproduced here draw for draw (virtual-time backoff assertion)."""
+    vt = VirtualTime()
+    pol = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=1.0,
+                      seed=42, sleep=vt.sleep, clock=vt.clock)
+
+    def always_fail():
+        raise TimeoutError("nope")
+
+    with pytest.raises(TimeoutError):
+        pol.call(always_fail)
+    ref = random.Random(42)
+    expected = [ref.uniform(0.0, min(1.0, 0.1 * 2 ** k)) for k in range(4)]
+    assert vt.sleeps == expected
+    # same seed, fresh policy -> the identical schedule, bit for bit
+    vt2 = VirtualTime()
+    pol2 = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=1.0,
+                       seed=42, sleep=vt2.sleep, clock=vt2.clock)
+    with pytest.raises(TimeoutError):
+        pol2.call(always_fail)
+    assert vt2.sleeps == vt.sleeps
+
+
+def test_retry_non_retryable_raises_immediately():
+    vt = VirtualTime()
+    pol = RetryPolicy(max_attempts=5, sleep=vt.sleep, clock=vt.clock)
+    calls = {"n": 0}
+
+    def semantic():
+        calls["n"] += 1
+        raise FileNotFoundError("missing key")
+
+    with pytest.raises(FileNotFoundError):
+        pol.call(semantic)
+    assert calls["n"] == 1 and vt.sleeps == []
+
+
+def test_retry_deadline_bounds_the_schedule():
+    vt = VirtualTime()
+    pol = RetryPolicy(max_attempts=50, base_delay=1.0, max_delay=1.0,
+                      deadline=2.5, seed=0, sleep=vt.sleep, clock=vt.clock)
+    calls = {"n": 0}
+
+    def fail():
+        calls["n"] += 1
+        raise ConnectionError("x")
+
+    with pytest.raises(ConnectionError):
+        pol.call(fail)
+    assert vt.now <= 2.5
+    assert calls["n"] < 50  # the deadline, not the attempt cap, stopped it
+
+
+# -- FaultSchedule determinism ---------------------------------------------
+
+
+def test_fault_schedule_same_seed_same_decisions():
+    def drive(seed):
+        s = FaultSchedule(seed=seed, fail_rate=0.2, truncate_rate=0.1,
+                          max_faults=10)
+        for i in range(50):
+            s.decide("open_r" if i % 2 else "commit", f"p{i}")
+        return s.log
+
+    assert drive(11) == drive(11)
+    assert drive(11) != drive(12)
+
+
+def test_fault_injecting_fs_fail_nth_is_transient_and_logged():
+    inner = fsio.MemoryFileSystem()
+    sched = FaultSchedule(seed=0, fail_nth=(2,))
+    fs = FaultInjectingFileSystem(inner, sched)
+    with fs.open("mock://d/a", "wb") as f:  # commit = call 1
+        f.write(b"x")
+    with pytest.raises(FaultInjectedError):
+        fs.open("mock://d/a", "rb")  # open_r = call 2 -> injected
+    with fs.open("mock://d/a", "rb") as f:  # call 3: clean again
+        assert f.read() == b"x"
+    assert [a for _, _, _, a in sched.log] == ["-", "fail", "-"]
+    # an injected fault is retryable by the default policy
+    assert RetryPolicy().retryable(FaultInjectedError("x"))
+
+
+def test_fault_fs_truncate_on_write_publishes_detectable_corruption():
+    """A truncated snapshot write is PUBLISHED (the store can't know)
+    but the checksum catches it at load — the never-silently-corrupt
+    contract."""
+    inner = fsio.MemoryFileSystem()
+    # call 1 = makedirs (Snapshotter init), call 2 = the save's commit
+    sched = FaultSchedule(seed=3, truncate_nth=(2,), ops=("commit",))
+    fsio.register("chaos", FaultInjectingFileSystem(inner, sched))
+    try:
+        s = Snapshotter("chaos://ck", "fp", "reference")
+        s.save(1, np.arange(64, dtype=np.float64))
+        assert s.iterations() == [1]
+        with pytest.raises(SnapshotCorruptError):
+            s.load(1)
+        assert s.load_latest_valid() is None
+    finally:
+        fsio.unregister("chaos")
+
+
+# -- snapshot checksums + corrupt-skip fallback ----------------------------
+
+
+def toy_graph(seed=0, n=60, e=400):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+CFG = PageRankConfig(num_iters=10, dtype="float64", accum_dtype="float64")
+
+
+def test_snapshot_checksum_detects_content_tamper(tmp_path):
+    """A VALID npz whose ranks were swapped after checksumming (valid
+    zip, wrong bytes) must fail the checksum — not just zip CRC."""
+    s = Snapshotter(str(tmp_path), "fp", "reference")
+    s.save(3, np.arange(8, dtype=np.float64))
+    with fsio.fopen(s.path(3), "rb") as f, np.load(f) as z:
+        stored = bytes(z["checksum"])
+    with fsio.fopen(s.path(3), "wb") as f:
+        np.savez(f, ranks=np.zeros(8), iteration=np.int64(3),
+                 fingerprint=np.bytes_(b"fp"),
+                 semantics=np.bytes_(b"reference"),
+                 checksum=np.bytes_(stored))
+    with pytest.raises(SnapshotCorruptError, match="checksum"):
+        s.load(3)
+
+
+def test_snapshot_garbage_and_truncation_detected(tmp_path):
+    s = Snapshotter(str(tmp_path), "fp", "reference")
+    s.save(2, np.ones(16))
+    raw = (tmp_path / "ranks_iter2.npz").read_bytes()
+    (tmp_path / "ranks_iter2.npz").write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(SnapshotCorruptError):
+        s.load(2)
+    (tmp_path / "ranks_iter2.npz").write_bytes(b"not a zip at all")
+    with pytest.raises(SnapshotCorruptError):
+        s.load(2)
+
+
+def test_resume_falls_back_to_newest_valid_snapshot(tmp_path):
+    g = toy_graph()
+    s = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    vecs = {i: np.full(g.n, float(i)) for i in (1, 2, 3, 4)}
+    for i, v in vecs.items():
+        s.save(i, v)
+    # newest corrupt (garbage), next truncated -> fall back to 2
+    (tmp_path / "ranks_iter4.npz").write_bytes(b"garbage")
+    raw = (tmp_path / "ranks_iter3.npz").read_bytes()
+    (tmp_path / "ranks_iter3.npz").write_bytes(raw[:40])
+    eng = ReferenceCpuEngine(CFG).build(g)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert resume_engine(eng, s) == 2
+    np.testing.assert_array_equal(eng.ranks(), vecs[2])
+    # all corrupt -> clean no-resume, never a crash
+    for i in (1, 2):
+        (tmp_path / f"ranks_iter{i}.npz").write_bytes(b"junk")
+    eng2 = ReferenceCpuEngine(CFG).build(g)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert resume_engine(eng2, s) == 0
+
+
+# -- self-healing solve loop -----------------------------------------------
+
+
+def _nan_bomb(eng, at_iteration, repeat=False):
+    """Wrap eng.step: poison the solver state (and the step info) the
+    first time iteration ``at_iteration`` executes — a transient
+    soft-error model. ``repeat`` poisons EVERY attempt (persistent)."""
+    orig = eng.step
+    state = {"fired": 0}
+
+    def step():
+        info = orig()
+        if eng.iteration == at_iteration and (repeat or not state["fired"]):
+            state["fired"] += 1
+            eng._r = eng._r * np.nan
+            return {k: float("nan") for k in info}
+        return info
+
+    eng.step = step
+    return state
+
+
+def test_self_healing_run_recovers_from_transient_nan(tmp_path):
+    g = toy_graph()
+    full = ReferenceCpuEngine(CFG).build(g).run()
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    eng = ReferenceCpuEngine(CFG).build(g)
+    _nan_bomb(eng, at_iteration=5)
+    r = eng.run(
+        on_iteration=lambda i, info: snap.save(i + 1, eng.ranks()),
+        snapshotter=snap,
+    )
+    assert eng.health["rollbacks"] == 1
+    assert eng.health["first_bad_iteration"] == 5
+    np.testing.assert_allclose(r, full, rtol=0, atol=1e-12)
+
+
+def test_unhealthy_step_without_snapshotter_raises(tmp_path):
+    g = toy_graph()
+    eng = ReferenceCpuEngine(CFG).build(g)
+    _nan_bomb(eng, at_iteration=2)
+    with pytest.raises(SolverHealthError, match="iteration 2") as ei:
+        eng.run()
+    assert ei.value.first_bad_iteration == 2 and ei.value.rollbacks == 0
+
+
+def test_persistent_fault_exhausts_budget_names_first_bad_iteration(tmp_path):
+    g = toy_graph()
+    cfg = CFG.replace(robustness=RobustnessConfig(max_rollbacks=2))
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    eng = ReferenceCpuEngine(cfg).build(g)
+    _nan_bomb(eng, at_iteration=3, repeat=True)
+    with pytest.raises(SolverHealthError, match="first bad iteration 3") as ei:
+        eng.run(
+            on_iteration=lambda i, info: snap.save(i + 1, eng.ranks()),
+            snapshotter=snap,
+        )
+    assert ei.value.rollbacks == 2
+    assert "budget (2) exhausted" in str(ei.value)
+
+
+def test_mass_drift_check_triggers_rollback(tmp_path):
+    g = toy_graph()
+    full = ReferenceCpuEngine(CFG).build(g).run()
+    cfg = CFG.replace(robustness=RobustnessConfig(mass_tol=0.5))
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    eng = ReferenceCpuEngine(cfg).build(g)
+    orig = eng.step
+    state = {"fired": False}
+
+    def step():
+        info = orig()
+        if eng.iteration == 4 and not state["fired"]:
+            state["fired"] = True
+            eng._r = eng._r * 3.0  # finite info, silently inflated mass
+        return info
+
+    eng.step = step
+    r = eng.run(
+        on_iteration=lambda i, info: snap.save(i + 1, eng.ranks()),
+        snapshotter=snap,
+    )
+    assert eng.health["rollbacks"] == 1
+    np.testing.assert_allclose(r, full, rtol=0, atol=1e-12)
+
+
+# -- S3 retries against the stub -------------------------------------------
+
+
+@pytest.fixture
+def s3rt():
+    """Stub + filesystem whose retry policy runs on a virtual sleep (no
+    real backoff wall-clock) with a pinned jitter seed."""
+    with S3Stub() as stub:
+        vt = VirtualTime()
+        fs = S3FileSystem(
+            stub.endpoint,
+            retry_policy=RetryPolicy(
+                max_attempts=4, base_delay=0.05, max_delay=0.5, seed=5,
+                retryable=_s3_retryable, sleep=vt.sleep,
+            ),
+        )
+        yield stub, fs, vt
+
+
+def test_s3_503_slowdown_then_success(s3rt):
+    stub, fs, vt = s3rt
+    hits = {"PUT": 0, "GET": 0}
+
+    def hook(method, path):
+        if path == "/b/k" and method in hits:
+            hits[method] += 1
+            if hits[method] == 1:
+                return ("status", 503, "SlowDown")
+        return None
+
+    stub.fault_hook = hook
+    with fs.open("s3://b/k", "wb") as f:
+        f.write(b"payload")
+    assert stub.objects["/b/k"] == b"payload"
+    with fs.open("s3://b/k", "rb") as f:
+        assert f.read() == b"payload"
+    assert hits == {"PUT": 2, "GET": 2}
+    assert fs.retry_stats.retries == 2
+    assert vt.sleeps and len(vt.sleeps) == 2  # backoff was virtual
+
+
+def test_s3_connection_reset_mid_body_retries(s3rt):
+    stub, fs, vt = s3rt
+    with fs.open("s3://b/big", "wb") as f:
+        f.write(bytes(range(256)) * 8)
+    state = {"n": 0}
+
+    def hook(method, path):
+        if method == "GET" and path == "/b/big":
+            state["n"] += 1
+            if state["n"] == 1:
+                return ("truncate", 100)  # full length, short body
+        return None
+
+    stub.fault_hook = hook
+    with fs.open("s3://b/big", "rb") as f:
+        assert f.read() == bytes(range(256)) * 8
+    assert state["n"] >= 2 and fs.retry_stats.retries >= 1
+
+
+def test_s3_dropped_connection_retries(s3rt):
+    stub, fs, vt = s3rt
+    stub.objects["/b/x"] = b"here"
+    state = {"n": 0}
+
+    def hook(method, path):
+        if method == "HEAD":
+            state["n"] += 1
+            if state["n"] == 1:
+                return ("reset",)  # no response at all
+        return None
+
+    stub.fault_hook = hook
+    assert fs.isfile("s3://b/x")
+    assert state["n"] == 2
+
+
+def test_s3_multipart_complete_transient_then_relist_and_recomplete(s3rt):
+    stub, fs, vt = s3rt
+    fs.MULTIPART_PART_SIZE = 1024
+    state = {"n": 0}
+
+    def hook(method, path):
+        if method == "POST" and "uploadId=" in path:
+            state["n"] += 1
+            if state["n"] == 1:
+                return ("status", 500)
+        return None
+
+    stub.fault_hook = hook
+    data = bytes(range(256)) * 17  # 5 parts
+    with fs.open("s3://b/big.bin", "wb") as f:
+        f.write(data)
+    assert stub.objects["/b/big.bin"] == data
+    assert state["n"] == 2  # re-completed only after a parts re-list
+    assert not stub.uploads
+
+
+def test_s3_multipart_complete_committed_but_response_lost(s3rt):
+    """The non-idempotent case: the first complete COMMITS server-side
+    but its response is lost. The client must NOT blindly re-POST (the
+    upload is gone); it re-lists parts, sees NoSuchUpload, verifies the
+    object exists, and treats the upload as done."""
+    stub, fs, vt = s3rt
+    fs.MULTIPART_PART_SIZE = 1024
+    state = {"n": 0}
+
+    def hook(method, path):
+        if method == "POST" and "uploadId=" in path:
+            state["n"] += 1
+            if state["n"] == 1:
+                return ("commit_then_status", 500)
+        return None
+
+    stub.fault_hook = hook
+    data = b"q" * 5000
+    with fs.open("s3://b/once.bin", "wb") as f:
+        f.write(data)
+    assert stub.objects["/b/once.bin"] == data
+    assert state["n"] == 1  # never re-POSTed the complete
+    assert stub.completed_multiparts == ["/b/once.bin"]
+
+
+# -- AsyncRankWriter: retries, drop policy, close path ---------------------
+
+
+def test_async_writer_retries_transient_sink_failures():
+    seen = []
+    state = {"n": 0}
+
+    def flaky_sink(i, r):
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionResetError("blip")
+        seen.append((i, float(r[0])))
+
+    guard = SinkGuard(retry_policy=RetryPolicy(max_attempts=5, base_delay=0.0))
+    with AsyncRankWriter(lambda p: p, [flaky_sink], guard=guard) as w:
+        w.submit(0, np.ones(2))
+    assert seen == [(0, 1.0)]
+    assert guard.retries == 2 and guard.dropped == []
+
+
+def test_async_writer_warn_and_drop_writes_dead_letter(tmp_path):
+    dead = str(tmp_path / "dead_letter.json")
+
+    def doomed_sink(i, r):
+        raise IOError(f"disk full at {i}")
+
+    guard = SinkGuard(
+        retry_policy=RetryPolicy(max_attempts=2, base_delay=0.0),
+        on_failure="warn_and_drop", dead_letter_path=dead,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with AsyncRankWriter(lambda p: p, [doomed_sink], guard=guard) as w:
+            for i in range(3):
+                w.submit(i, np.ones(2))
+        # close() did NOT raise: the run survives, the drops are recorded
+    assert [d["iteration"] for d in guard.dropped] == [0, 1, 2]
+    manifest = json.loads((tmp_path / "dead_letter.json").read_text())
+    assert [d["iteration"] for d in manifest["dropped"]] == [0, 1, 2]
+    assert all("disk full" in d["error"] for d in manifest["dropped"])
+
+
+def test_async_writer_error_after_final_submit_surfaces_at_exit():
+    """Regression (ISSUE 3 satellite): a worker failure that lands
+    AFTER the last submit must surface from close()/__exit__ — there is
+    no later submit to observe it."""
+    gate = threading.Event()
+
+    def late_sink(i, r):
+        gate.wait(timeout=10)
+        raise IOError("late boom")
+
+    with pytest.raises(RuntimeError, match="late boom"):
+        with AsyncRankWriter(lambda p: p, [late_sink]) as w:
+            w.submit(0, np.ones(2))
+            gate.set()  # the failure happens strictly after this submit
+    # close is idempotent AND keeps re-raising: no later caller path
+    # (e.g. an outer finally) can exit cleanly over the lost write
+    with pytest.raises(RuntimeError, match="late boom"):
+        w.close()
+    with pytest.raises(RuntimeError, match="submit\\(\\) after close"):
+        w.submit(1, np.ones(2))
+
+
+def test_cli_warn_and_drop_keeps_run_alive(tmp_path, monkeypatch):
+    """CLI integration: a persistently failing snapshot write under
+    --on-write-failure warn_and_drop completes the run, records the
+    dropped iterations in dead_letter.json, and still writes the
+    healthy snapshots."""
+    from pagerank_tpu import cli as cli_mod
+    from pagerank_tpu.utils import snapshot as snap_mod
+
+    edges = tmp_path / "e.txt"
+    edges.write_text("0 1\n1 2\n2 0\n")
+    real_save = snap_mod.Snapshotter.save
+
+    def failing_save(self, iteration, ranks):
+        if iteration >= 4:
+            raise IOError("disk full")
+        return real_save(self, iteration, ranks)
+
+    monkeypatch.setattr(snap_mod.Snapshotter, "save", failing_save)
+    sd = tmp_path / "s"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        rc = cli_mod.main([
+            "--input", str(edges), "--iters", "5",
+            "--snapshot-dir", str(sd), "--log-every", "0",
+            "--on-write-failure", "warn_and_drop", "--write-retries", "1",
+        ])
+    assert rc == 0
+    manifest = json.loads((sd / "dead_letter.json").read_text())
+    assert [d["iteration"] for d in manifest["dropped"]] == [3, 4]
+    assert sorted(p.name for p in sd.iterdir()) == [
+        "dead_letter.json", "ranks_iter1.npz", "ranks_iter2.npz",
+        "ranks_iter3.npz",
+    ]
+
+
+def test_text_dump_failure_leaves_no_parseable_part(tmp_path, monkeypatch):
+    """A dump killed mid-write must never leave a parseable-looking
+    part-00000 (satellite: TextDumper rides the same atomic
+    tmp+rename path as Snapshotter.save)."""
+    import pagerank_tpu.ingest.native as native_mod
+
+    d = TextDumper(str(tmp_path / "dumps"))
+    calls = {"n": 0}
+
+    def dying_formatter(*a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise OSError("killed mid-dump")
+        return b"(0,1.0)\n" * 2
+
+    monkeypatch.setattr(native_mod, "format_rank_lines_native",
+                        dying_formatter)
+    monkeypatch.setattr(TextDumper, "CHUNK_ROWS", 2)
+    with pytest.raises(OSError, match="killed mid-dump"):
+        d.dump(0, np.ones(6))
+    out = tmp_path / "dumps" / "PageRank0"
+    assert not (out / "part-00000").exists()
+    assert not (out / "_SUCCESS").exists()
+
+
+def test_sink_guard_never_swallows_interrupts():
+    """warn_and_drop applies to write FAILURES only: a
+    KeyboardInterrupt/SystemExit raised during a sink write must
+    propagate, never be dead-lettered."""
+    guard = SinkGuard(on_failure="warn_and_drop")
+
+    def interrupted():
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        guard(0, interrupted)
+    assert guard.dropped == []
+
+
+def test_rollback_scan_skips_foreign_graph_snapshots(tmp_path):
+    """match=True (the rollback contract): a snapshot from a different
+    graph or semantics in a reused directory is skipped like
+    corruption — never restored into the solver."""
+    s_old = Snapshotter(str(tmp_path), "other-graph", "reference")
+    s_old.save(5, np.ones(8))
+    s = Snapshotter(str(tmp_path), "this-graph", "reference")
+    s.save(2, np.full(8, 2.0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        found = s.load_latest_valid(match=True)
+    assert found is not None and found[0] == 2  # skipped the foreign 5
+    # without match (the resume path) the newest still surfaces, so
+    # resume_engine can RAISE on the mismatch instead of starting over
+    assert s.load_latest_valid()[0] == 5
+
+
+def test_writer_synced_snapshotter_drains_queue_before_scan(tmp_path):
+    """Rollback must not race snapshots still in the async writer's
+    queue: the WriterSyncedSnapshotter flushes first, so the scan sees
+    every already-submitted iteration."""
+    import time
+
+    from pagerank_tpu.utils.snapshot import WriterSyncedSnapshotter
+
+    snap = Snapshotter(str(tmp_path), "fp", "reference")
+
+    def slow_save(i, ranks):
+        time.sleep(0.05)
+        snap.save(i + 1, ranks)
+
+    with AsyncRankWriter(lambda p: p, [slow_save]) as w:
+        for i in range(3):
+            w.submit(i, np.full(4, float(i)))
+        synced = WriterSyncedSnapshotter(snap, w)
+        found = synced.load_latest_valid(max_iteration=3)
+        assert found is not None and found[0] == 3
+        assert synced.fingerprint == "fp" and synced.semantics == "reference"
+
+
+def test_s3_retry_policy_none_disables_retries():
+    with S3Stub() as stub:
+        fs = S3FileSystem(stub.endpoint, retry_policy=None)
+        calls = {"n": 0}
+
+        def hook(method, path):
+            calls["n"] += 1
+            return ("status", 503, "SlowDown")
+
+        stub.fault_hook = hook
+        with pytest.raises(OSError, match="503"):
+            with fs.open("s3://b/k", "wb") as f:
+                f.write(b"x")
+        assert calls["n"] == 1  # one attempt, no retry
+
+
+def test_s3_multipart_lost_complete_with_stale_object_raises(s3rt):
+    """Upload vanishes without committing (e.g. a lifecycle abort)
+    while a PREVIOUS version of the key exists: mere key existence must
+    not pass for success — the ETag check refuses, the caller sees the
+    failure instead of trusting stale bytes."""
+    stub, fs, vt = s3rt
+    fs.MULTIPART_PART_SIZE = 1024
+    stale = b"old snapshot content"
+    with fs.open("s3://b/snap.bin", "wb") as f:
+        f.write(stale)
+
+    def hook(method, path):
+        if method == "POST" and "uploadId=" in path:
+            with stub.lock:  # server-side abort + transient answer
+                stub.uploads.clear()
+            return ("status", 500)
+        return None
+
+    stub.fault_hook = hook
+    with pytest.raises(OSError, match="verifiable commit"):
+        with fs.open("s3://b/snap.bin", "wb") as f:
+            f.write(b"n" * 5000)
+    assert stub.objects["/b/snap.bin"] == stale  # untouched
+
+
+# -- seeded chaos runs (the acceptance criterion) --------------------------
+
+
+def _fs_chaos_run(seed):
+    """Full run() with per-iteration snapshots through a seeded
+    FaultInjectingFileSystem: finite fault budget below the retry
+    budget, so the run must complete. Returns (ranks, schedule log,
+    snapshot validity map)."""
+    inner = fsio.MemoryFileSystem()
+    sched = FaultSchedule(seed=seed, fail_rate=0.08, truncate_rate=0.04,
+                          max_faults=8)
+    fs = FaultInjectingFileSystem(inner, sched, sleep=lambda s: None)
+    fsio.register("chaos", fs)
+    try:
+        g = toy_graph(seed=1)
+        snap = Snapshotter("chaos://run/ck", g.fingerprint(), "reference")
+        guard = SinkGuard(
+            retry_policy=RetryPolicy(max_attempts=6, base_delay=0.0, seed=seed)
+        )
+        eng = ReferenceCpuEngine(CFG).build(g)
+        ranks = eng.run(
+            on_iteration=lambda i, info: guard(
+                i, lambda: snap.save(i + 1, eng.ranks())
+            ),
+            snapshotter=snap,
+        )
+        validity = {}
+        for it in snap.iterations():
+            try:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    r, _ = snap.load(it)
+                validity[it] = ("valid", r)
+            except SnapshotCorruptError:
+                validity[it] = ("corrupt", None)
+        return ranks, list(sched.log), validity, guard
+    finally:
+        fsio.unregister("chaos")
+
+
+def test_fs_chaos_run_completes_correct_and_reproducible():
+    r1, log1, validity1, guard1 = _fs_chaos_run(seed=23)
+    r2, log2, validity2, _ = _fs_chaos_run(seed=23)
+    # same seed -> the same fault schedule, bit for bit
+    assert log1 == log2
+    assert any(a != "-" for _, _, _, a in log1), "chaos run injected nothing"
+    # faulted runs still produce ORACLE ranks
+    oracle = ReferenceCpuEngine(CFG).build(toy_graph(seed=1)).run()
+    np.testing.assert_allclose(r1, oracle, rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(r1, r2)
+    # never a silent drop or corruption: every iteration's snapshot is
+    # present (retries beat the fault budget) and every one that loads
+    # matches the true trajectory; truncated ones are DETECTED
+    assert sorted(validity1) == list(range(1, CFG.num_iters + 1))
+    eng = ReferenceCpuEngine(CFG).build(toy_graph(seed=1))
+    for it in range(1, CFG.num_iters + 1):
+        eng.step()
+        state, r = validity1[it]
+        if state == "valid":
+            np.testing.assert_array_equal(r, eng.ranks())
+
+
+def _s3_chaos_run(seed):
+    """The acceptance-criteria chaos run: snapshots live in an
+    S3-protocol store whose wire randomly answers 5xx/SlowDown
+    (seeded), the snapshot directory is CORRUPTED mid-run (garbage +
+    truncation), and the solver state is poisoned with NaN — the run
+    must roll back past the corrupt snapshots, retry the faulted
+    requests, and land on oracle ranks."""
+    with S3Stub() as stub:
+        inj = HttpFaultInjector(seed=seed, fail_rate=0.04, max_faults=10)
+        stub.fault_hook = inj
+        fs = S3FileSystem(
+            stub.endpoint,
+            retry_policy=RetryPolicy(
+                max_attempts=6, base_delay=0.0, max_delay=0.0, seed=seed,
+                retryable=_s3_retryable, sleep=lambda s: None,
+            ),
+        )
+        fsio.register("s3", fs)
+        try:
+            g = toy_graph(seed=2)
+            snap = Snapshotter("s3://ck/run", g.fingerprint(), "reference")
+            eng = ReferenceCpuEngine(CFG).build(g)
+            orig = eng.step
+            state = {"fired": False}
+
+            def step():
+                info = orig()
+                if eng.iteration == 7 and not state["fired"]:
+                    state["fired"] = True
+                    # corrupt the snapshot directory mid-run: newest
+                    # garbage, next truncated...
+                    with fsio.fopen(snap.path(7), "wb") as f:
+                        f.write(b"garbage, not a zip")
+                    with fsio.fopen(snap.path(6), "rb") as f:
+                        raw = f.read()
+                    with fsio.fopen(snap.path(6), "wb") as f:
+                        f.write(raw[: len(raw) // 3])
+                    # ...and poison the solver state
+                    eng._r = eng._r * np.nan
+                    return {k: float("nan") for k in info}
+                return info
+
+            eng.step = step
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                ranks = eng.run(
+                    on_iteration=lambda i, info: snap.save(
+                        i + 1, eng.ranks()
+                    ),
+                    snapshotter=snap,
+                )
+            return ranks, list(inj.log), dict(eng.health), fs.retry_stats
+        finally:
+            fsio.unregister("s3")
+
+
+def test_s3_chaos_run_matches_oracle_and_reproduces_bit_for_bit():
+    r1, log1, health1, stats1 = _s3_chaos_run(seed=37)
+    r2, log2, health2, _ = _s3_chaos_run(seed=37)
+    # bit-for-bit reproducible wire-fault schedule across two runs
+    assert log1 == log2
+    assert any(a != "-" for _, _, _, a in log1), "no S3 faults injected"
+    assert stats1.retries > 0, "no request was actually retried"
+    # rollback skipped the corrupted 7/6 snapshots (fell back to 5)
+    assert health1["rollbacks"] == 1
+    assert health1["first_bad_iteration"] == 7
+    # faulted, corrupted, rolled-back run still lands on oracle ranks
+    oracle = ReferenceCpuEngine(CFG).build(toy_graph(seed=2)).run()
+    np.testing.assert_allclose(r1, oracle, rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(r1, r2)

@@ -136,3 +136,39 @@ def test_sigkill_mid_run_then_resume(tmp_path, fused):
     a = np.load(snap_dir / "ranks_iter40.npz")["ranks"]
     b = np.load(ctrl_dir / "ranks_iter40.npz")["ranks"]
     np.testing.assert_array_equal(a, b)
+
+
+def test_resume_skips_corrupted_latest_snapshot(tmp_path, capsys):
+    """Chaos variant of kill-and-resume (ISSUE 3): the newest snapshot
+    is CORRUPTED after the 'crash'. --resume must detect it via the
+    content checksum, fall back to the newest valid iteration, and
+    still land on the exact ranks of an uninterrupted run."""
+    import warnings
+
+    from pagerank_tpu.cli import main
+
+    rng = np.random.default_rng(7)
+    edges = tmp_path / "e.txt"
+    edges.write_text(
+        "".join(f"{s} {d}\n" for s, d in
+                zip(rng.integers(0, 200, 1500), rng.integers(0, 200, 1500)))
+    )
+    sd = tmp_path / "snaps"
+    base = ["--input", str(edges), "--dtype", "float64",
+            "--accum-dtype", "float64", "--log-every", "0"]
+    # phase 1: 5 iterations, then "crash" and corrupt the newest
+    assert main(base + ["--iters", "5", "--snapshot-dir", str(sd)]) == 0
+    raw = (sd / "ranks_iter5.npz").read_bytes()
+    (sd / "ranks_iter5.npz").write_bytes(raw[: len(raw) // 2])
+    # phase 2: resume to 8 — must fall back to iteration 4
+    capsys.readouterr()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert main(base + ["--iters", "8", "--snapshot-dir", str(sd),
+                            "--resume"]) == 0
+    assert "resumed from iteration 4" in capsys.readouterr().err
+    ctrl = tmp_path / "ctrl"
+    assert main(base + ["--iters", "8", "--snapshot-dir", str(ctrl)]) == 0
+    a = np.load(sd / "ranks_iter8.npz")["ranks"]
+    b = np.load(ctrl / "ranks_iter8.npz")["ranks"]
+    np.testing.assert_array_equal(a, b)
