@@ -140,6 +140,18 @@ def run_build(scale, edge_factor=16, dtype="float32", accum_dtype=None,
     return {"build_s": build_s, "stages": stages, "num_edges": num_edges}
 
 
+def _env_fingerprint():
+    """Environment fingerprint embedded in every bench JSON artifact
+    (obs/report.py): jax/jaxlib version, backend + device kind, x64,
+    git rev. BENCH_r*.json cells recorded with this field are
+    comparable across backend drift — the r5 failure mode, where an
+    hour-scale backend degradation contaminated cells and had to be
+    controlled for by hand (VERDICT r5; docs/OBSERVABILITY.md)."""
+    from pagerank_tpu.obs import environment_fingerprint
+
+    return environment_fingerprint()
+
+
 def _enable_compile_cache():
     """Persist XLA executables across bench runs — the graph-build and
     step compiles are ~2 minutes of the wall-clock otherwise (shared
@@ -378,6 +390,7 @@ def main(argv=None):
                    "pair_over_f32": pair["build_s"] / f32["build_s"],
                    "pair_warm_over_f32":
                        pair_warm["build_s"] / f32["build_s"]}
+        out["env"] = _env_fingerprint()
         print(json.dumps(out))
         return
 
@@ -393,6 +406,7 @@ def main(argv=None):
         }
         if not args.no_accuracy:
             out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
+        out["env"] = _env_fingerprint()
         print(json.dumps(out))
         return
 
@@ -425,6 +439,7 @@ def main(argv=None):
         )["build_s"]
     if not args.no_accuracy:
         out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
+    out["env"] = _env_fingerprint()
     print(json.dumps(out))
 
 

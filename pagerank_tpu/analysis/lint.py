@@ -298,12 +298,43 @@ def rule_ptl006(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]
             )
 
 
+def rule_ptl007(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL007: bare ``print(...)`` / direct ``sys.stderr.write`` /
+    ``sys.stdout.write`` in LIBRARY modules (scope excludes CLI entry
+    points: ``cli.py`` and ``*/__main__.py``). Ad-hoc prints bypass the
+    observability layer — they never land in traces or run reports and
+    cannot be silenced as a unit; telemetry flows through
+    ``pagerank_tpu.obs`` (spans, metrics, ``obs.log``) instead. The
+    deliberate exceptions (MetricsLogger's per-iteration stream,
+    obs/log.py's own stderr write) carry allowlist entries."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name == "print":
+            yield Finding(
+                "PTL007", path, node.lineno,
+                "bare print() in a library module: route diagnostics "
+                "through pagerank_tpu.obs (obs.log / spans / metrics)",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+        elif name in ("sys.stderr.write", "sys.stdout.write"):
+            yield Finding(
+                "PTL007", path, node.lineno,
+                f"direct {name} in a library module: route diagnostics "
+                "through pagerank_tpu.obs (obs.log / spans / metrics)",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+
+
 RuleFn = Callable[[ast.AST, str, List[str]], Iterable[Finding]]
 
 # rule id -> (fn, scope, one-line description). Scopes:
 #   ops     — files under ops/
 #   kernel  — ops/ plus the jax engines (the modules that trace device code)
 #   all     — every package file
+#   library — every package file EXCEPT CLI entry points (cli.py,
+#             */__main__.py), which legitimately print to the terminal
 RULES: Dict[str, Tuple[RuleFn, str, str]] = {
     "PTL001": (rule_ptl001, "ops",
                "magic lane-geometry constants outside LANES"),
@@ -316,6 +347,8 @@ RULES: Dict[str, Tuple[RuleFn, str, str]] = {
                "float64 literals outside config-gated paths"),
     "PTL006": (rule_ptl006, "all",
                "bare/broad exception swallows"),
+    "PTL007": (rule_ptl007, "library",
+               "bare print()/sys.std*.write outside CLI entry points"),
 }
 
 _KERNEL_FILES = ("engines/jax_engine.py", "engines/ppr.py")
@@ -328,6 +361,8 @@ def _scope_match(scope: str, rel: str) -> bool:
         return rel.startswith("ops/")
     if scope == "kernel":
         return rel.startswith("ops/") or rel in _KERNEL_FILES
+    if scope == "library":
+        return rel != "cli.py" and not rel.endswith("__main__.py")
     raise ValueError(f"unknown rule scope {scope!r}")
 
 

@@ -20,7 +20,7 @@ import time
 
 import numpy as np
 
-from pagerank_tpu import PageRankConfig, build_graph, make_engine
+from pagerank_tpu import PageRankConfig, build_graph, make_engine, obs
 from pagerank_tpu.utils import fsio
 from pagerank_tpu.utils.metrics import MetricsLogger
 from pagerank_tpu.utils.snapshot import Snapshotter, TextDumper, resume_engine
@@ -171,7 +171,28 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--log-every", type=int, default=1, help="0 silences per-iter logs")
     p.add_argument("--jsonl", default=None, help="append per-iter metrics to this JSONL file")
-    p.add_argument("--profile-dir", default=None, help="write a jax.profiler trace here")
+    ob = p.add_argument_group("observability (docs/OBSERVABILITY.md)")
+    ob.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="record a span trace of the whole run and export it here: "
+        "Chrome trace-event JSON (open in Perfetto / chrome://tracing), "
+        "or span-per-line JSONL when PATH ends in .jsonl. Enabling "
+        "tracing also engages the device build's per-stage fences "
+        "(stages serialize — the same observer effect as bench.py "
+        "--build-only)",
+    )
+    ob.add_argument(
+        "--run-report", default=None, metavar="PATH",
+        help="write the run flight-recorder JSON here: environment "
+        "fingerprint (jax/backend/device/x64/git), resolved config, "
+        "span summary, metrics-registry snapshot, per-iteration "
+        "history, robustness summary. Implies tracing. Inspect/diff "
+        "with `python -m pagerank_tpu.obs report A.json [B.json]`",
+    )
+    ob.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace of the solve here "
+                    "(obs.profiler_session: stopped on every exit path, "
+                    "recorded as a 'profile' span when tracing)")
     p.add_argument("--strict-parse", action="store_true", help="crawl mode: die on bad records")
     p.add_argument(
         "--ingest-workers", type=int, default=None,
@@ -293,6 +314,11 @@ def reject_ppr_incompatible_flags(args) -> None:
             ("--dump-text-dir", args.dump_text_dir is not None),
             ("--jsonl", args.jsonl is not None),
             ("--profile-dir", args.profile_dir is not None),
+            # The PPR engine has its own chunked dispatch loop; the
+            # tracer/flight-recorder instrumentation covers the global-
+            # PageRank path only (for now — reject, never silently drop).
+            ("--trace", args.trace is not None),
+            ("--run-report", args.run_report is not None),
             # PprJaxEngine builds replicated [n, k] state and its own
             # stripe layout; the memory-scaling mode and the lane-group
             # override are not implemented there (VERDICT r4 weak #2).
@@ -570,8 +596,106 @@ def _s3_retry_total(paths) -> int:
     return total
 
 
+def _robustness_summary(args, engine, guard) -> dict:
+    """The run's robustness counters (docs/ROBUSTNESS.md) as one dict —
+    feeds both the stderr summary line and the flight recorder."""
+    return {
+        "rollbacks": getattr(engine, "health", {}).get("rollbacks", 0) or 0,
+        "write_retries": guard.retries,
+        "dropped_writes": len(guard.dropped),
+        "s3_request_retries": _s3_retry_total(
+            (args.snapshot_dir, args.dump_text_dir, args.out, args.jsonl)
+        ),
+    }
+
+
+def _export_observability(args, tracer, cfg, graph, metrics, summary,
+                          robustness, error=None) -> None:
+    """Write the --trace export and/or --run-report artifact
+    (docs/OBSERVABILITY.md). Called on the success path AND — with
+    ``error`` set, best-effort — from the failure path: the failing
+    run's telemetry is exactly what a postmortem needs. ``cfg`` /
+    ``graph`` / ``metrics`` may be None on early failures (the run
+    died before they existed); the report still carries every section
+    key."""
+    if args.trace:
+        tracer.export(args.trace)
+        print(f"wrote trace to {args.trace}", file=sys.stderr)
+    if args.run_report:
+        extra = {
+            "graph": (
+                {"n": int(graph.n), "num_edges": int(graph.num_edges)}
+                if graph is not None else None
+            ),
+            "engine": args.engine,
+            "fused": bool(args.fused),
+            "failed": error is not None,
+        }
+        if error is not None:
+            extra["error"] = repr(error)
+        report = obs.build_run_report(
+            config=cfg,
+            tracer=tracer,
+            registry=obs.get_registry(),
+            history=metrics.history if metrics is not None else [],
+            summary=summary,
+            robustness=robustness,
+            extra=extra,
+        )
+        obs.write_run_report(args.run_report, report)
+        print(f"wrote run report to {args.run_report}", file=sys.stderr)
+
+
+def _export_failure(ctx, err) -> None:
+    """Best-effort failure-path export from whatever run state exists.
+    ``ctx`` is filled incrementally by _main as objects come into
+    existence, so a run that dies during ingest, engine build, resume,
+    the solve, or the final --out write all leave their trace and a
+    failure-marked report — the postmortem case the flight recorder
+    exists for. (When the success export already ran and a LATER step
+    failed, this overwrites it with the correctly failure-marked one.)
+    Never masks the primary error."""
+    args = ctx.get("args")
+    tracer = ctx.get("tracer")
+    if args is None or tracer is None or not tracer.enabled:
+        return
+    if not (args.trace or args.run_report):
+        return
+    try:
+        metrics = ctx.get("metrics")
+        guard = ctx.get("guard")
+        _export_observability(
+            args, tracer, ctx.get("cfg"), ctx.get("graph"), metrics,
+            summary=metrics.summary() if metrics is not None else {},
+            robustness=(
+                _robustness_summary(args, ctx.get("engine"), guard)
+                if guard is not None else {}
+            ),
+            error=err,
+        )
+    except Exception as e2:
+        print(f"pagerank_tpu: failure-path observability export "
+              f"failed: {e2!r}", file=sys.stderr)
+
+
 def main(argv=None) -> int:
+    ctx = {}
+    try:
+        return _main(argv, ctx)
+    except BaseException as e:
+        _export_failure(ctx, e)
+        raise
+    finally:
+        # The process-global tracer must never outlive the run that
+        # enabled it — success, failure, and SystemExit alike (tests
+        # drive main() in-process; a leaked tracer would silently
+        # accumulate the next run's spans).
+        obs.disable_tracing()
+
+
+def _main(argv, ctx) -> int:
     args = build_parser().parse_args(argv)
+    ctx["args"] = args
     if args.engine == "jax" and not args.no_compile_cache:
         # Persist XLA executables across CLI runs: the engine-setup
         # chain is ~50 small jitted programs (and the device build ~50
@@ -612,14 +736,25 @@ def main(argv=None) -> int:
             return 2
     if args.ppr_sources:
         reject_ppr_incompatible_flags(args)
+    # Observability state is per-run, never inherited: a previous
+    # in-process main() call (tests drive the CLI this way) must not
+    # leak its tracer or counters into this one.
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    tracer = (obs.enable_tracing() if (args.trace or args.run_report)
+              else obs.get_tracer())
+    ctx["tracer"] = tracer
     t0 = time.perf_counter()
-    try:
-        graph, ids = load_graph(args)
-    except ValueError as e:
-        # e.g. "empty graph: no vertices" (host build_graph and the
-        # device-build guard alike) — a clean CLI error, not a traceback.
-        raise SystemExit(str(e))
+    with obs.span("ingest/load", input=args.input or args.synthetic):
+        try:
+            graph, ids = load_graph(args)
+        except ValueError as e:
+            # e.g. "empty graph: no vertices" (host build_graph and the
+            # device-build guard alike) — a clean CLI error, not a
+            # traceback.
+            raise SystemExit(str(e))
     t_load = time.perf_counter() - t0
+    ctx["graph"] = graph
     print(
         f"graph: {graph.n:,} vertices, {graph.num_edges:,} edges, "
         f"{int(graph.dangling_mask.sum()):,} dangling ({t_load:.2f}s load)",
@@ -655,7 +790,9 @@ def main(argv=None) -> int:
     if args.lane_group is not None:
         cfg = cfg.replace(lane_group=args.lane_group)
     cfg.validate()
+    ctx["cfg"] = cfg
     engine = make_engine(args.engine, cfg)
+    ctx["engine"] = engine
     if args.device_build:
         engine.build_device(graph)
     else:
@@ -675,6 +812,7 @@ def main(argv=None) -> int:
     metrics = MetricsLogger(
         graph.num_edges, num_chips, log_every=args.log_every, jsonl_path=args.jsonl
     )
+    ctx["metrics"] = metrics
 
     dumper = None
     if args.dump_text_dir:
@@ -711,6 +849,7 @@ def main(argv=None) -> int:
         on_failure=args.on_write_failure,
         dead_letter_path=dead_letter,
     )
+    ctx["guard"] = guard
 
     writer = None
     can_write = dumper is not None or (snap and args.snapshot_every)
@@ -735,124 +874,132 @@ def main(argv=None) -> int:
             # one device->host fetch for both sinks
             guard(i, lambda: write_sinks(i, (want_snap, engine.ranks())))
 
-    profiling = False
-    if args.profile_dir:
-        import jax
-
-        jax.profiler.start_trace(args.profile_dir)
-        profiling = True
     try:
-        if args.fused:
-            import jax
+        # Profiler lifecycle via obs.profiler_session: started here,
+        # stopped on EVERY exit path (the trace of a failing run is
+        # what the user wants to inspect), recorded as a 'profile'
+        # span when tracing is on — replaces the hand-rolled
+        # start/stop+finally this block used to carry.
+        with obs.profiler_session(args.profile_dir):
+            if args.fused:
+                import jax
 
-            first = engine.iteration
-            chunked = snap is not None and args.snapshot_every
-            # compile outside the timed region
-            engine.prepare_fused(
-                tol=args.tol,
-                every=args.snapshot_every if chunked else None,
-            )
-            t_run = time.perf_counter()
-            if chunked:
-                # Fused dispatches BETWEEN snapshot points; snapshots at
-                # chunk boundaries ride the same async writer/sink path
-                # as the stepwise loop.
-                def on_chunk(done_iters, ranks_thunk, traces):
-                    # Same absolute cadence as the stepwise loop: no
-                    # snapshot at an off-cadence final-remainder
-                    # boundary, so both modes write identical file sets.
-                    # (The device-side rank copy is only made when the
-                    # thunk is called — skipped boundaries cost nothing.)
-                    if done_iters % args.snapshot_every != 0:
-                        return
-                    if writer is not None:
-                        writer.submit(done_iters - 1, (True, ranks_thunk()))
-                    else:
-                        guard(
-                            done_iters - 1,
-                            lambda: write_sinks(
-                                done_iters - 1,
-                                (True, engine.decode_ranks(ranks_thunk())),
-                            ),
-                        )
-
-                ranks = engine.run_fused_chunked(
-                    every=args.snapshot_every, on_chunk=on_chunk,
+                first = engine.iteration
+                chunked = snap is not None and args.snapshot_every
+                # compile outside the timed region
+                engine.prepare_fused(
                     tol=args.tol,
+                    every=args.snapshot_every if chunked else None,
                 )
-            elif args.tol is not None:
-                # On-device early stop: only the FINAL iteration's
-                # delta/mass exist (dynamic trip count).
-                ranks = engine.run_fused_tol(args.tol)
-            else:
-                ranks = engine.run_fused()
-            total = time.perf_counter() - t_run
-            tr = engine.last_run_metrics
-            deltas = np.asarray(jax.device_get(tr["l1_delta"]))
-            masses = np.asarray(jax.device_get(tr["dangling_mass"]))
-            done = engine.iteration - first
-            for i in range(len(deltas) if done else 0):
-                # one record per executed iteration, except the
-                # device-tol form which keeps only the final one.
-                it = first + (i if len(deltas) == done else done - 1)
-                metrics.record(
-                    it,
-                    {"l1_delta": deltas[i], "dangling_mass": masses[i]},
-                    total / max(1, done),
-                    timing="averaged",
-                )
-            fused_summary = dict(iters=done, total_seconds=total)
-        else:
-            # snap doubles as the rollback source for the self-healing
-            # loop (unhealthy steps restore the newest valid snapshot
-            # and recompute — engine.run; docs/ROBUSTNESS.md). With the
-            # async writer active, rollback scans must drain its queue
-            # first or they race the snapshots still in flight.
-            roll_snap = snap
-            if snap is not None and writer is not None:
-                from pagerank_tpu.utils.snapshot import WriterSyncedSnapshotter
+                t_run = time.perf_counter()
+                if chunked:
+                    # Fused dispatches BETWEEN snapshot points;
+                    # snapshots at chunk boundaries ride the same async
+                    # writer/sink path as the stepwise loop.
+                    def on_chunk(done_iters, ranks_thunk, traces):
+                        # Same absolute cadence as the stepwise loop: no
+                        # snapshot at an off-cadence final-remainder
+                        # boundary, so both modes write identical file
+                        # sets. (The device-side rank copy is only made
+                        # when the thunk is called — skipped boundaries
+                        # cost nothing.)
+                        if done_iters % args.snapshot_every != 0:
+                            return
+                        if writer is not None:
+                            writer.submit(done_iters - 1,
+                                          (True, ranks_thunk()))
+                        else:
+                            guard(
+                                done_iters - 1,
+                                lambda: write_sinks(
+                                    done_iters - 1,
+                                    (True,
+                                     engine.decode_ranks(ranks_thunk())),
+                                ),
+                            )
 
-                roll_snap = WriterSyncedSnapshotter(snap, writer)
-            ranks = engine.run(on_iteration=on_iteration,
-                               snapshotter=roll_snap)
+                    ranks = engine.run_fused_chunked(
+                        every=args.snapshot_every, on_chunk=on_chunk,
+                        tol=args.tol,
+                    )
+                elif args.tol is not None:
+                    # On-device early stop: only the FINAL iteration's
+                    # delta/mass exist (dynamic trip count).
+                    ranks = engine.run_fused_tol(args.tol)
+                else:
+                    ranks = engine.run_fused()
+                total = time.perf_counter() - t_run
+                tr = engine.last_run_metrics
+                deltas = np.asarray(jax.device_get(tr["l1_delta"]))
+                masses = np.asarray(jax.device_get(tr["dangling_mass"]))
+                done = engine.iteration - first
+                if tracer.enabled:
+                    # One span for the fused dispatch window (per-step
+                    # host spans don't exist here by design — the loop
+                    # runs on device).
+                    tracer.add_span("solve/fused", t_run, total,
+                                    iters=done)
+                for i in range(len(deltas) if done else 0):
+                    # one record per executed iteration, except the
+                    # device-tol form which keeps only the final one.
+                    it = first + (i if len(deltas) == done else done - 1)
+                    metrics.record(
+                        it,
+                        {"l1_delta": deltas[i], "dangling_mass": masses[i]},
+                        total / max(1, done),
+                        timing="averaged",
+                    )
+                fused_summary = dict(iters=done, total_seconds=total)
+            else:
+                # snap doubles as the rollback source for the
+                # self-healing loop (unhealthy steps restore the newest
+                # valid snapshot and recompute — engine.run;
+                # docs/ROBUSTNESS.md). With the async writer active,
+                # rollback scans must drain its queue first or they
+                # race the snapshots still in flight.
+                roll_snap = snap
+                if snap is not None and writer is not None:
+                    from pagerank_tpu.utils.snapshot import (
+                        WriterSyncedSnapshotter)
+
+                    roll_snap = WriterSyncedSnapshotter(snap, writer)
+                ranks = engine.run(on_iteration=on_iteration,
+                                   snapshotter=roll_snap)
     finally:
         # Capture BEFORE any nested try: inside an except handler,
         # sys.exc_info() would report the just-caught close() error.
+        # (Failure-path observability export happens in main()'s
+        # wrapper — _export_failure — so ingest/build/resume/--out
+        # failures are covered too, not just this block's.)
         propagating = sys.exc_info()[0] is not None
-        try:
-            if writer is not None:
-                try:
-                    writer.close()  # flush pending writes; surface failures
-                except Exception:
-                    if not propagating:
-                        raise
-                    # an engine error is already propagating; don't mask it
-        finally:
-            # Always finalize the profiler trace — even when close()
-            # raises, the trace of the failing run is what the user
-            # wants to inspect.
-            if profiling:
-                import jax
-
-                jax.profiler.stop_trace()
+        if writer is not None:
+            try:
+                writer.close()  # flush pending writes; surface failures
+            except Exception:
+                if not propagating:
+                    raise
+                # an engine error is already propagating; don't mask it
     # Fused runs know the true iteration count and wall-clock directly
     # (the tol form records only the final iteration).
     summary = metrics.summary(**fused_summary) if args.fused else metrics.summary()
     metrics.close()
     if summary:
+        # The rate fields are null (not inf) on a degenerate zero
+        # wall-clock (utils/metrics.py) — skip them rather than format
+        # None.
+        eps = summary["edges_per_sec_per_chip"]
         print(
             f"done: {summary['iters']} iters, "
-            f"{summary['mean_iter_seconds'] * 1e3:.2f} ms/iter, "
-            f"{summary['edges_per_sec_per_chip']:.4g} edges/s/chip",
+            f"{summary['mean_iter_seconds'] * 1e3:.2f} ms/iter"
+            + (f", {eps:.4g} edges/s/chip" if eps is not None else ""),
             file=sys.stderr,
         )
     # Robustness summary (docs/ROBUSTNESS.md): rollback/retry/drop
     # counts, plus transparent S3 request retries for any object-store
     # outputs. Printed only when something is worth reporting.
-    rollbacks = getattr(engine, "health", {}).get("rollbacks", 0) or 0
-    io_retries = _s3_retry_total(
-        (args.snapshot_dir, args.dump_text_dir, args.out, args.jsonl)
-    )
+    rb_summary = _robustness_summary(args, engine, guard)
+    rollbacks = rb_summary["rollbacks"]
+    io_retries = rb_summary["s3_request_retries"]
     if rollbacks or guard.retries or guard.dropped or io_retries:
         parts = [f"{rollbacks} rollback(s)", f"{guard.retries} write retr(y/ies)"]
         if io_retries:
@@ -865,6 +1012,14 @@ def main(argv=None) -> int:
                 + ")"
             )
         print("robustness: " + ", ".join(parts), file=sys.stderr)
+
+    # Flight recorder + trace export (docs/OBSERVABILITY.md): ONE
+    # artifact that explains the run — env fingerprint, resolved
+    # config, span summary, metrics snapshot, per-iteration history,
+    # robustness counters. Diff two with
+    # `python -m pagerank_tpu.obs report A.json B.json`.
+    _export_observability(args, tracer, cfg, graph, metrics,
+                          summary=summary, robustness=rb_summary)
 
     if args.out:
         names = ids.names if ids is not None else None

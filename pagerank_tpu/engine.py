@@ -16,6 +16,8 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from pagerank_tpu.graph import Graph
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.utils.config import PageRankConfig
 
 
@@ -114,8 +116,18 @@ class PageRankEngine(abc.ABC):
         rb = self.config.robustness
         self.health = {"rollbacks": 0, "first_bad_iteration": None}
         last_mass: Optional[float] = None
+        # Tracer read ONCE per run: with observability disabled the
+        # loop body touches the tracer zero times per iteration (the
+        # no-op contract tests/test_obs.py::test_noop_tracer_hot_path
+        # pins); enabled, each step is a solve/step span.
+        tracer = obs_trace.get_tracer()
+        trace_steps = tracer.enabled
         while self.iteration < total:
-            info = self.step()
+            if trace_steps:
+                with tracer.span("solve/step", iteration=self.iteration):
+                    info = self.step()
+            else:
+                info = self.step()
             i = self.iteration
             reason = None
             if rb.health_checks:
@@ -135,6 +147,14 @@ class PageRankEngine(abc.ABC):
                     else:
                         last_mass = mass
             if reason is not None:
+                obs_metrics.counter(
+                    "engine.health_check_failures",
+                    "solver steps declared unhealthy (NaN/Inf, mass "
+                    "drift)",
+                ).inc()
+                if trace_steps:
+                    tracer.add_event("solve/unhealthy_step",
+                                     iteration=i, reason=reason)
                 if self.health["first_bad_iteration"] is None:
                     self.health["first_bad_iteration"] = i
                 first_bad = self.health["first_bad_iteration"]
@@ -165,6 +185,11 @@ class PageRankEngine(abc.ABC):
                 it0, ranks, _meta = rolled
                 self.set_ranks(ranks, iteration=it0)
                 self.health["rollbacks"] += 1
+                obs_metrics.counter(
+                    "engine.rollbacks",
+                    "snapshot rollbacks performed by the self-healing "
+                    "solve loop",
+                ).inc()
                 last_mass = None  # re-baseline the drift check
                 continue
             self.iteration = i + 1

@@ -60,6 +60,8 @@ from pagerank_tpu.utils.jax_compat import shard_map
 from pagerank_tpu import graph as graph_mod
 from pagerank_tpu.engine import PageRankEngine, register_engine
 from pagerank_tpu.graph import Graph
+from pagerank_tpu.obs import log as obs_log
+from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.models import pagerank as pr_model
 from pagerank_tpu.ops import ell as ell_lib
 from pagerank_tpu.ops import spmv
@@ -111,12 +113,9 @@ class JaxTpuEngine(PageRankEngine):
         )
         for d in (cfg.dtype, cfg.accum_dtype):
             if np.dtype(d).itemsize == 8 and not jax.config.jax_enable_x64:
-                import sys
-
-                print(
-                    f"pagerank_tpu: config requests {d}; enabling "
-                    "jax_enable_x64 (process-global)",
-                    file=sys.stderr,
+                obs_log.info(
+                    f"config requests {d}; enabling jax_enable_x64 "
+                    "(process-global)"
                 )
                 jax.config.update("jax_enable_x64", True)
         self._dtype = jnp.dtype(cfg.dtype)
@@ -181,6 +180,10 @@ class JaxTpuEngine(PageRankEngine):
         """Build from an on-device blocked-ELL graph
         (ops/device_build.DeviceEllGraph) — no bulk host->device
         transfer; see device_build's module docstring."""
+        with obs_trace.span("engine/build", mode="device"):
+            return self._build_device_impl(dg)
+
+    def _build_device_impl(self, dg) -> "JaxTpuEngine":
         from pagerank_tpu.ops.device_build import DeviceEllGraph
 
         assert isinstance(dg, DeviceEllGraph)
@@ -202,14 +205,11 @@ class JaxTpuEngine(PageRankEngine):
             self.gather_z_item(cfg, self._pair),
         )
         if sz > allowed:
-            import sys
-
-            print(
-                f"pagerank_tpu: device-built graph has stripe span "
+            obs_log.warn(
+                f"device-built graph has stripe span "
                 f"{sz} > {allowed} — the gather runs outside "
                 "the fast regime (~4x slower SpMV); rebuild with "
-                f"stripe_size<={allowed}",
-                file=sys.stderr,
+                f"stripe_size<={allowed}"
             )
 
         n, pad = dg.n, dg.n_padded - dg.n
@@ -250,6 +250,10 @@ class JaxTpuEngine(PageRankEngine):
         return self
 
     def build(self, graph: Graph) -> "JaxTpuEngine":
+        with obs_trace.span("engine/build", mode="host"):
+            return self._build_impl(graph)
+
+    def _build_impl(self, graph: Graph) -> "JaxTpuEngine":
         cfg = self.config
         self.graph = graph
         self._begin_build()
@@ -310,12 +314,9 @@ class JaxTpuEngine(PageRankEngine):
                 # like plan_build instead of letting the packer raise.
                 grp = self.clamp_group_for_span(group, span)
                 if grp != group:
-                    import sys
-
-                    print(
-                        f"pagerank_tpu: lane group clamped to {grp} "
-                        f"for stripe span {span}",
-                        file=sys.stderr,
+                    obs_log.info(
+                        f"lane group clamped to {grp} "
+                        f"for stripe span {span}"
                     )
                     group = grp
                 pack = ell_lib.ell_pack_striped(
@@ -511,7 +512,8 @@ class JaxTpuEngine(PageRankEngine):
 
         t0 = _time.perf_counter()
         try:
-            return self._autotune_chunk_impl(*args, **kw)
+            with obs_trace.span("engine/autotune"):
+                return self._autotune_chunk_impl(*args, **kw)
         finally:
             self.build_timings["autotune_s"] = _time.perf_counter() - t0
 
@@ -665,12 +667,9 @@ class JaxTpuEngine(PageRankEngine):
         )
         want_pallas = cfg.kernel == "pallas"
         if want_pallas and n_stripes > 1:
-            import sys
-
-            print(
-                "pagerank_tpu: kernel='pallas' cannot run the striped "
-                "large-graph layout; using the XLA ell path",
-                file=sys.stderr,
+            obs_log.info(
+                "kernel='pallas' cannot run the striped "
+                "large-graph layout; using the XLA ell path"
             )
             want_pallas = False
         self._kernel = "pallas" if want_pallas else "ell"
@@ -993,23 +992,17 @@ class JaxTpuEngine(PageRankEngine):
                     self._kernel = f"pallas:{mode}"
                     break
                 except Exception as e:  # pragma: no cover - hw-dependent
-                    import sys
-
                     msg = str(e).splitlines()[0][:160] if str(e) else ""
                     if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
                         raise  # OOM is not a lowering problem; surface it
-                    print(
-                        f"pagerank_tpu: pallas gather '{mode}' unavailable "
-                        f"({type(e).__name__}: {msg})",
-                        file=sys.stderr,
+                    obs_log.info(
+                        f"pallas gather '{mode}' unavailable "
+                        f"({type(e).__name__}: {msg})"
                     )
             if contrib_fn is None:
-                import sys
-
-                print(
-                    "pagerank_tpu: pallas kernel unavailable; falling back "
-                    "to the XLA ell path",
-                    file=sys.stderr,
+                obs_log.info(
+                    "pallas kernel unavailable; falling back "
+                    "to the XLA ell path"
                 )
                 self._kernel = "ell"
                 contrib_fn = make_contrib("ell")
@@ -2119,9 +2112,11 @@ class JaxTpuEngine(PageRankEngine):
                         jnp.zeros((), acc))
                 return jax.lax.while_loop(cond, body, init)
 
-            fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
-                *self._device_args()
-            ).compile()
+            with obs_trace.span("engine/compile", form="fused_tol",
+                                iters=k):
+                fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
+                    *self._device_args()
+                ).compile()
             self._fused_cache[key] = fused
         return fused
 
@@ -2138,9 +2133,11 @@ class JaxTpuEngine(PageRankEngine):
 
                 return jax.lax.scan(body, r, None, length=k)
 
-            fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
-                *self._device_args()
-            ).compile()
+            with obs_trace.span("engine/compile", form="fused_scan",
+                                iters=k):
+                fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
+                    *self._device_args()
+                ).compile()
             self._fused_cache[k] = fused
         return fused
 

@@ -24,10 +24,13 @@ segment-sum, plus per-edge contribution weights w[e] = 1/out_degree[src[e]].
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
+
+from pagerank_tpu.obs import trace as obs_trace
 
 
 @dataclass
@@ -129,6 +132,7 @@ def build_graph(
         sort working set blow up past ~100M edges). docs/PERF_NOTES.md
         "Host ingest".
     """
+    t_build0 = time.perf_counter()
     src = np.ascontiguousarray(src, dtype=np.int64)
     dst = np.ascontiguousarray(dst, dtype=np.int64)
     if src.shape != dst.shape:
@@ -194,6 +198,16 @@ def build_graph(
 
     edge_weight = inv_out_degree(out_degree)[src_s]
 
+    # Recorded as a pre-measured span (no behavior change when tracing
+    # is off): the host build is a single stage from the trace's point
+    # of view — its internal sort/pack split lives in PERF_NOTES, the
+    # device build's per-stage spans in ops/device_build.
+    tracer = obs_trace.get_tracer()
+    if tracer.enabled:
+        tracer.add_span(
+            "build/host_graph", t_build0,
+            time.perf_counter() - t_build0, n=n, edges=int(len(src_s)),
+        )
     return Graph(
         n=n,
         src=src_s,

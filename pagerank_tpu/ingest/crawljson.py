@@ -105,14 +105,23 @@ def load_crawl_file_arrays(path: str, strict: bool = True,
 
 
 def _load_crawl_file(path, strict, native, raw):
-    if native == "auto":
-        from pagerank_tpu.ingest import native as native_mod
+    from pagerank_tpu.obs import trace as obs_trace
 
-        result = native_mod.try_crawl_load([path], "tsv", strict=strict,
-                                           raw=raw)
-        if result is not None:
-            return result
-    from pagerank_tpu.ingest.ids import records_to_arrays, records_to_graph
+    with obs_trace.span("ingest/crawl", path=path) as sp:
+        if native == "auto":
+            from pagerank_tpu.ingest import native as native_mod
 
-    records = iter_crawl_records(path, strict=strict)
-    return records_to_arrays(records) if raw else records_to_graph(records)
+            result = native_mod.try_crawl_load([path], "tsv", strict=strict,
+                                               raw=raw)
+            if result is not None:
+                if sp is not None:
+                    sp.attrs["parser"] = "native"
+                return result
+        from pagerank_tpu.ingest.ids import (records_to_arrays,
+                                             records_to_graph)
+
+        if sp is not None:
+            sp.attrs["parser"] = "python"
+        records = iter_crawl_records(path, strict=strict)
+        return (records_to_arrays(records) if raw
+                else records_to_graph(records))

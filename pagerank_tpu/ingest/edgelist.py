@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.utils import fsio
 
 
@@ -26,27 +27,32 @@ def load_edgelist(path: str, comments: str = "#") -> Tuple[np.ndarray, np.ndarra
     when available; falls back to numpy. ``path`` may use a registered
     URI scheme (utils/fsio); the native mmap parser applies to local
     paths only."""
-    if comments == "#" and fsio.scheme_of(path) is None:
-        from pagerank_tpu.ingest import native as native_lib
+    with obs_trace.span("ingest/edgelist", path=path) as sp:
+        if comments == "#" and fsio.scheme_of(path) is None:
+            from pagerank_tpu.ingest import native as native_lib
 
-        try:
-            out = native_lib.parse_edgelist_native(path)
-        except FileNotFoundError:
-            raise
-        if out is not None:
-            return out
-    with fsio.fopen(path, "rb") as f:
-        data = f.read()
-    if comments:
-        lines = [
-            ln for ln in data.splitlines() if ln and not ln.lstrip().startswith(comments.encode())
-        ]
-        data = b"\n".join(lines)
-    flat = np.array(data.split(), dtype=np.int64)
-    if flat.size % 2 != 0:
-        raise ValueError(f"{path}: odd token count {flat.size}; not a src/dst list")
-    pairs = flat.reshape(-1, 2)
-    return pairs[:, 0].copy(), pairs[:, 1].copy()
+            try:
+                out = native_lib.parse_edgelist_native(path)
+            except FileNotFoundError:
+                raise
+            if out is not None:
+                if sp is not None:
+                    sp.attrs.update(edges=len(out[0]), parser="native")
+                return out
+        with fsio.fopen(path, "rb") as f:
+            data = f.read()
+        if comments:
+            lines = [
+                ln for ln in data.splitlines() if ln and not ln.lstrip().startswith(comments.encode())
+            ]
+            data = b"\n".join(lines)
+        flat = np.array(data.split(), dtype=np.int64)
+        if flat.size % 2 != 0:
+            raise ValueError(f"{path}: odd token count {flat.size}; not a src/dst list")
+        pairs = flat.reshape(-1, 2)
+        if sp is not None:
+            sp.attrs.update(edges=len(pairs), parser="numpy")
+        return pairs[:, 0].copy(), pairs[:, 1].copy()
 
 
 def save_binary_edges(
@@ -62,9 +68,10 @@ def save_binary_edges(
 
 
 def load_binary_edges(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[int]]:
-    with fsio.fopen(path, "rb") as f, np.load(f) as z:
-        n = int(z["n"]) if "n" in z.files else None
-        return z["src"], z["dst"], n
+    with obs_trace.span("ingest/npz", path=path):
+        with fsio.fopen(path, "rb") as f, np.load(f) as z:
+            n = int(z["n"]) if "n" in z.files else None
+            return z["src"], z["dst"], n
 
 
 def load_edges_any(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[int]]:

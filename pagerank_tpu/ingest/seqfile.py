@@ -336,11 +336,34 @@ def iter_segment_records(
         or len(paths) <= 1
         or "fork" not in multiprocessing.get_all_start_methods()
     ):
-        from pagerank_tpu.ingest.crawljson import parse_metadata_record
+        import time as _time
 
+        from pagerank_tpu.ingest.crawljson import parse_metadata_record
+        from pagerank_tpu.obs import trace as obs_trace
+
+        tracer = obs_trace.get_tracer()
         for path in paths:
-            for url, meta in read_sequence_file(path):
-                yield parse_metadata_record(url, meta, strict=strict)
+            if tracer.enabled:
+                # Per-file attribution (docs/OBSERVABILITY.md) WITHOUT
+                # changing the memory profile: the stream stays lazy
+                # (a production segment file holds millions of
+                # records) and a pre-measured span is recorded when
+                # the file's iterator is exhausted. The span covers
+                # the file's streaming WINDOW — consumer work
+                # interleaved by the generator is included — which is
+                # the honest bound a lazy pipeline admits.
+                t0 = _time.perf_counter()
+                n = 0
+                for url, meta in read_sequence_file(path):
+                    n += 1
+                    yield parse_metadata_record(url, meta, strict=strict)
+                tracer.add_span(
+                    "ingest/seqfile_file", t0,
+                    _time.perf_counter() - t0, path=path, records=n,
+                )
+            else:
+                for url, meta in read_sequence_file(path):
+                    yield parse_metadata_record(url, meta, strict=strict)
         return
     import collections
     import concurrent.futures
@@ -408,18 +431,27 @@ def _load_crawl_seqfile(spec, strict, workers, native, raw):
     """Shared native-try/Python-fallback gating for both return forms —
     one copy of the rules (auto + no explicit workers -> native;
     NativeUnsupported or no library -> Python path)."""
+    from pagerank_tpu.obs import trace as obs_trace
+
     paths = expand_seqfile_paths(spec)
-    if native == "auto" and workers is None:
-        from pagerank_tpu.ingest import native as native_mod
+    with obs_trace.span("ingest/seqfile", files=len(paths)) as sp:
+        if native == "auto" and workers is None:
+            from pagerank_tpu.ingest import native as native_mod
 
-        result = native_mod.try_crawl_load(paths, "seqfile", strict=strict,
-                                           raw=raw)
-        if result is not None:
-            return result
-    from pagerank_tpu.ingest.ids import records_to_arrays, records_to_graph
+            result = native_mod.try_crawl_load(paths, "seqfile",
+                                               strict=strict, raw=raw)
+            if result is not None:
+                if sp is not None:
+                    sp.attrs["parser"] = "native"
+                return result
+        from pagerank_tpu.ingest.ids import (records_to_arrays,
+                                             records_to_graph)
 
-    records = iter_segment_records(paths, strict, workers)
-    return records_to_arrays(records) if raw else records_to_graph(records)
+        if sp is not None:
+            sp.attrs["parser"] = "python"
+        records = iter_segment_records(paths, strict, workers)
+        return (records_to_arrays(records) if raw
+                else records_to_graph(records))
 
 
 # -- writing (tests + interop) -------------------------------------------

@@ -1,0 +1,77 @@
+"""pagerank_tpu.obs — the unified observability layer (ISSUE 4;
+docs/OBSERVABILITY.md).
+
+Three pieces, one subsystem:
+
+  - **span tracing** (obs/trace.py): nested context-manager spans over
+    every layer — ingest, device build, engine setup/compile,
+    solve, snapshot I/O — exportable as JSONL or Chrome trace-event
+    JSON (Perfetto). A process-global default tracer that is a NO-OP
+    unless enabled, so the hot path pays nothing when off.
+  - **metrics registry** (obs/metrics.py): typed counters / gauges /
+    histograms in one place; the formerly scattered counters (S3
+    request retries, health-check failures, rollbacks, dead-letters,
+    compile-cache hits/misses, snapshot bytes) all register here.
+  - **run flight-recorder** (obs/report.py): ``run_report.json`` per
+    run — environment fingerprint, resolved config, span summary,
+    registry snapshot, per-iteration history, robustness summary —
+    with ``python -m pagerank_tpu.obs report A.json [B.json]`` to
+    render one or diff two.
+
+Plus :func:`profiler_session` (obs/profiler.py), the jax.profiler
+lifecycle as a tracer-composed context manager, and :mod:`obs.log`,
+the sanctioned stderr channel for library diagnostics (lint PTL007).
+
+Import cost: stdlib only (jax is imported lazily inside the functions
+that need it), so any utils module can depend on obs without cycles.
+"""
+
+from pagerank_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from pagerank_tpu.obs.profiler import profiler_session
+from pagerank_tpu.obs.report import (
+    build_run_report,
+    diff_reports,
+    environment_fingerprint,
+    load_report,
+    render_report,
+    write_run_report,
+)
+from pagerank_tpu.obs.trace import (
+    NULL_TRACER,
+    NullTracer,
+    Span,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "profiler_session",
+    "build_run_report",
+    "diff_reports",
+    "environment_fingerprint",
+    "load_report",
+    "render_report",
+    "write_run_report",
+    "NULL_TRACER",
+    "NullTracer",
+    "Span",
+    "Tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "get_tracer",
+    "span",
+]

@@ -1,0 +1,63 @@
+"""``python -m pagerank_tpu.obs`` — inspect run flight-recorder
+artifacts.
+
+  report A.json          pretty-print one run report
+  report A.json B.json   diff two reports (phase-by-phase wall and
+                         rate deltas; environment differences called
+                         out first so backend drift is separable from
+                         code regressions — docs/OBSERVABILITY.md)
+
+Exit codes: 0 ok, 2 usage/unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pagerank_tpu.obs import report as report_mod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m pagerank_tpu.obs",
+        description="Run-report tooling for the observability layer "
+        "(docs/OBSERVABILITY.md).",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    rp = sub.add_parser(
+        "report", help="render one run_report.json, or diff two"
+    )
+    rp.add_argument("paths", nargs="+", metavar="REPORT.json",
+                    help="one report to render, or two to diff (A B)")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the loaded report (or {'a','b'} pair) "
+                    "as JSON instead of the human rendering")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if len(args.paths) > 2:
+        print("report takes one or two files", file=sys.stderr)
+        return 2
+    try:
+        reports = [report_mod.load_report(p) for p in args.paths]
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"obs report: cannot load report: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        doc = (reports[0] if len(reports) == 1
+               else {"a": reports[0], "b": reports[1]})
+        print(json.dumps(doc, indent=2))
+        return 0
+    if len(reports) == 1:
+        print(report_mod.render_report(reports[0]))
+    else:
+        print(report_mod.diff_reports(reports[0], reports[1]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

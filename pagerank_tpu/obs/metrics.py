@@ -1,0 +1,207 @@
+"""Central metrics registry — the counter half of the observability
+layer (docs/OBSERVABILITY.md).
+
+Before this module the run counters were scattered one-per-subsystem:
+S3 request retries in ``S3FileSystem.retry_stats``, health-check
+failures and rollbacks in ``engine.health``, dead-letters in
+``SinkGuard.dropped``, compile-cache behavior invisible entirely. Each
+stayed (they are the subsystems' own API), but every one is now ALSO
+registered here, so one ``snapshot()`` captures the whole run and the
+flight recorder (obs/report.py) can embed it.
+
+Typed instruments:
+
+  - :class:`Counter` — monotone count (``s3.request.retries``);
+  - :class:`Gauge` — last-set value (``engine.num_chips``);
+  - :class:`Histogram` — count/sum/min/max plus power-of-two bucket
+    counts (``snapshot.bytes_written`` per save).
+
+Naming scheme mirrors the span scheme: ``subsystem.thing[.verb]``,
+dot-separated (docs/OBSERVABILITY.md has the full catalogue).
+
+Counter updates are plain in-GIL arithmetic (the same discipline as
+``SinkGuard.retries``): the writer thread and the solve loop may both
+increment, and a lost update under a hypothetical no-GIL runtime would
+cost a count, never a crash — these are telemetry, not ledgers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("name", "help", "value")
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("name", "help", "value")
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value: Optional[float] = None
+
+    def set(self, v) -> None:
+        self.value = v
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Summary stats + power-of-two buckets. ``record(v)`` files ``v``
+    under the smallest bucket bound ``2**k >= v`` (one ``+inf`` bucket
+    past 2**63); the snapshot keeps only non-empty buckets."""
+
+    __slots__ = ("name", "help", "count", "sum", "min", "max", "buckets")
+
+    kind = "histogram"
+
+    _MAX_EXP = 63
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.buckets: Dict[str, int] = {}
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if v <= 0:
+            key = "0"
+        else:
+            e = 0
+            while (1 << e) < v and e < self._MAX_EXP:
+                e += 1
+            key = str(1 << e) if (1 << e) >= v else "+inf"
+        self.buckets[key] = self.buckets.get(key, 0) + 1
+
+    def snapshot(self):
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": (self.sum / self.count) if self.count else None,
+            "buckets": dict(self.buckets),
+        }
+
+
+Metric = Union[Counter, Gauge, Histogram]
+
+
+class MetricsRegistry:
+    """Get-or-create registry of typed metrics, snapshot-able to a
+    plain-JSON dict and renderable as a human table."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+
+    def _get(self, cls, name: str, help: str) -> Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, help)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(Histogram, name, help)
+
+    def names(self) -> List[str]:
+        return sorted(self._metrics)
+
+    def reset(self) -> None:
+        """Drop every metric — one run's counters must not bleed into
+        the next in-process run (cli.main resets at entry)."""
+        self._metrics.clear()
+
+    def snapshot(self) -> Dict[str, dict]:
+        """``{"counters": {...}, "gauges": {...}, "histograms": {...}}``
+        — pure JSON-able values, stable key order."""
+        out: Dict[str, dict] = {"counters": {}, "gauges": {},
+                                "histograms": {}}
+        for name in self.names():
+            m = self._metrics[name]
+            out[m.kind + "s"][name] = m.snapshot()
+        return out
+
+    def render_table(self) -> str:
+        """Aligned human-readable table of the current values."""
+        rows = []
+        for name in self.names():
+            m = self._metrics[name]
+            if m.kind == "histogram":
+                s = m.snapshot()
+                val = (f"count={s['count']} sum={s['sum']:g} "
+                       f"min={s['min']:g} max={s['max']:g}"
+                       if s["count"] else "count=0")
+            else:
+                val = str(m.snapshot())
+            rows.append((name, m.kind, val))
+        if not rows:
+            return "(no metrics registered)"
+        w_name = max(len(r[0]) for r in rows)
+        w_kind = max(len(r[1]) for r in rows)
+        return "\n".join(
+            f"{n:<{w_name}}  {k:<{w_kind}}  {v}" for n, k, v in rows
+        )
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every instrumented subsystem reports
+    into."""
+    return _REGISTRY
+
+
+def counter(name: str, help: str = "") -> Counter:
+    """Get-or-create a counter on the global registry (the one-line
+    idiom instrumentation sites use)."""
+    return _REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "") -> Histogram:
+    return _REGISTRY.histogram(name, help)

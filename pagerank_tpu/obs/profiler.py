@@ -1,0 +1,59 @@
+"""jax.profiler lifecycle as a context manager, composed with the span
+tracer.
+
+The CLI's ``--profile-dir`` handling used to be hand-rolled start/stop
+around only part of the run (cli.py pre-obs): the stop lived in a
+``finally`` that had to be manually kept in sync with the writer-close
+ordering, and nothing tied the profiler window to the rest of the
+run's telemetry. :func:`profiler_session` owns both:
+
+  - ``jax.profiler.start_trace`` on entry, ``stop_trace`` ALWAYS on
+    exit — including the failure path, where the trace of the failing
+    run is exactly what the user wants to inspect
+    (tests/test_obs.py::test_profiler_session_stops_on_failure);
+  - a ``profile`` span on the active tracer with the trace directory
+    as an attribute, so a run report / Chrome trace shows WHEN the
+    profiler window was open relative to every other phase.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from pagerank_tpu.obs import trace as _trace
+
+
+@contextlib.contextmanager
+def profiler_session(profile_dir, tracer=None):
+    """Run the body under a ``jax.profiler`` trace written to
+    ``profile_dir``; no-op (still yields) when ``profile_dir`` is
+    falsy, so callers wrap unconditionally::
+
+        with obs.profiler_session(args.profile_dir):
+            ... the run ...
+
+    Yields True when profiling is active, False otherwise. The profiler
+    is stopped on EVERY exit path; a stop failure never masks the
+    body's own exception (it is swallowed only while one is already
+    propagating)."""
+    if not profile_dir:
+        yield False
+        return
+    import jax
+
+    tr = tracer if tracer is not None else _trace.get_tracer()
+    with tr.span("profile", dir=str(profile_dir)):
+        jax.profiler.start_trace(profile_dir)
+        try:
+            yield True
+        except BaseException:
+            # The body failed: stop (and flush) the trace of the failing
+            # run, but never let a secondary stop failure mask the
+            # primary error.
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            raise
+        else:
+            jax.profiler.stop_trace()

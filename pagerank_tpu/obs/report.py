@@ -1,0 +1,309 @@
+"""Run flight-recorder: one JSON artifact that explains a run.
+
+VERDICT r5 documented an hour-scale backend degradation that
+contaminated several bench cells and had to be controlled for BY HAND —
+nothing recorded which jaxlib, which device kind, or which phase slowed
+down. The flight recorder turns that into a mechanical comparison:
+every CLI/bench run can write ``run_report.json`` carrying
+
+  - an **environment fingerprint** (jax/jaxlib version, backend +
+    device kind, device/process count, x64 flag, git rev) — the
+    backend-drift axis;
+  - the **resolved config** — the code-change axis;
+  - the **span-tree summary** (obs/trace.Tracer.summary) — where the
+    wall went, phase by phase;
+  - the **metrics registry snapshot** (obs/metrics) — retries,
+    rollbacks, dead-letters, cache hits;
+  - the **per-iteration history** (utils/metrics.MetricsLogger) and
+    run summary — convergence telemetry (asynchronous-iteration
+    analyses, Kollias et al., arXiv:cs/0606047: convergence telemetry
+    is what makes solver behaviour debuggable);
+  - the **robustness summary** (docs/ROBUSTNESS.md counters).
+
+``python -m pagerank_tpu.obs report A.json [B.json]`` pretty-prints one
+report or diffs two phase-by-phase (wall and rate deltas), separating
+code regressions from backend drift.
+
+Reports are STRICT JSON: every float is sanitized (non-finite -> null)
+and dumped with ``allow_nan=False``, so no consumer ever sees a bare
+``Infinity`` (the defect class fixed in utils/metrics.py — ISSUE 4
+satellite 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from pagerank_tpu.utils import fsio
+
+SCHEMA_VERSION = 1
+
+#: Top-level keys every run report carries (schema-stability contract,
+#: tests/test_obs.py::test_cli_run_report_schema).
+REPORT_KEYS = (
+    "schema_version", "created_unix", "environment", "config", "spans",
+    "metrics", "iterations", "summary", "robustness",
+)
+
+
+def _json_safe(obj):
+    """Recursively coerce to strict-JSON values: non-finite floats ->
+    None, dataclasses -> dicts, unknown scalars -> repr strings."""
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _json_safe(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    try:  # numpy scalars: sanitize through their python value
+        return _json_safe(obj.item())
+    except (AttributeError, ValueError):
+        return repr(obj)
+
+
+def git_rev(repo_dir: Optional[str] = None) -> Optional[str]:
+    """Short git revision of the checkout (None outside a repo / without
+    git) — pins the code axis of a report."""
+    if repo_dir is None:
+        repo_dir = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=repo_dir,
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def environment_fingerprint() -> Dict[str, object]:
+    """The backend-drift axis: everything about WHERE a run executed
+    that can move its numbers without a code change. jax is imported
+    lazily and every field degrades to None rather than failing — a
+    report must be writable even when the backend is broken (that run
+    is the one most worth explaining)."""
+    import platform
+
+    env: Dict[str, object] = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "git_rev": git_rev(),
+    }
+    try:
+        import jax
+
+        env["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+
+            env["jaxlib_version"] = jaxlib.__version__
+        except (ImportError, AttributeError):
+            env["jaxlib_version"] = None
+        try:
+            env["backend"] = jax.default_backend()
+            devs = jax.devices()
+            env["device_count"] = len(devs)
+            env["device_kind"] = devs[0].device_kind if devs else None
+        except Exception as e:  # backend init failure: record, don't die
+            env["backend"] = None
+            env["device_count"] = None
+            env["device_kind"] = None
+            env["backend_error"] = repr(e)
+        try:
+            # Also touches the backend — same degrade-to-None contract
+            # as above (a broken backend is the run MOST worth a report).
+            env["process_count"] = jax.process_count()
+        except Exception:
+            env["process_count"] = None
+        env["x64"] = bool(jax.config.jax_enable_x64)
+    except ImportError:
+        env["jax_version"] = None
+    return env
+
+
+def build_run_report(
+    config=None,
+    tracer=None,
+    registry=None,
+    history: Optional[List[dict]] = None,
+    summary: Optional[dict] = None,
+    robustness: Optional[dict] = None,
+    extra: Optional[dict] = None,
+) -> dict:
+    """Assemble the report dict. Every section is optional — a bench
+    run has no per-iteration history, a CPU run has no profile — but
+    every REPORT_KEYS key is always present (null/empty when unused)
+    so consumers never key-error across producers."""
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "created_unix": time.time(),
+        "environment": environment_fingerprint(),
+        "config": _json_safe(config) if config is not None else None,
+        "spans": _json_safe(tracer.summary()) if tracer is not None else {},
+        "metrics": _json_safe(registry.snapshot())
+        if registry is not None else {},
+        "iterations": _json_safe(history or []),
+        "summary": _json_safe(summary or {}),
+        "robustness": _json_safe(robustness or {}),
+    }
+    if extra:
+        report.update(_json_safe(extra))
+    return report
+
+
+def write_run_report(path: str, report: dict) -> None:
+    """Strict-JSON dump (``allow_nan=False``: a non-finite float
+    reaching here is a bug in _json_safe coverage, surfaced loudly)."""
+    with fsio.fopen(path, "w") as f:
+        json.dump(report, f, indent=2, allow_nan=False)
+        f.write("\n")
+
+
+def load_report(path: str) -> dict:
+    with fsio.fopen(path) as f:
+        return json.load(f)
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def render_report(report: dict) -> str:
+    """Human view of one report: environment, headline rates, phase
+    table, robustness + notable metrics."""
+    lines = []
+    env = report.get("environment", {})
+    lines.append(
+        f"run report (schema v{report.get('schema_version')}): "
+        f"jax {env.get('jax_version')} / jaxlib {env.get('jaxlib_version')}"
+        f", backend {env.get('backend')} ({env.get('device_kind')}, "
+        f"{env.get('device_count')} device(s)), x64={env.get('x64')}, "
+        f"git {env.get('git_rev')}"
+    )
+    summ = report.get("summary") or {}
+    if summ:
+        its = summ.get("iters")
+        ms = summ.get("mean_iter_seconds")
+        eps = summ.get("edges_per_sec_per_chip")
+        lines.append(
+            f"solve: {its} iters, "
+            + (f"{ms * 1e3:.2f} ms/iter, " if ms is not None else "")
+            + (f"{eps:.4g} edges/s/chip" if eps is not None else "")
+        )
+    spans = report.get("spans") or {}
+    if spans:
+        lines.append("phases (total wall, count, mean):")
+        w = max(len(n) for n in spans)
+        for name, a in spans.items():
+            lines.append(
+                f"  {name:<{w}}  {a['total_s']:9.3f}s  x{a['count']:<5d}"
+                f"  mean {a['mean_s'] * 1e3:9.2f} ms"
+            )
+    rb = report.get("robustness") or {}
+    if any(rb.values()):
+        lines.append(
+            "robustness: "
+            + ", ".join(f"{k}={v}" for k, v in rb.items() if v)
+        )
+    mets = report.get("metrics") or {}
+    counters = mets.get("counters") or {}
+    if counters:
+        lines.append("counters:")
+        for k, v in counters.items():
+            lines.append(f"  {k} = {v}")
+    n_iter = len(report.get("iterations") or [])
+    if n_iter:
+        lines.append(f"iterations recorded: {n_iter}")
+    return "\n".join(lines)
+
+
+def _rel(a, b) -> Optional[float]:
+    if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+        return None
+    if a == 0:
+        return None
+    return (b - a) / a
+
+
+def diff_reports(a: dict, b: dict) -> str:
+    """Phase-by-phase diff of two reports: environment differences
+    first (the backend-drift axis — if these differ, wall deltas below
+    may be drift, not code), then per-phase wall deltas, rate deltas,
+    and counter deltas. The r5 'environment variance' problem as a
+    mechanical comparison."""
+    lines = []
+    ea, eb = a.get("environment", {}), b.get("environment", {})
+    keys = sorted(set(ea) | set(eb))
+    env_diffs = [
+        f"  {k}: {ea.get(k)!r} -> {eb.get(k)!r}"
+        for k in keys if ea.get(k) != eb.get(k) and k != "git_rev"
+    ]
+    if ea.get("git_rev") != eb.get("git_rev"):
+        lines.append(
+            f"code: git {ea.get('git_rev')} -> {eb.get('git_rev')}"
+        )
+    if env_diffs:
+        lines.append("environment DIFFERS (wall deltas below may be "
+                     "backend drift, not code):")
+        lines.extend(env_diffs)
+    else:
+        lines.append("environment: identical (deltas below are code or "
+                     "load, not backend drift)")
+
+    sa, sb = a.get("spans") or {}, b.get("spans") or {}
+    names = sorted(set(sa) | set(sb),
+                   key=lambda n: -(sa.get(n, sb.get(n))["total_s"]))
+    if names:
+        lines.append("phase wall deltas (A -> B):")
+        w = max(len(n) for n in names)
+        for name in names:
+            ta = sa.get(name, {}).get("total_s")
+            tb = sb.get(name, {}).get("total_s")
+            rel = _rel(ta, tb)
+            tag = (f"{rel:+.1%}" if rel is not None
+                   else "only in B" if ta is None else "only in A")
+            lines.append(
+                f"  {name:<{w}}  {_fmt_s(ta):>10} -> {_fmt_s(tb):>10}"
+                f"  {tag}"
+            )
+
+    ra, rb = a.get("summary") or {}, b.get("summary") or {}
+    rate_keys = ("mean_iter_seconds", "iters_per_sec",
+                 "edges_per_sec_per_chip")
+    rate_lines = []
+    for k in rate_keys:
+        va, vb = ra.get(k), rb.get(k)
+        if va is None and vb is None:
+            continue
+        rel = _rel(va, vb)
+        rate_lines.append(
+            f"  {k}: {va if va is not None else '-'} -> "
+            f"{vb if vb is not None else '-'}"
+            + (f"  ({rel:+.1%})" if rel is not None else "")
+        )
+    if rate_lines:
+        lines.append("rate deltas:")
+        lines.extend(rate_lines)
+
+    ca = (a.get("metrics") or {}).get("counters") or {}
+    cb = (b.get("metrics") or {}).get("counters") or {}
+    counter_lines = [
+        f"  {k}: {ca.get(k, 0)} -> {cb.get(k, 0)}"
+        for k in sorted(set(ca) | set(cb)) if ca.get(k, 0) != cb.get(k, 0)
+    ]
+    if counter_lines:
+        lines.append("counter deltas:")
+        lines.extend(counter_lines)
+    return "\n".join(lines)

@@ -1,0 +1,381 @@
+"""Span tracing — the structural half of the observability layer
+(docs/OBSERVABILITY.md).
+
+The reference's entire observability is one println per iteration
+(Sparky.java:188); partition-centric PageRank work (Lakhotia et al.,
+arXiv:1709.07122, PAPERS.md) shows per-stage timing ATTRIBUTION is what
+drives the next optimisation. This module is the attribution substrate:
+a zero-dependency :class:`Tracer` whose nested context-manager spans
+(``with tracer.span("build/sort"):``) record wall time, attributes and
+parent/child structure, exportable as JSONL or Chrome trace-event JSON
+(loadable in Perfetto / ``chrome://tracing``).
+
+Design constraints, in priority order:
+
+  1. **The hot path pays nothing when tracing is off.** The process
+     default is :data:`NULL_TRACER` (``enabled`` False); its ``span()``
+     returns ONE shared no-op context manager (no allocation, no
+     recording), and per-iteration call sites gate on ``.enabled`` so a
+     production solve makes zero tracer-induced host calls per
+     iteration (tests/test_obs.py::test_noop_tracer_hot_path).
+  2. **Thread-correct nesting.** The AsyncRankWriter worker records
+     spans concurrently with the solve loop; span stacks are
+     thread-local and the finished-span list is lock-protected, so
+     parent/child linkage never crosses threads.
+  3. **One timebase.** Spans are measured on ``time.perf_counter``
+     relative to the tracer's epoch; the epoch's wall-clock
+     (``time.time``) is exported once in the trace header so tools can
+     anchor absolute time without per-span clock mixing.
+
+Naming scheme (docs/OBSERVABILITY.md): ``layer/stage`` with ``/`` as
+the hierarchy separator — ``ingest/edgelist``, ``build/sort``,
+``engine/compile``, ``solve/step``, ``snapshot/save``,
+``writer/queue_wait``, ``retry/attempt``, ``profile``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from pagerank_tpu.utils import fsio
+
+
+class Span:
+    """One finished (or live) span. ``start``/``duration`` are seconds
+    on the owning tracer's perf_counter timebase (relative to its
+    epoch); ``attrs`` is a plain JSON-able dict."""
+
+    __slots__ = ("span_id", "name", "start", "duration", "parent_id",
+                 "tid", "attrs")
+
+    def __init__(self, span_id: int, name: str, start: float,
+                 parent_id: Optional[int], tid: int, attrs: dict):
+        self.span_id = span_id
+        self.name = name
+        self.start = start
+        self.duration = 0.0
+        self.parent_id = parent_id
+        self.tid = tid
+        self.attrs = attrs
+
+    @property
+    def end(self) -> float:
+        return self.start + self.duration
+
+    def to_json(self) -> dict:
+        return {
+            "type": "span",
+            "id": self.span_id,
+            "name": self.name,
+            "start_s": self.start,
+            "duration_s": self.duration,
+            "parent": self.parent_id,
+            "tid": self.tid,
+            "attrs": self.attrs,
+        }
+
+
+class _SpanCm:
+    """The live-span context manager. Yields the :class:`Span` so the
+    body can attach attributes (``sp.attrs["bytes"] = n``); records the
+    span on exit. On an exception the span is still recorded, with
+    ``error`` set to the exception type — a failing stage is exactly
+    the one the trace must show."""
+
+    __slots__ = ("_tracer", "_span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Span:
+        self._tracer._push(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._span.attrs["error"] = exc_type.__name__
+        self._tracer._pop(self._span)
+        return False
+
+
+class _NullCm:
+    """The shared no-op context manager NULL_TRACER.span() returns:
+    nothing is allocated or recorded, and the body receives None (call
+    sites that attach attributes must gate on ``tracer.enabled``)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CM = _NullCm()
+
+
+class NullTracer:
+    """Disabled tracer — the process default. Every operation is a
+    no-op; ``span()`` returns one shared context manager so the
+    disabled path allocates nothing."""
+
+    enabled = False
+
+    def span(self, name: str, **attrs):
+        return _NULL_CM
+
+    def add_span(self, name: str, start_pc: float, duration: float,
+                 **attrs) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs) -> None:
+        pass
+
+    def spans(self) -> List[Span]:
+        return []
+
+    def events(self) -> List[dict]:
+        return []
+
+    def summary(self) -> Dict[str, dict]:
+        return {}
+
+
+NULL_TRACER = NullTracer()
+
+
+class Tracer:
+    """Recording tracer: nested context-manager spans with thread-local
+    stacks, instant events, aggregation, and JSONL / Chrome trace-event
+    export."""
+
+    enabled = True
+
+    def __init__(self):
+        self.epoch_pc = time.perf_counter()
+        self.epoch_unix = time.time()
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._events: List[dict] = []
+        self._local = threading.local()
+        self._next_id = 0
+
+    # -- recording --------------------------------------------------------
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _new_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def span(self, name: str, **attrs) -> _SpanCm:
+        """Open a nested span; use as ``with tracer.span("build/sort",
+        edges=m) as sp:``. Parent is the innermost live span on THIS
+        thread."""
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(self._new_id(), name,
+                  time.perf_counter() - self.epoch_pc, parent,
+                  threading.get_ident(), dict(attrs))
+        return _SpanCm(self, sp)
+
+    def _push(self, sp: Span) -> None:
+        self._stack().append(sp)
+
+    def _pop(self, sp: Span) -> None:
+        sp.duration = (time.perf_counter() - self.epoch_pc) - sp.start
+        stack = self._stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        else:  # defensive: out-of-order exit must not corrupt linkage
+            try:
+                stack.remove(sp)
+            except ValueError:
+                pass
+        with self._lock:
+            self._spans.append(sp)
+
+    def add_span(self, name: str, start_pc: float, duration: float,
+                 **attrs) -> None:
+        """Record a PRE-MEASURED span from raw ``time.perf_counter``
+        readings — for stages whose timing already exists (the device
+        build's fenced stage walls) so the measurement is made once and
+        the trace is a faithful view of it, never a second clock."""
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(self._new_id(), name, start_pc - self.epoch_pc, parent,
+                  threading.get_ident(), dict(attrs))
+        sp.duration = duration
+        with self._lock:
+            self._spans.append(sp)
+
+    def add_event(self, name: str, **attrs) -> None:
+        """Record an instant event (Chrome ``ph: "i"``) — log lines,
+        retries, rollbacks."""
+        ev = {
+            "type": "event",
+            "name": name,
+            "ts_s": time.perf_counter() - self.epoch_pc,
+            "tid": threading.get_ident(),
+            "attrs": attrs,
+        }
+        with self._lock:
+            self._events.append(ev)
+
+    # -- views ------------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-name aggregation (count / total / mean / max seconds),
+        ordered by total wall descending — the span-tree summary the
+        run flight-recorder embeds. Names are hierarchical by the
+        ``layer/stage`` convention, so sorting by name prefix recovers
+        the tree."""
+        agg: Dict[str, dict] = {}
+        for sp in self.spans():
+            a = agg.setdefault(
+                sp.name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            a["count"] += 1
+            a["total_s"] += sp.duration
+            a["max_s"] = max(a["max_s"], sp.duration)
+        for a in agg.values():
+            a["mean_s"] = a["total_s"] / a["count"]
+        return dict(
+            sorted(agg.items(), key=lambda kv: -kv[1]["total_s"])
+        )
+
+    def timings_view(self, prefix: str = "build/") -> Dict[str, float]:
+        """Total seconds per stage under ``prefix``, keyed the
+        historical ``{stage}_s`` way — the --build-only breakdown as a
+        VIEW over the trace (ops/device_build fills its ``timings``
+        dict from the very same fence measurements)."""
+        out: Dict[str, float] = {}
+        for sp in self.spans():
+            if sp.name.startswith(prefix):
+                key = sp.name[len(prefix):] + "_s"
+                out[key] = out.get(key, 0.0) + sp.duration
+        return out
+
+    # -- export -----------------------------------------------------------
+
+    def _header(self) -> dict:
+        return {
+            "type": "trace_header",
+            "schema_version": 1,
+            "epoch_unix": self.epoch_unix,
+            "pid": os.getpid(),
+        }
+
+    def export_jsonl(self, path: str) -> None:
+        """One JSON object per line: a trace_header, then every span and
+        instant event. Strict JSON (no NaN/Infinity) by construction —
+        durations are finite perf_counter differences."""
+        with fsio.fopen(path, "w") as f:
+            f.write(json.dumps(self._header()) + "\n")
+            for sp in self.spans():
+                f.write(json.dumps(sp.to_json()) + "\n")
+            for ev in self.events():
+                f.write(json.dumps(ev) + "\n")
+
+    def chrome_events(self) -> List[dict]:
+        """Chrome trace-event list: complete ("X") events for spans,
+        instant ("i") events for events. ``ts``/``dur`` are MICROSECONDS
+        (the format's unit), pid/tid integers."""
+        pid = os.getpid()
+        out = []
+        for sp in self.spans():
+            out.append({
+                "name": sp.name,
+                "cat": sp.name.split("/", 1)[0],
+                "ph": "X",
+                "ts": sp.start * 1e6,
+                "dur": sp.duration * 1e6,
+                "pid": pid,
+                "tid": sp.tid,
+                "args": sp.attrs,
+            })
+        for ev in self.events():
+            out.append({
+                "name": ev["name"],
+                "cat": ev["name"].split("/", 1)[0],
+                "ph": "i",
+                "ts": ev["ts_s"] * 1e6,
+                "pid": pid,
+                "tid": ev["tid"],
+                "s": "t",
+                "args": ev["attrs"],
+            })
+        return out
+
+    def export_chrome(self, path: str) -> None:
+        """Write the Chrome trace-event JSON object form (Perfetto /
+        ``chrome://tracing`` load it directly)."""
+        doc = {
+            "traceEvents": self.chrome_events(),
+            "displayTimeUnit": "ms",
+            "metadata": {"epoch_unix": self.epoch_unix},
+        }
+        with fsio.fopen(path, "w") as f:
+            json.dump(doc, f)
+
+    def export(self, path: str) -> None:
+        """Dispatch on extension: ``.jsonl`` -> JSONL, anything else ->
+        Chrome trace-event JSON."""
+        if path.endswith(".jsonl"):
+            self.export_jsonl(path)
+        else:
+            self.export_chrome(path)
+
+
+# -- process-global default tracer -----------------------------------------
+
+_TRACER = NULL_TRACER
+
+
+def get_tracer():
+    """The process-global tracer — NULL_TRACER unless
+    :func:`enable_tracing` installed a recording one."""
+    return _TRACER
+
+
+def enable_tracing(tracer: Optional[Tracer] = None) -> Tracer:
+    """Install (and return) a recording tracer as the process default.
+    Instrumented call sites across the package pick it up on their next
+    ``get_tracer()`` read."""
+    global _TRACER
+    _TRACER = tracer if tracer is not None else Tracer()
+    return _TRACER
+
+
+def disable_tracing():
+    """Restore the no-op default; returns the tracer that was active
+    (so a caller can still export what it recorded)."""
+    global _TRACER
+    prev = _TRACER
+    _TRACER = NULL_TRACER
+    return prev
+
+
+def span(name: str, **attrs):
+    """Convenience: a span on the CURRENT process-global tracer (no-op
+    context manager when tracing is disabled)."""
+    return _TRACER.span(name, **attrs)

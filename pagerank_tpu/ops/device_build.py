@@ -70,6 +70,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pagerank_tpu import graph as graph_lib
+from pagerank_tpu.obs import log as obs_log
+from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.ops import LANES
 from pagerank_tpu.utils import compile_cache
 
@@ -81,13 +83,23 @@ def _stage_fence(timings, key, t0, *arrays):
     the whole stage) and charge the elapsed wall to ``timings[key]``.
     Stage walls INCLUDE any compile that stage paid — the separate
     ``compile_s`` key (stage_call) says how much. No-op (keeping the
-    build fully async) when ``timings`` is None."""
+    build fully async) when ``timings`` is None.
+
+    The SAME measurement is recorded as a ``build/{stage}`` span on the
+    active tracer (obs/trace), so the --build-only breakdown and a
+    Chrome trace of the build can never disagree — the dict is a view
+    over the fence, not a second clock."""
     if timings is None:
         return
     for a in arrays:
         if a is not None:
             jax.device_get(jnp.sum(jnp.reshape(a, (-1,))[:1]))
-    timings[key] = timings.get(key, 0.0) + time.perf_counter() - t0
+    dur = time.perf_counter() - t0
+    timings[key] = timings.get(key, 0.0) + dur
+    tracer = obs_trace.get_tracer()
+    if tracer.enabled:
+        stage = key[:-2] if key.endswith("_s") else key
+        tracer.add_span("build/" + stage, t0, dur, fenced=True)
 
 
 @jax.jit
@@ -212,8 +224,6 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
     are fine) enables the occupancy-aware pair-span doubling on sparse
     graphs (JaxTpuEngine.occupancy_span — measured +30% at R-MAT 26
     ef 8)."""
-    import sys
-
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
 
     n_padded = -(-n // LANES) * LANES
@@ -244,8 +254,7 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
     )
     grp = JaxTpuEngine.clamp_group_for_span(grp_req, span)
     if grp != grp_req:
-        print(f"pagerank_tpu: lane group clamped to {grp} for span {span}",
-              file=sys.stderr)
+        obs_log.info(f"lane group clamped to {grp} for span {span}")
     return grp, stripe
 
 
@@ -536,6 +545,12 @@ def build_ell_device(
     """
     if group < 1 or group > LANES or (group & (group - 1)):
         raise ValueError(f"group must be a power of two in [1, {LANES}]")
+    if timings is None and obs_trace.get_tracer().enabled:
+        # Tracing is on: engage the per-stage fences so the trace
+        # carries honest stage walls rather than async dispatch time.
+        # Observer effect — the stages serialize, exactly as in
+        # --build-only timing mode (docs/OBSERVABILITY.md).
+        timings = {}
     n_padded = -(-n // LANES) * LANES
     if stripe_size and (stripe_size <= 0 or stripe_size % LANES):
         raise ValueError("stripe_size must be a positive multiple of 128")

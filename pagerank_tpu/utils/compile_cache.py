@@ -28,7 +28,6 @@ smaller, same-keyed caches:
 from __future__ import annotations
 
 import os
-import sys
 import time
 
 
@@ -121,19 +120,32 @@ def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
     aval_key = tuple(
         (tuple(a.shape), str(a.dtype)) for a in args
     )
+    from pagerank_tpu.obs import metrics as obs_metrics
+    from pagerank_tpu.obs import trace as obs_trace
+
     key = (name, dev.platform, getattr(dev, "device_kind", ""),
            tuple(static_key), tuple(donate_argnums), aval_key)
     exe = _STAGE_EXECS.get(key)
     if exe is None:
+        obs_metrics.counter(
+            "compile_cache.stage_misses",
+            "build-stage programs lowered+compiled this process",
+        ).inc()
         t0 = time.perf_counter()
-        exe = jax.jit(fn, donate_argnums=donate_argnums).lower(
-            *args
-        ).compile()
+        with obs_trace.span("build/compile", stage=name):
+            exe = jax.jit(fn, donate_argnums=donate_argnums).lower(
+                *args
+            ).compile()
         _STAGE_EXECS[key] = exe
         if timings is not None:
             timings["compile_s"] = (
                 timings.get("compile_s", 0.0) + time.perf_counter() - t0
             )
+    else:
+        obs_metrics.counter(
+            "compile_cache.stage_hits",
+            "build-stage dispatches served by the AOT executable cache",
+        ).inc()
     return exe(*args)
 
 
@@ -169,5 +181,6 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception as e:
-        print(f"pagerank_tpu: compilation cache unavailable ({e})",
-              file=sys.stderr)
+        from pagerank_tpu.obs import log as obs_log
+
+        obs_log.warn(f"compilation cache unavailable ({e})")

@@ -9,6 +9,7 @@ JSONL file.
 from __future__ import annotations
 
 import json
+import math
 import sys
 import time
 from typing import Dict, Optional, TextIO
@@ -68,30 +69,44 @@ class MetricsLogger:
         dispatch (JaxTpuEngine.run_fused) rather than measured per call.
         Pass ``timing="averaged"`` there so JSONL consumers can tell the
         synthetic per-record seconds from genuinely measured ones."""
+        # A zero/negative dt (clock granularity on a trivial graph)
+        # yields null rates, NOT float("inf"): json.dumps writes inf as
+        # a bare ``Infinity`` token, which is not JSON — strict JSONL
+        # consumers (json.loads with parse_constant raising) choke on
+        # the whole line (tests/test_obs.py::test_metrics_jsonl_is_strict_json).
         rec = {
             "iter": iteration,
             "seconds": dt,
-            "iters_per_sec": (1.0 / dt) if dt > 0 else float("inf"),
+            "iters_per_sec": (1.0 / dt) if dt > 0 else None,
             "edges_per_sec_per_chip": self.num_edges / dt / self.num_chips
             if dt > 0
-            else float("inf"),
+            else None,
         }
         if timing is not None:
             rec["timing"] = timing
         for k in ("l1_delta", "dangling_mass"):
             if k in info:
-                rec[k] = float(info[k])
+                # Non-finite step info (a diverging solve under
+                # --no-health-checks) is encoded as null too — NaN is
+                # no more a JSON token than Infinity is.
+                v = float(info[k])
+                rec[k] = v if math.isfinite(v) else None
         self.history.append(rec)
         if self._jsonl:
-            self._jsonl.write(json.dumps(rec) + "\n")
+            # allow_nan=False: any non-finite float reaching the dump
+            # is a bug in the sanitizing above — fail loudly rather
+            # than emitting a non-spec line.
+            self._jsonl.write(json.dumps(rec, allow_nan=False) + "\n")
             self._jsonl.flush()
         if self.log_every and iteration % self.log_every == 0:
             parts = [f"iter {iteration}", f"{dt * 1e3:.1f} ms"]
-            if "l1_delta" in rec:
+            if rec.get("l1_delta") is not None:
                 parts.append(f"l1_delta {rec['l1_delta']:.3e}")
-            if "dangling_mass" in rec:
+            if rec.get("dangling_mass") is not None:
                 parts.append(f"mass {rec['dangling_mass']:.6g}")
-            parts.append(f"{rec['edges_per_sec_per_chip']:.3g} edges/s/chip")
+            eps = rec["edges_per_sec_per_chip"]
+            if eps is not None:
+                parts.append(f"{eps:.3g} edges/s/chip")
             print("  ".join(parts), file=self.stream)
 
     def summary(
@@ -132,10 +147,13 @@ class MetricsLogger:
             "iters": len(self.history),
             "timed_iters": n,
             "mean_iter_seconds": total / n,
-            "iters_per_sec": n / total if total > 0 else float("inf"),
+            # Same discipline as record(): a degenerate zero wall-clock
+            # reports null rates, never Infinity (the summary is embedded
+            # verbatim in run_report.json, which is strict JSON).
+            "iters_per_sec": n / total if total > 0 else None,
             "edges_per_sec_per_chip": self.num_edges * n / total / self.num_chips
             if total > 0
-            else float("inf"),
+            else None,
         }
 
     def close(self) -> None:

@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from pagerank_tpu.obs import trace as obs_trace
+
 
 def default_retryable(exc: BaseException) -> bool:
     """Transient-I/O default: network/socket/timeout errors retry;
@@ -101,12 +103,21 @@ class RetryPolicy:
         caller's except clauses keep working). ``on_retry(failure,
         delay, exc)`` fires before each backoff sleep."""
         is_retryable = retryable if retryable is not None else self.retryable
+        # Tracer read once per call(): each attempt becomes a
+        # ``retry/attempt`` span (with the failure count and backoff as
+        # attributes) when tracing is on; the disabled path touches the
+        # tracer zero times per attempt.
+        tracer = obs_trace.get_tracer()
+        traced = tracer.enabled
         start = self.clock()
         failures = 0
         while True:
             if stats is not None:
                 stats.attempts += 1
             try:
+                if traced:
+                    with tracer.span("retry/attempt", attempt=failures + 1):
+                        return fn()
                 return fn()
             except BaseException as e:
                 failures += 1
@@ -121,4 +132,9 @@ class RetryPolicy:
                 if stats is not None:
                     stats.retries += 1
                     stats.slept += delay
+                if traced:
+                    tracer.add_event(
+                        "retry/backoff", failure=failures,
+                        delay_s=delay, error=type(e).__name__,
+                    )
                 self.sleep(delay)

@@ -39,6 +39,7 @@ import xml.etree.ElementTree as ET
 from typing import Dict, Iterator, List, Optional, Tuple
 from xml.sax.saxutils import escape as ET_escape
 
+from pagerank_tpu.obs import metrics as obs_metrics
 from pagerank_tpu.utils import fsio
 from pagerank_tpu.utils.retry import RetryPolicy, RetryStats
 
@@ -321,8 +322,19 @@ class S3FileSystem(fsio.FileSystem):
                 raise _TransientStatus(result)
             return result
 
+        def on_retry(failures, delay, exc):
+            # Per-instance RetryStats stays the CLI's summary source;
+            # the central registry gets the same count so one snapshot
+            # covers every S3FileSystem in the process (obs/metrics).
+            obs_metrics.counter(
+                "s3.request.retries",
+                "transparent S3 request re-attempts (transient "
+                "status / network error)",
+            ).inc()
+
         try:
-            return self.retry.call(once, stats=self.retry_stats)
+            return self.retry.call(once, stats=self.retry_stats,
+                                   on_retry=on_retry)
         except _TransientStatus as e:
             return e.result
 
@@ -594,6 +606,7 @@ class S3FileSystem(fsio.FileSystem):
                 delay = self.retry.backoff(failures)
                 self.retry_stats.retries += 1
                 self.retry_stats.slept += delay
+                obs_metrics.counter("s3.request.retries").inc()
                 self.retry.sleep(delay)
 
     def _get(self, path: str) -> bytes:

@@ -27,6 +27,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.utils import fsio
 from pagerank_tpu.utils.retry import RetryPolicy
 
@@ -70,21 +72,32 @@ class Snapshotter:
 
     def save(self, iteration: int, ranks: np.ndarray) -> str:
         p = self.path(iteration)
-        # atomic: a killed run never leaves a torn file under the
-        # consumers' name pattern (suffix keeps the historical
-        # *.tmp.npz spelling tests/test_hardening.py filters on)
-        with fsio.atomic_write(p, "wb", suffix=".tmp.npz") as f:
-            np.savez(
-                f,
-                ranks=ranks,
-                iteration=np.int64(iteration),
-                fingerprint=np.bytes_(self.fingerprint.encode()),
-                semantics=np.bytes_(self.semantics.encode()),
-                checksum=np.bytes_(
-                    _digest(ranks, iteration, self.fingerprint,
-                            self.semantics).encode()
-                ),
-            )
+        with obs_trace.span("snapshot/save", iteration=iteration) as sp:
+            # atomic: a killed run never leaves a torn file under the
+            # consumers' name pattern (suffix keeps the historical
+            # *.tmp.npz spelling tests/test_hardening.py filters on)
+            with fsio.atomic_write(p, "wb", suffix=".tmp.npz") as f:
+                np.savez(
+                    f,
+                    ranks=ranks,
+                    iteration=np.int64(iteration),
+                    fingerprint=np.bytes_(self.fingerprint.encode()),
+                    semantics=np.bytes_(self.semantics.encode()),
+                    checksum=np.bytes_(
+                        _digest(ranks, iteration, self.fingerprint,
+                                self.semantics).encode()
+                    ),
+                )
+                nbytes = f.tell()
+            obs_metrics.counter(
+                "snapshot.bytes_written",
+                "total snapshot payload bytes committed",
+            ).inc(nbytes)
+            obs_metrics.histogram(
+                "snapshot.save_bytes", "per-snapshot file size"
+            ).record(nbytes)
+            if sp is not None:
+                sp.attrs["bytes"] = nbytes
         return p
 
     def iterations(self) -> List[int]:
@@ -234,6 +247,11 @@ class TextDumper:
     CHUNK_ROWS = 1 << 20
 
     def dump(self, iteration: int, ranks: np.ndarray) -> str:
+        with obs_trace.span("snapshot/dump", iteration=iteration,
+                            rows=len(ranks)):
+            return self._dump(iteration, ranks)
+
+    def _dump(self, iteration: int, ranks: np.ndarray) -> str:
         from pagerank_tpu.ingest.native import format_rank_lines_native
 
         d = fsio.join(self.directory, f"PageRank{iteration}")
@@ -319,6 +337,11 @@ class SinkGuard:
 
         def on_retry(failures, delay, exc):
             self.retries += 1
+            obs_metrics.counter(
+                "sink.write_retries",
+                "snapshot/dump write re-attempts under the SinkGuard "
+                "policy",
+            ).inc()
 
         try:
             if self._policy is not None:
@@ -334,6 +357,11 @@ class SinkGuard:
             self.dropped.append(
                 {"iteration": int(iteration), "error": repr(e)}
             )
+            obs_metrics.counter(
+                "sink.dead_letters",
+                "iterations dropped under on_write_failure="
+                "'warn_and_drop'",
+            ).inc()
             self._flush_dead_letter()
             warnings.warn(
                 f"{self.label}: dropped iteration {iteration} after "
@@ -434,7 +462,15 @@ class AsyncRankWriter:
         if self._closed:
             raise RuntimeError("submit() after close()")
         self._check()
-        self._q.put((iteration, payload))
+        tracer = obs_trace.get_tracer()
+        if tracer.enabled:
+            # The put blocks when the writer falls behind (max_pending
+            # full) — exactly the backpressure a trace must show: it is
+            # solve wall-clock spent waiting on I/O.
+            with tracer.span("writer/queue_wait", iteration=iteration):
+                self._q.put((iteration, payload))
+        else:
+            self._q.put((iteration, payload))
         # Re-check: if the worker failed while the put above blocked on a
         # full queue, fail now rather than queueing more device copies.
         self._check()

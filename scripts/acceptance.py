@@ -111,8 +111,15 @@ CONFIGS = {
     # and a broken build pipeline fails everything after it anyway.
     "D": dict(kind="build", scale=18,
               label="build-stage smoke (scale-18 pair-f64 device build)"),
+    # Observability smoke (ISSUE 4): a tiny traced CLI run that must
+    # produce a complete run_report.json (every REPORT_KEYS section,
+    # env fingerprint, per-iteration history) and a parseable Chrome
+    # trace, in under OBS_SMOKE_BUDGET_S. Right after D: sub-second,
+    # and every other gate's artifacts lean on this layer.
+    "G": dict(kind="obs", iters=4,
+              label="observability smoke (traced run + flight recorder)"),
 }
-DEFAULT_KEYS = ["D", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -120,6 +127,13 @@ DEFAULT_KEYS = ["D", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 # compile cache while still catching an order-of-magnitude build
 # regression of the r5 class (74.8s at scale 23).
 BUILD_SMOKE_BUDGET_S = 60.0
+
+# Budget for the observability smoke (seconds): a 4-iteration cpu-engine
+# run on a 400-vertex graph plus two JSON artifacts is tens of
+# milliseconds; 2s absorbs a loaded host while still catching an
+# accidentally-heavyweight tracer (the whole point of the no-op/cheap
+# contract, docs/OBSERVABILITY.md).
+OBS_SMOKE_BUDGET_S = 2.0
 
 # PPR gates. Top-k membership is judged against ORACLE SCORES, not id
 # sets: vertices tied at the k-th score legitimately swap in/out of an
@@ -289,6 +303,92 @@ def run_fault_smoke(key: str):
         f"write retr(y/ies), {health1['rollbacks']} rollback(s); schedule "
         f"{'reproducible' if rec['schedule_reproducible'] else 'DIVERGED'}; "
         f"oracle L1 {l1:.3e} vs gate {GATE:g} ({t_run:.1f}s) -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+def run_obs_smoke(key: str):
+    """ISSUE-4 observability gate, in milliseconds not minutes: one
+    traced CLI run (`--trace` + `--run-report`) on a tiny synthetic
+    graph. Gates: the CLI exits 0, run_report.json carries EVERY
+    schema section (obs/report.REPORT_KEYS) + the env fingerprint +
+    one history record per iteration + a solve/step span per
+    iteration, the Chrome trace parses as STRICT JSON with schema-
+    complete events, and the whole thing lands under
+    OBS_SMOKE_BUDGET_S."""
+    import shutil
+    import tempfile
+
+    from pagerank_tpu.cli import main as cli_main
+    from pagerank_tpu.obs.report import REPORT_KEYS
+
+    spec = CONFIGS[key]
+    iters = spec["iters"]
+    work = tempfile.mkdtemp(prefix="pagerank_obs_")
+    t0 = time.perf_counter()
+    try:
+        report_path = os.path.join(work, "run_report.json")
+        trace_path = os.path.join(work, "trace.json")
+        rc = cli_main([
+            "--synthetic", "uniform:400:3000", "--engine", "cpu",
+            "--iters", str(iters), "--log-every", "0",
+            "--trace", trace_path, "--run-report", report_path,
+        ])
+
+        def strict(path):
+            def no_const(name):
+                raise ValueError(f"non-spec JSON constant {name!r}")
+
+            with open(path) as f:
+                return json.load(f, parse_constant=no_const)
+
+        report = strict(report_path)
+        trace_doc = strict(trace_path)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    t_run = time.perf_counter() - t0
+
+    missing = [k for k in REPORT_KEYS if k not in report]
+    env_ok = all(
+        k in report.get("environment", {})
+        for k in ("jax_version", "backend", "device_kind", "x64", "git_rev")
+    )
+    steps = report.get("spans", {}).get("solve/step", {})
+    events = trace_doc.get("traceEvents", [])
+    trace_ok = bool(events) and all(
+        "name" in e and e.get("ph") in ("X", "i") and "ts" in e
+        and "pid" in e and "tid" in e
+        and ("dur" in e if e.get("ph") == "X" else True)
+        for e in events
+    )
+    passed = bool(
+        rc == 0 and not missing and env_ok and trace_ok
+        and steps.get("count") == iters
+        and len(report.get("iterations", [])) == iters
+        and t_run <= OBS_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "obs",
+        "label": spec["label"],
+        "iters": iters,
+        "missing_report_keys": missing,
+        "env_fingerprint_ok": env_ok,
+        "trace_events": len(events),
+        "trace_schema_ok": trace_ok,
+        "seconds": t_run,
+        "budget_s": OBS_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] traced run + flight recorder in {t_run:.2f}s vs budget "
+        f"{OBS_SMOKE_BUDGET_S:g}s; report "
+        f"{'complete' if not missing else 'MISSING ' + repr(missing)}; "
+        f"env fingerprint {'OK' if env_ok else 'INCOMPLETE'}; "
+        f"{len(events)} trace event(s) "
+        f"{'schema-OK' if trace_ok else 'SCHEMA-BAD'} -> "
         f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
@@ -794,7 +894,7 @@ def main(argv=None) -> int:
     _enable_compile_cache()
     keys = [args.only] if args.only else DEFAULT_KEYS
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
-               "faults": run_fault_smoke}
+               "faults": run_fault_smoke, "obs": run_obs_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

@@ -67,6 +67,13 @@ FIXTURES = {
             except Exception:
                 pass
     """,
+    "PTL007": """
+        import sys
+
+        def f(msg):
+            print(msg)
+            sys.stderr.write(msg)
+    """,
 }
 
 
@@ -162,6 +169,32 @@ def test_ptl006_swallow_semantics(tmp_path):
     assert [f for f in lint_mod.lint_file(clean) if f.rule == "PTL006"] == []
 
 
+def test_ptl007_scope_exempts_cli_entry_points(tmp_path):
+    """PTL007 polices LIBRARY modules: a print/stderr-write flags under
+    a library-relative path (and in fixture mode), but the CLI entry
+    points — cli.py and any */__main__.py — are exempt by scope, and
+    prints routed to an injectable stream still flag (the deliberate
+    MetricsLogger stream is an allowlist entry, not a carve-out)."""
+    p = _write(tmp_path, "prints.py", FIXTURES["PTL007"])
+
+    def ptl007(rel):
+        return [f for f in lint_mod.lint_file(p, rel)
+                if f.rule == "PTL007"]
+
+    assert len(ptl007(None)) == 2          # fixture mode: all rules
+    assert len(ptl007("utils/foo.py")) == 2  # library module: flags
+    assert ptl007("cli.py") == []            # CLI entry point: exempt
+    assert ptl007("obs/__main__.py") == []   # module CLI: exempt
+    assert ptl007("analysis/__main__.py") == []
+
+    streamed = _write(tmp_path, "streamed.py", """
+        def f(msg, stream):
+            print(msg, file=stream)
+    """)
+    assert [f.rule for f in lint_mod.lint_file(streamed, "utils/m.py")
+            if f.rule == "PTL007"] == ["PTL007"]
+
+
 def test_lanes_assignment_is_the_one_allowed_spelling(tmp_path):
     p = _write(tmp_path, "geom.py", "LANES = 128\nHALF = 128 // 2\n")
     findings = lint_mod.lint_file(p)
@@ -250,7 +283,7 @@ def test_list_rules(capsys):
     text = capsys.readouterr().out
     assert rc == 0
     for rid in ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
-                "PTL006",
+                "PTL006", "PTL007",
                 "PTC001", "PTC002", "PTC003", "PTC004", "PTC005",
                 "PTC006"):
         assert rid in text

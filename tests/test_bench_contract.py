@@ -32,7 +32,7 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "fast_f32", "accuracy"}
+                        "build_s", "fast_f32", "accuracy", "env"}
     assert rec["build_s"] > 0 and rec["fast_f32"]["build_s"] > 0
     assert rec["metric"] == "edges_per_sec_per_chip"
     assert rec["unit"] == "edges/s/chip"
@@ -58,7 +58,11 @@ def test_bench_json_contract_single_mode(tmp_path):
     json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "build_s"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "build_s", "env"}
+    # The environment fingerprint makes future BENCH_r*.json cells
+    # comparable across backend drift (ISSUE 4; obs/report.py).
+    assert rec["env"]["jax_version"] and rec["env"]["backend"]
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
 
 
@@ -78,7 +82,8 @@ def test_bench_build_only_reports_stage_breakdown(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "scale", "pair", "f32",
-                        "pair_warm", "pair_over_f32", "pair_warm_over_f32"}
+                        "pair_warm", "pair_over_f32", "pair_warm_over_f32",
+                        "env"}
     assert rec["metric"] == "build_s" and rec["unit"] == "s"
     assert rec["value"] == rec["pair"]["build_s"] > 0
     assert rec["pair_over_f32"] > 0 and rec["pair_warm_over_f32"] > 0

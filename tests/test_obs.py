@@ -1,0 +1,587 @@
+"""Observability layer tests (ISSUE 4; docs/OBSERVABILITY.md): span
+nesting/ordering invariants, Chrome trace-event schema, the no-op
+tracer's zero-cost contract, registry snapshot round-trip, the CLI
+flight recorder's schema stability, strict-JSON metrics output, and the
+profiler session's stop-on-failure path."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import obs
+from pagerank_tpu.obs import trace as obs_trace
+from pagerank_tpu.obs.metrics import MetricsRegistry
+from pagerank_tpu.obs.report import REPORT_KEYS
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Process-global tracer/registry must never leak between tests."""
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    yield
+    obs.disable_tracing()
+    obs.get_registry().reset()
+
+
+def _strict_loads(s):
+    """json.loads that REJECTS NaN/Infinity — what a spec-compliant
+    JSONL consumer does (the regression the inf->null fix pins)."""
+
+    def _no_const(name):
+        raise ValueError(f"non-spec JSON constant {name!r}")
+
+    return json.loads(s, parse_constant=_no_const)
+
+
+# -- span tracing -----------------------------------------------------------
+
+
+def test_span_nesting_and_ordering():
+    tr = obs_trace.Tracer()
+    with tr.span("solve/run", engine="t") as outer:
+        with tr.span("solve/step", iteration=0) as s0:
+            pass
+        with tr.span("solve/step", iteration=1) as s1:
+            pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == [
+        "solve/step", "solve/step", "solve/run"
+    ]  # children finish (and record) before the parent
+    assert s0.parent_id == outer.span_id
+    assert s1.parent_id == outer.span_id
+    assert outer.parent_id is None
+    # Containment: children start at/after the parent and end at/before
+    # it; siblings are ordered.
+    assert outer.start <= s0.start and s0.end <= outer.end
+    assert outer.start <= s1.start and s1.end <= outer.end
+    assert s0.end <= s1.start
+    assert all(s.duration >= 0 for s in spans)
+    assert s0.attrs["iteration"] == 0 and s1.attrs["iteration"] == 1
+
+
+def test_span_records_error_attribute():
+    tr = obs_trace.Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("snapshot/save"):
+            raise ValueError("boom")
+    (sp,) = tr.spans()
+    assert sp.attrs["error"] == "ValueError"
+
+
+def test_span_threads_do_not_cross_link():
+    """A worker thread's spans must not parent under the main thread's
+    open span (the AsyncRankWriter records concurrently with the solve
+    loop)."""
+    tr = obs_trace.Tracer()
+    seen = {}
+
+    def worker():
+        with tr.span("writer/queue_wait") as sp:
+            seen["span"] = sp
+
+    with tr.span("solve/run"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["span"].parent_id is None
+    assert seen["span"].tid != threading.get_ident()
+
+
+def test_summary_and_timings_view():
+    tr = obs_trace.Tracer()
+    with tr.span("build/sort"):
+        pass
+    with tr.span("build/sort"):
+        pass
+    with tr.span("build/scatter"):
+        pass
+    summ = tr.summary()
+    assert summ["build/sort"]["count"] == 2
+    assert summ["build/sort"]["total_s"] == pytest.approx(
+        summ["build/sort"]["mean_s"] * 2
+    )
+    view = tr.timings_view("build/")
+    assert set(view) == {"sort_s", "scatter_s"}
+
+
+def test_chrome_trace_event_schema(tmp_path):
+    tr = obs_trace.Tracer()
+    with tr.span("a/b", k=1):
+        with tr.span("a/c"):
+            pass
+    tr.add_event("log/info", message="hello")
+    path = str(tmp_path / "trace.json")
+    tr.export(path)
+    doc = _strict_loads(open(path).read())
+    evs = doc["traceEvents"]
+    assert len(evs) == 3
+    for ev in evs:
+        # The trace-event schema fields Perfetto/chrome://tracing need.
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        else:
+            assert ev["args"]["message"] == "hello"
+
+
+def test_jsonl_trace_export_is_strict(tmp_path):
+    tr = obs_trace.Tracer()
+    with tr.span("a/b"):
+        pass
+    tr.add_event("retry/backoff", delay_s=0.5)
+    path = str(tmp_path / "trace.jsonl")
+    tr.export(path)  # .jsonl extension dispatches the JSONL exporter
+    lines = [_strict_loads(l) for l in open(path)]
+    assert lines[0]["type"] == "trace_header"
+    kinds = {l["type"] for l in lines[1:]}
+    assert kinds == {"span", "event"}
+
+
+def test_noop_tracer_hot_path():
+    """With observability disabled the solve hot path makes ZERO tracer
+    calls per iteration (the acceptance criterion): a booby-trapped
+    disabled tracer runs a full engine.run without tripping, and the
+    NullTracer's span() allocates nothing (one shared cm)."""
+    from pagerank_tpu import PageRankConfig, ReferenceCpuEngine, build_graph
+
+    class BombTracer:
+        enabled = False
+
+        def span(self, *a, **k):  # pragma: no cover - the trap
+            raise AssertionError("tracer touched on the disabled hot path")
+
+        add_span = add_event = span
+
+    assert obs_trace.get_tracer() is obs_trace.NULL_TRACER
+    # NullTracer.span() is allocation-free: the SAME object every call.
+    null = obs_trace.NULL_TRACER
+    assert null.span("x") is null.span("y", a=1)
+    obs_trace._TRACER = BombTracer()
+    try:
+        rng = np.random.default_rng(0)
+        g = build_graph(rng.integers(0, 50, 300),
+                        rng.integers(0, 50, 300), n=50)
+        eng = ReferenceCpuEngine(PageRankConfig(num_iters=5)).build(g)
+        eng.run()  # would raise if any per-iteration tracer call fired
+        assert eng.iteration == 5
+    finally:
+        obs_trace._TRACER = obs_trace.NULL_TRACER
+
+
+def test_enabled_tracer_records_solve_steps():
+    from pagerank_tpu import PageRankConfig, ReferenceCpuEngine, build_graph
+
+    tr = obs.enable_tracing()
+    rng = np.random.default_rng(0)
+    g = build_graph(rng.integers(0, 50, 300), rng.integers(0, 50, 300),
+                    n=50)
+    ReferenceCpuEngine(PageRankConfig(num_iters=4)).build(g).run()
+    steps = [s for s in tr.spans() if s.name == "solve/step"]
+    assert [s.attrs["iteration"] for s in steps] == [0, 1, 2, 3]
+
+
+# -- metrics registry -------------------------------------------------------
+
+
+def test_registry_snapshot_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("s3.request.retries").inc(3)
+    reg.gauge("engine.num_chips").set(8)
+    h = reg.histogram("snapshot.save_bytes")
+    h.record(100)
+    h.record(5000)
+    snap = reg.snapshot()
+    # Round trip through strict JSON: identical structure and values.
+    assert _strict_loads(json.dumps(snap)) == snap
+    assert snap["counters"]["s3.request.retries"] == 3
+    assert snap["gauges"]["engine.num_chips"] == 8
+    hs = snap["histograms"]["snapshot.save_bytes"]
+    assert hs["count"] == 2 and hs["min"] == 100 and hs["max"] == 5000
+    assert sum(hs["buckets"].values()) == 2
+    table = reg.render_table()
+    assert "s3.request.retries" in table and "counter" in table
+
+
+def test_registry_type_conflict_and_get_or_create():
+    reg = MetricsRegistry()
+    c = reg.counter("x")
+    assert reg.counter("x") is c
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_sink_guard_registers_central_counters():
+    from pagerank_tpu.utils.retry import RetryPolicy
+    from pagerank_tpu.utils.snapshot import SinkGuard
+
+    guard = SinkGuard(
+        retry_policy=RetryPolicy(max_attempts=3, base_delay=0.0,
+                                 sleep=lambda s: None, seed=0),
+        on_failure="warn_and_drop",
+    )
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+
+    assert guard(0, flaky) is True
+    with pytest.warns(RuntimeWarning):
+        assert guard(1, lambda: (_ for _ in ()).throw(OSError("x"))) is False
+    snap = obs.get_registry().snapshot()
+    assert snap["counters"]["sink.write_retries"] == guard.retries
+    assert snap["counters"]["sink.dead_letters"] == 1
+
+
+def test_engine_health_counters_register():
+    """A NaN-poisoned run increments the central health/rollback
+    counters alongside engine.health (the scattered counter it
+    mirrors)."""
+    from pagerank_tpu import PageRankConfig, ReferenceCpuEngine, build_graph
+    from pagerank_tpu.engine import SolverHealthError
+
+    rng = np.random.default_rng(1)
+    g = build_graph(rng.integers(0, 30, 200), rng.integers(0, 30, 200),
+                    n=30)
+    eng = ReferenceCpuEngine(PageRankConfig(num_iters=6)).build(g)
+    orig = eng.step
+
+    def bad_step():
+        info = orig()
+        return {k: float("nan") for k in info}
+
+    eng.step = bad_step
+    with pytest.raises(SolverHealthError):
+        eng.run()
+    snap = obs.get_registry().snapshot()
+    assert snap["counters"]["engine.health_check_failures"] >= 1
+    assert "engine.rollbacks" not in snap["counters"]  # nothing to roll to
+
+
+# -- strict-JSON metrics logger (satellite 1) -------------------------------
+
+
+def test_metrics_jsonl_is_strict_json(tmp_path):
+    """iters_per_sec/edges_per_sec_per_chip must be null (not bare
+    Infinity) when dt == 0 — json.dumps would otherwise emit non-spec
+    JSON that strict JSONL consumers reject."""
+    import io
+
+    from pagerank_tpu.utils.metrics import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(num_edges=10, jsonl_path=path, stream=io.StringIO())
+    m.record(0, {"l1_delta": 0.5}, dt=0.0)  # the degenerate-clock case
+    m.record(1, {"l1_delta": 0.25}, dt=0.01)
+    # NaN step info (a diverging solve under --no-health-checks) is the
+    # same defect class: null, never a bare NaN token.
+    m.record(2, {"l1_delta": float("nan"),
+                 "dangling_mass": float("inf")}, dt=0.01)
+    m.close()
+    recs = [_strict_loads(l) for l in open(path)]
+    assert recs[0]["iters_per_sec"] is None
+    assert recs[0]["edges_per_sec_per_chip"] is None
+    assert recs[1]["iters_per_sec"] == pytest.approx(100.0)
+    assert recs[2]["l1_delta"] is None
+    assert recs[2]["dangling_mass"] is None
+
+
+# -- profiler session (satellite 2) -----------------------------------------
+
+
+class _FakeProfiler:
+    def __init__(self, fail_stop=False):
+        self.calls = []
+        self.fail_stop = fail_stop
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+        if self.fail_stop:
+            raise RuntimeError("stop failed")
+
+
+def test_profiler_session_stops_on_failure(tmp_path, monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    tr = obs.enable_tracing()
+    with pytest.raises(ValueError, match="mid-run"):
+        with obs.profiler_session(str(tmp_path / "prof")):
+            raise ValueError("mid-run failure")
+    # The profiler was stopped despite the failure, and the profile
+    # span records both the directory and the error.
+    assert fake.calls == [("start", str(tmp_path / "prof")), ("stop",)]
+    (sp,) = [s for s in tr.spans() if s.name == "profile"]
+    assert sp.attrs["dir"] == str(tmp_path / "prof")
+    assert sp.attrs["error"] == "ValueError"
+
+
+def test_profiler_session_stop_failure_never_masks_body_error(
+    tmp_path, monkeypatch
+):
+    import jax
+
+    fake = _FakeProfiler(fail_stop=True)
+    monkeypatch.setattr(jax, "profiler", fake)
+    with pytest.raises(ValueError, match="primary"):
+        with obs.profiler_session(str(tmp_path / "p")):
+            raise ValueError("primary")
+    assert ("stop",) in fake.calls
+
+
+def test_profiler_session_noop_without_dir():
+    with obs.profiler_session(None) as active:
+        assert active is False
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+def test_run_report_build_and_diff():
+    tr = obs.enable_tracing()
+    with tr.span("solve/step"):
+        pass
+    obs.get_registry().counter("s3.request.retries").inc(2)
+    a = obs.build_run_report(
+        config={"num_iters": 3},
+        tracer=tr,
+        registry=obs.get_registry(),
+        history=[{"iter": 0, "iters_per_sec": float("inf")}],
+        summary={"iters": 3, "edges_per_sec_per_chip": 1e6},
+        robustness={"rollbacks": 0},
+    )
+    # Strict JSON end to end — the inf in history is sanitized to null.
+    a = _strict_loads(json.dumps(a))
+    assert a["iterations"][0]["iters_per_sec"] is None
+    for k in REPORT_KEYS:
+        assert k in a
+    b = json.loads(json.dumps(a))
+    b["summary"]["edges_per_sec_per_chip"] = 2e6
+    b["environment"]["jaxlib_version"] = "9.9.9"
+    out = obs.diff_reports(a, b)
+    assert "environment DIFFERS" in out
+    assert "jaxlib_version" in out
+    assert "edges_per_sec_per_chip" in out and "+100.0%" in out
+    rendered = obs.render_report(a)
+    assert "solve/step" in rendered and "s3.request.retries" in rendered
+
+
+def test_cli_run_report_schema(tmp_path):
+    """The acceptance-criterion CLI contract: one flag pair produces a
+    complete, schema-stable run_report.json and a loadable Chrome
+    trace."""
+    from pagerank_tpu.cli import main
+
+    report_path = str(tmp_path / "run_report.json")
+    trace_path = str(tmp_path / "trace.json")
+    rc = main([
+        "--synthetic", "uniform:300:2000", "--engine", "cpu",
+        "--iters", "4", "--log-every", "0",
+        "--trace", trace_path, "--run-report", report_path,
+    ])
+    assert rc == 0
+    report = _strict_loads(open(report_path).read())
+    assert report["schema_version"] == 1
+    for k in REPORT_KEYS:
+        assert k in report, f"run report missing {k!r}"
+    env = report["environment"]
+    for k in ("jax_version", "jaxlib_version", "backend", "device_kind",
+              "device_count", "process_count", "x64", "git_rev"):
+        assert k in env, f"environment fingerprint missing {k!r}"
+    assert report["config"]["num_iters"] == 4
+    assert len(report["iterations"]) == 4
+    assert report["summary"]["iters"] == 4
+    assert report["graph"]["n"] == 300
+    assert {"rollbacks", "write_retries", "dropped_writes",
+            "s3_request_retries"} <= set(report["robustness"])
+    # Span summary covers ingest and solve at minimum.
+    assert "ingest/load" in report["spans"]
+    assert "solve/step" in report["spans"]
+    assert report["spans"]["solve/step"]["count"] == 4
+    # The Chrome trace parses and carries the same phases.
+    doc = _strict_loads(open(trace_path).read())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "solve/step" in names and "ingest/load" in names
+    # The CLI tore the global tracer back down on exit.
+    assert obs_trace.get_tracer() is obs_trace.NULL_TRACER
+
+
+def test_cli_jax_traced_run_records_engine_and_snapshot_spans(tmp_path):
+    """A jax-engine traced run with snapshots exercises the deeper
+    instrumentation: engine/build, snapshot/save, and the async
+    writer's queue-wait spans all land in one trace."""
+    from pagerank_tpu.cli import main
+
+    report_path = str(tmp_path / "r.json")
+    rc = main([
+        "--synthetic", "uniform:256:1500", "--engine", "jax",
+        "--iters", "3", "--log-every", "0",
+        "--snapshot-dir", str(tmp_path / "snaps"),
+        "--run-report", report_path,
+    ])
+    assert rc == 0
+    report = _strict_loads(open(report_path).read())
+    spans = report["spans"]
+    assert "engine/build" in spans
+    assert "snapshot/save" in spans and spans["snapshot/save"]["count"] == 3
+    assert "writer/queue_wait" in spans
+    counters = report["metrics"]["counters"]
+    assert counters["snapshot.bytes_written"] > 0
+    hist = report["metrics"]["histograms"]["snapshot.save_bytes"]
+    assert hist["count"] == 3
+
+
+def test_device_build_stage_spans_under_tracing():
+    """Tracing a device build yields the per-stage build/ spans, and
+    the timings dict stays a faithful view of the same fences."""
+    jnp = pytest.importorskip("jax.numpy")
+    from pagerank_tpu.ops.device_build import build_ell_device
+
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, 256, 2000), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, 256, 2000), jnp.int32)
+    tr = obs.enable_tracing()
+    dg = build_ell_device(src, dst, n=256, with_weights=False)
+    assert dg.num_edges > 0
+    view = tr.timings_view("build/")
+    for key in ("relabel_s", "sort_s", "slots_s", "scatter_s"):
+        assert key in view and view[key] >= 0.0
+
+
+def test_cli_failure_path_still_writes_artifacts(tmp_path, monkeypatch):
+    """A failing run must still produce its trace and (failure-marked)
+    run report — the postmortem case the flight recorder exists for —
+    and must tear the global tracer down."""
+    from pagerank_tpu.cli import main
+    from pagerank_tpu.engine import SolverHealthError
+    from pagerank_tpu.engines.cpu import ReferenceCpuEngine
+
+    orig = ReferenceCpuEngine.step
+
+    def poisoned(self):
+        info = orig(self)
+        if self.iteration >= 2:
+            return {k: float("nan") for k in info}
+        return info
+
+    monkeypatch.setattr(ReferenceCpuEngine, "step", poisoned)
+    report_path = str(tmp_path / "r.json")
+    trace_path = str(tmp_path / "t.json")
+    with pytest.raises(SolverHealthError):
+        main([
+            "--synthetic", "uniform:200:1000", "--engine", "cpu",
+            "--iters", "6", "--log-every", "0",
+            "--trace", trace_path, "--run-report", report_path,
+        ])
+    report = _strict_loads(open(report_path).read())
+    assert report["failed"] is True
+    assert "SolverHealthError" in report["error"]
+    assert report["spans"]["solve/step"]["count"] >= 2  # healthy steps
+    assert report["metrics"]["counters"][
+        "engine.health_check_failures"] >= 1
+    doc = _strict_loads(open(trace_path).read())
+    assert doc["traceEvents"]
+    assert obs_trace.get_tracer() is obs_trace.NULL_TRACER
+
+
+def test_cli_early_failure_writes_partial_report(tmp_path):
+    """A run that dies BEFORE the solve (here: ingest of a missing
+    input) still exports its artifacts — sections that never came to
+    exist are null, the failure is marked."""
+    from pagerank_tpu.cli import main
+
+    report_path = str(tmp_path / "r.json")
+    trace_path = str(tmp_path / "t.json")
+    with pytest.raises(FileNotFoundError):
+        main([
+            "--input", str(tmp_path / "missing.txt"), "--engine", "cpu",
+            "--log-every", "0",
+            "--trace", trace_path, "--run-report", report_path,
+        ])
+    report = _strict_loads(open(report_path).read())
+    assert report["failed"] is True
+    assert "FileNotFoundError" in report["error"]
+    assert report["graph"] is None and report["config"] is None
+    for k in REPORT_KEYS:
+        assert k in report
+    assert _strict_loads(open(trace_path).read())["traceEvents"] is not None
+    assert obs_trace.get_tracer() is obs_trace.NULL_TRACER
+
+
+def test_seqfile_per_file_spans_stay_lazy(tmp_path):
+    """Tracing records one span per segment file (with its record
+    count) while the record stream stays a generator — lazily consumed
+    records arrive BEFORE the file's span is recorded."""
+    from pagerank_tpu.ingest.seqfile import (iter_segment_records,
+                                             write_sequence_file)
+
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / f"metadata-0000{i}")
+        write_sequence_file(p, [
+            (f"http://site{i}.test/p{j}",
+             json.dumps({"content": {"links": []}}))
+            for j in range(3)
+        ])
+        paths.append(p)
+    tr = obs.enable_tracing()
+    it = iter_segment_records(paths, workers=1)
+    first = next(it)  # streams: a record exists before any file span
+    assert not [s for s in tr.spans() if s.name == "ingest/seqfile_file"]
+    rest = list(it)
+    assert 1 + len(rest) == 6 and first is not None
+    spans = [s for s in tr.spans() if s.name == "ingest/seqfile_file"]
+    assert [s.attrs["records"] for s in spans] == [3, 3]
+    assert [s.attrs["path"] for s in spans] == paths
+
+
+def test_environment_fingerprint_degrades_on_backend_failure(monkeypatch):
+    """A broken backend must yield a report-able fingerprint (None
+    fields + backend_error), never a raise — the failing run is the
+    one most worth a report."""
+    import jax
+
+    def boom(*a, **k):
+        raise RuntimeError("backend init failed")
+
+    monkeypatch.setattr(jax, "default_backend", boom)
+    monkeypatch.setattr(jax, "process_count", boom)
+    env = obs.environment_fingerprint()
+    assert env["backend"] is None and env["device_kind"] is None
+    assert env["process_count"] is None
+    assert "backend init failed" in env["backend_error"]
+    assert env["jax_version"]  # the import half still fingerprints
+
+
+def test_obs_report_cli(tmp_path, capsys):
+    from pagerank_tpu.cli import main as cli_main
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    for path, iters in ((a, 3), (b, 5)):
+        assert cli_main([
+            "--synthetic", "uniform:200:1000", "--engine", "cpu",
+            "--iters", str(iters), "--log-every", "0",
+            "--run-report", path,
+        ]) == 0
+    capsys.readouterr()
+    assert obs_main(["report", a]) == 0
+    out = capsys.readouterr().out
+    assert "run report" in out and "solve/step" in out
+    assert obs_main(["report", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "phase wall deltas" in out
+    assert obs_main(["report", str(tmp_path / "missing.json")]) == 2
