@@ -250,12 +250,42 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         f"{dt / args.iters * 1e3:.2f} ms/iter, {eps_chip:.4g} edges/s/chip",
         file=sys.stderr,
     )
+    costs = _leg_costs(engine, dt / args.iters, num_edges)
     del engine  # free HBM before the next config builds
     return {
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
         "build_s": t_build,  # graph build wall-clock (VERDICT r3 weak #1)
+        # XLA cost model per compiled form + achieved-vs-roofline at
+        # the measured rate (obs/costs; None fields where the backend
+        # doesn't report) — the "is this fast enough" anchor the r5
+        # backend-variance incident lacked.
+        "costs": costs,
     }
+
+
+def _leg_costs(engine, seconds_per_iter, num_edges):
+    """One rate leg's cost block: reset the ledger (per-leg scoping —
+    a warm second leg must not inherit the first leg's stale stage
+    entries), harvest the step program(s), attach the measured
+    per-iteration wall, and snapshot. The wall attaches ONLY to the
+    whole-iteration 'step' program: on multi-dispatch layouts the
+    ledger holds prescale/stripe{i}/final instead, and dividing the
+    finalize program's bytes (a fraction of the iteration's traffic)
+    by the full wall would fabricate a too-low roofline fraction — the
+    per-program models stay unmeasured there (roofline null)."""
+    from pagerank_tpu.obs import costs as obs_costs
+
+    obs_costs.reset()
+    engine.cost_reports()
+    step = obs_costs.attach_measurement("step", seconds_per_iter,
+                                        num_edges=num_edges)
+    if step is not None and step.bytes_per_edge is not None:
+        line = f"cost[step]: {step.bytes_per_edge:.1f} B/edge"
+        if step.roofline_fraction is not None:
+            line += f", {step.roofline_fraction:.1%} of HBM roofline"
+        print(line, file=sys.stderr)
+    return obs_costs.ledger_snapshot()
 
 
 def run_accuracy(scale: int = 20, iters: int = 50):
@@ -403,6 +433,7 @@ def main(argv=None):
             "unit": "edges/s/chip",
             "vs_baseline": rate["vs_baseline"],
             "build_s": rate["build_s"],
+            "costs": rate["costs"],
         }
         if not args.no_accuracy:
             out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
@@ -426,7 +457,8 @@ def main(argv=None):
         "unit": "edges/s/chip",
         "vs_baseline": pair_rate["vs_baseline"],
         "build_s": pair_rate["build_s"],
-        "fast_f32": f32_rate,
+        "costs": pair_rate["costs"],  # headline (pair) leg's cost model
+        "fast_f32": f32_rate,  # carries its own "costs" block
     }
     if not args.host_build and args.kernel != "coo":
         # LAST, so the rebuild cannot perturb the rate legs; warm by

@@ -84,6 +84,8 @@ def main(argv=None) -> int:
             ("PTC004", "step compilation key independent of num_iters/tol"),
             ("PTC005", "no host callbacks inside iteration programs"),
             ("PTC006", "device build chain 32-bit under x64 (no i64/f64 op)"),
+            ("PTC007", "probe-enabled step: same collectives, no "
+                       "callbacks, no f64, donation intact"),
         ):
             print(f"{rid}  [jaxpr ] {desc}")
         return 0

@@ -28,7 +28,13 @@ PTC006 32-bit build chain (the device graph-build stages must emit no
 process-wide, and a weak-typed promotion in the per-edge path silently
 doubles sort/scatter bytes; it is also what licenses
 utils/compile_cache.stage_call to key executables WITHOUT the x64
-flag). Waivers (with the root cause) live in analysis/allowlist.txt.
+flag), PTC007 probe transparency (the probe-enabled step —
+``JaxTpuEngine.step_probed``, ISSUE 5 — must keep the EXACT collective
+multiset of the plain step, add no host callback, no f64 under f32
+configs, and keep the rank donation consumable; on multi-dispatch
+layouts the standalone probe program must be collective- and
+callback-free). Waivers (with the root cause) live in
+analysis/allowlist.txt.
 """
 
 from __future__ import annotations
@@ -216,6 +222,28 @@ def engine_forms(ndev: int) -> List[Form]:
         dg = db.build_ell_device(src, dst, n=512, with_weights=False)
         return Eng(cfg()).build_device(dg)
 
+    def dev_build_striped():
+        # The multichip dryrun's grouped+striped presentinel shape
+        # (__graft_entry__.dryrun_multichip step 5: group=4,
+        # stripe_size=128, with_weights=False, 4096 raw edges) — the
+        # dispatch whose build once left a residual "Some donated
+        # buffers were not usable: int32[4096], int32[4096],
+        # int8[4096]" warning in the MULTICHIP_r05 tail. Covering it
+        # here puts the PTC003 warning capture on that exact shape so
+        # an unconsumable donation in the grouped/striped stage chain
+        # cannot regress silently again.
+        import jax.numpy as jnp
+
+        from pagerank_tpu.ops import device_build as db
+
+        rng = np.random.default_rng(2)
+        src = jnp.asarray(rng.integers(0, 256, 4096), jnp.int32)
+        dst = jnp.asarray(rng.integers(0, 256, 4096), jnp.int32)
+        dg = db.build_ell_device(
+            src, dst, n=256, group=4, stripe_size=128, with_weights=False
+        )
+        return Eng(cfg()).build_device(dg)
+
     return [
         Form("ell", lambda: Eng(cfg()).build(g), True),
         Form("pair", lambda: Eng(cfg(
@@ -225,6 +253,7 @@ def engine_forms(ndev: int) -> List[Form]:
         Form("multi_dispatch", lambda: Scan(cfg()).build(g), True),
         Form("coo", lambda: Eng(cfg(kernel="coo")).build(g), True),
         Form("device_build", dev_build, True),
+        Form("device_build_striped", dev_build_striped, True),
         Form("vertex_sharded", lambda: Eng(cfg(
             vertex_sharded=True,
         )).build(g), True),
@@ -278,7 +307,8 @@ def expected_collectives(engine, form: str) -> Dict[str, int]:
 
     n_stripes = len(engine._src) if getattr(engine, "_src", None) is not None \
         and isinstance(engine._src, list) else 1
-    if form in ("ell", "pair", "striped", "coo", "device_build"):
+    if form in ("ell", "pair", "striped", "coo", "device_build",
+                "device_build_striped"):
         return {"psum": 1}
     if form == "multi_dispatch":
         # The cross-device merge is the finalize's sharded .sum(0)
@@ -397,6 +427,119 @@ def check_engine_form(form: Form) -> List[Finding]:
                 f"host callback(s) {sorted(set(cbs))} inside {label}",
                 form.name,
             ))
+
+    # PTC007 — probe transparency (ISSUE 5).
+    findings.extend(check_probe_form(engine, form))
+    return findings
+
+
+def _collective_tally(jx) -> Tuple[Dict[str, int], int]:
+    """(bulk-collective multiset, scalar-collective count) of one
+    program — the communication structure PTC007 compares across the
+    plain and probe-enabled steps."""
+    bulk: Dict[str, int] = {}
+    scalars = 0
+    for prim, size in collectives(jx):
+        if size > 1:
+            bulk[prim] = bulk.get(prim, 0) + 1
+        else:
+            scalars += 1
+    return bulk, scalars
+
+
+def check_probe_form(form_engine, form: Form) -> List[Finding]:
+    """PTC007: enabling convergence probes (obs/probes.py) must be
+    COMMUNICATION-TRANSPARENT. On single-program forms the probed step
+    (``_get_probed_step``: step body + on-device mass/top-k/churn tail
+    in ONE program) must trace to the exact collective multiset of the
+    plain step, add no host callback, introduce no f64 under an
+    all-f32 config, and keep the donated rank buffer consumable. On
+    multi-dispatch layouts the standalone probe program
+    (``_get_probe_fn``) must be collective- and callback-free (the
+    probe reductions are local; GSPMD owns any sharded gather below
+    jaxpr level). Abstract evaluation only; nothing runs."""
+    import jax
+    import jax.numpy as jnp
+
+    findings: List[Finding] = []
+    k = 8
+    prev = jnp.zeros(k, jnp.int32)
+    if form_engine._ms_stripe is None:
+        args = form_engine._device_args()
+        plain = jax.make_jaxpr(form_engine._step_core)(*args)
+        probed_fn = form_engine._get_probed_step(k)
+        probed = jax.make_jaxpr(probed_fn)(*args, prev)
+        if _collective_tally(probed) != _collective_tally(plain):
+            findings.append(_finding(
+                "PTC007",
+                f"probe-enabled step changed the collective structure: "
+                f"plain {_collective_tally(plain)} vs probed "
+                f"{_collective_tally(probed)}",
+                form.name,
+            ))
+        cbs = callback_prims(probed)
+        if cbs:
+            findings.append(_finding(
+                "PTC007",
+                f"probe-enabled step emits host callback(s) "
+                f"{sorted(set(cbs))}",
+                form.name,
+            ))
+        if form.f32:
+            hits = f64_avals(probed)
+            if hits:
+                findings.append(_finding(
+                    "PTC007",
+                    "probe tail promotes to f64 in f32 config: "
+                    + "; ".join(sorted(set(hits))[:4]),
+                    form.name,
+                ))
+        # The probed step donates the rank buffer exactly like the
+        # plain step — its output set must still carry a matching aval.
+        out_avals = jax.tree_util.tree_leaves(
+            jax.eval_shape(probed_fn, *args, prev)
+        )
+        r_aval = (tuple(args[0].shape), np.dtype(args[0].dtype))
+        if not any(
+            (tuple(o.shape), np.dtype(o.dtype)) == r_aval
+            for o in out_avals
+        ):
+            findings.append(_finding(
+                "PTC007",
+                "probed step has no output aval matching the donated "
+                "rank buffer: donation can never be consumed",
+                form.name,
+            ))
+    else:
+        probe_jx = jax.make_jaxpr(form_engine._get_probe_fn(k))(
+            form_engine._r, form_engine._valid, prev
+        )
+        colls = [p for p, _s in collectives(probe_jx)]
+        if colls:
+            findings.append(_finding(
+                "PTC007",
+                f"standalone probe program emits collective(s) "
+                f"{sorted(set(colls))} (probes must add none beyond "
+                f"the form's budget)",
+                form.name,
+            ))
+        cbs = callback_prims(probe_jx)
+        if cbs:
+            findings.append(_finding(
+                "PTC007",
+                f"standalone probe program emits host callback(s) "
+                f"{sorted(set(cbs))}",
+                form.name,
+            ))
+        if form.f32:
+            hits = f64_avals(probe_jx)
+            if hits:
+                findings.append(_finding(
+                    "PTC007",
+                    "probe program promotes to f64 in f32 config: "
+                    + "; ".join(sorted(set(hits))[:4]),
+                    form.name,
+                ))
     return findings
 
 

@@ -16,6 +16,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from pagerank_tpu.graph import Graph
+from pagerank_tpu.obs import live as obs_live
 from pagerank_tpu.obs import metrics as obs_metrics
 from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.utils.config import PageRankConfig
@@ -81,11 +82,49 @@ class PageRankEngine(abc.ABC):
         Engines override with a cheaper device-side reduction."""
         return float(np.asarray(self.ranks(), dtype=np.float64).sum())
 
+    # -- convergence probes (obs/probes.py; ISSUE 5) -----------------------
+
+    def probe_values(self, k: int, prev_ids):
+        """(rank_mass, entered_count, topk_ids_engine_space,
+        topk_ids_original_space) of the CURRENT state — the standalone
+        probe used at fused-chunk boundaries. ``prev_ids`` is the
+        previous probe's engine-space top-k (None on the first probe);
+        ``entered_count`` is how many current top-k ids are NOT in it.
+        Base impl: host numpy over ranks() (the CPU oracle's own probe
+        — what the device path is parity-tested against). Ties break
+        by lowest id, matching ``lax.top_k``."""
+        r = np.asarray(self.ranks(), dtype=np.float64)
+        k = min(int(k), r.shape[0])
+        ids = np.argsort(-r, kind="stable")[:k].astype(np.int64)
+        entered = (
+            k if prev_ids is None
+            else int(k - np.isin(ids, np.asarray(prev_ids)).sum())
+        )
+        return float(r.sum()), entered, ids, ids
+
+    def step_probed(self, probes):
+        """One iteration WITH the convergence probe: returns
+        ``(info, (ids_engine, ids_original))`` where ``info`` carries
+        ``rank_mass`` and ``topk_churn`` next to the step scalars.
+        Base impl: plain step() + the host probe; JaxTpuEngine
+        overrides with one fused device dispatch (zero extra host
+        syncs — contract PTC007). Never called when probing is off
+        (the zero-probe-call contract, tests/test_telemetry.py)."""
+        info = self.step()
+        prev = probes.prev_ids
+        mass, entered, ids_engine, ids_original = self.probe_values(
+            probes.topk, prev
+        )
+        info["rank_mass"] = mass
+        info["topk_churn"] = 0 if prev is None else entered
+        return info, (ids_engine, ids_original)
+
     def run(
         self,
         num_iters: Optional[int] = None,
         on_iteration: Optional[Callable[[int, Dict[str, float]], None]] = None,
         snapshotter=None,
+        probes=None,
     ) -> np.ndarray:
         """Drive ``num_iters`` iterations (default: config.num_iters).
 
@@ -108,6 +147,17 @@ class PageRankEngine(abc.ABC):
         Recomputed steps re-fire ``on_iteration`` (snapshot re-saves
         are idempotent; metrics may show repeated iterations).
         Rollback/retry counts land in ``self.health``.
+
+        ``probes`` (obs/probes.ConvergenceProbes; ISSUE 5): at its
+        cadence the step runs as :meth:`step_probed` — residual, rank
+        mass, and top-k churn in the step's own dispatch — and the
+        record is committed AFTER the health check accepts the step
+        (a rolled-back iterate is never probed into history). Its
+        ``stop_tol`` early-exits at probe points; None/off takes the
+        exact pre-probe code path — zero probe calls per iteration
+        (the booby-trap contract, tests/test_telemetry.py). An armed
+        stall watchdog (obs/live.py) is heartbeat on every completed
+        step; disarmed costs one ``is None`` check per iteration.
         """
         if self.graph is None:
             raise RuntimeError("call build(graph) before run()")
@@ -122,12 +172,26 @@ class PageRankEngine(abc.ABC):
         # pins); enabled, each step is a solve/step span.
         tracer = obs_trace.get_tracer()
         trace_steps = tracer.enabled
+        # Watchdog and probes read ONCE per run, same discipline as the
+        # tracer: disarmed/off, the loop body adds one `is None` check
+        # and one `False and` short-circuit per iteration.
+        watchdog = obs_live.get_watchdog()
+        probing = probes is not None and probes.enabled
+        probe_ids = None
         while self.iteration < total:
+            probe_now = probing and probes.wants(self.iteration)
             if trace_steps:
                 with tracer.span("solve/step", iteration=self.iteration):
-                    info = self.step()
+                    if probe_now:
+                        info, probe_ids = self.step_probed(probes)
+                    else:
+                        info = self.step()
+            elif probe_now:
+                info, probe_ids = self.step_probed(probes)
             else:
                 info = self.step()
+            if watchdog is not None:
+                watchdog.heartbeat(self.iteration)
             i = self.iteration
             reason = None
             if rb.health_checks:
@@ -195,6 +259,13 @@ class PageRankEngine(abc.ABC):
             self.iteration = i + 1
             if on_iteration is not None:
                 on_iteration(i, info)
+            if probe_now:
+                # Committed only AFTER the health check accepted the
+                # step (rolled-back iterates `continue` above) and
+                # after on_iteration saw the probe-augmented info.
+                rec = probes.commit(i, info, *probe_ids)
+                if probes.should_stop(rec):
+                    break
             if tol is not None:
                 delta = info.get("l1_delta")
                 if delta is None:

@@ -60,6 +60,8 @@ from pagerank_tpu.utils.jax_compat import shard_map
 from pagerank_tpu import graph as graph_mod
 from pagerank_tpu.engine import PageRankEngine, register_engine
 from pagerank_tpu.graph import Graph
+from pagerank_tpu.obs import costs as obs_costs
+from pagerank_tpu.obs import live as obs_live
 from pagerank_tpu.obs import log as obs_log
 from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.models import pagerank as pr_model
@@ -1881,6 +1883,191 @@ class JaxTpuEngine(PageRankEngine):
         delta, m = self._device_step()
         return {"l1_delta": float(delta), "dangling_mass": float(m)}
 
+    # -- convergence probes (obs/probes.py; ISSUE 5) -----------------------
+
+    def _probe_tail(self, k: int):
+        """The ON-DEVICE probe computation over a (padded, relabeled)
+        rank vector — THE one spelling shared by the fused probed step
+        and the standalone boundary probe so the two cannot drift:
+        rank mass in the accumulation dtype, top-k ids over VALID lanes
+        (padding masked to -inf; ``lax.top_k`` tie-breaks by lowest
+        index, matching the CPU oracle's stable argsort), and the
+        entered-count against the previous probe's ids. int32
+        throughout (the churn count is a sum of bools — an unpinned
+        dtype would widen under the pair config's x64 flip)."""
+        accum = self._accum_dtype
+
+        def tail(r, valid_m, prev_ids):
+            mass = jnp.sum(r.astype(accum))
+            rv = jnp.where(valid_m, r, -jnp.inf)
+            _vals, ids = jax.lax.top_k(rv, k)
+            ids = ids.astype(jnp.int32)
+            entered = jnp.sum(
+                (ids[:, None] != prev_ids[None, :]).all(axis=1),
+                dtype=jnp.int32,
+            )
+            return mass, ids, entered
+
+        return tail
+
+    def _get_probe_fn(self, k: int):
+        """Standalone probe dispatch over the current state — used on
+        multi-dispatch layouts (where the step is already a pipelined
+        dispatch sequence) and at fused-chunk boundaries. Cached per k
+        alongside the fused executables."""
+        key = ("probe_fn", k)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._probe_tail(k))
+            self._fused_cache[key] = fn
+        return fn
+
+    def _get_probed_step(self, k: int):
+        """The probe-enabled step: ONE jitted program running the
+        step body plus the probe tail on its output — probing adds no
+        extra dispatch, no host callback, and no collective beyond the
+        form's own budget (the tail is elementwise + top_k on the
+        already-merged rank vector; contract PTC007 proves it). The
+        rank buffer stays donated exactly like the plain step."""
+        key = ("probe_step", k)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            core = self._step_core
+            tail = self._probe_tail(k)
+            # valid's position in the device-args tail (see
+            # _device_args: prescaled forms carry inv at index 1).
+            vi = 4 if self._inv_in_args else 3
+
+            def probed(*args):
+                prev_ids = args[-1]
+                core_args = args[:-1]
+                r2, delta, m = core(*core_args)
+                mass, ids, entered = tail(r2, core_args[vi], prev_ids)
+                return r2, delta, m, mass, ids, entered
+
+            fn = jax.jit(probed, donate_argnums=(0,))
+            self._fused_cache[key] = fn
+        return fn
+
+    def _resolve_probe_k(self, k: int) -> int:
+        return max(1, min(int(k), self.graph.n))
+
+    def probe_values(self, k: int, prev_ids):
+        """Device-side probe of the CURRENT state (fused-chunk
+        boundaries; PageRankEngine.probe_values contract). One
+        dispatch, one host sync for the scalars + k ids."""
+        k = self._resolve_probe_k(k)
+        prev_dev = (jnp.full((k,), jnp.int32(-1)) if prev_ids is None
+                    else prev_ids)
+        mass, ids, entered = self._get_probe_fn(k)(
+            self._r, self._valid, prev_dev
+        )
+        mass_h, ent_h, ids_np = jax.device_get((mass, entered, ids))
+        ids_np = np.asarray(ids_np)
+        ids_orig = self._perm[ids_np] if self._perm is not None else ids_np
+        return float(mass_h), int(ent_h), ids, np.asarray(ids_orig)
+
+    def step_probed(self, probes):
+        """One iteration + probe in a single device dispatch (the
+        multi-dispatch layouts append one standalone probe dispatch to
+        their pipelined sequence instead — still zero extra host
+        syncs: everything is fetched in the ONE device_get the
+        stepwise loop already pays per iteration)."""
+        k = self._resolve_probe_k(probes.topk)
+        prev = probes.prev_ids
+        prev_dev = jnp.full((k,), jnp.int32(-1)) if prev is None else prev
+        if self._ms_stripe is not None:
+            delta, m = self._device_step()
+            mass, ids, entered = self._get_probe_fn(k)(
+                self._r, self._valid, prev_dev
+            )
+        else:
+            fn = self._get_probed_step(k)
+            self._r, delta, m, mass, ids, entered = fn(
+                *self._device_args(), prev_dev
+            )
+        d_h, m_h, mass_h, ent_h, ids_np = jax.device_get(
+            (delta, m, mass, entered, ids)
+        )
+        info = {
+            "l1_delta": float(d_h),
+            "dangling_mass": float(m_h),
+            "rank_mass": float(mass_h),
+            "topk_churn": 0 if prev is None else int(ent_h),
+        }
+        ids_np = np.asarray(ids_np)
+        ids_orig = self._perm[ids_np] if self._perm is not None else ids_np
+        return info, (ids, np.asarray(ids_orig))
+
+    # -- cost accounting (obs/costs.py; ISSUE 5) ---------------------------
+
+    def cost_reports(self, refresh: bool = False) -> Dict[str, dict]:
+        """Harvest the step program(s)' XLA cost model — FLOPs, HBM
+        bytes accessed, peak/argument/output/temp allocation — into
+        the cost ledger and return its snapshot (the run report's
+        ``costs`` section; bench.py embeds the same dict).
+
+        The stepwise executable is dispatch-compiled (``jax.jit``), so
+        this AOT-lowers ``step_core`` once more to get a harvestable
+        Compiled handle — persistent-compile-cache-assisted on TPU,
+        milliseconds on CPU, and cached here so repeat calls are free.
+        Multi-dispatch layouts harvest prescale / per-stripe /
+        finalize individually (stripe inputs come from
+        ``jax.eval_shape``, so nothing executes). Fields are None on
+        backends whose PJRT plugin doesn't report — never zero. Best
+        effort by contract: accounting must not be able to fail a
+        run.
+
+        The repeat-call memo is the LEDGER itself (is this engine's
+        whole-iteration form already filed?), not an engine flag: a
+        per-leg ``costs.reset()`` (bench) must force a re-harvest, and
+        a stale flag would return an empty block there."""
+        whole_form = "step" if self._ms_stripe is None else "final"
+        if not refresh and obs_costs.get_report(whole_form) is not None:
+            return obs_costs.ledger_snapshot()
+        ne = (int(self.graph.num_edges)
+              if self.graph is not None and self.graph.num_edges else None)
+        try:
+            if self._ms_stripe is None:
+                with obs_trace.span("engine/compile", form="cost_step"):
+                    compiled = jax.jit(
+                        self._step_core, donate_argnums=(0,)
+                    ).lower(*self._device_args()).compile()
+                obs_costs.harvest("step", compiled, num_edges=ne)
+            else:
+                pres_args = (self._r, self._inv_out)
+                with obs_trace.span("engine/compile", form="cost_ms"):
+                    if hasattr(self._ms_prescale, "lower"):
+                        obs_costs.harvest(
+                            "prescale",
+                            self._ms_prescale.lower(*pres_args).compile(),
+                        )
+                    zs = jax.eval_shape(self._ms_prescale, *pres_args)
+                    parts = []
+                    for s, fn in enumerate(self._ms_stripe_fns):
+                        stripe_args = (*zs, self._src[s],
+                                       self._row_block[s])
+                        if hasattr(fn, "lower"):
+                            obs_costs.harvest(
+                                f"stripe{s}",
+                                fn.lower(*stripe_args).compile(),
+                            )
+                        parts.append(jax.eval_shape(fn, *stripe_args))
+                    final_args = (self._r, *parts, *self._ms_ids,
+                                  self._dangling, self._zero_in,
+                                  self._valid)
+                    obs_costs.harvest(
+                        "final",
+                        self._ms_final.lower(*final_args).compile(),
+                        num_edges=ne,
+                    )
+        except Exception as e:  # accounting never fails a run
+            obs_log.warn(
+                f"cost harvest unavailable ({type(e).__name__}: "
+                f"{str(e)[:120]})"
+            )
+        return obs_costs.ledger_snapshot()
+
     def run_fast(self, num_iters: Optional[int] = None) -> np.ndarray:
         """Benchmark loop: no per-iteration host sync; one honest scalar
         fence at the end."""
@@ -2001,13 +2188,22 @@ class JaxTpuEngine(PageRankEngine):
         With ``tol``, stops after the first chunk whose final L1 delta
         is <= tol — checked host-side at the boundary, which costs
         nothing extra since the boundary already materializes the chunk
-        traces. Unlike :meth:`run_fused_tol`, per-iteration traces for
-        every executed iteration survive in ``last_run_metrics``.
+        traces. ``on_chunk`` may also return a truthy value to stop
+        after its boundary (the CLI's probe-point ``--stop-tol``, which
+        must NOT fire at snapshot-only boundaries when both cadences
+        are engaged). Unlike :meth:`run_fused_tol`, per-iteration
+        traces for every executed iteration survive in
+        ``last_run_metrics``.
         """
         total = self.config.num_iters if num_iters is None else num_iters
         if every is not None and every < 0:
             raise ValueError(f"every must be >= 0, got {every}")
         every = int(every) if every else max(1, total - self.iteration)
+        # An armed stall watchdog is fed at chunk boundaries — the
+        # finest host-visible progress granularity of a fused run
+        # (size --stall-timeout above every * the expected iteration
+        # wall there).
+        watchdog = obs_live.get_watchdog()
         ds, ms = [], []
         while self.iteration < total:
             # Align boundaries to ABSOLUTE multiples of ``every`` so a
@@ -2032,9 +2228,14 @@ class JaxTpuEngine(PageRankEngine):
                 self.iteration += k
             ds.append(deltas)
             ms.append(masses)
+            if watchdog is not None:
+                watchdog.heartbeat(self.iteration - 1)
+            stop = None
             if on_chunk is not None:
-                on_chunk(self.iteration, self.device_ranks,
-                         (deltas, masses))
+                stop = on_chunk(self.iteration, self.device_ranks,
+                                (deltas, masses))
+            if stop:
+                break
             if tol is not None and float(jax.device_get(deltas[-1])) <= tol:
                 break
         if ds:
@@ -2117,6 +2318,12 @@ class JaxTpuEngine(PageRankEngine):
                 fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
                     *self._device_args()
                 ).compile()
+            # iters=k is the BUDGET (the while_loop may stop early):
+            # per-iteration fields are a floor, not a measurement.
+            obs_costs.harvest(
+                "fused_tol", fused, iters=k,
+                num_edges=int(self.graph.num_edges) if self.graph else None,
+            )
             self._fused_cache[key] = fused
         return fused
 
@@ -2138,6 +2345,12 @@ class JaxTpuEngine(PageRankEngine):
                 fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
                     *self._device_args()
                 ).compile()
+            # Cost ledger entry per compile; per-iteration fields
+            # divide by k, so chunked runs (several k's) agree.
+            obs_costs.harvest(
+                "fused_scan", fused, iters=k,
+                num_edges=int(self.graph.num_edges) if self.graph else None,
+            )
             self._fused_cache[k] = fused
         return fused
 

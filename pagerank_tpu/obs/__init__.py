@@ -18,6 +18,18 @@ Three pieces, one subsystem:
     with ``python -m pagerank_tpu.obs report A.json [B.json]`` to
     render one or diff two.
 
+ISSUE 5 adds the live/predictive half:
+
+  - **cost accounting** (obs/costs.py): XLA's own ``cost_analysis`` /
+    ``memory_analysis`` per compiled dispatch form — FLOPs, HBM bytes,
+    peak allocation, bytes-per-edge, achieved-vs-roofline;
+  - **convergence probes** (obs/probes.py): opt-in in-loop L1
+    residual / rank mass / top-k churn, computed on device inside the
+    step (contract PTC007);
+  - **live monitoring** (obs/live.py): a zero-dependency Prometheus
+    text exporter (atomic textfile + HTTP endpoint) and the stall
+    watchdog that makes hung collectives loud.
+
 Plus :func:`profiler_session` (obs/profiler.py), the jax.profiler
 lifecycle as a tracer-composed context manager, and :mod:`obs.log`,
 the sanctioned stderr channel for library diagnostics (lint PTL007).
@@ -26,6 +38,15 @@ Import cost: stdlib only (jax is imported lazily inside the functions
 that need it), so any utils module can depend on obs without cycles.
 """
 
+from pagerank_tpu.obs import costs
+from pagerank_tpu.obs.live import (
+    MetricsExporter,
+    StallWatchdog,
+    arm_watchdog,
+    disarm_watchdog,
+    get_watchdog,
+    render_prometheus,
+)
 from pagerank_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -33,6 +54,7 @@ from pagerank_tpu.obs.metrics import (
     MetricsRegistry,
     get_registry,
 )
+from pagerank_tpu.obs.probes import ConvergenceProbes
 from pagerank_tpu.obs.profiler import profiler_session
 from pagerank_tpu.obs.report import (
     build_run_report,
@@ -54,6 +76,14 @@ from pagerank_tpu.obs.trace import (
 )
 
 __all__ = [
+    "costs",
+    "MetricsExporter",
+    "StallWatchdog",
+    "arm_watchdog",
+    "disarm_watchdog",
+    "get_watchdog",
+    "render_prometheus",
+    "ConvergenceProbes",
     "Counter",
     "Gauge",
     "Histogram",

@@ -1,0 +1,251 @@
+"""Static cost accounting — what a dispatch *should* cost (ISSUE 5).
+
+The r5 backend-variance incident was diagnosed by diffing wall times
+with no model to anchor "fast enough"; Lakhotia et al. (arXiv:1709.07122,
+PAPERS.md) show bytes-per-edge against a roofline is the right lens for
+PageRank performance work. This module gives the repo that lens
+natively: after every engine / build-stage compile, the caller harvests
+XLA's own cost model (``compiled.cost_analysis()`` — FLOPs, HBM bytes
+accessed) and memory breakdown (``memory_analysis()`` — argument /
+output / temp / peak allocation) into a typed :class:`CostReport`,
+via the ``utils/jax_compat`` shims that degrade to None on backends
+that don't report (PJRT plugins legitimately vary).
+
+Reports land in three places at once:
+
+  - a process-global **ledger** (one report per compiled form —
+    ``step``, ``fused_scan``, ``prescale``/``stripe{i}``/``final`` on
+    multi-dispatch layouts, ``build/{stage}`` for the device build),
+    reset per run like the metrics registry;
+  - the **MetricsRegistry** as ``cost.<form>.*`` gauges, so the live
+    exporter (obs/live.py) publishes the model next to the measured
+    rates;
+  - the **run report** (``costs`` section; ``python -m pagerank_tpu.obs
+    report A B`` diffs it — "did the model change or just the wall
+    time" becomes mechanical) and ``bench.py``'s JSON.
+
+The analytic layer: ``bytes_per_edge = bytes_accessed / num_edges`` per
+iteration, and — once a measured wall time is attached
+(:func:`attach_measurement`) — ``achieved_bytes_per_s`` against the
+device's HBM roofline (:data:`HBM_PEAK_BYTES_PER_S`), i.e. what
+fraction of the memory-bound ceiling the dispatch actually reached.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from pagerank_tpu.obs import metrics as obs_metrics
+
+#: Published peak HBM bandwidth per chip, bytes/s, keyed by substring
+#: of ``device_kind`` (matched case-insensitively, longest key first).
+#: The roofline denominator for memory-bound SpMV work — PageRank at
+#: graph scale is bandwidth-bound, so achieved-bytes/s over this peak
+#: is the honest utilization number (Lakhotia et al.). Unlisted kinds
+#: (CPU, unknown TPUs) yield None fractions rather than a wrong model.
+HBM_PEAK_BYTES_PER_S = {
+    "tpu v6": 1_640e9,
+    "tpu v5p": 2_765e9,
+    "tpu v5": 819e9,  # v5e ("TPU v5 lite" / "TPU v5e")
+    "tpu v4": 1_228e9,
+    "tpu v3": 900e9,
+    "tpu v2": 700e9,
+}
+
+
+def hbm_peak_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
+    """Roofline peak for a ``device_kind`` string, or None when the
+    kind is unknown (no guess: a wrong roofline is worse than none)."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key in sorted(HBM_PEAK_BYTES_PER_S, key=len, reverse=True):
+        if key in kind:
+            return HBM_PEAK_BYTES_PER_S[key]
+    return None
+
+
+@dataclass
+class CostReport:
+    """One compiled program's static cost model (+ optional measured
+    achievement). Every analysis-derived field is Optional — backends
+    without ``cost_analysis`` report None, never zero (a zero would
+    read as "free", a None as "unreported")."""
+
+    form: str                    # dispatch-form / program label
+    #: Iterations ONE dispatch of this program executes (a k-iteration
+    #: fused scan is k) — the per-iteration fields divide by it.
+    iters: int = 1
+    flops: Optional[float] = None          # whole-program FLOPs
+    bytes_accessed: Optional[float] = None  # whole-program HBM bytes
+    peak_bytes: Optional[int] = None       # peak device allocation
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    num_edges: Optional[int] = None
+    #: Measured seconds per iteration (attach_measurement) — turns the
+    #: static model into an achieved-vs-roofline fraction.
+    seconds_per_iter: Optional[float] = None
+    device_kind: Optional[str] = None
+
+    # -- analytic views ----------------------------------------------------
+
+    def _per_iter(self, total: Optional[float]) -> Optional[float]:
+        return None if total is None else total / max(1, self.iters)
+
+    @property
+    def flops_per_iter(self) -> Optional[float]:
+        return self._per_iter(self.flops)
+
+    @property
+    def bytes_per_iter(self) -> Optional[float]:
+        return self._per_iter(self.bytes_accessed)
+
+    @property
+    def bytes_per_edge(self) -> Optional[float]:
+        """Analytic HBM bytes per edge per iteration — the layout-
+        efficiency number PERF_NOTES' per-form table tracks."""
+        b = self.bytes_per_iter
+        if b is None or not self.num_edges:
+            return None
+        return b / self.num_edges
+
+    @property
+    def flops_per_edge(self) -> Optional[float]:
+        f = self.flops_per_iter
+        if f is None or not self.num_edges:
+            return None
+        return f / self.num_edges
+
+    @property
+    def achieved_bytes_per_s(self) -> Optional[float]:
+        b = self.bytes_per_iter
+        if b is None or not self.seconds_per_iter:
+            return None
+        return b / self.seconds_per_iter
+
+    @property
+    def roofline_fraction(self) -> Optional[float]:
+        """achieved HBM bytes/s over the device's published peak —
+        how close the dispatch runs to the memory-bound ceiling (None
+        off-roofline-table or unmeasured)."""
+        a = self.achieved_bytes_per_s
+        peak = hbm_peak_bytes_per_s(self.device_kind)
+        if a is None or peak is None:
+            return None
+        return a / peak
+
+    def to_json(self) -> dict:
+        """Flat strict-JSON dict: stored fields plus the derived
+        analytics — the shape the run report / bench JSON embed."""
+        out = dataclasses.asdict(self)
+        out["flops_per_iter"] = self.flops_per_iter
+        out["bytes_per_iter"] = self.bytes_per_iter
+        out["bytes_per_edge"] = self.bytes_per_edge
+        out["flops_per_edge"] = self.flops_per_edge
+        out["achieved_bytes_per_s"] = self.achieved_bytes_per_s
+        out["roofline_fraction"] = self.roofline_fraction
+        return out
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        import jax
+
+        devs = jax.devices()
+        return devs[0].device_kind if devs else None
+    except Exception:
+        return None
+
+
+def harvest(form: str, compiled, *, num_edges: Optional[int] = None,
+            iters: int = 1, record: bool = True) -> CostReport:
+    """Harvest one AOT-compiled program's cost/memory analysis into a
+    :class:`CostReport` (fields None where the backend doesn't report)
+    and — by default — record it in the ledger + registry. Never
+    raises: the jax_compat shims are the degrade-to-None boundary for
+    every backend-facing call, so accounting cannot fail a build."""
+    from pagerank_tpu.utils import jax_compat
+
+    report = CostReport(form=form, iters=max(1, int(iters)),
+                        num_edges=num_edges, device_kind=_device_kind())
+    ca = jax_compat.compiled_cost_analysis(compiled)
+    if ca is not None:
+        report.flops = ca.get("flops")
+        report.bytes_accessed = ca.get("bytes accessed")
+    ma = jax_compat.compiled_memory_analysis(compiled)
+    if ma is not None:
+        report.peak_bytes = ma.get("peak_bytes")
+        report.argument_bytes = ma.get("argument_bytes")
+        report.output_bytes = ma.get("output_bytes")
+        report.temp_bytes = ma.get("temp_bytes")
+        report.generated_code_bytes = ma.get("generated_code_bytes")
+    if record:
+        record_report(report)
+    return report
+
+
+# -- process-global ledger --------------------------------------------------
+
+_LEDGER: Dict[str, CostReport] = {}
+
+
+def record_report(report: CostReport) -> CostReport:
+    """File ``report`` under its form (last write wins — a recompile of
+    the same form replaces the stale model) and mirror the headline
+    numbers into the metrics registry as ``cost.<form>.*`` gauges, so
+    the live exporter publishes the model alongside measured rates."""
+    _LEDGER[report.form] = report
+    for metric, value in (
+        ("flops", report.flops_per_iter),
+        ("hbm_bytes", report.bytes_per_iter),
+        ("peak_bytes", report.peak_bytes),
+    ):
+        if value is not None:
+            obs_metrics.gauge(
+                f"cost.{report.form}.{metric}",
+                f"XLA cost model: per-iteration {metric} of the "
+                f"'{report.form}' program",
+            ).set(value)
+    return report
+
+
+def attach_measurement(form: str, seconds_per_iter: float,
+                       num_edges: Optional[int] = None) -> Optional[CostReport]:
+    """Attach a measured per-iteration wall to a ledgered form —
+    activates the achieved-vs-roofline view. Returns the report (None
+    when the form was never harvested)."""
+    report = _LEDGER.get(form)
+    if report is None:
+        return None
+    report.seconds_per_iter = float(seconds_per_iter)
+    if num_edges is not None:
+        report.num_edges = num_edges
+    frac = report.roofline_fraction
+    if frac is not None:
+        obs_metrics.gauge(
+            f"cost.{form}.roofline_fraction",
+            f"achieved HBM bytes/s over the device peak for "
+            f"'{form}'",
+        ).set(frac)
+    return report
+
+
+def get_report(form: str) -> Optional[CostReport]:
+    return _LEDGER.get(form)
+
+
+def ledger_snapshot() -> Dict[str, dict]:
+    """``{form: CostReport.to_json()}``, stable key order — the
+    ``costs`` section of the run report and bench JSON."""
+    return {form: _LEDGER[form].to_json() for form in sorted(_LEDGER)}
+
+
+def reset() -> None:
+    """Drop every ledgered report — one run's cost model must not
+    bleed into the next in-process run (cli.main resets at entry,
+    alongside the metrics registry)."""
+    _LEDGER.clear()

@@ -103,6 +103,33 @@ class Histogram:
             key = str(1 << e) if (1 << e) >= v else "+inf"
         self.buckets[key] = self.buckets.get(key, 0) + 1
 
+    #: Fixed quantile summaries published by snapshot() — what the
+    #: Prometheus exporter (obs/live.py) and the run report surface as
+    #: latency distributions, not just count/sum/max.
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper-bound estimate of the ``q``-quantile from the
+        power-of-two buckets: the smallest bucket bound whose cumulative
+        count reaches ``q * count``. Exact observed extremes clamp it —
+        the estimate is never below ``min`` or above ``max`` (a
+        one-bucket histogram answers the true range, not the bucket
+        ceiling)."""
+        if not self.count:
+            return None
+        target = q * self.count
+
+        def bound(key: str) -> float:
+            return float("inf") if key == "+inf" else float(int(key))
+
+        cum = 0
+        for key in sorted(self.buckets, key=bound):
+            cum += self.buckets[key]
+            if cum >= target:
+                est = bound(key)
+                return float(min(max(est, self.min), self.max))
+        return float(self.max)  # pragma: no cover - cum always reaches
+
     def snapshot(self):
         return {
             "count": self.count,
@@ -110,6 +137,10 @@ class Histogram:
             "min": self.min,
             "max": self.max,
             "mean": (self.sum / self.count) if self.count else None,
+            # Bucket-estimated (upper-bound) latency quantiles — see
+            # quantile(); None when empty, like min/max.
+            **{f"p{int(q * 100)}": self.quantile(q)
+               for q in self.QUANTILES},
             "buckets": dict(self.buckets),
         }
 

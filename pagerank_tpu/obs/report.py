@@ -48,7 +48,7 @@ SCHEMA_VERSION = 1
 #: tests/test_obs.py::test_cli_run_report_schema).
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
-    "metrics", "iterations", "summary", "robustness",
+    "metrics", "iterations", "summary", "robustness", "costs",
 )
 
 
@@ -140,12 +140,20 @@ def build_run_report(
     history: Optional[List[dict]] = None,
     summary: Optional[dict] = None,
     robustness: Optional[dict] = None,
+    costs: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Assemble the report dict. Every section is optional — a bench
     run has no per-iteration history, a CPU run has no profile — but
     every REPORT_KEYS key is always present (null/empty when unused)
-    so consumers never key-error across producers."""
+    so consumers never key-error across producers. ``costs`` defaults
+    to the cost-accounting ledger (obs/costs.py): the per-compiled-form
+    FLOPs/HBM-bytes/peak-allocation model — ISSUE 5's "did the model
+    change or just the wall time" axis."""
+    if costs is None:
+        from pagerank_tpu.obs import costs as costs_mod
+
+        costs = costs_mod.ledger_snapshot()
     report = {
         "schema_version": SCHEMA_VERSION,
         "created_unix": time.time(),
@@ -157,6 +165,7 @@ def build_run_report(
         "iterations": _json_safe(history or []),
         "summary": _json_safe(summary or {}),
         "robustness": _json_safe(robustness or {}),
+        "costs": _json_safe(costs or {}),
     }
     if extra:
         report.update(_json_safe(extra))
@@ -178,6 +187,17 @@ def load_report(path: str) -> dict:
 
 def _fmt_s(v) -> str:
     return f"{v:.3f}s" if isinstance(v, (int, float)) else "-"
+
+
+def _fmt_qty(v) -> str:
+    """Compact magnitude formatting for cost-model quantities (flops,
+    bytes); '-' for unreported (None)."""
+    if not isinstance(v, (int, float)):
+        return "-"
+    for div, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suffix}"
+    return f"{v:.0f}"
 
 
 def render_report(report: dict) -> str:
@@ -210,6 +230,22 @@ def render_report(report: dict) -> str:
             lines.append(
                 f"  {name:<{w}}  {a['total_s']:9.3f}s  x{a['count']:<5d}"
                 f"  mean {a['mean_s'] * 1e3:9.2f} ms"
+            )
+    costs = report.get("costs") or {}
+    if costs:
+        lines.append("cost model (per iteration; '-' = backend did not "
+                     "report):")
+        w = max(len(n) for n in costs)
+        for form in sorted(costs):
+            c = costs[form]
+            lines.append(
+                f"  {form:<{w}}  flops {_fmt_qty(c.get('flops_per_iter'))}"
+                f"  hbm {_fmt_qty(c.get('bytes_per_iter'))}B"
+                f"  peak {_fmt_qty(c.get('peak_bytes'))}B"
+                + (f"  {c['bytes_per_edge']:.1f} B/edge"
+                   if c.get("bytes_per_edge") is not None else "")
+                + (f"  roofline {c['roofline_fraction']:.1%}"
+                   if c.get("roofline_fraction") is not None else "")
             )
     rb = report.get("robustness") or {}
     if any(rb.values()):
@@ -296,6 +332,40 @@ def diff_reports(a: dict, b: dict) -> str:
     if rate_lines:
         lines.append("rate deltas:")
         lines.extend(rate_lines)
+
+    # Cost-model deltas (ISSUE 5): a changed model means the CODE
+    # changed what a step should cost; identical models with moved wall
+    # times point at the backend — the regression-vs-drift separation,
+    # now on the analytic axis too.
+    qa, qb = a.get("costs") or {}, b.get("costs") or {}
+    cost_lines = []
+    for form in sorted(set(qa) | set(qb)):
+        fa, fb = qa.get(form, {}), qb.get(form, {})
+        deltas = []
+        for key, tag in (("flops_per_iter", "flops"),
+                         ("bytes_per_iter", "hbm"),
+                         ("peak_bytes", "peak")):
+            va, vb = fa.get(key), fb.get(key)
+            if va == vb:
+                continue
+            rel = _rel(va, vb)
+            deltas.append(
+                f"{tag} {_fmt_qty(va)} -> {_fmt_qty(vb)}"
+                + (f" ({rel:+.1%})" if rel is not None else "")
+            )
+        if not fa:
+            deltas = ["only in B"]
+        elif not fb:
+            deltas = ["only in A"]
+        if deltas:
+            cost_lines.append(f"  {form}: " + ", ".join(deltas))
+    if cost_lines:
+        lines.append("cost-model deltas (the program changed, not just "
+                     "the wall):")
+        lines.extend(cost_lines)
+    elif qa or qb:
+        lines.append("cost model: identical (wall deltas above are "
+                     "execution, not program, changes)")
 
     ca = (a.get("metrics") or {}).get("counters") or {}
     cb = (b.get("metrics") or {}).get("counters") or {}

@@ -666,6 +666,18 @@ def build_ell_device(
     rows_total = stripe_bounds[-1]
     num_edges = int(num_edges_np)
     _stage_fence(timings, "slots_s", t0)
+    # Build-shape gauges: with a live exporter attached (obs/live.py)
+    # a long build shows its resolved geometry before the solve
+    # starts; they also anchor the cost ledger's bytes-per-edge reads.
+    from pagerank_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.gauge(
+        "build.num_edges", "unique edges of the latest device build"
+    ).set(num_edges)
+    obs_metrics.gauge(
+        "build.slot_rows", "packed 128-lane slot rows of the latest "
+        "device build"
+    ).set(rows_total)
 
     if dangling_mask is None:
         mass_mask = out_degree == 0

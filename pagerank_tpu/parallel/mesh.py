@@ -50,3 +50,27 @@ def vertex_sharding(mesh: Mesh) -> NamedSharding:
     contiguous vertex blocks over the mesh axis — the analogue of the
     reference's hash-partitioned ``ranks`` RDD (Sparky.java:165-170)."""
     return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def device_view() -> Sequence[str]:
+    """One human line per visible device — id, kind, process, and (when
+    the backend reports it) live HBM use — the per-device evidence the
+    stall watchdog prints when a multichip solve wedges (obs/live.py).
+    Memory stats are best-effort: CPU devices and older plugins return
+    None, and a diagnostic must never fail gathering itself."""
+    lines = []
+    for d in jax.devices():
+        line = f"{d.platform}:{d.id} ({d.device_kind}, proc {d.process_index})"
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            used = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if used is not None:
+                line += f" hbm {used / 1e9:.2f}GB"
+                if limit:
+                    line += f"/{limit / 1e9:.2f}GB"
+        lines.append(line)
+    return lines

@@ -137,6 +137,13 @@ def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
                 *args
             ).compile()
         _STAGE_EXECS[key] = exe
+        # Every build-stage compile feeds the cost ledger (obs/costs):
+        # FLOPs / HBM bytes / peak allocation per stage, the "what a
+        # build SHOULD cost" model the run report and `obs report`
+        # diffs carry. Harvest never raises (degrades to None fields).
+        from pagerank_tpu.obs import costs as obs_costs
+
+        obs_costs.harvest("build/" + name, exe)
         if timings is not None:
             timings["compile_s"] = (
                 timings.get("compile_s", 0.0) + time.perf_counter() - t0

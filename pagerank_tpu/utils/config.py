@@ -182,6 +182,20 @@ class PageRankConfig:
     log_every: int = 1
     profile_dir: Optional[str] = None
 
+    # In-loop convergence probes (obs/probes.py; ISSUE 5): every
+    # probe_every iterations the step also computes the L1 residual,
+    # rank mass, and top-probe_topk churn ON DEVICE (contract PTC007:
+    # no extra host syncs between probe points, no collectives beyond
+    # the form's budget). 0 disables — the solve takes the exact
+    # unprobed code path (zero probe calls), reproducing the
+    # reference's check-free loop (Sparky.java:187). stop_tol
+    # early-exits when the PROBED residual reaches it (checked at
+    # probe points only — unlike `tol`, which checks every iteration);
+    # None keeps exact Sparky semantics.
+    probe_every: int = 0
+    probe_topk: int = 64
+    stop_tol: Optional[float] = None
+
     # Fault tolerance (docs/ROBUSTNESS.md): solver health checks +
     # rollback budget + sink-write failure policy.
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
@@ -203,6 +217,27 @@ class PageRankConfig:
             raise ValueError(
                 f"tol must be a finite positive float, got {self.tol}"
             )
+        if self.probe_every < 0:
+            raise ValueError(
+                f"probe_every must be >= 0 (0 disables), got "
+                f"{self.probe_every}"
+            )
+        if self.probe_topk < 1:
+            raise ValueError(
+                f"probe_topk must be >= 1, got {self.probe_topk}"
+            )
+        if self.stop_tol is not None:
+            if not (0.0 < self.stop_tol < float("inf")):
+                raise ValueError(
+                    f"stop_tol must be a finite positive float, got "
+                    f"{self.stop_tol}"
+                )
+            if self.probe_every == 0:
+                raise ValueError(
+                    "stop_tol is checked at probe points only; set "
+                    "probe_every > 0 (or use tol for an every-"
+                    "iteration check)"
+                )
         if self.kernel not in ("auto", "ell", "coo", "pallas"):
             raise ValueError(f"unknown kernel: {self.kernel!r}")
         if self.vertex_sharded and self.kernel in ("coo", "pallas"):

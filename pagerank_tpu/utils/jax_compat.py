@@ -39,6 +39,70 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
     )
 
 
+def compiled_cost_analysis(compiled):
+    """XLA's per-program cost model from an AOT ``Compiled`` object, as
+    one flat ``{metric: float}`` dict — or None when this backend /
+    jax version does not report one (PJRT plugins may raise
+    ``NotImplementedError``; some return empty). The jax API has
+    shifted shape across releases (a list of per-computation dicts on
+    0.4.x, a bare dict later), so THIS is the one place that
+    normalizes it (obs/costs.py consumes it)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # unimplemented on this backend: degrade to None
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    out = {}
+    for k, v in ca.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
+
+
+def compiled_memory_analysis(compiled):
+    """XLA's compiled-memory breakdown as a plain ``{field: int}`` dict
+    (argument/output/temp/alias/generated-code bytes, plus
+    ``peak_bytes`` — the explicit attr when the backend reports one,
+    else the argument+output+temp sum, the standard upper proxy for a
+    program's device allocation). None when unavailable — same
+    degrade-to-None contract as :func:`compiled_cost_analysis`."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+        "alias_bytes": "alias_size_in_bytes",
+        "generated_code_bytes": "generated_code_size_in_bytes",
+    }
+    out = {}
+    for name, attr in fields.items():
+        v = getattr(ma, attr, None)
+        if v is not None:
+            try:
+                out[name] = int(v)
+            except (TypeError, ValueError):
+                continue
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    if peak is None:
+        parts = [out.get(k) for k in
+                 ("argument_bytes", "output_bytes", "temp_bytes")]
+        peak = sum(p for p in parts if p is not None) if any(
+            p is not None for p in parts) else None
+    if peak is not None:
+        out["peak_bytes"] = int(peak)
+    return out or None
+
+
 def pallas_tpu_compiler_params(**kw):
     """`pltpu.CompilerParams` (jax >= 0.6) / `pltpu.TPUCompilerParams`
     (jax 0.4.x) — renamed class, and the older one lacks some fields

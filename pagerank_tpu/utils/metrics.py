@@ -14,6 +14,7 @@ import sys
 import time
 from typing import Dict, Optional, TextIO
 
+from pagerank_tpu.obs import live as obs_live
 from pagerank_tpu.utils import fsio
 
 
@@ -84,14 +85,23 @@ class MetricsLogger:
         }
         if timing is not None:
             rec["timing"] = timing
-        for k in ("l1_delta", "dangling_mass"):
+        # rank_mass / topk_churn appear on probe iterations only
+        # (obs/probes.py) — the per-iteration history is where the run
+        # report's convergence telemetry lives.
+        for k in ("l1_delta", "dangling_mass", "rank_mass"):
             if k in info:
                 # Non-finite step info (a diverging solve under
                 # --no-health-checks) is encoded as null too — NaN is
                 # no more a JSON token than Infinity is.
                 v = float(info[k])
                 rec[k] = v if math.isfinite(v) else None
+        if "topk_churn" in info:
+            rec["topk_churn"] = int(info["topk_churn"])
         self.history.append(rec)
+        # Mirror the headline scalars into registry gauges + the
+        # step-seconds histogram — the live exporter's (obs/live.py)
+        # per-iteration feed; plain in-GIL arithmetic, no I/O.
+        obs_live.update_solve_gauges(iteration, rec, dt)
         if self._jsonl:
             # allow_nan=False: any non-finite float reaching the dump
             # is a bug in the sanitizing above — fail loudly rather

@@ -118,8 +118,16 @@ CONFIGS = {
     # and every other gate's artifacts lean on this layer.
     "G": dict(kind="obs", iters=4,
               label="observability smoke (traced run + flight recorder)"),
+    # Live-telemetry smoke (ISSUE 5): a probed CPU run with the
+    # Prometheus textfile exporter and the stall watchdog armed —
+    # probe history in the run report at the exact cadence, a
+    # strict text-format parse of the exporter output, and watchdog
+    # non-fire. Right after G: same sub-second class, and the live
+    # layer is what a wedged long run is diagnosed with.
+    "H": dict(kind="live", iters=6, probe_every=2,
+              label="live-telemetry smoke (probes + exporter + watchdog)"),
 }
-DEFAULT_KEYS = ["D", "G", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -134,6 +142,13 @@ BUILD_SMOKE_BUDGET_S = 60.0
 # accidentally-heavyweight tracer (the whole point of the no-op/cheap
 # contract, docs/OBSERVABILITY.md).
 OBS_SMOKE_BUDGET_S = 2.0
+
+# Budget for the live-telemetry smoke (seconds): a 6-iteration probed
+# cpu run + a textfile rewrite per iteration is tens of milliseconds;
+# 2s catches an accidentally-heavyweight probe/exporter path — the
+# zero-extra-host-syncs contract's wall-clock shadow (PTC007 checks
+# the structural half).
+LIVE_SMOKE_BUDGET_S = 2.0
 
 # PPR gates. Top-k membership is judged against ORACLE SCORES, not id
 # sets: vertices tied at the k-th score legitimately swap in/out of an
@@ -389,6 +404,128 @@ def run_obs_smoke(key: str):
         f"env fingerprint {'OK' if env_ok else 'INCOMPLETE'}; "
         f"{len(events)} trace event(s) "
         f"{'schema-OK' if trace_ok else 'SCHEMA-BAD'} -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+_PROM_SAMPLE_RE = None
+
+
+def _parse_prometheus_strict(text: str) -> int:
+    """Line-by-line strict parse of Prometheus text exposition format;
+    returns the sample count, raises AssertionError on any bad line
+    (the exporter's syntax gate — tests/test_telemetry.py carries the
+    same grammar)."""
+    import re
+
+    global _PROM_SAMPLE_RE
+    if _PROM_SAMPLE_RE is None:
+        _PROM_SAMPLE_RE = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+            r" (?:[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|Inf)|NaN)$"
+        )
+    samples = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _PROM_SAMPLE_RE.match(line), f"bad exporter line: {line!r}"
+        samples += 1
+    return samples
+
+
+def run_live_smoke(key: str):
+    """ISSUE-5 live-telemetry gate: one probed CPU run through the CLI
+    with `--metrics-textfile` and the stall watchdog armed. Gates: the
+    CLI exits 0, the run report's probe history has one record per
+    probe point with residual/mass/churn, those records also appear in
+    the per-iteration history, the final textfile parses strictly as
+    Prometheus text format and carries the probe counters, the
+    watchdog never fired, and the whole thing lands under
+    LIVE_SMOKE_BUDGET_S."""
+    import shutil
+    import tempfile
+
+    from pagerank_tpu.cli import main as cli_main
+
+    spec = CONFIGS[key]
+    iters, every = spec["iters"], spec["probe_every"]
+    work = tempfile.mkdtemp(prefix="pagerank_live_")
+    t0 = time.perf_counter()
+    try:
+        report_path = os.path.join(work, "run_report.json")
+        textfile = os.path.join(work, "metrics.prom")
+        rc = cli_main([
+            "--synthetic", "uniform:400:3000", "--engine", "cpu",
+            "--iters", str(iters), "--log-every", "0",
+            "--probe-every", str(every), "--probe-topk", "16",
+            "--metrics-textfile", textfile,
+            "--stall-timeout", "300",
+            "--run-report", report_path,
+        ])
+        with open(report_path) as f:
+            report = json.load(f)
+        text = open(textfile).read()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    t_run = time.perf_counter() - t0
+
+    want_iters = [i for i in range(iters) if (i + 1) % every == 0]
+    probes = report.get("probes") or []
+    probes_ok = (
+        [r.get("iteration") for r in probes] == want_iters
+        and all(
+            r.get("l1_residual") is not None
+            and r.get("rank_mass") is not None
+            and r.get("topk_churn") is not None
+            for r in probes
+        )
+    )
+    hist_probe_iters = [
+        r["iter"] for r in report.get("iterations", [])
+        if "rank_mass" in r
+    ]
+    history_ok = hist_probe_iters == want_iters
+    try:
+        samples = _parse_prometheus_strict(text)
+        text_ok = (samples > 0
+                   and f"pagerank_probe_points {len(want_iters)}" in text)
+    except AssertionError as e:
+        samples, text_ok = 0, False
+        print(f"[{key}] {e}", file=sys.stderr)
+    counters = (report.get("metrics") or {}).get("counters") or {}
+    watchdog_quiet = counters.get("watchdog.stalls", 0) == 0
+    passed = bool(
+        rc == 0 and probes_ok and history_ok and text_ok
+        and watchdog_quiet and t_run <= LIVE_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "live",
+        "label": spec["label"],
+        "iters": iters,
+        "probe_every": every,
+        "probe_records_ok": probes_ok,
+        "history_records_ok": history_ok,
+        "exporter_samples": samples,
+        "exporter_syntax_ok": text_ok,
+        "watchdog_fired": not watchdog_quiet,
+        "seconds": t_run,
+        "budget_s": LIVE_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] probed run + exporter + watchdog in {t_run:.2f}s vs "
+        f"budget {LIVE_SMOKE_BUDGET_S:g}s; probe records "
+        f"{'OK' if probes_ok else 'BAD'}; history "
+        f"{'OK' if history_ok else 'BAD'}; {samples} exporter sample(s) "
+        f"{'parse OK' if text_ok else 'PARSE BAD'}; watchdog "
+        f"{'quiet' if watchdog_quiet else 'FIRED'} -> "
         f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
@@ -894,7 +1031,8 @@ def main(argv=None) -> int:
     _enable_compile_cache()
     keys = [args.only] if args.only else DEFAULT_KEYS
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
-               "faults": run_fault_smoke, "obs": run_obs_smoke}
+               "faults": run_fault_smoke, "obs": run_obs_smoke,
+               "live": run_live_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

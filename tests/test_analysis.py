@@ -285,7 +285,7 @@ def test_list_rules(capsys):
     for rid in ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
                 "PTL006", "PTL007",
                 "PTC001", "PTC002", "PTC003", "PTC004", "PTC005",
-                "PTC006"):
+                "PTC006", "PTC007"):
         assert rid in text
 
 
@@ -402,7 +402,12 @@ def test_contract_catches_host_callback(monkeypatch):
 
 def test_device_build_emits_no_donation_warning():
     """The fixed build chain must be warning-free end to end (the
-    contract the bench log violated)."""
+    contract the bench log violated). Shapes covered: the plain form
+    AND the multichip dryrun's grouped+striped presentinel geometry
+    (group=4, stripe_size=128, with_weights=False, 4096 raw edges —
+    the exact dispatch whose residual "int32[4096], int32[4096],
+    int8[4096]" warning the MULTICHIP_r05 tail showed; ISSUE 5
+    satellite)."""
     import warnings
 
     from pagerank_tpu.ops import device_build as db
@@ -416,5 +421,10 @@ def test_device_build_emits_no_donation_warning():
                 jnp.asarray(rng.integers(0, 300, 2048), jnp.int32),
                 n=300, with_weights=with_w,
             )
+        db.build_ell_device(
+            jnp.asarray(rng.integers(0, 256, 4096), jnp.int32),
+            jnp.asarray(rng.integers(0, 256, 4096), jnp.int32),
+            n=256, group=4, stripe_size=128, with_weights=False,
+        )
     bad = [w for w in wlog if "donated buffers" in str(w.message)]
     assert bad == []

@@ -10,6 +10,25 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _assert_costs_block(costs):
+    """The per-leg cost block (ISSUE 5): per-compiled-form FLOPs /
+    HBM-bytes / peak-allocation from XLA's cost model, with None
+    tolerated field-by-field (backends without cost_analysis report
+    None, never zero). Single-program layouts (every bench-contract
+    scale) carry the whole-iteration 'step' form with the measured
+    per-iteration wall attached; multi-dispatch layouts carry the
+    prescale/stripe/final program models unmeasured instead."""
+    assert isinstance(costs, dict) and costs
+    assert "step" in costs or "final" in costs, costs
+    for form, c in costs.items():
+        for key in ("flops", "bytes_accessed", "peak_bytes",
+                    "bytes_per_edge", "roofline_fraction"):
+            assert key in c, (form, key)
+            assert c[key] is None or c[key] >= 0, (form, key, c[key])
+    if "step" in costs:
+        assert costs["step"]["seconds_per_iter"] > 0
+
+
 def _env():
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
@@ -32,8 +51,11 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "fast_f32", "accuracy", "env"}
+                        "build_s", "costs", "fast_f32", "accuracy", "env"}
     assert rec["build_s"] > 0 and rec["fast_f32"]["build_s"] > 0
+    # Both legs carry the XLA cost-model block (ISSUE 5).
+    _assert_costs_block(rec["costs"])
+    _assert_costs_block(rec["fast_f32"]["costs"])
     assert rec["metric"] == "edges_per_sec_per_chip"
     assert rec["unit"] == "edges/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
@@ -59,11 +81,12 @@ def test_bench_json_contract_single_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "env"}
+                        "build_s", "costs", "env"}
     # The environment fingerprint makes future BENCH_r*.json cells
     # comparable across backend drift (ISSUE 4; obs/report.py).
     assert rec["env"]["jax_version"] and rec["env"]["backend"]
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    _assert_costs_block(rec["costs"])
 
 
 def test_bench_build_only_reports_stage_breakdown(tmp_path):

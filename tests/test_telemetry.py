@@ -1,0 +1,601 @@
+"""Device & convergence telemetry tests (ISSUE 5; docs/OBSERVABILITY.md
+"Live monitoring" / "Cost model"): Prometheus text-format golden +
+syntax tests, HTTP endpoint round-trip, virtual-time stall-watchdog
+fire/no-fire, probe parity against the CPU oracle, the zero-probe-call
+booby trap, histogram quantiles, and the XLA cost-accounting ledger."""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pagerank_tpu import PageRankConfig, build_graph, make_engine, obs
+from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+from pagerank_tpu.obs import costs as obs_costs
+from pagerank_tpu.obs import live as obs_live
+from pagerank_tpu.obs.metrics import Histogram, MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Process-global registry/ledger/watchdog must never leak between
+    tests (the obs-test discipline, tests/test_obs.py)."""
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    obs_costs.reset()
+    obs.disarm_watchdog()
+    yield
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    obs_costs.reset()
+    obs.disarm_watchdog()
+
+
+def _graph(n=600, e=4800, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+# -- Prometheus text format -------------------------------------------------
+
+
+def test_prometheus_render_golden():
+    """Exact rendering of one counter + gauge + histogram — the
+    name/help/type-line/bucket syntax a scraper parses."""
+    reg = MetricsRegistry()
+    reg.counter("s3.request.retries", "transparent re-attempts").inc(5)
+    reg.gauge("solve.iteration", "iterations completed").set(7)
+    h = reg.histogram("snapshot.save_bytes", "per-snapshot size")
+    for v in (3, 5, 1000):
+        h.record(v)
+    assert obs_live.render_prometheus(reg) == (
+        "# HELP pagerank_s3_request_retries transparent re-attempts\n"
+        "# TYPE pagerank_s3_request_retries counter\n"
+        "pagerank_s3_request_retries 5\n"
+        "# HELP pagerank_snapshot_save_bytes per-snapshot size\n"
+        "# TYPE pagerank_snapshot_save_bytes histogram\n"
+        'pagerank_snapshot_save_bytes_bucket{le="4"} 1\n'
+        'pagerank_snapshot_save_bytes_bucket{le="8"} 2\n'
+        'pagerank_snapshot_save_bytes_bucket{le="1024"} 3\n'
+        'pagerank_snapshot_save_bytes_bucket{le="+Inf"} 3\n'
+        "pagerank_snapshot_save_bytes_sum 1008.0\n"
+        "pagerank_snapshot_save_bytes_count 3\n"
+        "# HELP pagerank_solve_iteration iterations completed\n"
+        "# TYPE pagerank_solve_iteration gauge\n"
+        "pagerank_solve_iteration 7\n"
+    )
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" (?:[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|Inf)|NaN)$"
+)
+
+
+def assert_prometheus_syntax(text: str) -> int:
+    """Strict line-by-line parse of an exposition-format document;
+    returns the sample count. Shared with the acceptance smoke H."""
+    samples = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", parts[2]), line
+            if line.startswith("# TYPE "):
+                assert parts[3] in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"), line
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        samples += 1
+    return samples
+
+
+def test_prometheus_syntax_over_live_registry():
+    """Every metric the package actually registers must render to
+    spec-parseable lines (gauges with None values publish nothing)."""
+    reg = obs.get_registry()
+    reg.counter("a.b", "c").inc()
+    reg.gauge("unset.gauge", "never set")  # no sample line
+    reg.gauge("neg.gauge", "negative").set(-2.5)
+    h = reg.histogram("h.zero", "zero bucket")
+    h.record(0)
+    h.record(2 ** 70)  # lands in the +inf bucket
+    text = obs_live.render_prometheus(reg)
+    assert assert_prometheus_syntax(text) >= 3
+    assert not any(
+        l.startswith("pagerank_unset_gauge ") for l in text.splitlines()
+    )  # metadata only, no sample line
+    assert 'pagerank_h_zero_bucket{le="0"} 1' in text
+    assert 'pagerank_h_zero_bucket{le="+Inf"} 2' in text
+
+
+def test_prometheus_nonfinite_values_use_format_spellings():
+    """NaN/±Inf gauges (a diverging solve under --no-health-checks)
+    must render as the exposition format's 'NaN'/'+Inf'/'-Inf', never
+    Python's repr — the strict parser's grammar rejects 'nan'."""
+    reg = MetricsRegistry()
+    reg.gauge("bad.mass", "diverged").set(float("nan"))
+    reg.gauge("pos.inf", "over").set(float("inf"))
+    reg.gauge("neg.inf", "under").set(float("-inf"))
+    text = obs_live.render_prometheus(reg)
+    assert "pagerank_bad_mass NaN" in text
+    assert "pagerank_pos_inf +Inf" in text
+    assert "pagerank_neg_inf -Inf" in text
+    assert assert_prometheus_syntax(text) == 3
+
+
+def test_metrics_textfile_atomic_rewrite(tmp_path):
+    """--metrics-textfile: every write is a complete document (tmp +
+    rename), and repeated writes reflect the current registry."""
+    path = str(tmp_path / "metrics.prom")
+    reg = obs.get_registry()
+    c = reg.counter("solve.iterations", "done")
+    exp = obs.MetricsExporter(textfile=path)
+    c.inc()
+    exp.write_textfile()
+    first = open(path).read()
+    assert "pagerank_solve_iterations 1" in first
+    c.inc(4)
+    exp.write_textfile()
+    assert "pagerank_solve_iterations 5" in open(path).read()
+    assert not (tmp_path / "metrics.prom.prom.tmp").exists()
+    exp.close()
+    assert_prometheus_syntax(open(path).read())
+
+
+def test_http_endpoint_roundtrip():
+    """--metrics-port on an ephemeral port: GET /metrics returns the
+    current rendering with the exposition content type; other paths
+    404; close() tears the server down."""
+    reg = obs.get_registry()
+    reg.counter("probe.points", "probes").inc(3)
+    with obs.MetricsExporter(port=0) as exp:
+        assert exp.port and exp.port > 0
+        url = f"http://127.0.0.1:{exp.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert body == exp.render()
+        assert "pagerank_probe_points 3" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/nope", timeout=10
+            )
+        port = exp.port
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2
+        )
+
+
+# -- histogram quantiles (ISSUE 5 satellite) --------------------------------
+
+
+def test_histogram_quantiles_from_buckets():
+    h = Histogram("t", "")
+    for v in range(1, 101):  # 1..100
+        h.record(v)
+    s = h.snapshot()
+    assert set(s) >= {"p50", "p90", "p99", "count", "sum", "buckets"}
+    # Bucket-upper-bound estimates: p50 of 1..100 lands in the 64
+    # bucket, p90/p99 in the 128 bucket (clamped to max=100).
+    assert s["p50"] == 64
+    assert s["p90"] == 100  # 128 bucket, clamped to observed max
+    assert s["p99"] == 100
+    assert Histogram("e", "").snapshot()["p50"] is None
+
+
+def test_histogram_quantile_single_value_is_exact():
+    h = Histogram("t", "")
+    h.record(7)
+    s = h.snapshot()
+    # One observation: every quantile is that value (clamping to the
+    # observed range beats the bucket ceiling of 8).
+    assert s["p50"] == s["p99"] == 7
+
+
+# -- stall watchdog ---------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_watchdog_virtual_time_fire_and_no_fire():
+    clock = _Clock()
+    interrupts = []
+    wd = obs_live.StallWatchdog(
+        timeout_s=10.0, action="warn", clock=clock,
+        interrupt=lambda: interrupts.append(1),
+    )
+    # Heartbeats inside the timeout: never fires.
+    for _ in range(5):
+        clock.t += 8.0
+        wd.heartbeat(3)
+        assert wd.check() is False
+    assert wd.stalls == 0
+    # Silence past the timeout: fires ONCE per episode.
+    clock.t += 11.0
+    assert wd.check() is True
+    assert wd.check() is False  # same episode, one diagnostic
+    assert wd.stalls == 1
+    assert interrupts == []  # warn action never interrupts
+    assert wd.last_iteration == 3
+    # New progress re-arms; a second stall fires a second episode.
+    wd.heartbeat(9)
+    assert wd.check() is False
+    clock.t += 20.0
+    assert wd.check() is True
+    assert wd.stalls == 2
+    snap = obs.get_registry().snapshot()
+    assert snap["counters"]["watchdog.stalls"] == 2
+
+
+def test_watchdog_raise_action_interrupts():
+    clock = _Clock()
+    interrupts = []
+    wd = obs_live.StallWatchdog(
+        timeout_s=5.0, action="raise", clock=clock,
+        interrupt=lambda: interrupts.append(1),
+    )
+    clock.t += 6.0
+    assert wd.check() is True
+    assert interrupts == [1]
+
+
+def test_watchdog_heartbeat_fed_by_engine_run():
+    """An armed watchdog sees every completed step of engine.run (the
+    solve/step completion feed)."""
+    clock = _Clock()
+    wd = obs_live.StallWatchdog(timeout_s=1e9, clock=clock)
+    obs_live._WATCHDOG = wd  # arm without starting the thread
+    try:
+        eng = make_engine("cpu", PageRankConfig(num_iters=4)).build(_graph())
+        eng.run()
+    finally:
+        obs_live._WATCHDOG = None
+    assert wd.last_iteration == 3  # last completed iteration index
+
+
+def test_watchdog_validation():
+    with pytest.raises(ValueError):
+        obs_live.StallWatchdog(timeout_s=0)
+    with pytest.raises(ValueError):
+        obs_live.StallWatchdog(timeout_s=5, action="explode")
+
+
+# -- convergence probes -----------------------------------------------------
+
+
+def test_probe_parity_device_vs_cpu_oracle():
+    """Acceptance: a probed run's per-K residual / rank mass / top-k
+    churn (and the decoded top-k sets themselves) from the device
+    engine match the CPU oracle to dtype tolerance."""
+    g = _graph()
+    probes_j = obs.ConvergenceProbes(2, topk=16)
+    eng = make_engine("jax", PageRankConfig(
+        num_iters=8, num_devices=2)).build(g)
+    r_jax = eng.run(probes=probes_j)
+
+    probes_c = obs.ConvergenceProbes(2, topk=16)
+    cpu = make_engine("cpu", PageRankConfig(
+        num_iters=8, dtype="float64", accum_dtype="float64")).build(g)
+    r_cpu = cpu.run(probes=probes_c)
+
+    assert len(probes_j.history) == len(probes_c.history) == 4
+    for a, b in zip(probes_j.history, probes_c.history):
+        assert a["iteration"] == b["iteration"]
+        # f32 device vs f64 oracle: dtype tolerance.
+        assert a["l1_residual"] == pytest.approx(b["l1_residual"],
+                                                 rel=1e-4)
+        assert a["rank_mass"] == pytest.approx(b["rank_mass"], rel=1e-5)
+        assert a["topk_churn"] == b["topk_churn"]
+    # The decoded (original-id-space) top-k SETS agree.
+    assert set(map(int, probes_j.last_topk_ids)) == set(
+        map(int, probes_c.last_topk_ids)
+    )
+    np.testing.assert_allclose(r_jax, r_cpu, rtol=1e-4, atol=1e-6)
+
+
+def test_probed_run_is_bit_identical_to_unprobed():
+    """Probing must not perturb the solve: same graph, same config,
+    ranks bit-for-bit equal with and without probes."""
+    g = _graph(seed=3)
+    cfg = PageRankConfig(num_iters=6, num_devices=2)
+    r_plain = make_engine("jax", cfg).build(g).run()
+    eng = make_engine("jax", cfg).build(g)
+    r_probed = eng.run(probes=obs.ConvergenceProbes(3, topk=8))
+    np.testing.assert_array_equal(r_plain, r_probed)
+
+
+def test_zero_probe_call_booby_trap(monkeypatch):
+    """--probe-every 0 / probes=None takes the EXACT pre-probe code
+    path: booby-trap every probe entry point and run a full solve —
+    zero probe calls (the no-op tracer discipline, applied to
+    probes)."""
+
+    def boom(*a, **k):
+        raise AssertionError("probe machinery touched on an unprobed run")
+
+    from pagerank_tpu import engine as engine_mod
+
+    monkeypatch.setattr(engine_mod.PageRankEngine, "step_probed", boom)
+    monkeypatch.setattr(engine_mod.PageRankEngine, "probe_values", boom)
+    monkeypatch.setattr(JaxTpuEngine, "step_probed", boom)
+    monkeypatch.setattr(JaxTpuEngine, "probe_values", boom)
+    monkeypatch.setattr(JaxTpuEngine, "_get_probe_fn", boom)
+    monkeypatch.setattr(JaxTpuEngine, "_get_probed_step", boom)
+    g = _graph(seed=5)
+    eng = make_engine("jax", PageRankConfig(
+        num_iters=3, num_devices=2)).build(g)
+    r = eng.run()  # probes=None
+    assert np.all(np.isfinite(r))
+    cpu = make_engine("cpu", PageRankConfig(num_iters=3)).build(g)
+    assert np.all(np.isfinite(cpu.run()))
+
+
+def test_stop_tol_early_exit_at_probe_points_only():
+    g = _graph(seed=7)
+    probes = obs.ConvergenceProbes(5, topk=8, stop_tol=1e30)
+    eng = make_engine("cpu", PageRankConfig(num_iters=50)).build(g)
+    eng.run(probes=probes)
+    # An absurdly loose tol stops at the FIRST probe point (iteration
+    # 4 -> 5 iterations done), never earlier: the check is cadenced.
+    assert eng.iteration == 5
+    assert len(probes.history) == 1
+
+
+def test_probe_config_validation():
+    with pytest.raises(ValueError):
+        obs.ConvergenceProbes(-1)
+    with pytest.raises(ValueError):
+        obs.ConvergenceProbes(2, topk=0)
+    with pytest.raises(ValueError):
+        obs.ConvergenceProbes(2, stop_tol=0.0)
+    with pytest.raises(ValueError):
+        PageRankConfig(stop_tol=1e-6).validate()  # needs probe_every
+    PageRankConfig(stop_tol=1e-6, probe_every=4).validate()
+
+
+def test_probe_gauges_and_history_records():
+    g = _graph(seed=11)
+    probes = obs.ConvergenceProbes(2, topk=8)
+    eng = make_engine("cpu", PageRankConfig(num_iters=4)).build(g)
+    infos = []
+    eng.run(on_iteration=lambda i, info: infos.append(dict(info)),
+            probes=probes)
+    # Probe iterations carry the probe scalars in the on_iteration
+    # info (the per-iteration history feed); others don't.
+    assert "rank_mass" in infos[1] and "topk_churn" in infos[1]
+    assert "rank_mass" not in infos[0]
+    snap = obs.get_registry().snapshot()
+    assert snap["counters"]["probe.points"] == 2
+    assert snap["gauges"]["probe.rank_mass"] == pytest.approx(
+        probes.history[-1]["rank_mass"]
+    )
+
+
+# -- cost accounting --------------------------------------------------------
+
+
+def test_cost_harvest_from_compiled_program():
+    compiled = jax.jit(lambda x: (x * 2.0).sum()).lower(
+        jnp.ones((256, 256), jnp.float32)
+    ).compile()
+    rep = obs_costs.harvest("toy", compiled, num_edges=1000, iters=4)
+    # The CPU backend reports both analyses (probed in-session); a
+    # backend that doesn't yields None — the schema tolerates it, but
+    # HERE we know the substrate reports.
+    assert rep.flops and rep.flops > 0
+    assert rep.bytes_accessed and rep.bytes_accessed > 0
+    assert rep.peak_bytes and rep.peak_bytes > 0
+    assert rep.bytes_per_iter == rep.bytes_accessed / 4
+    assert rep.bytes_per_edge == pytest.approx(rep.bytes_accessed / 4 / 1000)
+    snap = obs_costs.ledger_snapshot()
+    assert set(snap) == {"toy"}
+    assert snap["toy"]["flops"] == rep.flops
+    # Mirrored into the registry as cost.* gauges.
+    gauges = obs.get_registry().snapshot()["gauges"]
+    assert gauges["cost.toy.flops"] == pytest.approx(rep.flops / 4)
+
+
+def test_cost_roofline_attachment():
+    compiled = jax.jit(lambda x: x + 1).lower(
+        jnp.ones((1024,), jnp.float32)
+    ).compile()
+    rep = obs_costs.harvest("leg", compiled)
+    rep.device_kind = "TPU v5e"  # pretend: CPU kinds are off-table
+    out = obs_costs.attach_measurement("leg", 1e-3)
+    assert out is rep and rep.seconds_per_iter == 1e-3
+    assert rep.achieved_bytes_per_s == pytest.approx(
+        rep.bytes_accessed / 1e-3
+    )
+    expected = rep.achieved_bytes_per_s / 819e9
+    assert rep.roofline_fraction == pytest.approx(expected)
+    assert obs_costs.attach_measurement("never-harvested", 1.0) is None
+
+
+def test_hbm_peak_lookup():
+    assert obs_costs.hbm_peak_bytes_per_s("TPU v5e") == 819e9
+    assert obs_costs.hbm_peak_bytes_per_s("TPU v5 lite") == 819e9
+    assert obs_costs.hbm_peak_bytes_per_s("TPU v5p") == 2_765e9
+    assert obs_costs.hbm_peak_bytes_per_s("cpu") is None
+    assert obs_costs.hbm_peak_bytes_per_s(None) is None
+
+
+def test_engine_cost_reports_all_layouts():
+    """cost_reports() harvests a usable model for the fused step AND
+    the multi-dispatch program sequence (prescale/stripe/final)."""
+    g = _graph()
+    eng = make_engine("jax", PageRankConfig(
+        num_iters=2, num_devices=2)).build(g)
+    snap = eng.cost_reports()
+    assert "step" in snap
+    assert snap["step"]["num_edges"] == g.num_edges
+    assert snap["step"]["bytes_per_edge"] is None or \
+        snap["step"]["bytes_per_edge"] > 0
+    # Repeat calls are served from the harvested flag (no recompile).
+    assert eng.cost_reports() == snap
+
+    class TinyScan(JaxTpuEngine):
+        def _stripe_max(self):
+            return 256
+
+        def _stripe_target(self):
+            return 256
+
+        SCAN_STRIPE_UNITS = 0
+
+    obs_costs.reset()
+    ms = TinyScan(PageRankConfig(num_iters=2, num_devices=2)).build(g)
+    assert ms._ms_stripe is not None
+    snap_ms = ms.cost_reports()
+    assert "prescale" in snap_ms and "final" in snap_ms
+    assert any(k.startswith("stripe") for k in snap_ms)
+
+
+def test_run_report_carries_costs_and_diff_renders(tmp_path):
+    """run_report.json costs section + `obs report A B` diffing it —
+    the code-regression-vs-backend-drift axis on the analytic model."""
+    from pagerank_tpu.obs import report as report_mod
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    compiled = jax.jit(lambda x: x * 3.0).lower(
+        jnp.ones((64,), jnp.float32)
+    ).compile()
+    obs_costs.harvest("step", compiled, num_edges=64)
+    a = report_mod.build_run_report()
+    assert "costs" in a and "step" in a["costs"]
+    pa = tmp_path / "a.json"
+    report_mod.write_run_report(str(pa), a)
+
+    obs_costs.reset()
+    compiled2 = jax.jit(lambda x: (x * 3.0) + x).lower(
+        jnp.ones((64,), jnp.float32)
+    ).compile()
+    obs_costs.harvest("step", compiled2, num_edges=64)
+    b = report_mod.build_run_report()
+    pb = tmp_path / "b.json"
+    report_mod.write_run_report(str(pb), b)
+
+    rendered = report_mod.render_report(a)
+    assert "cost model" in rendered
+    diff = report_mod.diff_reports(a, b)
+    assert "cost-model" in diff or "cost model" in diff
+    assert obs_main(["report", str(pa), str(pb)]) == 0
+
+
+def test_bench_leg_costs_block():
+    """bench.run_rate's costs block: the step form with an attached
+    measurement (roofline fields None off the TPU table)."""
+    import bench
+
+    g = _graph()
+    eng = make_engine("jax", PageRankConfig(
+        num_iters=2, num_devices=2)).build(g)
+    block = bench._leg_costs(eng, 0.01, g.num_edges)
+    assert "step" in block
+    assert block["step"]["seconds_per_iter"] == 0.01
+    assert block["step"]["roofline_fraction"] is None  # CPU substrate
+    b = block["step"]["bytes_per_edge"]
+    assert b is None or b > 0
+
+
+# -- probed fused path ------------------------------------------------------
+
+
+def test_fused_chunked_probe_boundaries():
+    """Probes at fused-chunk boundaries: same cadence and churn
+    telemetry as the stepwise loop's probe points."""
+    g = _graph(seed=13)
+    cfg = PageRankConfig(num_iters=8, num_devices=2)
+    eng = make_engine("jax", cfg).build(g)
+    probes = obs.ConvergenceProbes(4, topk=8)
+
+    def on_chunk(done, ranks_thunk, traces):
+        if done % probes.every == 0:
+            probes.probe_boundary(
+                eng, done - 1,
+                l1_delta=float(jax.device_get(traces[0][-1])),
+            )
+
+    eng.run_fused_chunked(every=4, on_chunk=on_chunk)
+    assert [r["iteration"] for r in probes.history] == [3, 7]
+
+    # Parity vs stepwise probes on the same graph/config.
+    eng2 = make_engine("jax", cfg).build(g)
+    probes2 = obs.ConvergenceProbes(4, topk=8)
+    eng2.run(probes=probes2)
+    for a, b in zip(probes.history, probes2.history):
+        assert a["iteration"] == b["iteration"]
+        assert a["rank_mass"] == pytest.approx(b["rank_mass"])
+        assert a["topk_churn"] == b["topk_churn"]
+
+
+def test_fused_stop_tol_fires_at_probe_points_only(tmp_path):
+    """--stop-tol under --fused with BOTH cadences set (gcd chunks):
+    the stop check runs at probe boundaries only — a snapshot-only
+    boundary must never early-exit the solve, matching the stepwise
+    contract."""
+    from pagerank_tpu.cli import main as cli_main
+
+    report = tmp_path / "rr.json"
+    rc = cli_main([
+        "--synthetic", "uniform:400:3000", "--iters", "12",
+        "--log-every", "0", "--fused",
+        "--snapshot-dir", str(tmp_path / "ck"), "--snapshot-every", "3",
+        "--probe-every", "2", "--stop-tol", "1e30",
+        "--run-report", str(report),
+    ])
+    assert rc == 0
+    rep = json.loads(report.read_text())
+    # An absurdly loose tol stops at the FIRST probe point (iteration
+    # 1, i.e. 2 iterations done) — not at the done=1 or done=3
+    # snapshot-cadence boundaries the gcd chunking also visits.
+    assert [r["iteration"] for r in rep["probes"]] == [1]
+    assert rep["summary"]["iters"] == 2
+
+
+def test_cli_probed_live_run(tmp_path):
+    """End-to-end CLI: probes + textfile + watchdog (non-fire) + run
+    report — the acceptance smoke H shape, as a tier-1 test."""
+    from pagerank_tpu.cli import main as cli_main
+
+    report = tmp_path / "rr.json"
+    textfile = tmp_path / "metrics.prom"
+    rc = cli_main([
+        "--synthetic", "uniform:400:3000", "--engine", "cpu",
+        "--iters", "6", "--log-every", "0",
+        "--probe-every", "2", "--probe-topk", "16",
+        "--metrics-textfile", str(textfile),
+        "--stall-timeout", "300",
+        "--run-report", str(report),
+    ])
+    assert rc == 0
+    rep = json.loads(report.read_text())
+    assert [r["iteration"] for r in rep["probes"]] == [1, 3, 5]
+    probe_iters = [r for r in rep["iterations"] if "rank_mass" in r]
+    assert [r["iter"] for r in probe_iters] == [1, 3, 5]
+    assert all("topk_churn" in r for r in probe_iters)
+    text = textfile.read_text()
+    assert_prometheus_syntax(text)
+    assert "pagerank_probe_points 3" in text
+    assert "pagerank_solve_step_seconds_ms_count 6" in text
+    # Watchdog armed and never fired.
+    assert "watchdog.stalls" not in (
+        rep["metrics"].get("counters") or {}
+    )
+    # The watchdog is disarmed after the run.
+    assert obs.get_watchdog() is None
