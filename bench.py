@@ -7,10 +7,19 @@ them: <60 s for 50 iters on Twitter-2010 AND ranks within 1e-6 L1):
   {"metric": "edges_per_sec_per_chip",
    "value": <pair-f64 accuracy-grade rate>, "unit": "edges/s/chip",
    "vs_baseline": <rate / north-star rate>,
-   "fast_f32": {"value": ..., "vs_baseline": ...},
+   "fast_f32": {"value": ..., "vs_baseline": ..., "costs": ...,
+                "layout": ...},
+   "partitioned_f32": {... the partition-centric layout leg ...},
+   "fast_bf16": {... partitioned + bf16-streamed gather table ...},
    "accuracy": {"config": "pair-f64", "scale": 20, "iters": 50,
                 "normalized_l1_vs_f64_oracle": ...,
-                "mass_normalized_l1": ...}}
+                "mass_normalized_l1": ...,
+                "fast_bf16": {"normalized_l1_vs_f64_oracle": ...}}}
+
+Every rate leg carries its XLA cost-model block ("costs") and the
+resolved kernel/layout/autotune record ("layout") — the partitioned
+legs' win must show as reduced step bytes/edge against fast_f32's
+"step" form, not just wall clock (ISSUE 6 acceptance).
 
 The HEADLINE value is the accuracy-grade config ("pair-f64": f64 rank
 storage with pair-packed f64 accumulation — matches the f64 CPU oracle
@@ -105,9 +114,12 @@ def run_build(scale, edge_factor=16, dtype="float32", accum_dtype=None,
         num_iters=1, dtype=dtype, accum_dtype=accum_dtype,
         wide_accum=wide_accum,
     ).validate()
-    grp, stripe = plan_build(
+    # The breakdown legs measure the DEFAULT layout's build pipeline
+    # (partition_span=0): the partitioned pack is the same pipeline at
+    # a different stripe key, so its stages are covered by these legs.
+    grp, stripe, _part = plan_build(
         cfg, 1 << scale, stripe_size=stripe_size, lane_group=lane_group,
-        num_edges=edge_factor << scale,
+        num_edges=edge_factor << scale, partition_span=0,
     )
     cfg = cfg.replace(lane_group=grp)
     # Start EMPTY: every key except compile_s must be written by a real
@@ -140,6 +152,16 @@ def run_build(scale, edge_factor=16, dtype="float32", accum_dtype=None,
     return {"build_s": build_s, "stages": stages, "num_edges": num_edges}
 
 
+def _fallback_span(n: int) -> int:
+    """THE one spelling of the small-graph fallback partition span the
+    bench legs use when the engine's auto rule says 'not worth it' —
+    a quarter of the padded range, so the partitioned/bf16 legs always
+    run and record what they ran (run_rate and run_accuracy share it;
+    plan_build applies its own clamps on top)."""
+    n_padded = -(-n // 128) * 128
+    return max(128, (n_padded // 4) & ~127)
+
+
 def _env_fingerprint():
     """Environment fingerprint embedded in every bench JSON artifact
     (obs/report.py): jax/jaxlib version, backend + device kind, x64,
@@ -164,9 +186,19 @@ def _enable_compile_cache():
 
 
 def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
-             build_only: bool = False):
+             build_only: bool = False, partition_span: int = 0,
+             stream_dtype: str = "", force_span_fallback: bool = False):
     """One throughput measurement: build (device by default) + timed
     stepwise loop with the honest scalar fence. Returns the result dict.
+
+    ``partition_span`` engages the partition-centric layout for this
+    leg (-1 = the engine's auto rule); ``force_span_fallback`` makes a
+    -1 that resolves to "off" run on a quarter-range fallback span
+    instead — the couple mode's dedicated partitioned legs use it so
+    they always run and record what they ran, while single-config
+    ``--partition-span -1`` honors the rule's "off" verdict.
+    ``stream_dtype`` streams the gather table reduced-precision (the
+    ``fast_bf16`` leg).
 
     ``build_only`` (VERDICT r4 weak #4): build, time it, free, and
     return only ``build_s`` — couple mode calls this LAST with the
@@ -193,17 +225,34 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
     # diverge on layout choices.
     from pagerank_tpu.ops.device_build import plan_build
 
+    # stream_dtype joins the config only after the span resolves (it
+    # validates against a set partition_span).
     cfg = PageRankConfig(
         num_iters=args.iters, dtype=dtype, accum_dtype=accum_dtype,
         kernel=kernel, wide_accum=wide_accum,
     ).validate()
-    grp, stripe = plan_build(
+    grp, stripe, part = plan_build(
         cfg, 1 << args.scale, stripe_size=args.stripe_size,
         lane_group=args.lane_group, host=host_build,
         num_edges=args.edge_factor << args.scale,  # raw count: the
         # occupancy rule is a density threshold, dedup loss is noise
+        partition_span=partition_span,
     )
-    cfg = cfg.replace(lane_group=grp)
+    if partition_span == -1 and not part and force_span_fallback:
+        # Auto said "not worth it" at this geometry (small/sparse);
+        # the dedicated couple-mode legs run anyway on a fallback span
+        # so they stay measurable/attributable — the recorded layout
+        # says which span actually ran.
+        grp, stripe, part = plan_build(
+            cfg, 1 << args.scale, lane_group=args.lane_group,
+            host=host_build, num_edges=args.edge_factor << args.scale,
+            partition_span=_fallback_span(1 << args.scale),
+        )
+    cfg = cfg.replace(lane_group=grp, partition_span=part,
+                      stream_dtype=stream_dtype if part else "").validate()
+    if stream_dtype and not part:
+        print("stream_dtype needs the partitioned layout; leg runs "
+              "without the narrowed stream", file=sys.stderr)
 
     def do_build():
         if host_build:
@@ -251,6 +300,7 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         file=sys.stderr,
     )
     costs = _leg_costs(engine, dt / args.iters, num_edges)
+    layout = engine.layout_info()
     del engine  # free HBM before the next config builds
     return {
         "value": eps_chip,
@@ -261,6 +311,11 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         # doesn't report) — the "is this fast enough" anchor the r5
         # backend-variance incident lacked.
         "costs": costs,
+        # The RESOLVED kernel/layout/autotune decisions (ISSUE 6) so
+        # every BENCH_r*.json cell is attributable to a concrete
+        # layout — including a pallas probe fallback, the autotuned
+        # chunk, and the partition-centric geometry when engaged.
+        "layout": layout,
     }
 
 
@@ -288,7 +343,8 @@ def _leg_costs(engine, seconds_per_iter, num_edges):
     return obs_costs.ledger_snapshot()
 
 
-def run_accuracy(scale: int = 20, iters: int = 50):
+def run_accuracy(scale: int = 20, iters: int = 50, with_bf16: bool = False,
+                 bf16_partition_span: int = -1):
     """Standing accuracy field: the accuracy-grade TPU config (pair-f64:
     f64 rank storage + pair-packed f64 accumulation) vs the float64 CPU
     oracle on the SAME host-built R-MAT graph, full-run L1.
@@ -327,13 +383,51 @@ def run_accuracy(scale: int = 20, iters: int = 50):
         f"[{time.perf_counter() - t0:.1f}s]",
         file=sys.stderr,
     )
-    return {
+    out = {
         "config": "pair-f64",
         "scale": scale,
         "iters": iters,
         "normalized_l1_vs_f64_oracle": norm,
         "mass_normalized_l1": mass_norm,
     }
+    if with_bf16:
+        # The fast_bf16 leg's accuracy bound (ISSUE 6 acceptance): the
+        # SAME graph and iteration count through the bf16-streamed
+        # partitioned form, diffed against the SAME f64 oracle the
+        # pair run is certified by — the pair-f64 oracle chain bounds
+        # the leg's normalized-L1 error in every bench artifact that
+        # ships the leg.
+        span = bf16_partition_span
+        if span == -1:
+            from pagerank_tpu.ops.device_build import plan_build
+
+            cfg_f = PageRankConfig(num_iters=iters)
+            _g2, _s2, span = plan_build(
+                cfg_f, g.n, host=True, num_edges=g.num_edges,
+                partition_span=-1,
+            )
+            if not span:
+                _g2, _s2, span = plan_build(
+                    cfg_f, g.n, host=True, num_edges=g.num_edges,
+                    partition_span=_fallback_span(g.n),
+                )
+        cfg_b = PageRankConfig(
+            num_iters=iters, dtype="float32", accum_dtype="float32",
+            stream_dtype="bfloat16", partition_span=span,
+        )
+        r_b = JaxTpuEngine(cfg_b).build(g).run_fast()
+        _l1b, norm_b, mass_b = oracle_l1(r_b, r_cpu)
+        print(
+            f"accuracy[fast_bf16]: scale-{scale}, {iters} iters: "
+            f"normalized L1 vs f64 oracle {norm_b:.3e} "
+            f"(mass-normalized {mass_b:.3e})",
+            file=sys.stderr,
+        )
+        out["fast_bf16"] = {
+            "normalized_l1_vs_f64_oracle": norm_b,
+            "mass_normalized_l1": mass_b,
+        }
+    return out
 
 
 def main(argv=None):
@@ -366,6 +460,13 @@ def main(argv=None):
                         "above, widened on sparse graphs — the measured "
                         "optima; see jax_engine.stripe_limits and "
                         "occupancy_span)")
+    p.add_argument("--partition-span", type=int, default=0,
+                   help="partition-centric layout span (ISSUE 6). "
+                        "Couple mode: the partitioned_f32/fast_bf16 "
+                        "legs always run (0 here means those legs use "
+                        "the engine's auto rule); single-config mode: "
+                        "0 = off, -1 = auto, >0 = explicit span for "
+                        "the one measured config")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
     p.add_argument("--build-only", action="store_true",
@@ -426,7 +527,8 @@ def main(argv=None):
 
     if args.dtype is not None:
         # Single-config mode (the original schema).
-        rate = run_rate(args, args.dtype, args.dtype)
+        rate = run_rate(args, args.dtype, args.dtype,
+                        partition_span=args.partition_span)
         out = {
             "metric": "edges_per_sec_per_chip",
             "value": rate["value"],
@@ -434,6 +536,7 @@ def main(argv=None):
             "vs_baseline": rate["vs_baseline"],
             "build_s": rate["build_s"],
             "costs": rate["costs"],
+            "layout": rate["layout"],
         }
         if not args.no_accuracy:
             out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
@@ -451,6 +554,21 @@ def main(argv=None):
     # backend ("auto" would resolve to native f64 off-TPU).
     pair_rate = run_rate(args, "float64", "float64", wide_accum="pair")
     f32_rate = run_rate(args, "float32", "float32")
+    # Partition-centric legs (ISSUE 6): the SAME f32 workload through
+    # the partitioned layout, and its bf16-streamed variant — separate
+    # legs so the win (and its cost-model bytes/edge delta vs the
+    # fast_f32 'step' form) is attributable. --partition-span > 0
+    # forces the span; otherwise the engine's auto rule (with a
+    # small-graph fallback) sizes it, and each leg's "layout" records
+    # what actually ran.
+    leg_span = args.partition_span if args.partition_span > 0 else -1
+    part_rate = run_rate(args, "float32", "float32",
+                         partition_span=leg_span,
+                         force_span_fallback=True)
+    bf16_rate = run_rate(args, "float32", "float32",
+                         partition_span=leg_span,
+                         stream_dtype="bfloat16",
+                         force_span_fallback=True)
     out = {
         "metric": "edges_per_sec_per_chip",
         "value": pair_rate["value"],
@@ -458,7 +576,10 @@ def main(argv=None):
         "vs_baseline": pair_rate["vs_baseline"],
         "build_s": pair_rate["build_s"],
         "costs": pair_rate["costs"],  # headline (pair) leg's cost model
+        "layout": pair_rate["layout"],
         "fast_f32": f32_rate,  # carries its own "costs" block
+        "partitioned_f32": part_rate,
+        "fast_bf16": bf16_rate,
     }
     if not args.host_build and args.kernel != "coo":
         # LAST, so the rebuild cannot perturb the rate legs; warm by
@@ -470,7 +591,10 @@ def main(argv=None):
             args, "float64", "float64", wide_accum="pair", build_only=True
         )["build_s"]
     if not args.no_accuracy:
-        out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
+        # with_bf16: the fast_bf16 leg ships in this artifact, so its
+        # oracle-L1 bound ships next to it (ISSUE 6 acceptance).
+        out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters,
+                                       with_bf16=True)
     out["env"] = _env_fingerprint()
     print(json.dumps(out))
 

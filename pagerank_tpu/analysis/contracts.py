@@ -11,10 +11,12 @@ checked here MECHANICALLY by abstract-evaluating every dispatch form on
 a tiny graph (CPU-fake mesh, the tests' own substrate) and walking the
 resulting jaxprs.
 
-Dispatch forms covered (the seven forms of engines/jax_engine.py plus
-the device-build path):
+Dispatch forms covered (engines/jax_engine.py plus the device-build
+paths):
 
   ell / pair / striped    — replicated, one fused shard_map program
+  partitioned (+bf16,     — partition-centric windowed layout
+    +device_build)          (ISSUE 6): one program at any size
   multi_dispatch          — per-stripe executables + finalize
   coo                     — segment-sum baseline
   device_build            — build_device (presentinel) + ell step
@@ -22,7 +24,10 @@ the device-build path):
   vs_bounded (+ms)        — owner-computes, per-stripe z psums
 
 Rule ids: PTC001 collective budget, PTC002 f64 promotion, PTC003
-donation consumed, PTC004 step-key stability, PTC005 host callbacks,
+donation consumed (warning capture per form + the structural
+build-chain check ``check_build_donations`` — every donating build
+stage's donated avals must match distinct output avals), PTC004
+step-key stability, PTC005 host callbacks,
 PTC006 32-bit build chain (the device graph-build stages must emit no
 64-bit op under x64 — the pair-f64 config flips ``jax_enable_x64``
 process-wide, and a weak-typed promotion in the per-edge path silently
@@ -244,11 +249,37 @@ def engine_forms(ndev: int) -> List[Form]:
         )
         return Eng(cfg()).build_device(dg)
 
+    def dev_build_partitioned():
+        # The partition-centric device path (ISSUE 6): a device graph
+        # whose stripes ARE the partitions, consumed with
+        # cfg.partition_span set — windowed gather, 3-byte planar slot
+        # words, chunk-local int16 pair ranks, per-partition expand
+        # scatters; PTC007 then proves its probed step is
+        # communication-transparent like every other form.
+        import jax.numpy as jnp
+
+        from pagerank_tpu.ops import device_build as db
+
+        rng = np.random.default_rng(3)
+        src = jnp.asarray(rng.integers(0, 512, 4096), jnp.int32)
+        dst = jnp.asarray(rng.integers(0, 512, 4096), jnp.int32)
+        dg = db.build_ell_device(
+            src, dst, n=512, group=4, stripe_size=256, with_weights=False
+        )
+        return Eng(cfg(partition_span=256)).build_device(dg)
+
     return [
         Form("ell", lambda: Eng(cfg()).build(g), True),
         Form("pair", lambda: Eng(cfg(
             dtype="float32", accum_dtype="float64", wide_accum="pair",
         )).build(g), False),
+        Form("partitioned", lambda: Eng(cfg(
+            partition_span=256,
+        )).build(g), True),
+        Form("partitioned_bf16", lambda: Eng(cfg(
+            partition_span=256, stream_dtype="bfloat16",
+        )).build(g), True),
+        Form("device_build_partitioned", dev_build_partitioned, True),
         Form("striped", lambda: Tiny(cfg()).build(g), True),
         Form("multi_dispatch", lambda: Scan(cfg()).build(g), True),
         Form("coo", lambda: Eng(cfg(kernel="coo")).build(g), True),
@@ -308,7 +339,8 @@ def expected_collectives(engine, form: str) -> Dict[str, int]:
     n_stripes = len(engine._src) if getattr(engine, "_src", None) is not None \
         and isinstance(engine._src, list) else 1
     if form in ("ell", "pair", "striped", "coo", "device_build",
-                "device_build_striped"):
+                "device_build_striped", "partitioned", "partitioned_bf16",
+                "device_build_partitioned"):
         return {"psum": 1}
     if form == "multi_dispatch":
         # The cross-device merge is the finalize's sharded .sum(0)
@@ -640,6 +672,21 @@ def check_kernels() -> List[Finding]:
         S((n_pad + gw,), f4), S((rows, LANES), i32), S((rows,), i32),
         out_shape=(nb * LANES,),
     )
+    # Partition-centric window mode (ISSUE 6): 2 partitions of 256
+    # lanes, 3-byte planar slot words, chunk-local int16 pair ranks,
+    # per-chunk (window, rank) bases. Collective-free, callback-free,
+    # f64-free like every kernel; compact per-PAIR output shape.
+    case(
+        "ops/spmv.py", "ell_contrib:partitioned",
+        lambda z, s, rb, b: spmv.ell_contrib(
+            z, s, rb, nb, gather_width=gw, chunk_rows=4,
+            num_present=6, window_rows=(256 + gw) // gw,
+            chunk_bases=b,
+        ),
+        S((2 * (256 + gw),), f4), S((rows, 3 * LANES), jnp.int8),
+        S((rows,), jnp.int16), S((2, 2), i32),
+        out_shape=(6 * LANES,),
+    )
     case(
         "ops/spmv.py", "ell_contrib_pair",
         lambda h, lo, s, rb: spmv.ell_contrib_pair(
@@ -768,6 +815,66 @@ def check_build_chain() -> List[Finding]:
     return findings
 
 
+def check_build_donations() -> List[Finding]:
+    """PTC003 (build chain, ISSUE 6 satellite): every donation the
+    device graph-build stages declare must be CONSUMABLE — each donated
+    input aval must have a distinct matching output aval, the same
+    structural matching jax's lowering performs. An unconsumable
+    donation never aliases; it only produces the "Some donated buffers
+    were not usable" warning that sat in the r1-r5 bench/multichip
+    tails (int32[e] x2 + int8[e] — per-edge planes whose shapes can
+    never match the slot-plane outputs). ``stage_call`` additionally
+    pre-filters donations and re-lowers clean if a version-specific
+    matcher still rejects one (utils/compile_cache.usable_donations) —
+    this check pins the STRUCTURAL half so a new unconsumable donation
+    in the chain fails analysis instead of warning at scale.
+
+    Checks every donating stage dispatch of ops/device_build.py at
+    single-stripe, striped, and partition-spanned keys, presentinel
+    and weighted."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from pagerank_tpu.ops import device_build as db
+    from pagerank_tpu.utils.compile_cache import usable_donations
+
+    findings: List[Finding] = []
+    S = jax.ShapeDtypeStruct
+    e, n, n_padded = 4096, 500, 512
+    i32, f4 = jnp.int32, jnp.float32
+
+    def donating_stages():
+        for stripe in (0, 256, 128):  # 128 = partition-sized key
+            tag = f":stripe{stripe}" if stripe else ""
+            yield (f"relabel_sort{tag}",
+                   functools.partial(db._relabel_sort, n_padded=n_padded,
+                                     stripe_size=stripe),
+                   (S((e,), i32), S((e,), i32), S((n,), i32)), (0, 1))
+            for group, ww in ((1, True), (8, False)):
+                yield (f"slot_coords:g{group}:w{int(ww)}{tag}",
+                       functools.partial(
+                           db._slot_coords, n=n, n_padded=n_padded,
+                           weight_dtype=jnp.dtype(f4), group=group,
+                           stripe_size=stripe, with_weights=ww),
+                       (S((e,), i32), S((e,), i32)), (0, 1))
+
+    for label, fn, avals, donate in donating_stages():
+        kept = usable_donations(fn, avals, donate)
+        if kept != tuple(donate):
+            dropped = sorted(set(donate) - set(kept))
+            findings.append(Finding(
+                "PTC003", _BUILD_PATH, 0,
+                f"unconsumable donation(s) at arg(s) {dropped}: no "
+                "matching output aval — the donation can never alias "
+                "and only emits the 'donated buffers were not usable' "
+                "warning",
+                snippet=f"stage={label}",
+            ))
+    return findings
+
+
 def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
     """Run the full contract suite; returns findings (empty = clean).
     ``forms`` filters the engine dispatch forms by name."""
@@ -791,4 +898,5 @@ def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
         findings.extend(check_step_key_stability(ndev))
         findings.extend(check_kernels())
         findings.extend(check_build_chain())
+        findings.extend(check_build_donations())
     return findings

@@ -98,6 +98,23 @@ def build_parser() -> argparse.ArgumentParser:
         "default: config default; 64 is fastest on v5e for large "
         "power-law graphs)",
     )
+    p.add_argument(
+        "--partition-span", type=int, default=0,
+        help="partition-centric SpMV layout (ISSUE 6): sub-bin slots "
+        "by source partition of this many vertices so each chunk's "
+        "gather window is VMEM/cache-resident. 0 = off (default "
+        "layout), -1 = auto (engine rule: dense cells + resident "
+        "window, off when not worth it), >0 = explicit span "
+        "(multiple of 128). jax ell kernel, 32-bit accumulation only",
+    )
+    p.add_argument(
+        "--stream-dtype", default="", choices=["", "bfloat16"],
+        help="stream the gather table in this dtype with f32 "
+        "accumulation (the fast_bf16 leg: ~half the table-side HBM "
+        "traffic for ~2^-9 relative z quantization). Requires "
+        "--partition-span (only the partitioned layout consumes the "
+        "narrowed stream)",
+    )
     p.add_argument("--tol", type=float, default=None, help="L1 early-stop (default: none)")
     p.add_argument(
         "--fused", action="store_true",
@@ -461,12 +478,22 @@ def _device_build_graph(args, src, dst, n, dangling_mask=None):
         raise ValueError("empty graph: no vertices")
     from pagerank_tpu.ops import device_build as db
 
+    # stream_dtype never changes the planned GEOMETRY (the stream is a
+    # per-iteration cast) and requires a resolved span to validate, so
+    # the plan config omits it — but the MODE flags must be here, or
+    # plan_build's partition-span compatibility gate (vertex-sharded
+    # modes plan span 0) never fires for device builds.
     plan_cfg = PageRankConfig(
         dtype=args.dtype, accum_dtype=args.accum_dtype or args.dtype,
+        vertex_sharded=args.vertex_sharded, vs_bounded=args.vs_bounded,
     ).validate()
-    grp, stripe = db.plan_build(
+    grp, stripe, part = db.plan_build(
         plan_cfg, n, lane_group=args.lane_group or 0, num_edges=len(src),
+        partition_span=args.partition_span,
     )
+    # The run config must adopt the RESOLVED span (engine.build_device
+    # checks it against the packed stripe span) — stash it for main().
+    args._resolved_partition_span = part
     return db.build_ell_device(
         src, dst, n=n, group=grp, stripe_size=stripe,
         with_weights=False,  # presentinel: no per-slot weight plane
@@ -855,6 +882,46 @@ def _main(argv, ctx) -> int:
     )
     if args.lane_group is not None:
         cfg = cfg.replace(lane_group=args.lane_group)
+    if args.partition_span:
+        # Device builds resolved the span when packing the graph
+        # (_device_build_graph); host builds resolve it here with the
+        # SAME shared planner (an explicit span passes through, -1
+        # resolves the engine's auto rule — possibly to 0/off).
+        part = getattr(args, "_resolved_partition_span", None)
+        if part is None:
+            from pagerank_tpu.ops.device_build import plan_build
+
+            _g, _s, part = plan_build(
+                cfg, graph.n, lane_group=args.lane_group or 0,
+                host=True, num_edges=graph.num_edges,
+                partition_span=args.partition_span,
+            )
+        if part:
+            cfg = cfg.replace(partition_span=part)
+        elif args.partition_span > 0:
+            # The planner refused an EXPLICIT span (unsupported mode
+            # combo): surface the config error as a clean CLI error,
+            # not a traceback.
+            try:
+                cfg = cfg.replace(
+                    partition_span=args.partition_span
+                ).validate()
+            except ValueError as e:
+                raise SystemExit(str(e))
+    if args.stream_dtype:
+        # Only the partitioned layout consumes the narrowed stream;
+        # when the auto rule resolved the span to 0 (or no span was
+        # requested), drop it LOUDLY instead of tripping validate
+        # (bench.py's legs do the same).
+        if cfg.partition_span:
+            cfg = cfg.replace(stream_dtype=args.stream_dtype)
+        else:
+            print(
+                "--stream-dtype needs the partitioned layout "
+                "(--partition-span); running without the narrowed "
+                "stream",
+                file=sys.stderr,
+            )
     cfg.validate()
     ctx["cfg"] = cfg
     engine = make_engine(args.engine, cfg)

@@ -71,6 +71,16 @@ from pagerank_tpu.parallel import mesh as mesh_lib
 from pagerank_tpu.parallel import partition
 
 
+class PallasUnavailableError(RuntimeError):
+    """Raised by the ELL setup when kernel='pallas' was requested but
+    BOTH Mosaic gather strategies fail to probe-compile on this
+    backend. The build entry points catch it and REBUILD with the
+    native ell layout (grouped lanes + slab scan) instead of running
+    the XLA path on the pallas-shaped group-1 non-slab arrays — the
+    ~9% fallback penalty PERF_NOTES measured was that layout, not
+    kernel arithmetic."""
+
+
 def _split_pair(z):
     """Dekker split z = hi + lo exactly, both f32 — the pair-packed
     gather's two planes (ops/spmv.py:ell_contrib_pair docstring). One
@@ -101,6 +111,11 @@ class JaxTpuEngine(PageRankEngine):
         self._perm: Optional[np.ndarray] = None  # relabeled -> original
         self._ms_stripe = None  # set by _setup_multi_dispatch
         self._inv_in_args = False  # set by _finalize
+        # Resolved-layout record (layout_info): every setup path fills
+        # this so bench JSON / the run report can say what ACTUALLY ran
+        # — including a pallas->ell probe fallback.
+        self._layout: Dict[str, object] = {}
+        self._kernel_requested: Optional[str] = None
 
     # -- build ------------------------------------------------------------
 
@@ -178,12 +193,101 @@ class JaxTpuEngine(PageRankEngine):
             group //= 2
         return group
 
+    # Partition-centric layout rule (ISSUE 6; Lakhotia et al.,
+    # arXiv:1709.07122). A (partition, 128-dst block) cell must stay
+    # DENSE: every nonempty cell still costs ceil-granular slot rows
+    # (max over lane groups of ceil(cell_group_edges/group)), so below
+    # ~512 expected edges per cell the ELL padding floor swamps the
+    # stream savings (measured on the cost model: slots/edge 1.50 at
+    # 256 edges/cell vs 1.13 at 1000 — docs/PERF_NOTES.md
+    # "Partition-centric restage"). The window must also be
+    # VMEM/cache-resident — the same ~12MB budget the pallas kernel
+    # uses for its resident z.
+    PART_MIN_CELL_EDGES = 512
+    PART_MAX_WINDOW_BYTES = 12 << 20
+    # Hard cap on partition count: each partition pads its rows to a
+    # chunk multiple and unrolls one expand scatter into the step
+    # program, so an undersized EXPLICIT span would explode memory and
+    # compile time (the density-gated auto rule can't get here).
+    MAX_PARTITIONS = 256
+
+    @classmethod
+    def partition_span(cls, n_padded: int, num_edges, z_item: int = 4) -> int:
+        """Auto partition span for the partition-centric layout: the
+        SMALLEST power-of-two span (multiple of 128, >= 2^15) whose
+        expected (partition, dst-block) cell edges
+        (``num_edges * span * 128 / n_padded^2``) reach
+        ``PART_MIN_CELL_EDGES`` — smallest dense span = tightest gather
+        window — subject to the window fitting
+        ``PART_MAX_WINDOW_BYTES`` and the layout having at least two
+        partitions. 0 = the partitioned form is not worth engaging
+        (graph too small/sparse: its padding floor would exceed the
+        stream savings). ``num_edges`` may be the RAW pre-dedup count
+        (density threshold, like occupancy_span)."""
+        if not num_edges or n_padded < (2 << 15):
+            return 0
+        span = 1 << 15
+        # Respect the engine's partition-count cap from the start: the
+        # finest span the rule may pick still keeps n_padded/span <=
+        # MAX_PARTITIONS (an auto-resolved span must never trip the
+        # setup's own explicit-span guard).
+        while span * cls.MAX_PARTITIONS < n_padded:
+            span *= 2
+        # Every span that still leaves >= 2 partitions gets its density
+        # check — including n_padded/2 itself, the coarsest layout the
+        # rule may pick.
+        while span * 2 <= n_padded:
+            cells = num_edges * span * 128.0 / float(n_padded) ** 2
+            if cells >= cls.PART_MIN_CELL_EDGES:
+                break
+            span *= 2
+        else:
+            return 0
+        if span * 2 > n_padded or span * z_item > cls.PART_MAX_WINDOW_BYTES:
+            return 0
+        return span
+
+    @staticmethod
+    def partition_words24(span: int, group: int) -> bool:
+        """Whether partition-local packed slot words
+        (src << log2(group) | sub, sentinel = span << log2(group)) fit
+        24 bits — the 3-byte planar slot stream
+        (ops/spmv.py:pack_words24), 25% off the dominant per-slot HBM
+        bytes. Falls back to int32 words when the alphabet is too
+        wide; the layout is otherwise identical."""
+        return span * group < (1 << 24)
+
+    def _pallas_fallback(self, exc: PallasUnavailableError) -> None:
+        """Downgrade the config to the NATIVE ell layout after a pallas
+        probe failure (satellite of ISSUE 6): the rebuild re-packs with
+        grouped lanes + slab scan instead of running the XLA path on
+        the pallas-shaped group-1 non-slab arrays (the measured ~9%
+        penalty, docs/PERF_NOTES.md "The Pallas kernel, settled").
+        The requested kernel is kept in ``kernel_requested`` /
+        ``layout_info()`` so bench JSON records what actually ran."""
+        self._kernel_requested = "pallas"
+        obs_log.warn(
+            "pallas kernel unavailable on this backend; rebuilding with "
+            "the NATIVE ell layout (grouped lanes + slab scan) — "
+            f"{exc}"
+        )
+        self.config = self.config.replace(kernel="ell")
+
     def build_device(self, dg) -> "JaxTpuEngine":
         """Build from an on-device blocked-ELL graph
         (ops/device_build.DeviceEllGraph) — no bulk host->device
         transfer; see device_build's module docstring."""
         with obs_trace.span("engine/build", mode="device"):
-            return self._build_device_impl(dg)
+            try:
+                return self._build_device_impl(dg)
+            except PallasUnavailableError as e:
+                self._pallas_fallback(e)
+                # A pallas device graph is group=1/single-stripe by
+                # construction; the native rebuild reuses it with the
+                # slab scan engaged (dense ranks). The group-1 padding
+                # stays — regrouping needs the raw edges, which a
+                # device graph no longer holds.
+                return self._build_device_impl(dg)
 
     def _build_device_impl(self, dg) -> "JaxTpuEngine":
         from pagerank_tpu.ops.device_build import DeviceEllGraph
@@ -201,12 +305,27 @@ class JaxTpuEngine(PageRankEngine):
                 "kernel='pallas' needs a group=1 single-stripe device "
                 "graph; pass group=1, stripe_size=0 to build_ell_device"
             )
+        part = int(cfg.partition_span) if cfg.kernel != "pallas" else 0
+        if part:
+            part = min(part, dg.n_padded) if dg.n_padded else part
+            # The partition-centric layout consumes a device graph
+            # whose STRIPES are the partitions (the shared planner —
+            # ops/device_build.plan_build — sizes the build that way).
+            if (stripe_size or dg.n_padded) != part:
+                raise ValueError(
+                    f"partition_span {part} needs a device graph built "
+                    f"with stripe_size={part} (got "
+                    f"{stripe_size or dg.n_padded}); plan the build via "
+                    "ops/device_build.plan_build"
+                )
         sz = stripe_size or dg.n_padded
         allowed = self.occupancy_span(
             self._stripe_max(), dg.n_padded, dg.num_edges, self._pair,
             self.gather_z_item(cfg, self._pair),
         )
-        if sz > allowed:
+        if sz > allowed and not part:
+            # (Partitioned layouts gather per-chunk WINDOWS — the
+            # fast-regime bound applies to the window, not the span.)
             obs_log.warn(
                 f"device-built graph has stripe span "
                 f"{sz} > {allowed} — the gather runs outside "
@@ -232,14 +351,19 @@ class JaxTpuEngine(PageRankEngine):
         inv_out_rel = jnp.concatenate(
             [inv[dg.perm], jnp.zeros(pad, inv_dtype)]
         )
+        src_in, w_in, rb_in = dg.src, dg.weight, dg.row_block
+        if part and not isinstance(src_in, (list, tuple)):
+            # A single-partition graph (span == n_padded) arrives as
+            # bare arrays; the partitioned setup expects lists.
+            src_in, w_in, rb_in = [src_in], [w_in], [rb_in]
         self._setup_ell(
-            dg.src, dg.weight, dg.row_block,
+            src_in, w_in, rb_in,
             jnp.concatenate([mass, zpad]),
             jnp.concatenate([zin, zpad]),
             jnp.concatenate([jnp.ones(n, bool), zpad]),
             n=n, n_state=dg.n_padded, num_blocks=dg.num_blocks,
             inv_out_rel=inv_out_rel, group=group,
-            stripe_size=stripe_size or None,
+            stripe_size=stripe_size or None, partition_span=part,
         )
         # The slot arrays are donated to the engine: _setup_ell derives
         # its sentinel-ized copies, and keeping the originals referenced
@@ -253,7 +377,11 @@ class JaxTpuEngine(PageRankEngine):
 
     def build(self, graph: Graph) -> "JaxTpuEngine":
         with obs_trace.span("engine/build", mode="host"):
-            return self._build_impl(graph)
+            try:
+                return self._build_impl(graph)
+            except PallasUnavailableError as e:
+                self._pallas_fallback(e)
+                return self._build_impl(graph)
 
     def _build_impl(self, graph: Graph) -> "JaxTpuEngine":
         cfg = self.config
@@ -281,6 +409,43 @@ class JaxTpuEngine(PageRankEngine):
             else graph.out_degree == 0
         )
         zero_in = graph.zero_in_mask
+
+        if kernel == "ell" and cfg.partition_span:
+            # Partition-centric layout (ISSUE 6): the packer's stripes
+            # ARE the source partitions — the sub-binning permutation is
+            # absorbed into its one composite-key sort.
+            psz = int(cfg.partition_span)
+            n_padded = -(-n // 128) * 128
+            group = self.clamp_group_for_span(
+                cfg.lane_group or cfg.effective_lane_group(False),
+                psz,
+            )
+            pack = ell_lib.ell_pack_striped(
+                graph, stripe_size=min(psz, max(128, n_padded)),
+                group=group,
+            )
+            self._pack = pack
+            self._perm = pack.perm
+            n_state = pack.n_padded
+            pad = n_state - n
+            mass_mask = np.concatenate(
+                [mass_mask[pack.perm], np.zeros(pad, bool)]
+            )
+            zero_in = np.concatenate(
+                [zero_in[pack.perm], np.zeros(pad, bool)]
+            )
+            valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+            inv = graph_mod.inv_out_degree(graph.out_degree)
+            inv_out_rel = np.concatenate([inv[pack.perm], np.zeros(pad)])
+            self._setup_ell(
+                pack.src, pack.weight, pack.row_block,
+                mass_mask, zero_in, valid,
+                n=n, n_state=n_state, num_blocks=pack.num_blocks,
+                inv_out_rel=inv_out_rel, group=group,
+                partition_span=min(psz, max(128, n_padded)),
+            )
+            pack.src, pack.weight, pack.row_block = [], [], []
+            return self
 
         if kernel in ("ell", "pallas"):
             stripe_max = self._stripe_max()
@@ -382,6 +547,12 @@ class JaxTpuEngine(PageRankEngine):
             )
             contrib_args = (self._src, self._dst, self._w)
             valid = np.ones(n, bool)  # no padding in coo state
+            self._layout = {
+                "form": "coo", "group": None, "gather_width": None,
+                "n_stripes": 1, "stripe_span": n_state,
+                "partition_span": 0, "chunk": None, "pair": False,
+                "stream_dtype": None,
+            }
             self._finalize(
                 contrib_fn, contrib_args, mass_mask, zero_in, valid, n, n_state
             )
@@ -493,6 +664,24 @@ class JaxTpuEngine(PageRankEngine):
         return min(span, n_padded)
 
     @staticmethod
+    def _dense_ranks_device(rb, num_blocks: int):
+        """Device-side counterpart of ops/ell.dense_block_ranks —
+        (ranks, present_ids, num_present, is_prefix) for a sorted
+        block-id device array. ONE spelling for every device-built
+        layout (plain slab and partitioned). cumsum dtype pinned:
+        cumsum of bool follows numpy's default-int promotion — int64
+        under the pair config's x64 flip (same class as PTC006)."""
+        present = jnp.zeros(num_blocks, bool).at[rb].set(True)
+        pc = max(1, int(present.sum()))
+        rank_of = jnp.cumsum(present, dtype=jnp.int32) - 1
+        ranks = rank_of[rb]
+        ids = jnp.nonzero(
+            present, size=pc, fill_value=num_blocks - 1
+        )[0].astype(jnp.int32)
+        prefix = bool(jax.device_get(ids[-1]) == pc - 1)
+        return ranks, ids, pc, prefix
+
+    @staticmethod
     def _gather_width(n_state: int, max_width: int = 128) -> int:
         """XLA's fast TPU gather regime (measured on v5e, see
         scripts/probe_gather.py) needs the reshaped (rows, width) table to
@@ -520,9 +709,18 @@ class JaxTpuEngine(PageRankEngine):
             self.build_timings["autotune_s"] = _time.perf_counter() - t0
 
     def _autotune_chunk_impl(self, cands, stripe_rows_dev, sz, z_item, gw,
-                             group, pair, accum, num_present, ndev):
+                             group, pair, accum, num_present, ndev,
+                             part=None):
         """Pick the scan chunk for the ELL gather by TIMING the candidate
         chunks on the largest stripe's real slot arrays.
+
+        ``part`` (partition-centric layouts): dict with the windowed
+        op's geometry — window_rows, table_len, table_dt, the placed
+        slot array, a ``bases_for(c)`` callback building the per-chunk
+        (window, rank) base arrays for a candidate, and the pair
+        count. The same compile-all-then-time protocol runs on the
+        windowed form of the op so chunk/partition geometry is tuned
+        by measurement exactly like the plain form (ISSUE 6).
 
         Rationale (measured on v5e): below ~16MB of gather table the
         chunk barely matters (mild preference for larger chunks), so the
@@ -559,10 +757,59 @@ class JaxTpuEngine(PageRankEngine):
         tune_key = "chunk:" + ":".join(map(str, (
             jax.devices()[0].device_kind, sz, z_item, gw, group, pair,
             jnp.dtype(accum).name, max(stripe_rows_dev), tuple(cands),
+            # Partitioned-window geometry tunes separately from the
+            # plain form at the same table size.
+            0 if part is None else part["window_rows"],
+            0 if part is None else part["pairs"],
         )))
         cached = compile_cache.tuning_get(tune_key)
         if cached in cands:
             return cached
+
+        if part is not None:
+            rows = stripe_rows_dev[0]
+            z_a = jnp.ones(part["table_len"], part["table_dt"])
+
+            def part_fn(c):
+                # num_blocks is unused in compact (num_present) mode;
+                # pass the pair count for shape sanity. The bases ride
+                # as a POSITIONAL arg of the jitted wrapper so the
+                # compiled executable's call signature stays flat.
+                return jax.jit(lambda z, s, r, b: spmv.ell_contrib(
+                    z, s, r, part["pairs"], accum_dtype=accum,
+                    gather_width=gw, chunk_rows=c, group=group,
+                    num_present=part["pairs"],
+                    window_rows=part["window_rows"], chunk_bases=b,
+                ))
+
+            compiled = []
+            for c in cands:
+                if rows % c:
+                    continue
+                rb_c, bases_c = part["bases_for"](c)
+                try:
+                    compiled.append((c, part_fn(c).lower(
+                        z_a, part["src_dev"], rb_c, bases_c
+                    ).compile(), rb_c, bases_c))
+                except Exception:
+                    continue
+            best, best_t = cands[0], None
+            for c, exe, rb_c, bases_c in compiled:
+                try:
+                    out = exe(z_a, part["src_dev"], rb_c, bases_c)
+                    jax.device_get(jnp.sum(out))
+                    t0 = _time.perf_counter()
+                    for _ in range(3):
+                        out = exe(z_a, part["src_dev"], rb_c, bases_c)
+                    jax.device_get(jnp.sum(out))
+                    dt = (_time.perf_counter() - t0) / 3
+                except Exception:
+                    continue
+                if best_t is None or dt < best_t:
+                    best, best_t = c, dt
+            if best_t is not None:
+                compile_cache.tuning_put(tune_key, best)
+            return best
 
         s_big = int(np.argmax(stripe_rows_dev))
         src_a, rb_a = self._src[s_big], self._row_block[s_big]
@@ -625,7 +872,7 @@ class JaxTpuEngine(PageRankEngine):
 
     def _setup_ell(self, src_slots, w_slots, row_block, mass_mask, zero_in,
                    valid, *, n, n_state, num_blocks, inv_out_rel,
-                   stripe_size=None, group=1):
+                   stripe_size=None, group=1, partition_span=0):
         """Common ELL-path setup from slot arrays (host numpy or device
         jnp) — pads rows to the per-device chunk multiple, places arrays
         over the mesh, builds the sharded contribution fn.
@@ -636,7 +883,19 @@ class JaxTpuEngine(PageRankEngine):
         here only to locate inert slots (weight 0: ELL padding, duplicate
         edges), which are re-pointed at the zero sentinel ``n_state``.
         Half the slot bytes stream from HBM per iteration as a result.
+
+        ``partition_span``: the slot lists are per-PARTITION (packed at
+        stripe_size=partition_span) and the whole setup routes to the
+        partition-centric layout (:meth:`_setup_ell_partitioned`).
         """
+        if partition_span:
+            self._setup_ell_partitioned(
+                src_slots, w_slots, row_block, mass_mask, zero_in, valid,
+                n=n, n_state=n_state, num_blocks=num_blocks,
+                inv_out_rel=inv_out_rel, psz=int(partition_span),
+                group=group,
+            )
+            return
         cfg = self.config
         mesh = self._mesh
         axis = cfg.mesh_axis
@@ -743,17 +1002,9 @@ class JaxTpuEngine(PageRankEngine):
                         rb, num_blocks
                     )
                 else:
-                    present = jnp.zeros(num_blocks, bool).at[rb].set(True)
-                    pcount = max(1, int(present.sum()))
-                    # dtype pinned: cumsum of bool follows numpy's
-                    # default-int promotion — int64 under the pair
-                    # config's x64 flip (same class as PTC006).
-                    rank_of = jnp.cumsum(present, dtype=jnp.int32) - 1
-                    rb = rank_of[rb]
-                    ids = jnp.nonzero(
-                        present, size=pcount, fill_value=num_blocks - 1
-                    )[0].astype(jnp.int32)
-                    prefix = bool(jax.device_get(ids[-1]) == pcount - 1)
+                    rb, ids, pcount, prefix = self._dense_ranks_device(
+                        rb, num_blocks
+                    )
                 ids = jax.device_put(jnp.asarray(ids), rep)
             rows_per_dev = -(-max(1, rows_s) // ndev)
             if want_pallas:
@@ -791,6 +1042,17 @@ class JaxTpuEngine(PageRankEngine):
             # divisibility holds because padded rows are a multiple of
             # cand_max or a power of two >= the clamped chunk).
             ell_chunks = [min(chosen, r) for r in stripe_rows_dev]
+        self._layout = {
+            "form": "step",
+            "group": group,
+            "gather_width": gw,
+            "n_stripes": n_stripes,
+            "stripe_span": sz,
+            "partition_span": 0,
+            "chunk": max(ell_chunks) if ell_chunks else None,
+            "pair": bool(pair),
+            "stream_dtype": None,
+        }
 
         inv_out_rel = xp.asarray(inv_out_rel)
         if inv_out_rel.dtype != z_dtype:
@@ -1002,12 +1264,14 @@ class JaxTpuEngine(PageRankEngine):
                         f"({type(e).__name__}: {msg})"
                     )
             if contrib_fn is None:
-                obs_log.info(
-                    "pallas kernel unavailable; falling back "
-                    "to the XLA ell path"
+                # Do NOT run the XLA path on these pallas-shaped
+                # (group-1, non-slab) arrays — that layout measured ~9%
+                # slower than the native ell layout (PERF_NOTES "The
+                # Pallas kernel, settled"). Signal the build entry
+                # point to rebuild natively instead.
+                raise PallasUnavailableError(
+                    "both Mosaic gather strategies failed to lower"
                 )
-                self._kernel = "ell"
-                contrib_fn = make_contrib("ell")
         else:
             contrib_fn = make_contrib("ell")
 
@@ -1029,6 +1293,258 @@ class JaxTpuEngine(PageRankEngine):
                 num_present=num_present, prefix_flags=prefix_flags,
                 ids=present_ids, n=n, n_state=n_state, prescale=prescale,
             )
+
+    def _setup_ell_partitioned(self, src_slots, w_slots, row_block,
+                               mass_mask, zero_in, valid, *, n, n_state,
+                               num_blocks, inv_out_rel, psz, group):
+        """Partition-centric ELL layout (ISSUE 6 tentpole; Lakhotia et
+        al., arXiv:1709.07122). The source range is split into
+        ``psz``-vertex partitions and slots are sub-binned by source
+        partition WITHIN each dst block at build time — a static
+        permutation the packer's single composite-key sort absorbs
+        (``ell_pack_striped(stripe_size=psz)`` /
+        ``build_ell_device(stripe_size=psz)``), never a per-iteration
+        shuffle. Per iteration:
+
+          - the prescale lays z out partition-padded: each partition's
+            ``psz`` lanes followed by ``gather_width`` zero lanes, so
+            every partition owns its own zero sentinel block;
+          - ONE chunked ell_contrib sweep runs over the concatenated
+            partition-major rows; each chunk's gather reads only the
+            dynamic window of its OWN partition
+            (ops/spmv.py:ell_contrib window mode) — the chunk's whole
+            gather working set is ``psz * z_item`` bytes,
+            VMEM/cache-resident by the partition_span rule, instead of
+            the full table;
+          - the compact per-(partition, block)-pair sums expand into
+            the global block accumulator with one sorted-unique
+            scatter per partition (static slices of the pair axis).
+
+        Because the fast-gather bound now applies to the WINDOW, the
+        layout needs no source striping at any graph size: one
+        program, always below SCAN_STRIPE_UNITS, no multi-dispatch.
+        Partition-local words also shrink the slot alphabet — when it
+        fits 24 bits the slot stream is stored as 3-byte planar int8
+        (``partition_words24``), 25% off the dominant per-slot HBM
+        bytes. Row bookkeeping rides per-chunk: CHUNK-LOCAL int16
+        dense pair ranks plus an int32 [nc, 2] (window base, rank
+        base) prefetch array.
+
+        Replicated mode, 32-bit accumulation only (config.validate).
+        ``cfg.stream_dtype='bfloat16'`` additionally streams the
+        gather table in bf16 with the one-hot select in bf16 (exact —
+        pure selection) and f32 accumulation (arXiv:2009.10443).
+        """
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        ndev = mesh.devices.size
+        accum = self._accum_dtype
+        dtype = self._dtype
+        self._kernel = "ell"
+        xp = np if isinstance(src_slots[0], np.ndarray) else jnp
+        K = len(src_slots)
+        assert K == -(-n_state // psz), (K, n_state, psz)
+        if K > self.MAX_PARTITIONS:
+            # Each partition pads to a chunk multiple and unrolls one
+            # expand scatter into the step program; a span this small
+            # relative to the graph would explode both. The auto rule
+            # never lands here (density-gated) — only an explicit
+            # undersized span can.
+            raise ValueError(
+                f"partition_span {psz} gives {K} partitions "
+                f"(> {self.MAX_PARTITIONS}): span too small for this "
+                f"graph — raise partition_span (auto rule: "
+                f"JaxTpuEngine.partition_span)"
+            )
+
+        stream = jnp.dtype(cfg.stream_dtype) if cfg.stream_dtype else None
+        z_dtype = dtype  # accum is 32-bit here by config contract
+        table_dt = stream or z_dtype
+        z_item = jnp.dtype(table_dt).itemsize
+        gw = max(
+            self.GATHER_WIDTH,
+            self._gather_width(psz, self.max_gather_lanes(False, z_item)),
+        )
+        win_rows = (psz + gw) // gw
+        log2g = group.bit_length() - 1
+        words24 = self.partition_words24(psz, group)
+        table_len = K * (psz + gw)
+
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+        e_shard = mesh_lib.edge_sharding(mesh)
+        rep = mesh_lib.replicated(mesh)
+
+        # Chunk candidates: the plain path's fetch-byte heuristic,
+        # CAPPED at 4096 rows — every partition's rows pad to
+        # ndev * cand_max so any candidate divides each partition AND
+        # device shards split on chunk boundaries (a chunk can then
+        # never straddle a partition, which is what makes the
+        # per-chunk window exact), and that per-partition pad must
+        # stay small next to the partition's real rows.
+        chunk_cands = sorted({
+            min(4096, max(256, 8192 * 8 // max(gw, group))),
+            min(4096, max(256, 8192 * 8 // gw)),
+            min(4096, max(256, 32768 * 8 // gw)),
+        })
+        cand_max = chunk_cands[-1]
+        unit = ndev * cand_max
+        sent = np.int32(psz << log2g)
+
+        parts_src, parts_rank, ids_list, prefix_flags, counts = \
+            [], [], [], [], []
+        rows_per_part = []
+        pair_off = 0
+        for p in range(K):
+            if w_slots[p] is None:
+                ss = src_slots[p]
+            else:
+                ss = xp.where(w_slots[p] != 0, src_slots[p], sent)
+            rb = row_block[p]
+            if xp is np:
+                rk, ids_p, pc, prefix = ell_lib.dense_block_ranks(
+                    rb, num_blocks
+                )
+            else:
+                rk, ids_p, pc, prefix = self._dense_ranks_device(
+                    rb, num_blocks
+                )
+            ss = _pad_rows(ss, unit, sent, xp)
+            rk = _pad_rows(
+                xp.asarray(rk, xp.int32), unit, max(0, pc - 1), xp
+            ) + xp.int32(pair_off)
+            parts_src.append(ss)
+            parts_rank.append(rk)
+            ids_list.append(ids_p)
+            prefix_flags.append(prefix)
+            counts.append(int(pc))
+            rows_per_part.append(int(ss.shape[0]))
+            pair_off += int(pc)
+        pairs_total = pair_off
+        rows_total = sum(rows_per_part)
+
+        src_cat = xp.concatenate(parts_src)
+        del parts_src
+        if words24:
+            src_cat = spmv.pack_words24(src_cat.astype(xp.int32), xp)
+        ranks_cat = xp.concatenate(parts_rank)  # GLOBAL pair ranks
+        del parts_rank
+        src_dev = jax.device_put(src_cat, shard2d)
+        del src_cat
+        ranks_dev = jnp.asarray(ranks_cat)  # transient: base building
+        del ranks_cat
+        ids_cat = jax.device_put(
+            jnp.concatenate([jnp.asarray(i) for i in ids_list]), rep
+        )
+        offs = np.concatenate([[0], np.cumsum(counts)]).astype(int)
+
+        def wb_for(c):
+            """Window row base per chunk (host math: chunks never
+            straddle partitions, see the padding rule above)."""
+            per_part = [r // c for r in rows_per_part]
+            return np.repeat(
+                np.arange(K, dtype=np.int32) * np.int32(win_rows), per_part
+            )
+
+        def bases_for(c):
+            rb0 = ranks_dev[::c]
+            rb_loc = (
+                ranks_dev - jnp.repeat(rb0, c, total_repeat_length=rows_total)
+            ).astype(jnp.int16)
+            bases = jnp.stack(
+                [jnp.asarray(wb_for(c)), rb0.astype(jnp.int32)], axis=1
+            )
+            return rb_loc, bases
+
+        # inv_out in z_dtype, replicated (the prescale argument).
+        inv_out_rel = xp.asarray(inv_out_rel)
+        if inv_out_rel.dtype != z_dtype:
+            inv_out_rel = inv_out_rel.astype(z_dtype)
+        self._inv_out = jax.device_put(inv_out_rel, rep)
+
+        chosen = self._autotune_chunk(
+            chunk_cands, [rows_total // ndev], table_len, z_item, gw,
+            group, False, accum, [pairs_total], ndev,
+            part=dict(window_rows=win_rows, table_len=table_len,
+                      table_dt=table_dt, src_dev=src_dev,
+                      bases_for=bases_for, pairs=pairs_total),
+        )
+        chunk = min(chosen, rows_total // ndev)
+        rb_loc, bases = bases_for(chunk)
+        rb_dev = jax.device_put(rb_loc, e_shard)
+        bases_dev = jax.device_put(bases, shard2d)
+        del rb_loc, bases, ranks_dev
+
+        self._src = [src_dev]
+        self._row_block = [rb_dev]
+        self._layout = {
+            "form": "partitioned",
+            "partition_span": psz,
+            "partitions": K,
+            "group": group,
+            "gather_width": gw,
+            "window_rows": win_rows,
+            "words24": words24,
+            "stream_dtype": cfg.stream_dtype or None,
+            "chunk": chunk,
+            "pairs": pairs_total,
+            "slot_rows": rows_total,
+            "n_stripes": 1,
+            "stripe_span": n_state,
+            "pair": False,
+        }
+        self._pack_stats = {
+            "num_rows": rows_total,
+            "padding_ratio": None,
+            "n_stripes": 1,
+        }
+
+        nb = num_blocks
+        nz_pad = K * psz - n_state
+
+        def prescale_part(r, inv):
+            z = r.astype(z_dtype) * inv
+            if nz_pad:
+                z = jnp.concatenate([z, jnp.zeros(nz_pad, z.dtype)])
+            if stream is not None:
+                z = z.astype(stream)
+            z2 = z.reshape(K, psz)
+            z2 = jnp.concatenate(
+                [z2, jnp.zeros((K, gw), z2.dtype)], axis=1
+            )
+            return z2.reshape(-1)
+
+        def sharded_contrib(z, src, rb_l, bases_a, ids_a):
+            part = spmv.ell_contrib(
+                z, src, rb_l, nb, accum_dtype=accum, gather_width=gw,
+                chunk_rows=chunk, group=group, num_present=pairs_total,
+                window_rows=win_rows, chunk_bases=bases_a,
+            )
+            p2 = part.reshape(pairs_total, 128)
+            total = jnp.zeros((nb, 128), p2.dtype)
+            # Expand (partition, block) pairs into the global block
+            # accumulator: one sorted-UNIQUE scatter per partition
+            # (static pair-axis slices) — the ids repeat ACROSS
+            # partitions, and a single non-unique scatter serializes
+            # (the vs_bounded pad lesson, docs/PERF_NOTES.md).
+            for j in range(K):
+                lo, hi = int(offs[j]), int(offs[j + 1])
+                total = spmv.scatter_block_sums(
+                    total, p2[lo:hi], ids_a[lo:hi], prefix_flags[j]
+                )
+            return jax.lax.psum(total.reshape(-1), axis)
+
+        contrib_fn = shard_map(
+            sharded_contrib,
+            mesh=mesh,
+            in_specs=(P(), P(axis, None), P(axis), P(axis, None), P()),
+            out_specs=P(),
+        )
+        self._finalize(
+            contrib_fn, (src_dev, rb_dev, bases_dev, ids_cat),
+            mass_mask, zero_in, valid, n, n_state,
+            prescale=prescale_part,
+        )
 
     def _setup_multi_dispatch(self, *, n_stripes, sz, gw, group, pair,
                               accum, num_blocks, chunks, num_present,
@@ -1109,6 +1625,7 @@ class JaxTpuEngine(PageRankEngine):
         self._ms_final = jax.jit(final_body, donate_argnums=(0,))
         self._ms_ids = list(ids)
         self._ms_n_stripes = n_stripes
+        self._layout = dict(self._layout, form="multi_dispatch")
 
     def _make_ms_stripe_fns(self, *, n_stripes, sz, gw, group, pair, accum,
                             num_blocks, chunks, num_present,
@@ -1372,6 +1889,7 @@ class JaxTpuEngine(PageRankEngine):
             "l1_delta": np.zeros(0, self._accum_dtype),
             "dangling_mass": np.zeros(0, self._accum_dtype),
         }
+        self._layout = dict(self._layout, form="vertex_sharded")
         if multi_dispatch:
             self._setup_multi_dispatch_vs(
                 n_stripes=n_stripes, sz=sz, gw=gw, group=group, pair=pair,
@@ -1447,6 +1965,7 @@ class JaxTpuEngine(PageRankEngine):
         )
         self._ms_ids = list(ids)
         self._ms_n_stripes = n_stripes
+        self._layout = dict(self._layout, form="vs_multi_dispatch")
 
     def _setup_ell_vs_bounded(self, src_slots, w_slots, row_blocks,
                               mass_mask, zero_in, valid, *, n, n_state,
@@ -1604,6 +2123,17 @@ class JaxTpuEngine(PageRankEngine):
             accum, num_present, ndev,
         )
         ell_chunks = [min(chosen, r) for r in stripe_rows_dev]
+        self._layout = {
+            "form": "vs_bounded",
+            "group": group,
+            "gather_width": gw,
+            "n_stripes": n_stripes,
+            "stripe_span": sz,
+            "partition_span": 0,
+            "chunk": max(ell_chunks) if ell_chunks else None,
+            "pair": bool(pair),
+            "stream_dtype": None,
+        }
 
         # -- step construction --------------------------------------------
         # Mirrors the replicated architecture (and for the same
@@ -1779,6 +2309,7 @@ class JaxTpuEngine(PageRankEngine):
         )
         self._ms_ids = ids_list
         self._ms_n_stripes = S
+        self._layout = dict(self._layout, form="vsb_multi_dispatch")
 
     def _finalize(self, contrib_fn, contrib_args, mass_mask, zero_in, valid,
                   n, n_state, prescale=None):
@@ -2408,6 +2939,25 @@ class JaxTpuEngine(PageRankEngine):
             r = rr
         self._r = jax.device_put(r, self._state_sharding)
         self.iteration = iteration
+
+    def layout_info(self) -> Dict[str, object]:
+        """The RESOLVED kernel/layout/autotune decisions of this build —
+        what ACTUALLY ran (ISSUE 6): the kernel (plus the requested one
+        when a pallas probe fell back to the native ell layout), lane
+        group, stripe/partition geometry, gather width, the autotuned
+        chunk, and the accumulation mode. bench.py embeds this per leg
+        so BENCH_r*.json cells are attributable to a concrete layout."""
+        info: Dict[str, object] = {
+            "kernel": getattr(self, "_kernel", None),
+            "pair": bool(getattr(self, "_pair", False)),
+            "accum_dtype": str(self._accum_dtype)
+            if getattr(self, "_accum_dtype", None) is not None else None,
+            "vertex_sharded": bool(self.config.vertex_sharded),
+        }
+        info.update(self._layout)
+        if self._kernel_requested:
+            info["kernel_requested"] = self._kernel_requested
+        return info
 
     @property
     def mesh(self):

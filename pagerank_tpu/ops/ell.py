@@ -31,6 +31,22 @@ noise next to the gather). Slot words are then packed as
 ``(src << log2(group)) | lane_sub``: the slot at row position p serves
 lane ``(p & ~(group-1)) | lane_sub``. group=1 keeps plain source ids.
 
+Partition-centric sub-binning (ISSUE 6; Lakhotia et al.,
+arXiv:1709.07122): packing with ``stripe_size`` set to a PARTITION span
+(config.partition_span) makes the stripes source partitions — slots
+are sub-binned by source partition WITHIN each dst block by the same
+single composite-key sort, a build-time static permutation. The engine
+(engines/jax_engine.py:_setup_ell_partitioned) then concatenates the
+partitions partition-major into ONE chunked sweep whose per-chunk
+gather reads only its partition's window of the rank table
+(ops/spmv.py:ell_contrib window mode), stores slot words
+partition-local (3-byte planar int8 when span*group < 2^24 —
+ops/spmv.py:pack_words24), and expands the compact per-(partition,
+block)-pair sums with one sorted-unique scatter per partition. The
+span must keep (partition, dst-block) cells DENSE — every nonempty
+cell still costs ceil-granular rows, exactly the striping padding
+floor — which is what JaxTpuEngine.partition_span's auto rule gates.
+
 All ids inside the packed arrays are in RELABELED space; `perm` maps
 relabeled -> original id, `inv_perm` the reverse.
 """

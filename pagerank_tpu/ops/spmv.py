@@ -62,7 +62,7 @@ def _group_scatter(v, sub, group, acc):
 
 
 def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows,
-                       num_segments, slab):
+                       num_segments, slab, chunk_bases=None):
     """Run ``chunk_sum(src_chunk, segment_ids_chunk, n_seg)`` over slot
     rows in ``chunk_rows``-sized chunks via lax.scan, accumulating the
     per-segment results. Bounds the gather intermediate each chunk
@@ -82,17 +82,26 @@ def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows,
         chunk=2048), independent of graph size. The carry has
         ``chunk_rows`` slack rows so the final slab never clamps.
 
+    ``chunk_bases`` (partition-centric layouts, ops/ell.py
+    "Partition-centric sub-binning"): int32 [nc, 2] of per-chunk
+    (gather-window row base, slab rank base). When set, ``chunk_sum``
+    is called as ``chunk_sum(src_c, rb_c, nseg, window_base)``,
+    ``row_block`` already carries CHUNK-LOCAL dense ranks (no ``- r0``
+    renormalization), and the slab lands at the prefetched rank base —
+    the scalar rides the scan's xs, so the scan body stays a single
+    fused program per chunk. Implies slab=True and chunking.
+
     The scan carry is seeded from chunk 0 (not plain zeros) so that
     under shard_map the carry is device-varying like the body output.
     """
     n_rows = src_slots.shape[0]
-    if chunk_rows is None or chunk_rows >= n_rows:
+    if chunk_bases is None and (chunk_rows is None or chunk_rows >= n_rows):
         return chunk_sum(src_slots, row_block, num_segments)
-    if n_rows % chunk_rows:
+    if chunk_rows is None or n_rows % chunk_rows:
         raise ValueError(f"chunk_rows {chunk_rows} must divide rows {n_rows}")
     nc = n_rows // chunk_rows
 
-    src_c = src_slots.reshape(nc, chunk_rows, LANES)
+    src_c = src_slots.reshape(nc, chunk_rows, -1)
     rb_c = row_block.reshape(nc, chunk_rows)
 
     if not slab:
@@ -106,6 +115,37 @@ def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows,
             (src_c[1:], rb_c[1:]),
         )
         return y2
+
+    if chunk_bases is not None:
+        if chunk_bases.shape[0] != nc:
+            raise ValueError(
+                f"chunk_bases rows {chunk_bases.shape[0]} != chunks {nc}"
+            )
+
+        def slab_add_p(y2, s_c, r_c, base2):
+            part = chunk_sum(s_c, r_c, chunk_rows, base2[0])
+            zero = jnp.zeros((), base2.dtype)
+            start = (base2[1],) + (zero,) * (part.ndim - 1)
+            cur = jax.lax.dynamic_slice(y2, start, part.shape)
+            return jax.lax.dynamic_update_slice(y2, cur + part, start)
+
+        probe = jax.eval_shape(
+            lambda s, r, b: chunk_sum(s, r, chunk_rows, b[0]),
+            src_c[0], rb_c[0], chunk_bases[0],
+        )
+        zeros = jnp.zeros(
+            (num_segments + chunk_rows,) + probe.shape[1:], probe.dtype
+        )
+
+        def body_p(y2, args):
+            return slab_add_p(y2, *args), None
+
+        y2, _ = jax.lax.scan(
+            body_p,
+            slab_add_p(zeros, src_c[0], rb_c[0], chunk_bases[0]),
+            (src_c[1:], rb_c[1:], chunk_bases[1:]),
+        )
+        return y2[:num_segments]
 
     def slab_add(y2, s_c, r_c):
         r0 = r_c[0]
@@ -135,8 +175,32 @@ def _chunked_block_sum(chunk_sum, src_slots, row_block, chunk_rows,
     return y2[:num_segments]
 
 
+def unpack_words24(slots8):
+    """Decode a 3-byte PLANAR slot-word array — int8 [rows, 3*LANES]
+    with byte plane k of slot (r, l) at column k*LANES + l — back to
+    int32 [rows, LANES] words. The partition-centric layout stores slot
+    words this way: partition-local source alphabets fit 24 bits where
+    stripe-local ones need 30+, so the dominant per-slot HBM stream
+    drops from 4 to 3 bytes (ops/ell.py "Partition-centric
+    sub-binning"). Planar (not interleaved) so each byte plane is a
+    contiguous 128-lane vector load."""
+    b = slots8.astype(jnp.int32) & 0xFF  # int8 sign-extends; mask it off
+    return b[..., :LANES] | (b[..., LANES:2 * LANES] << 8) \
+        | (b[..., 2 * LANES:] << 16)
+
+
+def pack_words24(words, xp=jnp):
+    """Inverse of :func:`unpack_words24` (build side): int32
+    [rows, LANES] words < 2**24 to the int8 [rows, 3*LANES] planar
+    form."""
+    return xp.concatenate(
+        [words & 0xFF, (words >> 8) & 0xFF, (words >> 16) & 0xFF], axis=-1
+    ).astype(xp.int8)
+
+
 def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
-                gather_width=8, chunk_rows=None, group=1, num_present=None):
+                gather_width=8, chunk_rows=None, group=1, num_present=None,
+                window_rows=0, chunk_bases=None):
     """contrib = Aᵀ_norm r over blocked-ELL slots (ops/ell.py layout),
     with the row-normalization PRE-SCALED into the rank vector.
 
@@ -177,26 +241,71 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
         _chunked_block_sum, whose carry traffic is O(chunk), not
         O(num_blocks); the caller expands ranks to blocks. None keeps
         global block ids and a full-width result.
+      window_rows: partition-centric mode (ops/ell.py
+        "Partition-centric sub-binning"). When > 0, ``z_ext`` is the
+        PARTITION-PADDED table (each partition's span followed by
+        ``gather_width`` zero lanes), slot words are PARTITION-LOCAL
+        (3-byte planar int8 when ``src_slots.dtype`` is int8 —
+        :func:`unpack_words24` — int32 otherwise), ``row_block``
+        carries CHUNK-LOCAL dense (partition, block)-pair ranks, and
+        each chunk's gather reads only the ``window_rows``-row
+        dynamic slice of the table starting at its prefetched window
+        base — the chunk's whole gather working set, sized to stay
+        VMEM/cache-resident. Requires ``chunk_bases`` and
+        ``num_present`` (the compact result is per PAIR).
+      chunk_bases: int32 [num_chunks, 2] per-chunk (window row base,
+        slab rank base) — see _chunked_block_sum.
 
     Returns:
       [num_blocks * 128] contribution sums (relabeled, padded), or
-      [num_present * 128] compact sums when ``num_present`` is set.
+      [num_present * 128] compact sums when ``num_present`` is set
+      (per (partition, block) pair in partition-centric mode).
     """
-    acc = accum_dtype or z_ext.dtype
+    acc = accum_dtype or (
+        z_ext.dtype if z_ext.dtype.itemsize >= 4 else jnp.float32
+    )
     zw = z_ext.reshape(-1, gather_width)
     shift = gather_width.bit_length() - 1
     mask = gather_width - 1
     log2g = group.bit_length() - 1
+    if (window_rows > 0) != (chunk_bases is not None):
+        raise ValueError("window_rows and chunk_bases go together")
+    if window_rows and num_present is None:
+        raise ValueError("partition-centric mode needs num_present")
+    # Low-precision streamed table (config.stream_dtype): the one-hot
+    # select runs in the TABLE dtype — products are x*1 or x*0 and the
+    # row-sum has exactly one nonzero term, so selection is EXACT at
+    # any float dtype — and only the selected (chunk, 128) values are
+    # widened to the accumulation dtype. Keeps the dominant
+    # (chunk, 128, gather_width) gather intermediates at stream width.
+    sel_dt = (
+        zw.dtype
+        if jnp.dtype(zw.dtype).itemsize < jnp.dtype(acc).itemsize
+        else acc
+    )
 
-    def chunk_sum(src_c, rb_c, nseg):
+    def select(rows, lane_ix):
+        sel = jax.nn.one_hot(lane_ix, gather_width, dtype=sel_dt)
+        return (rows.astype(sel_dt) * sel).sum(-1).astype(acc)
+
+    def chunk_sum(src_c, rb_c, nseg, *base):
+        if src_c.dtype == jnp.int8:
+            src_c = unpack_words24(src_c)
         if group > 1:
             sub = src_c & (group - 1)
             src_c = src_c >> log2g
-        rows = zw[src_c >> shift]  # (chunk, 128, gather_width)
-        sel = jax.nn.one_hot(src_c & mask, gather_width, dtype=acc)
-        v = (rows.astype(acc) * sel).sum(-1)
+        if window_rows:
+            table = jax.lax.dynamic_slice(
+                zw, (base[0], jnp.zeros((), base[0].dtype)),
+                (window_rows, gather_width),
+            )
+        else:
+            table = zw
+        rows = table[src_c >> shift]  # (chunk, 128, gather_width)
+        v = select(rows, src_c & mask)
         if group > 1:
             v = _group_scatter(v, sub, group, acc)
+        rb_c = rb_c.astype(jnp.int32)  # chunk-local ranks may be int16
         return jax.ops.segment_sum(
             v, rb_c, num_segments=nseg, indices_are_sorted=True
         )
@@ -204,6 +313,7 @@ def ell_contrib(z_ext, src_slots, row_block, num_blocks, accum_dtype=None,
     return _chunked_block_sum(
         chunk_sum, src_slots, row_block, chunk_rows,
         num_present or num_blocks, slab=num_present is not None,
+        chunk_bases=chunk_bases,
     ).reshape(-1)
 
 

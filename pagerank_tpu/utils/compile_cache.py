@@ -98,6 +98,42 @@ def clear_stage_cache() -> None:
     _STAGE_EXECS.clear()
 
 
+def usable_donations(fn, args, donate_argnums):
+    """The subset of ``donate_argnums`` whose (shape, dtype) matches a
+    DISTINCT output leaf of ``fn(*args)`` — mirroring jax's own
+    donation matching (mlir._set_up_aliases pairs donated inputs to
+    outputs by stripped aval, greedily), via one abstract eval. A
+    donation with no matching output can never alias and only produces
+    the "Some donated buffers were not usable" lowering warning — the
+    r1-r5 bench/multichip tails' warning class (analysis contract
+    PTC003). Returns the filtered tuple; on any eval failure returns
+    ``donate_argnums`` unchanged (the check must never break a build).
+    """
+    if not donate_argnums:
+        return ()
+    import jax
+    import numpy as _np
+
+    try:
+        outs = jax.tree_util.tree_leaves(jax.eval_shape(fn, *args))
+    except Exception:
+        return tuple(donate_argnums)
+    pool: dict = {}
+    for o in outs:
+        k = (tuple(o.shape), _np.dtype(o.dtype))
+        pool[k] = pool.get(k, 0) + 1
+    kept = []
+    for i in donate_argnums:
+        k = (tuple(args[i].shape), _np.dtype(args[i].dtype))
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+            kept.append(i)
+    return tuple(kept)
+
+
+_DONATION_WARNING = "donated buffers were not usable"
+
+
 def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
                timings=None):
     """Run one build-stage program through the AOT executable cache.
@@ -111,15 +147,29 @@ def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
     even across a ``jax_enable_x64`` flip (see module docstring — the
     stages are 32-bit-pinned, so the flag cannot change their program).
 
+    Donations are pre-filtered to the CONSUMABLE subset
+    (:func:`usable_donations`) and, as a belt-and-braces for jax
+    versions whose matching is stricter than the aval check (sharding/
+    layout-sensitive matchers), any residual "donated buffers were not
+    usable" warning at lowering triggers ONE re-lower without
+    donations — peak memory is identical either way (an unusable
+    donation never aliased), the dropped donation is obs-logged, and
+    no stage can leak that warning into a bench/multichip tail again
+    (the r5 residual; analysis contract PTC003 covers the structural
+    half).
+
     ``timings``: optional dict; compile seconds are accumulated under
     ``"compile_s"`` so build breakdowns separate compile from execute.
     """
+    import warnings as _warnings
+
     import jax
 
     dev = jax.devices()[0]
     aval_key = tuple(
         (tuple(a.shape), str(a.dtype)) for a in args
     )
+    from pagerank_tpu.obs import log as obs_log
     from pagerank_tpu.obs import metrics as obs_metrics
     from pagerank_tpu.obs import trace as obs_trace
 
@@ -131,11 +181,40 @@ def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
             "compile_cache.stage_misses",
             "build-stage programs lowered+compiled this process",
         ).inc()
+        donate = usable_donations(fn, args, tuple(donate_argnums))
+        if donate != tuple(donate_argnums):
+            dropped = sorted(set(donate_argnums) - set(donate))
+            obs_log.info(
+                f"build stage '{name}': dropped unconsumable "
+                f"donation(s) at arg(s) {dropped} (no matching output "
+                "aval; aliasing was impossible)"
+            )
         t0 = time.perf_counter()
         with obs_trace.span("build/compile", stage=name):
-            exe = jax.jit(fn, donate_argnums=donate_argnums).lower(
-                *args
-            ).compile()
+            with _warnings.catch_warnings(record=True) as wlog:
+                _warnings.simplefilter("always")
+                exe = jax.jit(fn, donate_argnums=donate).lower(
+                    *args
+                ).compile()
+            for w in wlog:  # pass every OTHER warning through
+                if _DONATION_WARNING not in str(w.message):
+                    _warnings.warn_explicit(
+                        w.message, w.category, w.filename, w.lineno
+                    )
+            if donate and any(
+                _DONATION_WARNING in str(w.message) for w in wlog
+            ):
+                # This jax's matcher rejected an aval-compatible
+                # donation (layout/sharding-level). Re-lower clean so
+                # the warning never reaches users and the executable
+                # carries no dead donation.
+                obs_log.info(
+                    f"build stage '{name}': donation rejected at "
+                    "lowering; re-lowered without donations"
+                )
+                with _warnings.catch_warnings():
+                    _warnings.simplefilter("ignore")
+                    exe = jax.jit(fn).lower(*args).compile()
         _STAGE_EXECS[key] = exe
         # Every build-stage compile feeds the cost ledger (obs/costs):
         # FLOPs / HBM bytes / peak allocation per stage, the "what a

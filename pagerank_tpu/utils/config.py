@@ -100,9 +100,11 @@ class PageRankConfig:
     # back to ell; refuses graphs over the VMEM budget). EXPERIMENTAL:
     # on the current jaxlib/Mosaic BOTH gather strategies fail to lower
     # on real TPU hardware (docs/PERF_NOTES.md "The Pallas kernel,
-    # settled end-to-end"), so this always probe-falls-back to ell with
-    # a ~9% layout penalty — measured 2.99e8 vs 3.26e8 edges/s/chip at
-    # scale 21. "ell" = blocked-ELL + row segment-sum (TPU-fast,
+    # settled end-to-end"); the probe failure now REBUILDS the NATIVE
+    # ell layout (grouped lanes + slab scan — the r2-r5 fallback ran
+    # the pallas-shaped group-1 arrays at a ~9% penalty instead), logs
+    # the downgrade, and records it in engine.layout_info().
+    # "ell" = blocked-ELL + row segment-sum (TPU-fast,
     # ops/ell.py), "coo" = dst-sorted COO + per-edge segment-sum
     # (simple; also the portable baseline), "auto" = ell.
     kernel: str = "auto"
@@ -125,6 +127,32 @@ class PageRankConfig:
     # rows (exact to ~1 ulp; ~3.4x slower on TPU where f64 is emulated),
     # "auto" = pair on TPU backends, native elsewhere.
     wide_accum: str = "auto"
+
+    # Partition-centric SpMV restage (Lakhotia et al., arXiv:1709.07122;
+    # ops/ell.py "Partition-centric sub-binning"): sub-bin slots within
+    # each dst block by SOURCE partition of this span at build time (a
+    # static permutation absorbed into the composite-key sort), so each
+    # scan chunk's gather working set is one bounded, VMEM/cache-
+    # resident window of the rank table instead of the full stripe —
+    # and the partition-local index alphabet fits 3-byte slot words
+    # (25% off the dominant per-slot HBM stream). Multiple of 128;
+    # 0 disables (the default form). Resolved by the shared planner
+    # (ops/device_build.plan_build: JaxTpuEngine.partition_span picks
+    # the smallest span whose (partition, dst-block) cells stay DENSE —
+    # sparse cells pay an ELL row-padding floor that swamps the stream
+    # savings). Requires the ell kernel, 32-bit accumulation, and the
+    # replicated (non-vertex-sharded) mode.
+    partition_span: int = 0
+
+    # Reduced-precision gather-table stream (arXiv:2009.10443: PageRank
+    # tolerates a narrow streamed operand when accumulation stays
+    # wide): "" keeps the table in the rank dtype; "bfloat16" streams
+    # it in bf16 with the one-hot select in bf16 (exact — pure
+    # selection) and all accumulation still in accum_dtype, roughly
+    # halving the dominant table-side HBM traffic. Accuracy cost is
+    # the bf16 quantization of z (~2^-9 relative); the bench
+    # ``fast_bf16`` leg reports its oracle-L1 bound alongside.
+    stream_dtype: str = ""
 
     # Early stop: if set, stop when L1(r' - r) <= tol. The reference has
     # no convergence check (Sparky.java:187); None reproduces that.
@@ -249,6 +277,42 @@ class PageRankConfig:
             raise ValueError("vs_bounded requires vertex_sharded")
         if self.wide_accum not in ("auto", "pair", "native"):
             raise ValueError(f"unknown wide_accum mode: {self.wide_accum!r}")
+        if self.stream_dtype not in ("", "bfloat16"):
+            raise ValueError(
+                f"stream_dtype must be '' or 'bfloat16', got "
+                f"{self.stream_dtype!r}"
+            )
+        if self.stream_dtype and not self.partition_span:
+            raise ValueError(
+                "stream_dtype is consumed by the partition-centric "
+                "layout only; set partition_span (the default layout "
+                "would silently ignore the narrowed stream)"
+            )
+        if self.partition_span:
+            if self.partition_span < 0 or self.partition_span % 128:
+                raise ValueError(
+                    f"partition_span must be a positive multiple of 128 "
+                    f"(0 disables), got {self.partition_span}"
+                )
+            if self.kernel not in ("auto", "ell"):
+                raise ValueError(
+                    f"partition_span requires the ell kernel, got "
+                    f"{self.kernel!r}"
+                )
+            if self.vertex_sharded:
+                raise ValueError(
+                    "partition_span is a replicated-mode layout; it does "
+                    "not compose with vertex_sharded"
+                )
+        if self.stream_dtype or self.partition_span:
+            import numpy as _np
+
+            if _np.dtype(self.accum_dtype).itemsize > 4:
+                raise ValueError(
+                    "partition_span/stream_dtype support 32-bit "
+                    "accumulation only (the pair/native wide paths keep "
+                    "the default layout)"
+                )
         g = self.lane_group
         if g != 0 and (not (1 <= g <= 128) or (g & (g - 1))):
             raise ValueError(

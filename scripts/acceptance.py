@@ -38,8 +38,14 @@ jax engine, reference semantics, 10 iterations -> per-iteration
 split (L1 / build / solve / L4) recorded in BASELINE.md (SURVEY
 §3.1-3.2; VERDICT r3 weak #3).
 
+Beyond those, the cheap smokes run FIRST in the default order: D
+(build-stage breakdown), G (observability), H (live telemetry), K
+(partition-centric layout: a windowed solve with --probe-every plus
+the contract-sweep coverage assertion — ISSUE 6), F (fault
+injection).
+
 Usage:
-  PYTHONPATH=. python scripts/acceptance.py [--only A|B|C|T|P|E] [--no-append]
+  PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
 """
 
 import argparse
@@ -126,8 +132,18 @@ CONFIGS = {
     # layer is what a wedged long run is diagnosed with.
     "H": dict(kind="live", iters=6, probe_every=2,
               label="live-telemetry smoke (probes + exporter + watchdog)"),
+    # Partition-centric smoke (ISSUE 6): a short jax-engine solve on
+    # the partitioned layout with --probe-every through the CLI —
+    # probe records at the exact cadence — plus the dispatch-form
+    # coverage assertion: the contract sweep must carry the
+    # partitioned forms (PTC001/002/005/007 run against them in
+    # tier-1 and, when this process is on the CPU backend, right
+    # here).
+    "K": dict(kind="partitioned", iters=6, probe_every=2, span=512,
+              label="partition-centric smoke (windowed solve + contracts)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "F", "A", "B", "T", "P", "E", "BV",
+                "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -527,6 +543,89 @@ def run_live_smoke(key: str):
         f"{'parse OK' if text_ok else 'PARSE BAD'}; watchdog "
         f"{'quiet' if watchdog_quiet else 'FIRED'} -> "
         f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+PARTITIONED_SMOKE_BUDGET_S = 120.0
+
+
+def run_partitioned_smoke(key: str):
+    """ISSUE-6 gate: a short solve on the partition-centric layout —
+    the jax engine through the CLI with an explicit --partition-span
+    and --probe-every — plus the contract-coverage assertion. Gates:
+    the CLI exits 0 with probe records at the exact cadence, the
+    contract sweep LISTS the partitioned dispatch forms, those forms'
+    contracts (collective budget, probe transparency PTC007, donation,
+    f64) come back clean when this process is on the CPU backend (on
+    a TPU the sweep's fake mesh would fight the live backend — tier-1
+    covers it there), and the wall stays under the budget."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from pagerank_tpu.analysis.contracts import engine_forms, run_contracts
+    from pagerank_tpu.cli import main as cli_main
+
+    spec = CONFIGS[key]
+    iters, every, span = spec["iters"], spec["probe_every"], spec["span"]
+    work = tempfile.mkdtemp(prefix="pagerank_part_")
+    t0 = time.perf_counter()
+    try:
+        report_path = os.path.join(work, "run_report.json")
+        rc = cli_main([
+            "--synthetic", "uniform:2048:65536",
+            "--iters", str(iters), "--log-every", "0",
+            "--partition-span", str(span),
+            "--probe-every", str(every), "--probe-topk", "16",
+            "--run-report", report_path,
+        ])
+        with open(report_path) as f:
+            report = json.load(f)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    want_iters = [i for i in range(iters) if (i + 1) % every == 0]
+    probes = report.get("probes") or []
+    probes_ok = [r.get("iteration") for r in probes] == want_iters
+
+    part_forms = ["partitioned", "partitioned_bf16",
+                  "device_build_partitioned"]
+    names = [f.name for f in engine_forms(1)]
+    covered = all(f in names for f in part_forms)
+    findings = []
+    contracts_ran = jax.default_backend() == "cpu"
+    if contracts_ran and covered:
+        findings = run_contracts(forms=part_forms)
+    t_run = time.perf_counter() - t0
+
+    passed = bool(
+        rc == 0 and probes_ok and covered and not findings
+        and t_run <= PARTITIONED_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "partitioned",
+        "label": spec["label"],
+        "iters": iters,
+        "partition_span": span,
+        "probe_records_ok": probes_ok,
+        "contract_forms_covered": covered,
+        "contracts_ran": contracts_ran,
+        "contract_findings": [str(f) for f in findings],
+        "seconds": t_run,
+        "budget_s": PARTITIONED_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] partitioned solve (span {span}, probe every {every}) "
+        f"in {t_run:.1f}s vs budget {PARTITIONED_SMOKE_BUDGET_S:g}s; "
+        f"probes {'OK' if probes_ok else 'BAD'}; contract sweep "
+        f"{'covers' if covered else 'MISSING'} the partitioned forms"
+        f"{' (' + str(len(findings)) + ' finding(s))' if findings else ''}"
+        f" -> {'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
     return rec
@@ -1032,7 +1131,7 @@ def main(argv=None) -> int:
     keys = [args.only] if args.only else DEFAULT_KEYS
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
                "faults": run_fault_smoke, "obs": run_obs_smoke,
-               "live": run_live_smoke}
+               "live": run_live_smoke, "partitioned": run_partitioned_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

@@ -344,24 +344,56 @@ def test_contract_catches_f64_promotion(monkeypatch):
     assert "PTC002" in _rules_of(findings), [f.render() for f in findings]
 
 
-def test_contract_catches_unconsumable_donation(monkeypatch):
-    """Seed the defect PTC003 exists for: the r5 bench log's 'Some
+def test_contract_neutralizes_unconsumable_donation(monkeypatch):
+    """Re-seed the defect PTC003 exists for: the r5 bench log's 'Some
     donated buffers were not usable' — the scatter stage donating
-    per-edge buffers that can never alias its slot-plane outputs. The
-    build stages now dispatch through the stage-executable cache, so
-    the bad donation is seeded at the stage_call boundary (a distinct
-    cache key: the poisoned executable can't leak into other tests)."""
+    per-edge buffers that can never alias its slot-plane outputs.
+    Since r6 the stage-call boundary SELF-HEALS (the unconsumable
+    donation is dropped before lowering — utils/compile_cache.
+    usable_donations), so the seeded defect must produce NO warning
+    and NO finding: the warning class is dead, not merely detected."""
     from pagerank_tpu.utils import compile_cache
 
     orig_call = compile_cache.stage_call
+    seeded = {"hit": False}
 
     def bad_call(name, fn, args, **kw):
         if name == "scatter_slots":
+            seeded["hit"] = True
             kw["donate_argnums"] = (0, 1, 2, 3)
         return orig_call(name, fn, args, **kw)
 
     monkeypatch.setattr(compile_cache, "stage_call", bad_call)
-    findings = contracts_mod.check_engine_form(_FORMS["device_build"])
+    compile_cache.clear_stage_cache()  # force a fresh (seeded) lowering
+    try:
+        findings = contracts_mod.check_engine_form(_FORMS["device_build"])
+    finally:
+        compile_cache.clear_stage_cache()  # drop the seeded executables
+    assert seeded["hit"]
+    assert "PTC003" not in _rules_of(findings), \
+        [f.render() for f in findings]
+
+
+def test_build_donation_check_catches_structural_defect(monkeypatch):
+    """The structural half (r6, check_build_donations): a donating
+    build stage whose outputs can no longer match the donated avals
+    must FAIL analysis — here the sort stage is broken to emit int16
+    keys, so its donated int32[e] inputs have no matching output."""
+    import functools
+
+    from pagerank_tpu.ops import device_build as db
+
+    assert contracts_mod.check_build_donations() == []
+
+    orig = db._relabel_sort
+
+    def bad_sort(src, dst, inv_perm, *, n_padded, stripe_size):
+        sb, ns = orig(src, dst, inv_perm, n_padded=n_padded,
+                      stripe_size=stripe_size)
+        return sb.astype(jnp.int16), ns.astype(jnp.int16)
+
+    monkeypatch.setattr(db, "_relabel_sort", bad_sort)
+    findings = contracts_mod.check_build_donations()
     assert "PTC003" in _rules_of(findings), [f.render() for f in findings]
 
 

@@ -37,9 +37,23 @@ def _env():
     return env
 
 
+def _assert_layout_block(layout, form=None):
+    """Every rate leg records the RESOLVED kernel/layout/autotune
+    decisions (ISSUE 6) so BENCH_r*.json cells are attributable to a
+    concrete layout."""
+    assert isinstance(layout, dict)
+    for key in ("kernel", "pair", "group", "gather_width", "chunk"):
+        assert key in layout, (key, layout)
+    assert layout["kernel"] in ("ell", "coo") or \
+        str(layout["kernel"]).startswith("pallas")
+    if form is not None:
+        assert layout["form"] == form, layout
+
+
 def test_bench_json_contract_couple_mode(tmp_path):
     """Default (couple) mode: pair-f64 headline + f32 secondary + the
-    standing scale-N accuracy field, all in ONE JSON line."""
+    partition-centric legs (ISSUE 6) + the standing scale-N accuracy
+    field, all in ONE JSON line."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--scale", "10",
          "--iters", "2", "--warmup", "1", "--host-build",
@@ -51,21 +65,40 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "costs", "fast_f32", "accuracy", "env"}
+                        "build_s", "costs", "layout", "fast_f32",
+                        "partitioned_f32", "fast_bf16", "accuracy", "env"}
     assert rec["build_s"] > 0 and rec["fast_f32"]["build_s"] > 0
-    # Both legs carry the XLA cost-model block (ISSUE 5).
+    # Every leg carries the XLA cost-model block (ISSUE 5) and the
+    # resolved-layout record (ISSUE 6).
     _assert_costs_block(rec["costs"])
-    _assert_costs_block(rec["fast_f32"]["costs"])
+    _assert_layout_block(rec["layout"])
+    for leg in ("fast_f32", "partitioned_f32", "fast_bf16"):
+        _assert_costs_block(rec[leg]["costs"])
+        assert rec[leg]["value"] > 0 and rec[leg]["vs_baseline"] > 0
+    _assert_layout_block(rec["fast_f32"]["layout"], form="step")
+    # The partition-centric legs must have ACTUALLY run partitioned,
+    # with the geometry recorded (span, window, autotuned chunk).
+    for leg in ("partitioned_f32", "fast_bf16"):
+        lay = rec[leg]["layout"]
+        _assert_layout_block(lay, form="partitioned")
+        assert lay["partition_span"] > 0 and lay["window_rows"] > 0
+        assert lay["partitions"] >= 1 and lay["chunk"] > 0
+    assert rec["fast_bf16"]["layout"]["stream_dtype"] == "bfloat16"
+    assert rec["partitioned_f32"]["layout"]["stream_dtype"] is None
     assert rec["metric"] == "edges_per_sec_per_chip"
     assert rec["unit"] == "edges/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
-    assert rec["fast_f32"]["value"] > 0 and rec["fast_f32"]["vs_baseline"] > 0
     acc = rec["accuracy"]
     assert acc["config"] == "pair-f64"
     assert acc["scale"] == 12 and acc["iters"] == 2
     # The accuracy-grade config must actually be accuracy-grade.
     assert 0 <= acc["normalized_l1_vs_f64_oracle"] < 1e-5
     assert 0 <= acc["mass_normalized_l1"] < 1e-5
+    # The fast_bf16 leg ships with its oracle-L1 bound (ISSUE 6
+    # acceptance: the pair-f64 oracle chain bounds the bf16 error).
+    bf = acc["fast_bf16"]
+    assert 0 <= bf["normalized_l1_vs_f64_oracle"] < 5e-2
+    assert 0 <= bf["mass_normalized_l1"] < 5e-2
 
 
 def test_bench_json_contract_single_mode(tmp_path):
@@ -81,12 +114,13 @@ def test_bench_json_contract_single_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "costs", "env"}
+                        "build_s", "costs", "layout", "env"}
     # The environment fingerprint makes future BENCH_r*.json cells
     # comparable across backend drift (ISSUE 4; obs/report.py).
     assert rec["env"]["jax_version"] and rec["env"]["backend"]
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     _assert_costs_block(rec["costs"])
+    _assert_layout_block(rec["layout"])
 
 
 def test_bench_build_only_reports_stage_breakdown(tmp_path):

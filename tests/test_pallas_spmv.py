@@ -56,6 +56,36 @@ def test_pallas_matches_csr_oracle(gather, chunk):
     np.testing.assert_allclose(got, expected_orig, rtol=2e-6, atol=2e-7)
 
 
+@pytest.mark.parametrize("gather", ["take", "onehot8"])
+def test_pallas_matches_ell_contrib_op(gather):
+    """Direct parity (ISSUE 6 satellite): ell_contrib_pallas (interpret
+    mode, both gather strategies) against the XLA ell_contrib op on
+    IDENTICAL sentinel-form inputs — the tightest guard against rot in
+    a kernel Mosaic currently refuses to compile on hardware (it runs
+    here in interpret mode only)."""
+    from pagerank_tpu.ops import spmv
+
+    rng = np.random.default_rng(7)
+    n, e, chunk = 700, 6000, 16
+    g = build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+    pack = ell_lib.ell_pack(g)
+    src, rb, rb0 = _sentinel_form(pack, chunk)
+
+    z = np.zeros(pack.n_padded + 8, np.float32)
+    z[: g.n] = rng.random(g.n).astype(np.float32)
+
+    y_pallas = np.asarray(pallas_spmv.ell_contrib_pallas(
+        jnp.asarray(z), jnp.asarray(src), jnp.asarray(rb),
+        jnp.asarray(rb0), pack.num_blocks, chunk=chunk, gather=gather,
+        interpret=True,
+    ))
+    y_ell = np.asarray(spmv.ell_contrib(
+        jnp.asarray(z), jnp.asarray(src), jnp.asarray(rb),
+        pack.num_blocks, gather_width=8, chunk_rows=None, group=1,
+    ))
+    np.testing.assert_allclose(y_pallas, y_ell, rtol=1e-6, atol=1e-7)
+
+
 @pytest.mark.parametrize("ndev", [1, 2])
 def test_engine_pallas_kernel_matches_oracle(ndev):
     # Full engine with kernel="pallas" (interpret mode on CPU) vs the
