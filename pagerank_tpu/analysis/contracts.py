@@ -15,6 +15,8 @@ Dispatch forms covered (engines/jax_engine.py plus the device-build
 paths):
 
   ell / pair / striped    — replicated, one fused shard_map program
+  elastic_resume          — the post-rescue re-sharded step (ISSUE 7):
+                            N-device snapshot resumed on 1 device
   partitioned (+bf16,     — partition-centric windowed layout
     +device_build)          (ISSUE 6): one program at any size
   multi_dispatch          — per-stripe executables + finalize
@@ -268,8 +270,39 @@ def engine_forms(ndev: int) -> List[Form]:
         )
         return Eng(cfg(partition_span=256)).build_device(dg)
 
+    def elastic_resume():
+        # ISSUE 7: the re-sharded engine AFTER an elastic rescue. Build
+        # at ndev, snapshot (canonical host-order payload + mesh-meta
+        # provenance), rebuild at ONE device, resume through the
+        # mesh-shape-agnostic path — then every contract below runs
+        # against the resumed engine: the post-rescue step must keep
+        # the original sharded form's collective multiset (PTC001),
+        # dtype discipline (PTC002), and consumable rank donation
+        # (PTC003/007), so a rescue can never silently compile a
+        # slower or f64-widened program.
+        import shutil
+        import tempfile
+
+        from pagerank_tpu.utils.snapshot import Snapshotter, resume_engine
+
+        e0 = Eng(cfg()).build(g)
+        e0._device_step()
+        e0.fence()
+        work = tempfile.mkdtemp(prefix="pagerank_ctc_elastic_")
+        try:
+            snap = Snapshotter(work, g.fingerprint(), "reference",
+                               mesh_meta=e0.snapshot_meta())
+            snap.save(1, e0.ranks())
+            e1 = Eng(PageRankConfig(num_iters=2, num_devices=1)).build(g)
+            resumed = resume_engine(e1, snap)
+            assert resumed == 1, resumed
+        finally:
+            shutil.rmtree(work, ignore_errors=True)
+        return e1
+
     return [
         Form("ell", lambda: Eng(cfg()).build(g), True),
+        Form("elastic_resume", elastic_resume, True),
         Form("pair", lambda: Eng(cfg(
             dtype="float32", accum_dtype="float64", wide_accum="pair",
         )).build(g), False),
@@ -340,7 +373,7 @@ def expected_collectives(engine, form: str) -> Dict[str, int]:
         and isinstance(engine._src, list) else 1
     if form in ("ell", "pair", "striped", "coo", "device_build",
                 "device_build_striped", "partitioned", "partitioned_bf16",
-                "device_build_partitioned"):
+                "device_build_partitioned", "elastic_resume"):
         return {"psum": 1}
     if form == "multi_dispatch":
         # The cross-device merge is the finalize's sharded .sum(0)

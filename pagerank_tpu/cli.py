@@ -178,6 +178,12 @@ def build_parser() -> argparse.ArgumentParser:
         "raising; needs --snapshot-dir to have anything to roll back to",
     )
     ft.add_argument(
+        "--max-rescues", type=int, default=None,
+        help="elastic-rescue budget under --stall-action rescue: mesh "
+        "teardown + re-shard + warm-start recoveries allowed after "
+        "device losses (default: the --max-rollbacks budget)",
+    )
+    ft.add_argument(
         "--mass-tol", type=float, default=None,
         help="opt-in per-step relative rank-mass drift tolerance for "
         "the health check (default: NaN/Inf checks only)",
@@ -255,10 +261,17 @@ def build_parser() -> argparse.ArgumentParser:
         "boundaries; size the timeout above a chunk's expected wall",
     )
     ob.add_argument(
-        "--stall-action", choices=["warn", "raise"], default="warn",
+        "--stall-action", choices=["warn", "raise", "rescue"],
+        default="warn",
         help="what the watchdog does on a stall: 'warn' logs and "
         "keeps waiting; 'raise' also interrupts the run "
-        "(KeyboardInterrupt at the next bytecode boundary)",
+        "(KeyboardInterrupt at the next bytecode boundary); 'rescue' "
+        "classifies the stall (hang vs device-lost via per-device "
+        "liveness probes), and on device loss tears down the mesh, "
+        "rebuilds it over the surviving devices, re-shards the graph, "
+        "and warm-starts from the newest valid snapshot "
+        "(docs/ROBUSTNESS.md 'Elastic solve'; jax engine, host-built "
+        "graph, stepwise loop)",
     )
     p.add_argument("--strict-parse", action="store_true", help="crawl mode: die on bad records")
     p.add_argument(
@@ -681,8 +694,11 @@ def _s3_retry_total(paths) -> int:
 def _robustness_summary(args, engine, guard) -> dict:
     """The run's robustness counters (docs/ROBUSTNESS.md) as one dict —
     feeds both the stderr summary line and the flight recorder."""
+    counters = obs.get_registry().snapshot()["counters"]
     return {
         "rollbacks": getattr(engine, "health", {}).get("rollbacks", 0) or 0,
+        "rescues": int(counters.get("elastic.rescues", 0)),
+        "devices_lost": int(counters.get("elastic.devices_lost", 0)),
         "write_retries": guard.retries,
         "dropped_writes": len(guard.dropped),
         "s3_request_retries": _s3_retry_total(
@@ -823,6 +839,30 @@ def _main(argv, ctx) -> int:
         if args.engine != "jax":
             print("--fused requires --engine jax", file=sys.stderr)
             return 2
+    if args.stall_action == "rescue":
+        # Pure-args validation before the graph load: rescue rebuilds
+        # the engine over surviving devices, which needs the stepwise
+        # loop and a host graph to re-shard (a device-built graph's
+        # slot arrays are donated away at build).
+        bad = [
+            flag for flag, on in (
+                ("--fused", args.fused),
+                ("--device-build", args.device_build),
+                ("--ppr-sources", bool(args.ppr_sources)),
+            ) if on
+        ]
+        if bad:
+            print(
+                f"--stall-action rescue re-shards the graph onto a "
+                f"rebuilt mesh (stepwise loop, host-built graph); "
+                f"incompatible with {', '.join(bad)}",
+                file=sys.stderr,
+            )
+            return 2
+        if args.engine != "jax":
+            print("--stall-action rescue requires --engine jax",
+                  file=sys.stderr)
+            return 2
     if args.ppr_sources:
         reject_ppr_incompatible_flags(args)
     # Observability state is per-run, never inherited: a previous
@@ -876,6 +916,7 @@ def _main(argv, ctx) -> int:
             health_checks=not args.no_health_checks,
             mass_tol=args.mass_tol,
             max_rollbacks=args.max_rollbacks,
+            max_rescues=args.max_rescues,
             write_attempts=args.write_retries,
             on_write_failure=args.on_write_failure,
         ),
@@ -931,9 +972,22 @@ def _main(argv, ctx) -> int:
     else:
         engine.build(graph)
 
+    # Engine indirection for the elastic path: a rescue REPLACES the
+    # engine mid-run (teardown + rebuild over survivors), so every
+    # closure below reaches the engine through this holder instead of
+    # binding the original object.
+    engine_ref = {"engine": engine}
+
+    def _eng():
+        return engine_ref["engine"]
+
     snap = None
     if args.snapshot_dir:
-        snap = Snapshotter(args.snapshot_dir, graph.fingerprint(), cfg.semantics)
+        # mesh_meta: topology + partition-geometry provenance in every
+        # snapshot (mesh-shape-agnostic resume; docs/ROBUSTNESS.md
+        # "Elastic solve").
+        snap = Snapshotter(args.snapshot_dir, graph.fingerprint(),
+                           cfg.semantics, mesh_meta=engine.snapshot_meta())
         if args.resume:
             it = resume_engine(engine, snap)
             if it:
@@ -990,7 +1044,7 @@ def _main(argv, ctx) -> int:
         from pagerank_tpu.utils.snapshot import AsyncRankWriter
 
         writer = AsyncRankWriter(
-            lambda p: (p[0], engine.decode_ranks(p[1])), [write_sinks],
+            lambda p: (p[0], _eng().decode_ranks(p[1])), [write_sinks],
             guard=guard,
         )
 
@@ -1009,8 +1063,16 @@ def _main(argv, ctx) -> int:
     # live server; armed right before the solve.
     watchdog = None
     if args.stall_timeout:
+        # Classification probes the SOLVE MESH's devices (tracking the
+        # rebuilt engine after a rescue), not every visible chip — a
+        # wedged device the solve never uses must not read as OUR loss.
+        device_source = None
+        if args.engine == "jax":
+            def device_source():
+                return list(_eng().mesh.devices.reshape(-1))
         watchdog = obs.StallWatchdog(
-            args.stall_timeout, action=args.stall_action
+            args.stall_timeout, action=args.stall_action,
+            device_source=device_source,
         )
 
     # Live metrics exporter (obs/live.py): atomic Prometheus textfile
@@ -1037,10 +1099,10 @@ def _main(argv, ctx) -> int:
         if not (want_snap or dumper is not None):
             return
         if writer is not None:
-            writer.submit(i, (want_snap, engine.device_ranks()))
+            writer.submit(i, (want_snap, _eng().device_ranks()))
         else:
             # one device->host fetch for both sinks
-            guard(i, lambda: write_sinks(i, (want_snap, engine.ranks())))
+            guard(i, lambda: write_sinks(i, (want_snap, _eng().ranks())))
 
     # Stall watchdog (obs/live.py): armed around the solve only — the
     # engine heartbeats it per completed step (chunk boundaries when
@@ -1183,8 +1245,48 @@ def _main(argv, ctx) -> int:
                         WriterSyncedSnapshotter)
 
                     roll_snap = WriterSyncedSnapshotter(snap, writer)
-                ranks = engine.run(on_iteration=on_iteration,
-                                   snapshotter=roll_snap, probes=probes)
+                if args.stall_action == "rescue":
+                    # Elastic solve (docs/ROBUSTNESS.md "Elastic
+                    # solve"): device losses — injected, backend
+                    # runtime errors confirmed by liveness probes, or
+                    # watchdog fires classified as device-lost — tear
+                    # down the mesh, rebuild over survivors, re-shard
+                    # the graph, and warm-start from the newest valid
+                    # snapshot.
+                    from pagerank_tpu.engines.jax_engine import (
+                        JaxTpuEngine)
+                    from pagerank_tpu.parallel.elastic import (
+                        DeviceHealthMonitor, ElasticRunner)
+
+                    def _factory(devs):
+                        e = JaxTpuEngine(
+                            cfg.replace(num_devices=len(devs)),
+                            devices=devs,
+                        )
+                        return e.build(graph)
+
+                    def _rebound(e):
+                        engine_ref["engine"] = e
+                        ctx["engine"] = e
+                        if snap is not None:
+                            snap.mesh_meta = e.snapshot_meta()
+
+                    runner = ElasticRunner(
+                        engine, _factory, snapshotter=roll_snap,
+                        max_rescues=cfg.robustness.rescue_budget(),
+                        monitor=DeviceHealthMonitor(
+                            straggler_factor=(
+                                cfg.robustness.straggler_factor),
+                        ),
+                        on_rebuild=_rebound,
+                    )
+                    ranks = runner.run(on_iteration=on_iteration,
+                                       probes=probes)
+                    engine = engine_ref["engine"]
+                else:
+                    ranks = engine.run(on_iteration=on_iteration,
+                                       snapshotter=roll_snap,
+                                       probes=probes)
     finally:
         # Capture BEFORE any nested try: inside an except handler,
         # sys.exc_info() would report the just-caught close() error.
@@ -1226,9 +1328,15 @@ def _main(argv, ctx) -> int:
     # outputs. Printed only when something is worth reporting.
     rb_summary = _robustness_summary(args, engine, guard)
     rollbacks = rb_summary["rollbacks"]
+    rescues = rb_summary["rescues"]
     io_retries = rb_summary["s3_request_retries"]
-    if rollbacks or guard.retries or guard.dropped or io_retries:
+    if rollbacks or rescues or guard.retries or guard.dropped or io_retries:
         parts = [f"{rollbacks} rollback(s)", f"{guard.retries} write retr(y/ies)"]
+        if rescues:
+            parts.append(
+                f"{rescues} elastic rescue(s) "
+                f"({rb_summary['devices_lost']} device(s) lost)"
+            )
         if io_retries:
             parts.append(f"{io_retries} s3 request retr(y/ies)")
         if guard.dropped:

@@ -82,6 +82,13 @@ class PageRankEngine(abc.ABC):
         Engines override with a cheaper device-side reduction."""
         return float(np.asarray(self.ranks(), dtype=np.float64).sum())
 
+    def snapshot_meta(self) -> Dict[str, object]:
+        """Mesh topology + partition geometry provenance recorded in
+        snapshot metadata (utils/snapshot.Snapshotter.mesh_meta;
+        ISSUE 7). Diagnostic only — resume is mesh-shape-agnostic.
+        The jax engine overrides with the real mesh/layout view."""
+        return {"num_devices": 1, "engine": self.name}
+
     # -- convergence probes (obs/probes.py; ISSUE 5) -----------------------
 
     def probe_values(self, k: int, prev_ids):

@@ -2959,6 +2959,33 @@ class JaxTpuEngine(PageRankEngine):
             info["kernel_requested"] = self._kernel_requested
         return info
 
+    def snapshot_meta(self) -> Dict[str, object]:
+        """Mesh topology + partition geometry recorded alongside every
+        snapshot (Snapshotter.mesh_meta; ISSUE 7): which mesh shape and
+        layout produced the checkpoint. Purely provenance — snapshots
+        hold the canonical host-order vector, so resume works on ANY
+        mesh shape; this is what the run report / a postmortem reads
+        to see that a rescue actually changed the mesh."""
+        mesh = self._mesh
+        devs = (
+            [d for d in mesh.devices.reshape(-1)]
+            if mesh is not None else []
+        )
+        return {
+            "engine": self.name,
+            "num_devices": len(devs) if devs else 1,
+            "axis": self.config.mesh_axis,
+            "device_ids": [int(d.id) for d in devs],
+            "device_kinds": sorted({str(d.device_kind) for d in devs}),
+            "vertex_sharded": bool(self.config.vertex_sharded),
+            "n_state": int(getattr(self, "_n_state", 0) or 0),
+            "layout": {
+                k: self._layout.get(k)
+                for k in ("form", "partition_span", "n_stripes",
+                          "stripe_span", "group")
+            },
+        }
+
     @property
     def mesh(self):
         return self._mesh

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence
 
 from pagerank_tpu.obs import log as obs_log
 from pagerank_tpu.obs import metrics as obs_metrics
@@ -237,19 +237,33 @@ class StallWatchdog:
     moment it returns). The episode re-arms on the next heartbeat, so
     a run that stalls twice logs twice.
 
+    ``action='rescue'`` (ISSUE 7, parallel/elastic.py): the fire
+    additionally classifies the stall — a deadline-bounded per-device
+    liveness probe (mesh.probe_liveness) discriminates *hang* (every
+    device answers) from *device-lost* — sets :attr:`rescue_requested`,
+    and interrupts the main thread exactly like 'raise'. The elastic
+    runner catches the interrupt, calls :meth:`consume_rescue`, and
+    performs the mesh teardown + re-shard + warm-start; a plain run
+    (no runner) sees an ordinary KeyboardInterrupt.
+
     ``clock``/``sleep`` are injectable: tests drive :meth:`check` in
     virtual time with no thread (utils/retry.py discipline).
     """
+
+    ACTIONS = ("warn", "raise", "rescue")
 
     def __init__(self, timeout_s: float, action: str = "warn",
                  poll_s: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep,
-                 interrupt: Optional[Callable[[], None]] = None):
+                 interrupt: Optional[Callable[[], None]] = None,
+                 liveness_timeout_s: float = 2.0,
+                 device_source: Optional[Callable[[], Sequence]] = None):
         if timeout_s <= 0:
             raise ValueError(f"stall timeout must be > 0, got {timeout_s}")
-        if action not in ("warn", "raise"):
-            raise ValueError(f"action must be 'warn' or 'raise', got {action!r}")
+        if action not in self.ACTIONS:
+            raise ValueError(
+                f"action must be one of {self.ACTIONS}, got {action!r}")
         self.timeout_s = float(timeout_s)
         self.action = action
         self.poll_s = poll_s if poll_s is not None else min(
@@ -260,10 +274,19 @@ class StallWatchdog:
         self._interrupt = interrupt if interrupt is not None else (
             self._default_interrupt
         )
+        self.liveness_timeout_s = float(liveness_timeout_s)
+        #: Where classification gets its device list: a callable
+        #: returning the SOLVE MESH's devices (the CLI wires the
+        #: current engine's mesh — post-rescue it must track the
+        #: rebuilt one). None falls back to every visible device,
+        #: which can blame a chip the solve never uses.
+        self.device_source = device_source
         self._last = self.clock()
         self.last_iteration: Optional[int] = None
         self.stalls = 0
         self._fired = False  # one diagnostic per stall episode
+        self.rescue_requested = False
+        self.last_classification: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -279,6 +302,35 @@ class StallWatchdog:
         if iteration is not None:
             self.last_iteration = iteration
         self._fired = False  # new progress re-arms the episode
+
+    def consume_rescue(self) -> bool:
+        """Whether the latest fire requested a rescue; reading it
+        clears the flag (the elastic runner's one-shot handshake —
+        a later unrelated KeyboardInterrupt must not rescue)."""
+        req = self.rescue_requested
+        self.rescue_requested = False
+        return req
+
+    def _classify(self) -> str:
+        """Hang vs device-lost, best-effort: a deadline-bounded
+        liveness probe per SOLVE-MESH device (``device_source``;
+        parallel/mesh.probe_liveness). Never raises — classification
+        is diagnostic input, and a probe that cannot run still leaves
+        the stall loud."""
+        try:
+            from pagerank_tpu.parallel import mesh as mesh_lib
+
+            devs = (self.device_source()
+                    if self.device_source is not None else None)
+            alive = mesh_lib.probe_liveness(
+                devs, timeout_s=self.liveness_timeout_s
+            )
+            dead = sorted(d for d, ok in alive.items() if not ok)
+            if dead:
+                return f"DEVICE-LOST (no liveness echo from {dead})"
+            return "hang (all devices answer liveness probes)"
+        except Exception as e:
+            return f"unclassified (liveness probe failed: {type(e).__name__})"
 
     def stalled_for(self) -> float:
         return self.clock() - self._last
@@ -309,12 +361,19 @@ class StallWatchdog:
         ).inc()
         it = ("none completed" if self.last_iteration is None
               else f"last completed iteration {self.last_iteration}")
+        classified = ""
+        if self.action == "rescue":
+            self.last_classification = self._classify()
+            classified = f"; classification: {self.last_classification}"
         obs_log.warn(
             f"STALL WATCHDOG: no solve progress for {stalled:.1f}s "
             f"(timeout {self.timeout_s:g}s); {it}; devices: "
-            f"{self._device_view()}"
+            f"{self._device_view()}{classified}"
         )
-        if self.action == "raise":
+        if self.action == "rescue":
+            self.rescue_requested = True
+            self._interrupt()
+        elif self.action == "raise":
             self._interrupt()
         return True
 

@@ -9,6 +9,15 @@ ICI within a slice and DCN across slices. No shuffle machinery exists to
 port: the graph is statically partitioned once (parallel/partition.py).
 
 Single-host (or single-chip) runs skip initialization entirely.
+
+Startup is RETRIED (ISSUE 7 satellite): at multihost bring-up the
+coordinator and its workers race — a worker that dials before the
+coordinator's port is bound sees a connection refusal/timeout that a
+second attempt moments later would not. ``maybe_initialize_distributed``
+therefore runs the initialize call under a ``utils/retry.RetryPolicy``
+(jittered exponential backoff + a wall-clock deadline) instead of
+aborting the whole run on the first transient; attempts land in the
+``distributed.init_retries`` counter for the run report.
 """
 
 from __future__ import annotations
@@ -16,34 +25,91 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from pagerank_tpu.obs import log as obs_log
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.utils.retry import RetryPolicy
+
+#: Default bring-up policy: 5 attempts over at most ~2 minutes — wide
+#: enough for a slow coordinator container, bounded enough that a
+#: genuinely absent coordinator still fails the run promptly.
+DEFAULT_INIT_RETRY = dict(max_attempts=5, base_delay=1.0, max_delay=15.0,
+                          deadline=120.0)
+
+
+def _init_retryable(exc: BaseException) -> bool:
+    """Coordinator-race classifier: connection/timeout errors (and the
+    RuntimeError/XlaRuntimeError spellings jax wraps them in when the
+    coordinator is not yet listening) retry; everything else — bad
+    process ids, double initialization — is a configuration error that
+    must surface unchanged."""
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in (
+        "deadline_exceeded", "deadline exceeded", "unavailable",
+        "connection refused", "connection reset", "failed to connect",
+        "barrier timed out", "timed out",
+    ))
+
 
 def maybe_initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    retry_policy: Optional[RetryPolicy] = None,
+    _initialize=None,
 ) -> bool:
     """Initialize jax.distributed when multi-host context is present.
 
     Resolution order: explicit args > PAGERANK_TPU_* env vars > cloud
     TPU auto-detection (jax.distributed.initialize() with no args reads
     the TPU metadata server). Returns True if initialization ran.
+
+    The initialize call runs under ``retry_policy`` (default:
+    ``DEFAULT_INIT_RETRY`` — jittered backoff + deadline) so a
+    transient coordinator race at startup costs a retry, not the run;
+    re-attempts are counted in ``distributed.init_retries``.
+    ``_initialize`` is injectable for tests (virtual-time schedules).
     """
     import jax
+
+    init = _initialize if _initialize is not None else (
+        jax.distributed.initialize
+    )
+    policy = retry_policy if retry_policy is not None else RetryPolicy(
+        retryable=_init_retryable, **DEFAULT_INIT_RETRY
+    )
+
+    def on_retry(failures, delay, exc):
+        obs_metrics.counter(
+            "distributed.init_retries",
+            "jax.distributed.initialize re-attempts after transient "
+            "coordinator races at multihost startup",
+        ).inc()
+        obs_log.warn(
+            f"jax.distributed.initialize attempt {failures} failed "
+            f"({type(exc).__name__}: {str(exc)[:120]}); retrying in "
+            f"{delay:.1f}s"
+        )
 
     coordinator = coordinator_address or os.environ.get("PAGERANK_TPU_COORDINATOR")
     nproc = num_processes if num_processes is not None else _env_int("PAGERANK_TPU_NUM_PROCESSES")
     pid = process_id if process_id is not None else _env_int("PAGERANK_TPU_PROCESS_ID")
 
     if coordinator is not None:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=nproc,
-            process_id=pid,
+        policy.call(
+            lambda: init(
+                coordinator_address=coordinator,
+                num_processes=nproc,
+                process_id=pid,
+            ),
+            on_retry=on_retry,
+            retryable=_init_retryable,
         )
         return True
     if os.environ.get("TPU_WORKER_HOSTNAMES") and _env_int("TPU_WORKER_ID") is not None \
             and os.environ.get("PAGERANK_TPU_AUTO_DISTRIBUTED") == "1":
-        jax.distributed.initialize()
+        policy.call(init, on_retry=on_retry, retryable=_init_retryable)
         return True
     return False
 

@@ -1,0 +1,424 @@
+"""Elastic multi-device solve: preemption-tolerant rescue (ISSUE 7;
+docs/ROBUSTNESS.md "Elastic solve").
+
+PR 3's self-healing loop survives bad NUMBERS (NaN/mass drift ->
+snapshot rollback) and PR 5's watchdog makes hung collectives LOUD —
+but nothing could finish a solve once a device dropped out of the mesh.
+This module closes that gap with the recovery strategy the
+asynchronous-iteration literature licenses (Kollias et al.,
+arXiv:cs/0606047: PageRank converges from stale/partial state):
+
+  1. classify — a step failure or watchdog fire is probed per device
+     (parallel/mesh.probe_liveness: deadline-bounded echo round-trips)
+     into *hang* (every device answers; keep waiting / warn) vs
+     *device-lost* (some device cannot answer a 4-byte echo);
+  2. rescue — tear down the mesh, rebuild it over the survivors
+     (mesh.surviving_devices), re-shard the graph by rebuilding the
+     engine at the smaller device count (the partitioner and every
+     layout planner are mesh-size-parametric already), and warm-start
+     from the newest valid snapshot (snapshots store the CANONICAL
+     host-order rank vector, so a snapshot taken on N devices restores
+     onto any M-device mesh — utils/snapshot.py "Mesh-shape-agnostic");
+  3. bound — rescues spend the same budget class as rollbacks
+     (config.robustness.max_rescues, defaulting to max_rollbacks);
+     exhausting it raises :class:`ElasticExhaustedError` naming every
+     device lost along the way.
+
+Stragglers are NOT rescued: a slow step that completes is telemetry
+(:class:`DeviceHealthMonitor` -> ``elastic.slow_steps`` /
+``elastic.straggler_skew``), never a teardown — rescue costs a rebuild
+plus recomputed iterations, and a straggler resolves itself.
+
+Everything is testable on CPU: ``testing/faults.DeviceFaultSchedule``
+injects kills/delays/poisons through a mesh-aware shim, and the
+liveness prober is injectable so an 8-fake-device run
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) exercises the
+full classify -> teardown -> re-shard -> resume path
+(tests/test_elastic.py; acceptance smoke L).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pagerank_tpu.obs import live as obs_live
+from pagerank_tpu.obs import log as obs_log
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.obs import trace as obs_trace
+from pagerank_tpu.parallel import mesh as mesh_lib
+from pagerank_tpu.utils.snapshot import resume_engine
+
+
+class DeviceLostError(RuntimeError):
+    """A mesh device is gone (preempted, detached, or wedged past the
+    liveness deadline). Carries the lost device ids so the rescue path
+    can rebuild over the survivors. Raised by the fault-injection shim
+    on CPU and mapped from backend runtime errors (confirmed by a
+    liveness probe) on real hardware."""
+
+    def __init__(self, message: str, device_ids: Sequence[int] = ()):
+        super().__init__(message)
+        self.device_ids = tuple(device_ids)
+
+
+class ElasticExhaustedError(RuntimeError):
+    """The rescue budget is spent (or no devices survive). Carries the
+    full casualty list and the rescue count — the 3am-page diagnostic,
+    same contract as engine.SolverHealthError."""
+
+    def __init__(self, message: str, lost_device_ids: Sequence[int],
+                 rescues: int):
+        super().__init__(message)
+        self.lost_device_ids = tuple(lost_device_ids)
+        self.rescues = rescues
+
+
+# Substrings that mark a backend runtime error as PLAUSIBLY a device
+# loss (worth a liveness probe before rescuing). Deliberately narrow:
+# an unrelated XLA error must re-raise, not trigger a teardown.
+_DEVICE_LOSS_MARKERS = (
+    "device_lost", "device lost", "deadline_exceeded", "data_loss",
+    "failed to connect", "socket closed", "unavailable",
+    "device or resource busy", "halted", "preempt",
+)
+
+
+def looks_like_device_loss(exc: BaseException) -> bool:
+    """Whether a step failure is worth a liveness probe (vs a plain
+    programming/numerics error that must surface unchanged)."""
+    if isinstance(exc, DeviceLostError):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+class DeviceHealthMonitor:
+    """Per-step health telemetry: straggler detection by step-time
+    skew. A step that takes more than ``straggler_factor`` times the
+    EWMA of previous steps — but COMPLETES — is a slow step, not a
+    stall: it increments ``elastic.slow_steps``, publishes the skew in
+    the ``elastic.straggler_skew`` gauge (the live exporter picks both
+    up), and logs once per episode. Per-device attribution, when the
+    caller has it (the fault shim does; real hardware gets it from the
+    per-device cost/metrics plumbing), lands in
+    ``elastic.device_skew`` as max/median across devices.
+
+    ``clock`` is injectable (utils/retry.py discipline) so tests drive
+    step timing in virtual time."""
+
+    def __init__(self, straggler_factor: float = 4.0, warmup_steps: int = 2,
+                 ewma_alpha: float = 0.3,
+                 clock: Callable[[], float] = time.monotonic):
+        if straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {straggler_factor}"
+            )
+        self.straggler_factor = float(straggler_factor)
+        self.warmup_steps = int(warmup_steps)
+        self.ewma_alpha = float(ewma_alpha)
+        self.clock = clock
+        self._ewma: Optional[float] = None
+        self._steps = 0
+        self._t_last: Optional[float] = None
+        self.slow_steps = 0
+        self.last_skew: Optional[float] = None
+
+    def reset(self) -> None:
+        """Re-baseline after a rescue: the fresh engine's first steps
+        pay compile/warm-up wall that must not read as stragglers, and
+        the degraded mesh's steady-state step time is legitimately
+        different."""
+        self._ewma = None
+        self._steps = 0
+        self._t_last = None
+
+    def begin_step(self) -> None:
+        self._t_last = self.clock()
+
+    def end_step(self, iteration: int) -> None:
+        """Record one completed step's wall (measured on ``clock``
+        since :meth:`begin_step`); flags it slow AFTER the warmup once
+        it exceeds ``straggler_factor`` x the EWMA."""
+        if self._t_last is None:
+            return
+        dt = self.clock() - self._t_last
+        self._t_last = None
+        self._steps += 1
+        if self._ewma is not None and self._steps > self.warmup_steps:
+            skew = dt / max(self._ewma, 1e-12)
+            if skew > self.straggler_factor:
+                self.slow_steps += 1
+                self.last_skew = skew
+                obs_metrics.counter(
+                    "elastic.slow_steps",
+                    "steps slower than straggler_factor x the step-time "
+                    "EWMA (completed — telemetry only, never a rescue)",
+                ).inc()
+                obs_metrics.gauge(
+                    "elastic.straggler_skew",
+                    "latest slow step's wall / step-time EWMA",
+                ).set(float(skew))
+                obs_log.warn(
+                    f"slow step at iteration {iteration}: {dt:.3f}s is "
+                    f"{skew:.1f}x the {self._ewma:.3f}s EWMA "
+                    f"(straggler telemetry; not a stall, not rescued)"
+                )
+                return  # a straggler must not poison the EWMA baseline
+        self._ewma = (
+            dt if self._ewma is None
+            else (1 - self.ewma_alpha) * self._ewma + self.ewma_alpha * dt
+        )
+
+    def record_device_times(self, iteration: int,
+                            device_seconds: Dict[int, float]) -> None:
+        """Optional per-device step walls (fault shim / per-device cost
+        plumbing): publishes max/median skew across devices."""
+        if not device_seconds:
+            return
+        vals = sorted(device_seconds.values())
+        med = vals[len(vals) // 2]
+        skew = vals[-1] / max(med, 1e-12)
+        obs_metrics.gauge(
+            "elastic.device_skew",
+            "max/median per-device step wall at the latest measured "
+            "iteration",
+        ).set(float(skew))
+
+
+class ElasticRunner:
+    """The rescue driver around ``engine.run``.
+
+    ``engine_factory(devices)`` must build a FRESH engine over exactly
+    ``devices`` (re-sharding the graph through the normal build path —
+    parallel/partition.py and the layout planners are mesh-size-
+    parametric). ``snapshotter`` is both the per-iteration sink's
+    Snapshotter and the warm-start source after a rescue; snapshots
+    hold the canonical host-order vector, so any mesh shape restores
+    (utils/snapshot.py). ``liveness`` is the device prober — injectable
+    so CPU chaos tests (and the fault shim) control which devices are
+    "dead"; the default is mesh.probe_liveness under
+    ``liveness_timeout_s``.
+
+    ``on_rebuild(engine)`` fires after every rescue with the fresh
+    engine — the hook tests use to re-install the fault shim and the
+    CLI uses to rebind sinks.
+    """
+
+    def __init__(
+        self,
+        engine,
+        engine_factory: Callable[[Sequence], object],
+        snapshotter=None,
+        *,
+        max_rescues: int = 3,
+        liveness: Optional[Callable[..., Dict[int, bool]]] = None,
+        liveness_timeout_s: float = 5.0,
+        resume_timeout_s: float = 60.0,
+        monitor: Optional[DeviceHealthMonitor] = None,
+        on_rebuild: Optional[Callable[[object], None]] = None,
+    ):
+        self.engine = engine
+        self._factory = engine_factory
+        self._snap = snapshotter
+        self.max_rescues = int(max_rescues)
+        self._liveness = liveness
+        self._liveness_timeout_s = float(liveness_timeout_s)
+        self._resume_timeout_s = float(resume_timeout_s)
+        self.monitor = monitor
+        self._on_rebuild = on_rebuild
+        self.rescues = 0
+        self.restarts = 0  # rescues that found no snapshot (iteration 0)
+        self.lost_device_ids: List[int] = []
+        obs_metrics.gauge(
+            "elastic.mesh_devices", "devices in the current solve mesh"
+        ).set(self._ndev())
+
+    def _ndev(self) -> int:
+        mesh = getattr(self.engine, "mesh", None)
+        return int(mesh.devices.size) if mesh is not None else 1
+
+    def _devices(self) -> List:
+        return list(self.engine.mesh.devices.reshape(-1))
+
+    def _probe(self) -> Dict[int, bool]:
+        if self._liveness is not None:
+            return self._liveness(self._devices(),
+                                  self._liveness_timeout_s)
+        return mesh_lib.probe_liveness(self._devices(),
+                                       self._liveness_timeout_s)
+
+    # -- rescue ------------------------------------------------------------
+
+    def _rescue(self, dead_ids: Sequence[int], cause: str):
+        """Teardown -> rebuild over survivors -> warm-start. Raises
+        :class:`ElasticExhaustedError` past the budget (or when nothing
+        survives)."""
+        dead = sorted(set(int(d) for d in dead_ids))
+        self.lost_device_ids.extend(
+            d for d in dead if d not in self.lost_device_ids
+        )
+        obs_metrics.counter(
+            "elastic.devices_lost",
+            "mesh devices declared dead across the run",
+        ).inc(len(dead))
+        if self.rescues >= self.max_rescues:
+            raise ElasticExhaustedError(
+                f"rescue budget ({self.max_rescues}) exhausted after "
+                f"losing device(s) {self.lost_device_ids} ({cause})",
+                lost_device_ids=self.lost_device_ids,
+                rescues=self.rescues,
+            )
+        with obs_trace.span("elastic/rescue", cause=cause,
+                            dead_devices=",".join(map(str, dead))) as sp:
+            try:
+                survivors = mesh_lib.surviving_devices(
+                    self.lost_device_ids, self._devices()
+                )
+            except RuntimeError as e:
+                raise ElasticExhaustedError(
+                    f"no surviving devices to rescue onto ({e}); lost "
+                    f"{self.lost_device_ids}",
+                    lost_device_ids=self.lost_device_ids,
+                    rescues=self.rescues,
+                ) from e
+            obs_log.warn(
+                f"ELASTIC RESCUE #{self.rescues + 1}: device(s) {dead} "
+                f"lost ({cause}); rebuilding the mesh over "
+                f"{len(survivors)} survivor(s) and warm-starting from "
+                f"the newest valid snapshot"
+            )
+            self.engine = self._factory(survivors)
+            resumed = 0
+            if self._snap is not None:
+                # DEADLINE-BOUNDED warm-start scan: it can touch
+                # buffers homed on the lost mesh — a
+                # WriterSyncedSnapshotter flushes the async writer,
+                # whose pending decode does a device_get that blocks
+                # forever against a dead device. Only the SCAN runs
+                # under the deadline (abandoned past it — the solve
+                # restarts from r0 instead: slower, still
+                # convergent); the set_ranks restore always happens
+                # here on the caller's thread via resume_engine's
+                # _found hand-off, so an abandoned scan thread can
+                # never mutate the fresh engine later.
+                try:
+                    found = mesh_lib.run_with_deadline(
+                        self._snap.load_latest_valid,
+                        self._resume_timeout_s,
+                    )
+                    # found=None means "no snapshot", already decided
+                    # under the deadline — never rescan unbounded.
+                    resumed = (
+                        resume_engine(self.engine, self._snap,
+                                      _found=found)
+                        if found is not None else 0
+                    )
+                except mesh_lib.DeadlineExpired:
+                    obs_log.warn(
+                        f"elastic rescue: warm-start source did not "
+                        f"answer within {self._resume_timeout_s:g}s "
+                        f"(pending writes against the lost mesh?); "
+                        f"abandoning it and restarting from the "
+                        f"initial vector"
+                    )
+                    resumed = 0
+            if resumed:
+                obs_log.info(
+                    f"elastic rescue resumed from iteration {resumed} on "
+                    f"{len(survivors)} device(s)"
+                )
+            else:
+                # Nothing valid to warm-start from: restart the solve
+                # from r0 on the degraded mesh — convergent (stale-start
+                # theory), just slower; counted separately.
+                self.restarts += 1
+                obs_metrics.counter(
+                    "elastic.restarts",
+                    "rescues that found no valid snapshot and restarted "
+                    "from the initial rank vector",
+                ).inc()
+                obs_log.warn(
+                    "elastic rescue found no valid snapshot; restarting "
+                    "from the initial rank vector on the degraded mesh"
+                )
+            self.rescues += 1
+            obs_metrics.counter(
+                "elastic.rescues",
+                "mesh teardown + re-shard + warm-start recoveries",
+            ).inc()
+            obs_metrics.gauge(
+                "elastic.mesh_devices",
+                "devices in the current solve mesh",
+            ).set(self._ndev())
+            if sp is not None:
+                sp.attrs["resumed_iteration"] = resumed
+                sp.attrs["survivors"] = len(survivors)
+            if self.monitor is not None:
+                self.monitor.reset()
+            if self._on_rebuild is not None:
+                self._on_rebuild(self.engine)
+        return self.engine
+
+    def _classify_and_rescue(self, exc: BaseException, cause: str):
+        """Confirm a plausible device loss with the liveness probe;
+        rescue when the probe finds casualties, re-raise otherwise
+        (a live mesh means the error is the caller's problem)."""
+        alive = self._probe()
+        dead = [d for d, ok in alive.items() if not ok]
+        if isinstance(exc, DeviceLostError) and exc.device_ids:
+            dead = sorted(set(dead) | set(exc.device_ids))
+        if not dead:
+            return None
+        return self._rescue(dead, cause)
+
+    # -- drive -------------------------------------------------------------
+
+    def run(self, num_iters: Optional[int] = None, on_iteration=None,
+            probes=None) -> np.ndarray:
+        """``engine.run`` with rescue: a step failure that classifies
+        as device loss (or a watchdog fire under ``--stall-action
+        rescue`` whose probe finds casualties) tears down and rebuilds;
+        anything else propagates unchanged. Numeric self-healing
+        (NaN -> rollback) keeps running INSIDE engine.run with the
+        same snapshotter."""
+        monitor = self.monitor
+        wrapped = on_iteration
+        if monitor is not None:
+            def wrapped(i, info, _inner=on_iteration):
+                monitor.end_step(i)
+                if _inner is not None:
+                    _inner(i, info)
+                monitor.begin_step()
+
+        while True:
+            try:
+                if monitor is not None:
+                    monitor.begin_step()
+                return self.engine.run(
+                    num_iters=num_iters, on_iteration=wrapped,
+                    snapshotter=self._snap, probes=probes,
+                )
+            except KeyboardInterrupt:
+                wd = obs_live.get_watchdog()
+                if wd is None or not wd.consume_rescue():
+                    raise
+                # Watchdog-initiated: a stall past the timeout. Probe:
+                # dead device(s) -> rescue; all alive -> a hang we must
+                # not "fix" by teardown (the watchdog already logged
+                # loudly) — surface it.
+                if self._classify_and_rescue(
+                        KeyboardInterrupt(), "stall watchdog") is None:
+                    raise RuntimeError(
+                        "stall watchdog fired but every device answers "
+                        "its liveness probe: hang, not device loss — "
+                        "not rescuing (see the watchdog diagnostic)"
+                    )
+            except Exception as e:
+                if not looks_like_device_loss(e):
+                    raise
+                if self._classify_and_rescue(e, f"step failure: "
+                                             f"{type(e).__name__}") is None:
+                    raise

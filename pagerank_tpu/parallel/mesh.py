@@ -10,7 +10,8 @@ DCN (multi-host).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import threading
+from typing import Callable, Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -50,6 +51,101 @@ def vertex_sharding(mesh: Mesh) -> NamedSharding:
     contiguous vertex blocks over the mesh axis — the analogue of the
     reference's hash-partitioned ``ranks`` RDD (Sparky.java:165-170)."""
     return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+class DeadlineExpired(TimeoutError):
+    """A deadline-bounded dispatch did not come back in time. The work
+    may still complete later (the worker thread is daemonic and
+    abandoned, never killed) — the CALLER's view is what timed out."""
+
+
+def run_with_deadline(fn: Callable[[], object], timeout_s: float):
+    """Run ``fn()`` on a worker thread and wait at most ``timeout_s``
+    for it — the deadline-bounded dispatch primitive of the elastic
+    layer (parallel/elastic.py). A device_get against a dead or wedged
+    device blocks FOREVER inside the runtime; bounding it from a
+    sibling thread is the only portable way to turn "hung" into a
+    classifiable signal. Raises :class:`DeadlineExpired` on timeout and
+    re-raises ``fn``'s own exception otherwise."""
+    box: Dict[str, object] = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # surfaced to the caller below
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, name="pagerank-deadline-dispatch",
+                         daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise DeadlineExpired(
+            f"dispatch did not complete within {timeout_s:g}s"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def deadline_device_get(value, timeout_s: float):
+    """``jax.device_get(value)`` bounded by ``timeout_s`` (see
+    :func:`run_with_deadline`)."""
+    return run_with_deadline(lambda: jax.device_get(value), timeout_s)
+
+
+def probe_liveness(devices: Optional[Sequence] = None,
+                   timeout_s: float = 2.0) -> Dict[int, bool]:
+    """Per-device liveness: {device id: alive}. Each device gets one
+    tiny round-trip (device_put + device_get of a scalar) under a
+    SHARED deadline — a device that cannot answer a 4-byte echo within
+    ``timeout_s`` is classified dead (preempted, wedged, or detached),
+    which is exactly the hang-vs-device-lost discrimination the rescue
+    path needs (parallel/elastic.py). All echoes launch CONCURRENTLY
+    (one daemon thread each), so a mesh with several dead devices
+    still classifies in ~``timeout_s`` total, not ndev * timeout_s.
+    Any error — timeout or a backend exception from the dead device —
+    counts as not-alive; the probe itself never raises."""
+    import time as _time
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    results: Dict[int, bool] = {}
+
+    def echo(dev):
+        try:
+            ok = int(jax.device_get(jax.device_put(np.int32(1), dev))) == 1
+        except Exception:
+            ok = False
+        results[dev.id] = ok  # per-key dict writes are GIL-atomic
+
+    threads = []
+    for d in devs:
+        t = threading.Thread(target=echo, args=(d,),
+                             name="pagerank-liveness-probe", daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = _time.monotonic() + timeout_s
+    for t in threads:
+        t.join(max(0.0, deadline - _time.monotonic()))
+    # A device whose echo thread missed the shared deadline is dead.
+    return {d.id: results.get(d.id, False) for d in devs}
+
+
+def surviving_devices(dead_ids, devices: Optional[Sequence] = None):
+    """The visible device list minus ``dead_ids`` — the mesh substrate
+    a rescue rebuilds over. Raises when nothing survives (there is no
+    mesh to rescue onto; the caller surfaces that as terminal)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    dead = set(dead_ids)
+    out = [d for d in devs if d.id not in dead]
+    if not out:
+        raise RuntimeError(
+            f"no surviving devices: all of {sorted(d.id for d in devs)} "
+            f"reported dead"
+        )
+    return out
 
 
 def device_view() -> Sequence[str]:

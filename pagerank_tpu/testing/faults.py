@@ -9,14 +9,21 @@ seams instead:
   and injects failures / truncated writes / latency spikes;
 - :class:`HttpFaultInjector` plugs into the S3 stub's wire level
   (tests/s3stub.S3Stub.fault_hook) to answer 5xx/SlowDown, drop
-  connections mid-body, or lose a multipart-complete response.
+  connections mid-body, or lose a multipart-complete response;
+- :class:`DeviceFaultSchedule` + :func:`install_device_faults`
+  (ISSUE 7) inject DEVICE-plane faults — kill device k at iteration i,
+  delay a step to simulate a straggler, poison the merged collective
+  output — through a mesh-aware shim over the engine's step, so the
+  elastic rescue path (parallel/elastic.py) is fully testable on CPU
+  with ``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
 
-Everything is driven by a :class:`FaultSchedule`: decisions are a pure
-function of (seed, call index) — never of wall clock or shared global
-randomness — and every decision is appended to a ``log``, so a chaos
-run is REPRODUCIBLE: the same seed yields the same schedule bit-for-bit
-across two runs (asserted in tests/test_faults.py; the acceptance
-chaos smoke in scripts/acceptance.py gates on it).
+Everything is driven by a schedule whose decisions are a pure function
+of (seed, call index) — device faults: (seed, iteration) — never of
+wall clock or shared global randomness — and every decision is
+appended to a ``log``, so a chaos run is REPRODUCIBLE: the same seed
+yields the same schedule bit-for-bit across two runs (asserted in
+tests/test_faults.py and tests/test_elastic.py; the acceptance chaos
+smokes in scripts/acceptance.py gate on it).
 """
 
 from __future__ import annotations
@@ -24,7 +31,9 @@ from __future__ import annotations
 import io
 import random
 import time
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from pagerank_tpu.utils import fsio
 
@@ -277,3 +286,215 @@ class HttpFaultInjector:
             self.faults += 1
         self.log.append((n, method, path, action[0] if action else "-"))
         return action
+
+
+# -- device-plane faults (ISSUE 7; parallel/elastic.py) ----------------------
+
+
+class DeviceFaultSchedule:
+    """Seed-deterministic DEVICE-plane fault plan, keyed by ITERATION.
+
+    Explicit plan entries:
+
+    - ``kill``:   {iteration: device_id or [device_ids]} — the device
+      drops out of the mesh mid-step (the shim raises
+      :class:`~pagerank_tpu.parallel.elastic.DeviceLostError`);
+    - ``delay``:  {iteration: (device_id, seconds)} — that device's
+      step runs ``seconds`` long (a straggler: the step COMPLETES,
+      only slower — must produce telemetry, never a rescue);
+    - ``poison``: iterable of iterations whose merged collective
+      output is corrupted (NaN state + NaN step info — the numeric
+      self-healing plane's rollback handles it, exactly the
+      separation the decision table documents).
+
+    ``kill_rate``/``delay_rate`` add seeded probabilistic chaos on
+    top. Every consulted iteration draws a FIXED number of uniforms
+    from an RNG derived purely from ``(seed, iteration)``, so the
+    schedule is a pure function of the seed and the iteration — NOT
+    of how many times an iteration is consulted: a post-rescue
+    recompute of iteration i sees the same decision, and the
+    ``fired`` memory keeps one-shot faults one-shot (a killed device
+    stays dead; it cannot die twice). Every decision lands in
+    ``log`` as ``(iteration, action, detail)`` — two same-seed runs
+    of the same scenario must produce identical logs bit-for-bit.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        kill: Optional[Dict[int, object]] = None,
+        delay: Optional[Dict[int, Tuple[int, float]]] = None,
+        poison: Iterable[int] = (),
+        kill_rate: float = 0.0,
+        delay_rate: float = 0.0,
+        delay_s: float = 0.1,
+        max_faults: Optional[int] = None,
+    ):
+        self.seed = seed
+        self._kill = {
+            int(i): tuple(v) if isinstance(v, (list, tuple)) else (int(v),)
+            for i, v in (kill or {}).items()
+        }
+        self._delay = {int(i): (int(d), float(s))
+                       for i, (d, s) in (delay or {}).items()}
+        self._poison = frozenset(int(i) for i in poison)
+        self._kill_rate = kill_rate
+        self._delay_rate = delay_rate
+        self._delay_s = delay_s
+        self._max_faults = max_faults
+        self.faults = 0
+        #: Devices killed so far — the injectable liveness probe's
+        #: ground truth (see :meth:`liveness_probe`).
+        self.dead: set = set()
+        self._fired: set = set()  # (kind, iteration) one-shot memory
+        #: (iteration, action, detail) — the reproducibility record.
+        self.log: List[Tuple[int, str, str]] = []
+
+    def _rng(self, iteration: int) -> random.Random:
+        # Pure function of (seed, iteration): consulting an iteration
+        # twice (post-rescue recompute) re-derives the SAME stream.
+        return random.Random((self.seed << 24) ^ (iteration + 1))
+
+    def _budget_ok(self) -> bool:
+        return self._max_faults is None or self.faults < self._max_faults
+
+    def decide(self, iteration: int,
+               device_ids: Sequence[int]) -> List[Tuple]:
+        """Actions for ``iteration`` over the CURRENT mesh's device
+        ids: ``("kill", dev)``, ``("delay", dev, seconds)``,
+        ``("poison",)``. Deterministic per (seed, iteration); one-shot
+        per (kind, iteration); killed devices never re-die."""
+        rng = self._rng(iteration)
+        u, v = rng.random(), rng.random()  # fixed draw count
+        alive = [d for d in device_ids if d not in self.dead]
+        actions: List[Tuple] = []
+
+        def fire(kind: str, action: Tuple, detail: str):
+            self._fired.add((kind, iteration))
+            self.faults += 1
+            actions.append(action)
+            self.log.append((iteration, action[0], detail))
+
+        if self._budget_ok() and ("kill", iteration) not in self._fired:
+            targets = [d for d in self._kill.get(iteration, ()) if d in alive]
+            if not targets and u < self._kill_rate and len(alive) > 1:
+                targets = [alive[int(v * len(alive))]]
+            for d in targets:
+                self.dead.add(d)
+                fire("kill", ("kill", d), f"device {d}")
+        if self._budget_ok() and ("delay", iteration) not in self._fired:
+            ent = self._delay.get(iteration)
+            if ent is None and u < self._kill_rate + self._delay_rate and alive:
+                ent = (alive[int(v * len(alive))], self._delay_s)
+            if ent is not None:
+                fire("delay", ("delay", ent[0], ent[1]),
+                     f"device {ent[0]} +{ent[1]:g}s")
+        if (self._budget_ok() and iteration in self._poison
+                and ("poison", iteration) not in self._fired):
+            fire("poison", ("poison",), "collective output")
+        if not actions:
+            self.log.append((iteration, "-", ""))
+        return actions
+
+    def liveness_probe(self, devices, timeout_s: float = 0.0
+                       ) -> Dict[int, bool]:
+        """Injectable stand-in for mesh.probe_liveness on the fake CPU
+        mesh (where every fake device shares one live process): a
+        device is alive iff the schedule has not killed it."""
+        return {int(d.id): int(d.id) not in self.dead for d in devices}
+
+
+def install_device_faults(engine, schedule: DeviceFaultSchedule,
+                          sleep: Callable[[float], None] = time.sleep,
+                          monitor=None):
+    """Wrap ``engine.step`` / ``engine.step_probed`` with the
+    mesh-aware injection shim. Idempotent per engine instance — a
+    repeat call REPLACES the shim (re-wrapping from the original
+    unwrapped methods) instead of stacking, so the schedule is never
+    consulted twice per iteration and the log-reproducibility
+    contract holds. Call it again on the fresh engine after a rescue
+    (ElasticRunner's ``on_rebuild`` hook exists for exactly this).
+
+    Semantics per action at iteration i:
+
+    - kill:   the step raises DeviceLostError BEFORE completing — the
+      device died mid-collective; the elastic runner classifies and
+      rescues;
+    - delay:  the real step runs, then the straggler's extra wall is
+      added via the injectable ``sleep`` (virtual in tests) and the
+      per-device walls are reported to the health ``monitor``
+      (straggler telemetry, never an error);
+    - poison: the real step runs, then the merged output is corrupted
+      (NaN state + NaN info) — the NUMERIC plane's health check +
+      rollback owns this, not the rescue path.
+    """
+    from pagerank_tpu.parallel.elastic import DeviceLostError
+
+    def device_ids():
+        mesh = getattr(engine, "mesh", None)
+        if mesh is None:
+            return [0]
+        return [int(d.id) for d in mesh.devices.reshape(-1)]
+
+    def poison_engine(info):
+        bad = {k: float("nan") for k in info}
+        try:
+            engine.set_ranks(
+                np.asarray(engine.ranks()) * float("nan"),
+                iteration=engine.iteration,
+            )
+        except NotImplementedError:
+            pass
+        return bad
+
+    def apply(actions, info):
+        for act in actions:
+            if act[0] == "delay":
+                sleep(act[2])
+                if monitor is not None:
+                    devs = device_ids()
+                    walls = {d: 0.0 for d in devs}
+                    walls[act[1]] = float(act[2])
+                    monitor.record_device_times(engine.iteration, walls)
+            elif act[0] == "poison":
+                info = poison_engine(info)
+        return info
+
+    def split(actions):
+        kills = [a for a in actions if a[0] == "kill"]
+        rest = [a for a in actions if a[0] != "kill"]
+        return kills, rest
+
+    # Re-installs rewrap from the ORIGINALS (stashed on first install),
+    # never the previous shim — stacking would double-consult the
+    # schedule and break bit-for-bit log reproducibility.
+    orig_step = getattr(engine, "_prefault_step", engine.step)
+    orig_probed = getattr(engine, "_prefault_step_probed",
+                          engine.step_probed)
+    engine._prefault_step = orig_step
+    engine._prefault_step_probed = orig_probed
+
+    def step():
+        kills, rest = split(schedule.decide(engine.iteration, device_ids()))
+        if kills:
+            raise DeviceLostError(
+                f"injected device loss at iteration {engine.iteration} "
+                f"(seed {schedule.seed})",
+                device_ids=[a[1] for a in kills],
+            )
+        return apply(rest, orig_step())
+
+    def step_probed(probes):
+        kills, rest = split(schedule.decide(engine.iteration, device_ids()))
+        if kills:
+            raise DeviceLostError(
+                f"injected device loss at iteration {engine.iteration} "
+                f"(seed {schedule.seed})",
+                device_ids=[a[1] for a in kills],
+            )
+        info, ids = orig_probed(probes)
+        return apply(rest, info), ids
+
+    engine.step = step
+    engine.step_probed = step_probed
+    return engine

@@ -49,10 +49,33 @@ class RobustnessConfig:
     write_attempts: int = 3
     on_write_failure: str = "fail"
 
+    #: Elastic-rescue budget (ISSUE 7, parallel/elastic.py): mesh
+    #: teardown + re-shard + warm-start recoveries the run may perform
+    #: after device losses before raising ElasticExhaustedError. None
+    #: (default) spends the SAME budget class as rollbacks
+    #: (max_rollbacks) — one knob bounds total recovery work unless
+    #: the operator splits them.
+    max_rescues: Optional[int] = None
+
+    #: Straggler-detection threshold (DeviceHealthMonitor): a step
+    #: slower than this factor times the step-time EWMA — but
+    #: COMPLETED — is flagged as slow-step telemetry (elastic.slow_steps
+    #: counter, straggler_skew gauge). Never triggers a rescue.
+    straggler_factor: float = 4.0
+
     def validate(self) -> "RobustnessConfig":
         if self.max_rollbacks < 0:
             raise ValueError(
                 f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if self.max_rescues is not None and self.max_rescues < 0:
+            raise ValueError(
+                f"max_rescues must be >= 0 (None = max_rollbacks), got "
+                f"{self.max_rescues}"
+            )
+        if self.straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {self.straggler_factor}"
             )
         if self.write_attempts < 1:
             raise ValueError(
@@ -74,6 +97,12 @@ class RobustnessConfig:
         if self.write_attempts <= 1:
             return None
         return RetryPolicy(max_attempts=self.write_attempts)
+
+    def rescue_budget(self) -> int:
+        """The resolved elastic-rescue budget (max_rescues, defaulting
+        to the rollback budget — ONE recovery-work bound by default)."""
+        return (self.max_rescues if self.max_rescues is not None
+                else self.max_rollbacks)
 
 
 @dataclass

@@ -27,6 +27,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from pagerank_tpu.obs import log as obs_log
 from pagerank_tpu.obs import metrics as obs_metrics
 from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.utils import fsio
@@ -44,27 +45,66 @@ class SnapshotCorruptError(RuntimeError):
 
 
 def _digest(ranks: np.ndarray, iteration: int, fingerprint: str,
-            semantics: str) -> str:
+            semantics: str, mesh: str = "") -> str:
     """sha256 over the rank payload AND its identifying metadata — a
-    corrupt header is as fatal as corrupt ranks."""
+    corrupt header is as fatal as corrupt ranks. ``mesh`` is the
+    mesh-topology JSON when the snapshot carries one (empty keeps the
+    pre-elastic digest, so older snapshots still verify)."""
     h = hashlib.sha256()
     h.update(
         f"{iteration}|{fingerprint}|{semantics}|"
         f"{ranks.dtype.str}|{ranks.shape}|".encode()
     )
+    if mesh:
+        h.update(f"mesh:{mesh}|".encode())
     h.update(np.ascontiguousarray(ranks).tobytes())
     return h.hexdigest()
+
+
+def _gather_to_host(ranks) -> np.ndarray:
+    """ONE host-resident contiguous buffer from whatever the caller
+    handed us. A sharded engine's rank vector is a jax Array whose
+    shards live across devices — ``np.ascontiguousarray`` on it can
+    tear through per-shard ``__array__`` paths mid-save, and the
+    checksum MUST cover the exact bytes written. Gathering first
+    (``jax.device_get`` assembles addressable shards into one numpy
+    array) makes save/checksum mesh-shape-agnostic: the file always
+    holds the canonical host-order vector regardless of how many
+    devices computed it (docs/ROBUSTNESS.md "Elastic solve")."""
+    if isinstance(ranks, np.ndarray):
+        return np.ascontiguousarray(ranks)
+    if hasattr(ranks, "addressable_shards") or hasattr(ranks, "devices"):
+        import jax
+
+        ranks = jax.device_get(ranks)
+    return np.ascontiguousarray(np.asarray(ranks))
 
 
 class Snapshotter:
     """Writes ``ranks_iter{i}.npz`` files into ``directory`` — a local
     path or any registered URI scheme (utils/fsio; the reference's sink
-    is an S3 bucket, Sparky.java:237)."""
+    is an S3 bucket, Sparky.java:237).
 
-    def __init__(self, directory: str, graph_fingerprint: str, semantics: str):
+    Snapshots are MESH-SHAPE-AGNOSTIC (ISSUE 7): the payload is always
+    the canonical host-order rank vector (``_gather_to_host`` assembles
+    sharded device buffers first), and ``mesh_meta`` — the mesh
+    topology + partition geometry of the engine that produced it
+    (``JaxTpuEngine.snapshot_meta``) — rides as checksummed JSON
+    metadata. A snapshot taken on N devices therefore restores onto
+    any M-device (or single-device) mesh: ``resume_engine`` hands the
+    canonical vector to ``engine.set_ranks``, which re-shards it
+    through the target mesh's own placement (the elastic rescue's
+    warm-start, parallel/elastic.py). ``mesh_meta`` is diagnostic
+    provenance, never a restore constraint."""
+
+    def __init__(self, directory: str, graph_fingerprint: str,
+                 semantics: str, mesh_meta: Optional[Dict] = None):
         self.directory = directory
         self.fingerprint = graph_fingerprint
         self.semantics = semantics
+        #: Provenance recorded in every save (mutable: the elastic
+        #: runner updates it after a rescue re-shards the mesh).
+        self.mesh_meta = mesh_meta
         fsio.makedirs(directory, exist_ok=True)
 
     def path(self, iteration: int) -> str:
@@ -73,6 +113,17 @@ class Snapshotter:
     def save(self, iteration: int, ranks: np.ndarray) -> str:
         p = self.path(iteration)
         with obs_trace.span("snapshot/save", iteration=iteration) as sp:
+            # Gather BEFORE checksumming: a sharded engine's device
+            # array becomes one host buffer, so the digest covers the
+            # exact bytes np.savez writes (the torn-shard hazard).
+            ranks = _gather_to_host(ranks)
+            mesh_json = (
+                json.dumps(self.mesh_meta, sort_keys=True)
+                if self.mesh_meta else ""
+            )
+            members = {}
+            if mesh_json:
+                members["mesh"] = np.bytes_(mesh_json.encode())
             # atomic: a killed run never leaves a torn file under the
             # consumers' name pattern (suffix keeps the historical
             # *.tmp.npz spelling tests/test_hardening.py filters on)
@@ -85,8 +136,9 @@ class Snapshotter:
                     semantics=np.bytes_(self.semantics.encode()),
                     checksum=np.bytes_(
                         _digest(ranks, iteration, self.fingerprint,
-                                self.semantics).encode()
+                                self.semantics, mesh_json).encode()
                     ),
+                    **members,
                 )
                 nbytes = f.tell()
             obs_metrics.counter(
@@ -132,6 +184,14 @@ class Snapshotter:
                     "semantics": bytes(z["semantics"]).decode(),
                     "iteration": int(z["iteration"]),
                 }
+                mesh_json = (
+                    bytes(z["mesh"]).decode() if "mesh" in z.files else ""
+                )
+                # Parsed topology/geometry provenance (None on
+                # pre-elastic snapshots): purely diagnostic — a resume
+                # onto a different mesh shape is the DESIGN, not an
+                # error (docs/ROBUSTNESS.md "Elastic solve").
+                meta["mesh"] = json.loads(mesh_json) if mesh_json else None
                 ranks = z["ranks"].copy()
                 stored = (
                     bytes(z["checksum"]).decode()
@@ -152,7 +212,8 @@ class Snapshotter:
                 )
             else:
                 want = _digest(ranks, meta["iteration"],
-                               meta["fingerprint"], meta["semantics"])
+                               meta["fingerprint"], meta["semantics"],
+                               mesh_json)
                 if stored != want:
                     raise SnapshotCorruptError(
                         f"snapshot {path} failed its checksum "
@@ -532,15 +593,28 @@ class WriterSyncedSnapshotter:
         )
 
 
-def resume_engine(engine, snap: Snapshotter) -> int:
+def resume_engine(engine, snap: Snapshotter, _found=None) -> int:
     """Restore the latest VALID snapshot into ``engine``; returns the
     iteration resumed from (0 if none found). Corrupt or truncated
     snapshots are skipped (warning) and the scan falls back to the
     newest valid one — a damaged snapshot directory costs recovery
     granularity, never the resume. Refuses a snapshot taken on a
     different graph or semantics mode (that is a configuration error,
-    not corruption)."""
-    found = snap.load_latest_valid()
+    not corruption).
+
+    Mesh-shape-AGNOSTIC (ISSUE 7): the payload is the canonical
+    host-order vector, so a snapshot taken on an N-device mesh
+    restores onto whatever mesh ``engine`` runs — ``set_ranks``
+    re-shards through the target's own placement. A shape change is
+    logged (and counted in ``snapshot.mesh_reshards``) for the run
+    report — AFTER the fingerprint/semantics validation, so a refused
+    resume never records a reshard that didn't happen — never
+    refused: it is the elastic rescue's warm-start path
+    (parallel/elastic.py), whose deadline-bounded scan hands the
+    already-loaded result in via ``_found`` so the restore itself
+    always runs on the CALLER's thread (an abandoned scan thread must
+    never be able to set_ranks later)."""
+    found = snap.load_latest_valid() if _found is None else _found
     if found is None:
         return 0
     _it, ranks, meta = found
@@ -556,5 +630,21 @@ def resume_engine(engine, snap: Snapshotter) -> int:
         raise ValueError(
             f"snapshot semantics {meta['semantics']!r} != current {snap.semantics!r}"
         )
+    saved_mesh = meta.get("mesh")
+    engine_mesh = getattr(engine, "mesh", None)
+    if saved_mesh is not None and engine_mesh is not None:
+        saved_nd = saved_mesh.get("num_devices")
+        now_nd = int(engine_mesh.devices.size)
+        if saved_nd is not None and int(saved_nd) != now_nd:
+            obs_metrics.counter(
+                "snapshot.mesh_reshards",
+                "resumes that re-sharded a snapshot onto a different "
+                "mesh shape",
+            ).inc()
+            obs_log.info(
+                f"resuming a {saved_nd}-device snapshot onto a "
+                f"{now_nd}-device mesh (canonical-order payload; "
+                f"set_ranks re-shards)"
+            )
     engine.set_ranks(ranks, iteration=meta["iteration"])
     return meta["iteration"]

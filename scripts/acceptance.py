@@ -41,8 +41,10 @@ split (L1 / build / solve / L4) recorded in BASELINE.md (SURVEY
 Beyond those, the cheap smokes run FIRST in the default order: D
 (build-stage breakdown), G (observability), H (live telemetry), K
 (partition-centric layout: a windowed solve with --probe-every plus
-the contract-sweep coverage assertion — ISSUE 6), F (fault
-injection).
+the contract-sweep coverage assertion — ISSUE 6), L (elastic rescue:
+an 8-fake-device chaos run with one injected device kill that must
+finish on the surviving mesh and match the oracle — ISSUE 7), F
+(fault injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -141,9 +143,21 @@ CONFIGS = {
     # here).
     "K": dict(kind="partitioned", iters=6, probe_every=2, span=512,
               label="partition-centric smoke (windowed solve + contracts)"),
+    # Elastic-rescue smoke (ISSUE 7): an 8-fake-device chaos run with
+    # one seed-deterministic device kill mid-solve — the solve must
+    # FINISH on the surviving mesh (teardown -> re-shard -> warm-start
+    # from the newest snapshot), final ranks must match the f64 CPU
+    # oracle at the standing f32 tolerance, and the run report must
+    # carry the elastic/rescue span + elastic.* counters. Runs
+    # in-process on a CPU backend with >= 2 devices; otherwise
+    # re-invokes itself in a subprocess with the fake-device flags.
+    "L": dict(kind="elastic", iters=12, kill_iter=6, kill_device=2,
+              seed=5,
+              label="elastic-rescue smoke (8-fake-device chaos, "
+                    "one device kill)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "F", "A", "B", "T", "P", "E", "BV",
-                "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "F", "A", "B", "T", "P", "E",
+                "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -549,6 +563,176 @@ def run_live_smoke(key: str):
 
 
 PARTITIONED_SMOKE_BUDGET_S = 120.0
+
+# Budget for the elastic-rescue smoke (seconds, measured around the
+# chaos run itself — NOT the initial engine compile, the f64 oracle
+# pass, or a subprocess fallback's interpreter/jax import): a
+# 12-iteration f32 solve on 1024 vertices with one device kill, one
+# classify + mesh teardown + survivor rebuild + warm-start inside it.
+ELASTIC_SMOKE_BUDGET_S = 3.0
+
+# The standing f32-grade oracle gate (normalized L1): f32 storage +
+# f32 accumulation carries ~1e-7/element rounding; 1e-4 bounds it with
+# margin while still failing any real rescue-path corruption.
+ELASTIC_F32_GATE = 1e-4
+
+
+def run_elastic_smoke(key: str):
+    """ISSUE-7 gate: seed-deterministic device kill mid-solve on the
+    8-fake-device CPU mesh -> classify -> teardown -> re-shard ->
+    warm-start -> FINISH; rank parity vs the f64 oracle at the f32
+    gate; `elastic/rescue` span + `elastic.*` counters in the run
+    report; under ELASTIC_SMOKE_BUDGET_S. When this process's backend
+    cannot host the fake mesh (a live TPU, or fewer than 2 devices),
+    the smoke re-invokes itself in a subprocess with the fake-device
+    flags and adopts the child's record."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        if env.get("PAGERANK_ELASTIC_SMOKE_CHILD"):
+            raise RuntimeError(
+                "elastic smoke child still lacks a multi-device CPU "
+                "backend; refusing to recurse"
+            )
+        env["PAGERANK_ELASTIC_SMOKE_CHILD"] = "1"
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--only", key,
+             "--no-append", "--no-analysis"],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        sys.stderr.write(proc.stderr)
+        try:
+            return json.loads(proc.stdout.strip().splitlines()[-1])[0]
+        except Exception:
+            return {"config": key, "kind": "elastic",
+                    "label": spec["label"], "passed": False,
+                    "error": f"child rc={proc.returncode}"}
+
+    import shutil
+    import tempfile
+    import warnings
+
+    from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
+                              ReferenceCpuEngine, build_graph, obs)
+    from pagerank_tpu.parallel.elastic import (DeviceHealthMonitor,
+                                               ElasticRunner)
+    from pagerank_tpu.testing.faults import (DeviceFaultSchedule,
+                                             install_device_faults)
+    from pagerank_tpu.utils.snapshot import Snapshotter
+
+    iters, seed = spec["iters"], spec["seed"]
+    kill_iter, kill_device = spec["kill_iter"], spec["kill_device"]
+    ndev = min(8, len(jax.devices()))
+    rng = np.random.default_rng(9)
+    n, e = 1024, 8192
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    g = build_graph(src, dst, n=n)
+    cfg = PageRankConfig(num_iters=iters, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev)
+
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    tracer = obs.enable_tracing()
+    work = tempfile.mkdtemp(prefix="pagerank_elastic_")
+    try:
+        snap = Snapshotter(work, g.fingerprint(), "reference")
+        sched = DeviceFaultSchedule(seed=seed,
+                                    kill={kill_iter: kill_device})
+        eng = JaxTpuEngine(cfg).build(g)
+        snap.mesh_meta = eng.snapshot_meta()
+        install_device_faults(eng, sched)
+        # The budget times the CHAOS RUN itself — solve + kill +
+        # classify + teardown + survivor rebuild + warm-start — not
+        # the initial 8-device compile above or the oracle pass below.
+        t0 = time.perf_counter()
+
+        def factory(devs):
+            return JaxTpuEngine(
+                cfg.replace(num_devices=len(devs)), devices=devs
+            ).build(g)
+
+        def rebound(e2):
+            install_device_faults(e2, sched)
+            snap.mesh_meta = e2.snapshot_meta()
+
+        runner = ElasticRunner(
+            eng, factory, snapshotter=snap, max_rescues=2,
+            liveness=sched.liveness_probe,
+            monitor=DeviceHealthMonitor(),
+            on_rebuild=rebound,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ranks = runner.run(
+                on_iteration=lambda i, info: snap.save(
+                    i + 1, runner.engine.ranks()),
+            )
+        t_run = time.perf_counter() - t0
+        report = obs.build_run_report(
+            config=cfg, tracer=tracer, registry=obs.get_registry(),
+            robustness={"rescues": runner.rescues,
+                        "lost_devices": runner.lost_device_ids},
+        )
+    finally:
+        obs.disable_tracing()
+        shutil.rmtree(work, ignore_errors=True)
+    oracle = ReferenceCpuEngine(
+        PageRankConfig(num_iters=iters, dtype="float64",
+                       accum_dtype="float64")
+    ).build(build_graph(src, dst, n=n)).run()
+
+    l1 = float(np.abs(ranks - oracle).sum()) / float(np.abs(oracle).sum())
+    counters = (report.get("metrics") or {}).get("counters") or {}
+    elastic_counters = {k: v for k, v in counters.items()
+                        if k.startswith("elastic.")}
+    rescue_span = "elastic/rescue" in (report.get("spans") or {})
+    passed = bool(
+        runner.rescues == 1
+        and runner.engine.mesh.devices.size == ndev - 1
+        and l1 <= ELASTIC_F32_GATE
+        and rescue_span
+        and elastic_counters.get("elastic.rescues") == 1
+        and elastic_counters.get("elastic.devices_lost") == 1
+        and t_run <= ELASTIC_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "elastic",
+        "label": spec["label"],
+        "iters": iters,
+        "devices": ndev,
+        "kill": {"iteration": kill_iter, "device": kill_device},
+        "rescues": runner.rescues,
+        "surviving_devices": int(runner.engine.mesh.devices.size),
+        "normalized_l1": l1,
+        "gate": ELASTIC_F32_GATE,
+        "rescue_span_ok": rescue_span,
+        "elastic_counters": elastic_counters,
+        "seconds": t_run,
+        "budget_s": ELASTIC_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] kill dev {kill_device} @ iter {kill_iter} on {ndev} "
+        f"fake devices: {runner.rescues} rescue(s), finished on "
+        f"{rec['surviving_devices']} device(s); oracle L1 {l1:.3e} vs "
+        f"gate {ELASTIC_F32_GATE:g}; rescue span "
+        f"{'OK' if rescue_span else 'MISSING'}; counters "
+        f"{sorted(elastic_counters)}; {t_run:.2f}s vs budget "
+        f"{ELASTIC_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
 
 
 def run_partitioned_smoke(key: str):
@@ -1131,7 +1315,8 @@ def main(argv=None) -> int:
     keys = [args.only] if args.only else DEFAULT_KEYS
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
                "faults": run_fault_smoke, "obs": run_obs_smoke,
-               "live": run_live_smoke, "partitioned": run_partitioned_smoke}
+               "live": run_live_smoke, "partitioned": run_partitioned_smoke,
+               "elastic": run_elastic_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

@@ -1,0 +1,628 @@
+"""Elastic multi-device solve tests (ISSUE 7; docs/ROBUSTNESS.md
+"Elastic solve"): the watchdog->rescue handoff in virtual time, a
+seed-deterministic device kill that rescues onto the degraded mesh and
+still matches the CPU oracle, straggler delays that produce telemetry
+but never a rescue, bit-for-bit schedule reproducibility, and
+mesh-shape-agnostic snapshots (8-device save -> 1-device resume,
+bit-identical at f32 grade)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
+from pagerank_tpu.engines.cpu import ReferenceCpuEngine
+from pagerank_tpu.obs import live as obs_live
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.parallel import mesh as mesh_lib
+from pagerank_tpu.parallel.elastic import (
+    DeviceHealthMonitor,
+    DeviceLostError,
+    ElasticExhaustedError,
+    ElasticRunner,
+    looks_like_device_loss,
+)
+from pagerank_tpu.testing.faults import (
+    DeviceFaultSchedule,
+    install_device_faults,
+)
+from pagerank_tpu.utils.retry import RetryPolicy
+from pagerank_tpu.utils.snapshot import Snapshotter, resume_engine
+
+NDEV = len(jax.devices())
+
+
+def _graph(seed=7, n=512, e=4096):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+def _f32_cfg(ndev, iters=12):
+    return PageRankConfig(num_iters=iters, dtype="float32",
+                          accum_dtype="float32", num_devices=ndev)
+
+
+def _oracle(graph, iters=12):
+    cfg = PageRankConfig(num_iters=iters, dtype="float64",
+                         accum_dtype="float64")
+    return ReferenceCpuEngine(cfg).build(graph).run()
+
+
+def _runner(graph, cfg, snap, sched, **kw):
+    """ElasticRunner over a fresh engine with the fault shim installed
+    (and re-installed on every rebuilt engine), the schedule's own
+    liveness probe, and per-iteration snapshots."""
+    eng = JaxTpuEngine(cfg).build(graph)
+    if snap is not None:
+        snap.mesh_meta = eng.snapshot_meta()
+    shim_kw = {}
+    if "sleep" in kw:
+        shim_kw["sleep"] = kw.pop("sleep")
+    if "monitor" in kw:
+        # The monitor is shared: the shim reports per-device walls to
+        # it AND the runner drives its step timing.
+        shim_kw["monitor"] = kw["monitor"]
+    install_device_faults(eng, sched, **shim_kw)
+
+    def factory(devs):
+        return JaxTpuEngine(
+            cfg.replace(num_devices=len(devs)), devices=devs
+        ).build(graph)
+
+    def rebound(e2):
+        install_device_faults(e2, sched)
+        if snap is not None:
+            snap.mesh_meta = e2.snapshot_meta()
+
+    return ElasticRunner(
+        eng, factory, snapshotter=snap,
+        liveness=sched.liveness_probe, on_rebuild=rebound, **kw
+    )
+
+
+# -- watchdog -> rescue handoff (virtual time) ------------------------------
+
+
+def test_watchdog_rescue_handshake_virtual_time():
+    t = {"now": 0.0}
+    fired = []
+    wd = obs_live.StallWatchdog(
+        5.0, action="rescue", clock=lambda: t["now"],
+        sleep=lambda s: None, interrupt=lambda: fired.append(1),
+    )
+    wd.heartbeat(0)
+    t["now"] = 3.0
+    assert wd.check() is False
+    assert not wd.rescue_requested
+    t["now"] = 9.0
+    assert wd.check() is True
+    assert fired == [1]
+    assert wd.rescue_requested
+    # CPU fake devices all answer their liveness echo: classified hang.
+    assert "hang" in wd.last_classification
+    # One-shot handshake: reading consumes.
+    assert wd.consume_rescue() is True
+    assert wd.consume_rescue() is False
+    # One diagnostic per episode; a heartbeat re-arms.
+    assert wd.check() is False
+    wd.heartbeat(1)
+    t["now"] = 20.0
+    assert wd.check() is True
+
+
+def test_watchdog_rejects_unknown_action():
+    with pytest.raises(ValueError):
+        obs_live.StallWatchdog(1.0, action="reboot")
+
+
+def test_watchdog_fire_hands_off_to_runner_rescue():
+    """The full handoff: engine.run is interrupted (the watchdog's
+    rescue fire), the runner consumes the request, probes liveness,
+    finds a casualty, and rebuilds over the survivors."""
+    mesh = mesh_lib.make_mesh(min(2, NDEV))
+    sentinel = np.arange(4.0)
+
+    class Wedged:
+        def __init__(self):
+            self.mesh = mesh
+
+        def run(self, **kw):
+            raise KeyboardInterrupt  # the watchdog's interrupt_main
+
+    class Good:
+        def __init__(self, devs):
+            self.mesh = mesh_lib.make_mesh(len(devs), devices=devs)
+
+        def run(self, **kw):
+            return sentinel
+
+    wd = obs_live.StallWatchdog(1.0, action="rescue",
+                                interrupt=lambda: None)
+    wd.rescue_requested = True
+    prev = obs_live._WATCHDOG
+    obs_live._WATCHDOG = wd  # armed, but no poll thread
+    try:
+        dead_id = int(mesh.devices.reshape(-1)[0].id)
+        runner = ElasticRunner(
+            Wedged(), lambda devs: Good(devs), snapshotter=None,
+            max_rescues=1,
+            liveness=lambda devs, t: {
+                int(d.id): int(d.id) != dead_id for d in devs
+            },
+        )
+        out = runner.run()
+    finally:
+        obs_live._WATCHDOG = prev
+    assert out is sentinel
+    assert runner.rescues == 1
+    assert runner.lost_device_ids == [dead_id]
+    assert not wd.rescue_requested  # consumed
+
+
+def test_watchdog_fire_on_live_mesh_is_not_rescued():
+    """A stall with every device answering its probe is a HANG: the
+    runner must surface it, never tear down a live mesh."""
+    mesh = mesh_lib.make_mesh(min(2, NDEV))
+
+    class Wedged:
+        def __init__(self):
+            self.mesh = mesh
+
+        def run(self, **kw):
+            raise KeyboardInterrupt
+
+    wd = obs_live.StallWatchdog(1.0, action="rescue",
+                                interrupt=lambda: None)
+    wd.rescue_requested = True
+    prev = obs_live._WATCHDOG
+    obs_live._WATCHDOG = wd
+    try:
+        runner = ElasticRunner(
+            Wedged(), lambda devs: None, snapshotter=None,
+            liveness=lambda devs, t: {int(d.id): True for d in devs},
+        )
+        with pytest.raises(RuntimeError, match="hang, not device loss"):
+            runner.run()
+    finally:
+        obs_live._WATCHDOG = prev
+    assert runner.rescues == 0
+
+
+def test_plain_keyboard_interrupt_propagates():
+    """No watchdog rescue request -> a KeyboardInterrupt is the
+    user's ctrl-C, not a stall signal."""
+    mesh = mesh_lib.make_mesh(min(2, NDEV))
+
+    class Wedged:
+        def __init__(self):
+            self.mesh = mesh
+
+        def run(self, **kw):
+            raise KeyboardInterrupt
+
+    runner = ElasticRunner(Wedged(), lambda devs: None, snapshotter=None)
+    with pytest.raises(KeyboardInterrupt):
+        runner.run()
+
+
+# -- device kill -> rescue -> oracle parity ---------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_device_kill_rescues_on_degraded_mesh_and_matches_oracle(tmp_path):
+    g = _graph()
+    iters = 12
+    cfg = _f32_cfg(min(8, NDEV), iters)
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    sched = DeviceFaultSchedule(seed=5, kill={6: 1})
+    runner = _runner(g, cfg, snap, sched, max_rescues=2)
+    ndev0 = runner.engine.mesh.devices.size
+
+    ranks = runner.run(
+        on_iteration=lambda i, info: snap.save(i + 1,
+                                               runner.engine.ranks())
+    )
+    assert runner.rescues == 1
+    assert runner.lost_device_ids == [1]
+    assert runner.engine.mesh.devices.size == ndev0 - 1
+    assert runner.engine.iteration == iters
+    # The post-rescue snapshots record the DEGRADED mesh.
+    assert snap.mesh_meta["num_devices"] == ndev0 - 1
+    _, meta = snap.load(iters)
+    assert meta["mesh"]["num_devices"] == ndev0 - 1
+    oracle = _oracle(g, iters)
+    l1 = np.abs(ranks - oracle).sum() / np.abs(oracle).sum()
+    assert l1 <= 1e-4  # the standing f32-grade gate
+
+
+@pytest.mark.skipif(NDEV < 3, reason="needs >= 3 fake devices")
+def test_rescue_budget_exhausted_raises(tmp_path):
+    g = _graph()
+    cfg = _f32_cfg(min(8, NDEV), 12)
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    sched = DeviceFaultSchedule(seed=5, kill={3: 0, 7: 1})
+    runner = _runner(g, cfg, snap, sched, max_rescues=1)
+    with pytest.raises(ElasticExhaustedError) as ei:
+        runner.run(on_iteration=lambda i, info: snap.save(
+            i + 1, runner.engine.ranks()))
+    assert ei.value.rescues == 1
+    assert set(ei.value.lost_device_ids) == {0, 1}
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_rescue_without_snapshot_restarts_from_r0(tmp_path):
+    """No valid snapshot to warm-start from: the rescue restarts the
+    solve from the initial vector on the degraded mesh (counted in
+    elastic.restarts) and still converges to the oracle."""
+    g = _graph()
+    iters = 10
+    cfg = _f32_cfg(min(8, NDEV), iters)
+    sched = DeviceFaultSchedule(seed=5, kill={4: 1})
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    runner = _runner(g, cfg, snap, sched, max_rescues=1)
+    ranks = runner.run()  # on_iteration never saves -> empty dir
+    assert runner.rescues == 1
+    assert runner.restarts == 1
+    oracle = _oracle(g, iters)
+    l1 = np.abs(ranks - oracle).sum() / np.abs(oracle).sum()
+    assert l1 <= 1e-4
+
+
+# -- stragglers: telemetry, never rescue ------------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_straggler_delay_is_telemetry_not_rescue(tmp_path):
+    g = _graph()
+    iters = 10
+    cfg = _f32_cfg(min(8, NDEV), iters)
+    obs_metrics.get_registry().reset()
+
+    # Virtual time: injected delays advance the monitor's clock; real
+    # steps cost zero virtual seconds.
+    vt = {"now": 0.0}
+    monitor = DeviceHealthMonitor(straggler_factor=3.0, warmup_steps=1,
+                                  clock=lambda: vt["now"])
+    sched = DeviceFaultSchedule(seed=3, delay={5: (1, 10.0)})
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    runner = _runner(
+        g, cfg, snap, sched, max_rescues=1, monitor=monitor,
+        sleep=lambda s: vt.__setitem__("now", vt["now"] + s),
+    )
+    ranks = runner.run()
+    assert runner.rescues == 0  # a slow step is NOT a dead device
+    assert runner.engine.mesh.devices.size == min(8, NDEV)
+    assert monitor.slow_steps >= 1
+    snap_counters = obs_metrics.get_registry().snapshot()["counters"]
+    assert snap_counters.get("elastic.slow_steps", 0) >= 1
+    assert "elastic.rescues" not in snap_counters
+    # The delay changes no math: bit-identical to a fault-free run.
+    clean = JaxTpuEngine(cfg).build(g).run()
+    np.testing.assert_array_equal(ranks, clean)
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_poison_routes_to_rollback_not_rescue(tmp_path):
+    """A poisoned collective output (NaN state) is the NUMERIC plane's
+    problem: health check -> snapshot rollback inside engine.run; the
+    rescue path must stay cold."""
+    g = _graph()
+    iters = 10
+    cfg = _f32_cfg(min(8, NDEV), iters)
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    sched = DeviceFaultSchedule(seed=11, poison=[5])
+    runner = _runner(g, cfg, snap, sched, max_rescues=1)
+    ranks = runner.run(
+        on_iteration=lambda i, info: snap.save(i + 1,
+                                               runner.engine.ranks())
+    )
+    assert runner.rescues == 0
+    assert runner.engine.health["rollbacks"] >= 1
+    oracle = _oracle(g, iters)
+    l1 = np.abs(ranks - oracle).sum() / np.abs(oracle).sum()
+    assert l1 <= 1e-4
+
+
+# -- determinism ------------------------------------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_same_seed_schedule_reproduces_bit_for_bit(tmp_path):
+    g = _graph()
+    cfg = _f32_cfg(min(8, NDEV), 12)
+
+    def chaos(run_id):
+        snap = Snapshotter(str(tmp_path / f"run{run_id}"),
+                           g.fingerprint(), "reference")
+        sched = DeviceFaultSchedule(seed=23, kill={7: 2},
+                                    delay={3: (0, 0.0)}, poison=[5])
+        runner = _runner(g, cfg, snap, sched, max_rescues=2,
+                         sleep=lambda s: None)
+        ranks = runner.run(on_iteration=lambda i, info: snap.save(
+            i + 1, runner.engine.ranks()))
+        return ranks, list(sched.log), runner.rescues
+
+    r1, log1, resc1 = chaos(1)
+    r2, log2, resc2 = chaos(2)
+    assert log1 == log2
+    assert resc1 == resc2 == 1
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_schedule_rate_faults_are_pure_function_of_seed_iteration():
+    devs = list(range(8))
+    a = DeviceFaultSchedule(seed=9, kill_rate=0.2, max_faults=3)
+    b = DeviceFaultSchedule(seed=9, kill_rate=0.2, max_faults=3)
+    for i in range(30):
+        assert a.decide(i, devs) == b.decide(i, devs)
+    assert a.log == b.log
+    assert a.dead == b.dead
+    # Re-consulting an iteration (post-rescue recompute) does not
+    # re-fire its one-shot faults.
+    before = set(a.dead)
+    for i in range(30):
+        for act in a.decide(i, devs):
+            assert act[0] != "kill"
+    assert a.dead == before
+
+
+def test_looks_like_device_loss_is_narrow():
+    assert looks_like_device_loss(DeviceLostError("x", [1]))
+    assert looks_like_device_loss(RuntimeError("DEVICE_LOST: chip 3"))
+    assert not looks_like_device_loss(ValueError("bad shape"))
+    assert not looks_like_device_loss(RuntimeError("divide by zero"))
+
+
+# -- mesh-agnostic snapshots ------------------------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_snapshot_8dev_resumes_on_1dev_bit_identical_f32(tmp_path):
+    g = _graph()
+    cfg = _f32_cfg(min(8, NDEV), 6)
+    eng = JaxTpuEngine(cfg).build(g)
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference",
+                       mesh_meta=eng.snapshot_meta())
+    eng.run(on_iteration=lambda i, info: snap.save(i + 1, eng.ranks()))
+    r_n = eng.ranks()
+
+    e1 = JaxTpuEngine(cfg.replace(num_devices=1)).build(g)
+    it = resume_engine(e1, snap)
+    assert it == 6
+    np.testing.assert_array_equal(e1.ranks(), r_n)  # bit-identical f32
+    # Provenance: the snapshot knows which mesh produced it.
+    _, meta = snap.load(6)
+    assert meta["mesh"]["num_devices"] == min(8, NDEV)
+    assert meta["mesh"]["layout"]["form"] is not None
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_snapshot_1dev_resumes_on_ndev(tmp_path):
+    """The other direction: a single-device snapshot re-shards onto a
+    multi-device mesh and the counter records the re-shard."""
+    g = _graph()
+    cfg = _f32_cfg(1, 5)
+    eng = JaxTpuEngine(cfg).build(g)
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference",
+                       mesh_meta=eng.snapshot_meta())
+    eng.run(on_iteration=lambda i, info: snap.save(i + 1, eng.ranks()))
+    r1 = eng.ranks()
+
+    obs_metrics.get_registry().reset()
+    en = JaxTpuEngine(cfg.replace(num_devices=min(8, NDEV))).build(g)
+    assert resume_engine(en, snap) == 5
+    np.testing.assert_array_equal(en.ranks(), r1)
+    counters = obs_metrics.get_registry().snapshot()["counters"]
+    assert counters.get("snapshot.mesh_reshards") == 1
+
+
+# -- mesh liveness primitives -----------------------------------------------
+
+
+def test_run_with_deadline_and_liveness_probe():
+    assert mesh_lib.run_with_deadline(lambda: 42, 5.0) == 42
+    with pytest.raises(mesh_lib.DeadlineExpired):
+        import time as _time
+
+        mesh_lib.run_with_deadline(lambda: _time.sleep(5), 0.05)
+    with pytest.raises(ZeroDivisionError):
+        mesh_lib.run_with_deadline(lambda: 1 // 0, 5.0)
+    alive = mesh_lib.probe_liveness(timeout_s=10.0)
+    assert set(alive) == {d.id for d in jax.devices()}
+    assert all(alive.values())
+
+
+def test_surviving_devices():
+    devs = jax.devices()
+    out = mesh_lib.surviving_devices([devs[0].id], devs)
+    assert devs[0] not in out and len(out) == len(devs) - 1
+    with pytest.raises(RuntimeError):
+        mesh_lib.surviving_devices([d.id for d in devs], devs)
+
+
+# -- distributed-init retry (satellite) -------------------------------------
+
+
+def test_distributed_init_retries_transient_coordinator_race():
+    from pagerank_tpu.parallel.distributed import (
+        maybe_initialize_distributed)
+
+    obs_metrics.get_registry().reset()
+    calls = {"n": 0}
+
+    def flaky_init(**kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError("connection refused")
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.0,
+                         sleep=lambda s: None, seed=0)
+    ok = maybe_initialize_distributed(
+        coordinator_address="127.0.0.1:9999", num_processes=1,
+        process_id=0, retry_policy=policy, _initialize=flaky_init,
+    )
+    assert ok and calls["n"] == 3
+    counters = obs_metrics.get_registry().snapshot()["counters"]
+    assert counters.get("distributed.init_retries") == 2
+
+
+def test_distributed_init_does_not_retry_config_errors():
+    from pagerank_tpu.parallel.distributed import (
+        maybe_initialize_distributed)
+
+    calls = {"n": 0}
+
+    def bad_config(**kw):
+        calls["n"] += 1
+        raise ValueError("process_id out of range")
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.0,
+                         sleep=lambda s: None)
+    with pytest.raises(ValueError):
+        maybe_initialize_distributed(
+            coordinator_address="127.0.0.1:9999", num_processes=1,
+            process_id=7, retry_policy=policy, _initialize=bad_config,
+        )
+    assert calls["n"] == 1
+
+
+# -- config knobs -----------------------------------------------------------
+
+
+def test_rescue_budget_config():
+    from pagerank_tpu.utils.config import RobustnessConfig
+
+    rb = RobustnessConfig().validate()
+    assert rb.rescue_budget() == rb.max_rollbacks
+    assert RobustnessConfig(max_rescues=7).validate().rescue_budget() == 7
+    with pytest.raises(ValueError):
+        RobustnessConfig(max_rescues=-1).validate()
+    with pytest.raises(ValueError):
+        RobustnessConfig(straggler_factor=1.0).validate()
+
+
+# -- CLI surface ------------------------------------------------------------
+
+
+def test_cli_rescue_rejects_fused_and_device_build(capsys):
+    from pagerank_tpu.cli import main as cli_main
+
+    rc = cli_main(["--synthetic", "uniform:256:1024", "--stall-action",
+                   "rescue", "--fused"])
+    assert rc == 2
+    assert "rescue" in capsys.readouterr().err
+    rc = cli_main(["--synthetic", "uniform:256:1024", "--stall-action",
+                   "rescue", "--engine", "cpu"])
+    assert rc == 2
+
+
+def test_cli_rescue_path_solves_clean(tmp_path):
+    """--stall-action rescue with no faults: the elastic runner drives
+    a plain solve to the same result as the default path."""
+    from pagerank_tpu.cli import main as cli_main
+
+    out_a = tmp_path / "a.tsv"
+    out_b = tmp_path / "b.tsv"
+    args = ["--synthetic", "uniform:256:1024", "--iters", "5",
+            "--log-every", "0", "--snapshot-dir"]
+    rc = cli_main(args + [str(tmp_path / "ck_a"), "--stall-action",
+                          "rescue", "--out", str(out_a)])
+    assert rc == 0
+    rc = cli_main(args + [str(tmp_path / "ck_b"), "--out", str(out_b)])
+    assert rc == 0
+    assert out_a.read_text() == out_b.read_text()
+
+
+# -- review regressions -----------------------------------------------------
+
+
+def test_install_device_faults_is_idempotent():
+    """A repeat install (same engine) must REPLACE the shim, not stack
+    it — a stacked shim consults the schedule twice per iteration and
+    silently breaks bit-for-bit log reproducibility."""
+    g = _graph()
+    cfg = _f32_cfg(min(2, NDEV), 3)
+    eng = JaxTpuEngine(cfg).build(g)
+    sched = DeviceFaultSchedule(seed=1)
+    install_device_faults(eng, sched)
+    install_device_faults(eng, sched)  # idempotent, not double-wrap
+    eng.run()
+    assert len(sched.log) == 3  # one decision per iteration, not two
+
+    ref = DeviceFaultSchedule(seed=1)
+    e2 = JaxTpuEngine(cfg).build(g)
+    install_device_faults(e2, ref)
+    e2.run()
+    assert sched.log == ref.log
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_rescue_abandons_blocked_warm_start_scan(tmp_path):
+    """A warm-start source that cannot answer (the async-writer flush
+    blocked on a dead-device decode) must not wedge the rescue: past
+    resume_timeout_s the scan is abandoned and the solve restarts
+    from r0 on the fresh mesh."""
+    import time as _time
+
+    g = _graph()
+    iters = 8
+    cfg = _f32_cfg(min(8, NDEV), iters)
+    inner = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+
+    class BlockedSnap:
+        """Duck-typed rollback/warm-start source whose scan blocks
+        far past the rescue's deadline."""
+
+        fingerprint = inner.fingerprint
+        semantics = inner.semantics
+        mesh_meta = None
+
+        def load_latest_valid(self, **kw):
+            _time.sleep(30)
+            return inner.load_latest_valid(**kw)
+
+    sched = DeviceFaultSchedule(seed=5, kill={4: 1})
+    eng = JaxTpuEngine(cfg).build(g)
+    install_device_faults(eng, sched)
+
+    def factory(devs):
+        return JaxTpuEngine(
+            cfg.replace(num_devices=len(devs)), devices=devs
+        ).build(g)
+
+    runner = ElasticRunner(
+        eng, factory, snapshotter=BlockedSnap(), max_rescues=1,
+        resume_timeout_s=0.2, liveness=sched.liveness_probe,
+        on_rebuild=lambda e2: install_device_faults(e2, sched),
+    )
+    t0 = _time.monotonic()
+    ranks = runner.run(on_iteration=lambda i, info: inner.save(
+        i + 1, runner.engine.ranks()))
+    assert _time.monotonic() - t0 < 20  # never waited out the block
+    assert runner.rescues == 1
+    assert runner.restarts == 1  # scan abandoned -> r0 restart
+    oracle = _oracle(g, iters)
+    l1 = np.abs(ranks - oracle).sum() / np.abs(oracle).sum()
+    assert l1 <= 1e-4
+
+
+def test_watchdog_classifies_the_solve_mesh_only(monkeypatch):
+    """Classification must probe the SOLVE MESH's devices (the
+    device_source), not every visible chip — a wedged device the
+    solve never uses must not read as OUR device loss."""
+    mesh = mesh_lib.make_mesh(min(2, NDEV))
+    mesh_devs = list(mesh.devices.reshape(-1))
+    seen = {}
+
+    def fake_probe(devices=None, timeout_s=2.0):
+        seen["devices"] = devices
+        return {int(d.id): True for d in (devices or [])}
+
+    monkeypatch.setattr(mesh_lib, "probe_liveness", fake_probe)
+    wd = obs_live.StallWatchdog(
+        1.0, action="rescue", interrupt=lambda: None,
+        device_source=lambda: mesh_devs,
+    )
+    assert "hang" in wd._classify()
+    assert seen["devices"] == mesh_devs
