@@ -23,6 +23,11 @@ paths):
   coo                     — segment-sum baseline
   device_build            — build_device (presentinel) + ell step
   vertex_sharded (+ms)    — sharded state, all_gather/reduce_scatter
+  vs_halo                 — sparse boundary exchange (ISSUE 8): head
+                            psum + static ppermute halo/band rounds;
+                            the budget reflects the SMALLER collectives
+                            (one round per active offset, no dense
+                            all_gather/reduce_scatter)
   vs_bounded (+ms)        — owner-computes, per-stripe z psums
 
 Rule ids: PTC001 collective budget, PTC002 f64 promotion, PTC003
@@ -324,6 +329,13 @@ def engine_forms(ndev: int) -> List[Form]:
         Form("vs_multi_dispatch", lambda: Scan(cfg(
             vertex_sharded=True,
         )).build(g), True),
+        # Sparse boundary exchange (ISSUE 8): halo_head pinned explicit
+        # so the head psum is always in the traced budget (the auto
+        # rule legitimately resolves K=0 on this tiny graph at 2 fake
+        # devices, where no vertex has enough remote readers).
+        Form("vs_halo", lambda: Eng(cfg(
+            vertex_sharded=True, halo_exchange=True, halo_head=128,
+        )).build(g), True),
         Form("vs_bounded", lambda: Eng(cfg(
             vertex_sharded=True, vs_bounded=True,
         )).build(g), True),
@@ -387,6 +399,22 @@ def expected_collectives(engine, form: str) -> Dict[str, int]:
     merge = {"reduce_scatter": 1} if use_rs else {"psum": 1}
     if form in ("vertex_sharded", "vs_multi_dispatch"):
         return {"all_gather": 1, **merge}
+    if form == "vs_halo":
+        # The sparse boundary exchange (ISSUE 8): NO dense
+        # all_gather/reduce_scatter — one ppermute per active
+        # read/write round (static at build, from the halo plan this
+        # exact engine carries) plus the head-replication psum. The
+        # budget is read off the plan so a layout change that silently
+        # reintroduces a dense collective (or doubles the rounds)
+        # fails here.
+        plan = engine._halo_plan
+        rounds = len(plan.read_rounds) + len(plan.write_rounds)
+        out: Dict[str, int] = {}
+        if rounds:
+            out["ppermute"] = rounds
+        if plan.head_k:
+            out["psum"] = 1
+        return out
     if form == "vs_bounded":
         return {"psum": n_stripes}
     if form == "vsb_multi_dispatch":
@@ -463,9 +491,12 @@ def check_engine_form(form: Form) -> List[Finding]:
                 ))
 
     # PTC003 (structural) — the step's donated rank buffer must match
-    # an output aval exactly, or the donation silently no-ops. (On
-    # multi-dispatch layouts the donated buffer lives in the finalize
-    # dispatch; the warning capture above covers it.)
+    # an output aval exactly, or the donation silently no-ops. On
+    # multi-dispatch layouts (the vertex-sharded forms included,
+    # ISSUE 8 satellite) the donated buffer lives in the FINALIZE
+    # dispatch — the same structural matching runs against it, so an
+    # unconsumable rank donation in any dispatch form fails analysis
+    # instead of warning at scale (the MULTICHIP_r05 tail class).
     if engine._ms_stripe is None:
         args = engine._device_args()
         out_avals = jax.tree_util.tree_leaves(
@@ -480,6 +511,30 @@ def check_engine_form(form: Form) -> List[Finding]:
                 "PTC003",
                 "donated rank buffer has no matching output aval: "
                 "donation can never be consumed",
+                form.name,
+            ))
+    else:
+        zs = engine._ms_prescale(engine._r, engine._inv_out)
+        parts = [
+            engine._ms_stripe_fns[s](
+                *zs, engine._src[s], engine._row_block[s]
+            )
+            for s in range(engine._ms_n_stripes)
+        ]
+        final_args = (engine._r, *parts, *engine._ms_ids,
+                      engine._dangling, engine._zero_in, engine._valid)
+        out_avals = jax.tree_util.tree_leaves(
+            jax.eval_shape(engine._ms_final, *final_args)
+        )
+        r_aval = (tuple(engine._r.shape), np.dtype(engine._r.dtype))
+        if not any(
+            (tuple(o.shape), np.dtype(o.dtype)) == r_aval
+            for o in out_avals
+        ):
+            findings.append(_finding(
+                "PTC003",
+                "finalize's donated rank buffer has no matching output "
+                "aval: donation can never be consumed",
                 form.name,
             ))
 

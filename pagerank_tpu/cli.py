@@ -82,6 +82,24 @@ def build_parser() -> argparse.ArgumentParser:
              "1/num_devices (jax engine, ell kernel)",
     )
     p.add_argument(
+        "--halo-exchange", action="store_true",
+        help="with --vertex-sharded: sparse boundary exchange "
+             "(ISSUE 8) — replace the dense all_gather + reduce-"
+             "scatter with build-time halo tables (head-replication "
+             "psum + static ppermute rounds), so per-iteration "
+             "exchanged bytes scale with the boundary instead of n; "
+             "comms.* counters report the model (downgrades to the "
+             "dense exchange on multi-dispatch layouts)",
+    )
+    p.add_argument(
+        "--halo-head", type=int, default=-1,
+        help="head-replication K for --halo-exchange: -1 auto (the "
+             "in-degree prefix whose replication MINIMIZES the "
+             "modeled exchange bytes over the build-time read sets — "
+             "may resolve to 0 on mild graphs), 0 off, >0 explicit "
+             "(rounded up to a multiple of 128)",
+    )
+    p.add_argument(
         "--vs-bounded", action="store_true",
         help="with --vertex-sharded: bound per-chip STEP transients too "
              "(destination-partitioned slot rows + per-stripe z "
@@ -909,6 +927,8 @@ def _main(argv, ctx) -> int:
         num_devices=args.num_devices,
         vertex_sharded=args.vertex_sharded,
         vs_bounded=args.vs_bounded,
+        halo_exchange=args.halo_exchange,
+        halo_head=args.halo_head,
         snapshot_dir=args.snapshot_dir,
         snapshot_every=args.snapshot_every,
         log_every=args.log_every,

@@ -116,6 +116,13 @@ class JaxTpuEngine(PageRankEngine):
         # — including a pallas->ell probe fallback.
         self._layout: Dict[str, object] = {}
         self._kernel_requested: Optional[str] = None
+        # Comms accounting (ISSUE 8, parallel/comms.py): filled by the
+        # vertex-sharded setups; None/0 for replicated forms (no
+        # per-vertex exchange to model).
+        self._comms_model: Optional[Dict[str, object]] = None
+        self._comms_counter = None
+        self._comms_bytes_per_iter = 0
+        self._halo_plan = None
 
     # -- build ------------------------------------------------------------
 
@@ -1817,19 +1824,6 @@ class JaxTpuEngine(PageRankEngine):
         vs_tail = self._make_vs_tail(accum, n)
         self._vs_tail = vs_tail
 
-        def gather_z(r_l, inv_l):
-            """Steps 1-2: sharded prescale + tiled all_gather; returns
-            the gather plane tuple (split AFTER the gather in pair mode
-            so one f64 vector crosses ICI, not two f32 planes plus a
-            second launch)."""
-            z_l = r_l.astype(inv_l.dtype) * inv_l
-            z = jax.lax.all_gather(z_l, axis, tiled=True)  # [n_vs]
-            if total_z > n_vs:
-                z = jnp.concatenate(
-                    [z, jnp.zeros(total_z - n_vs, z.dtype)]
-                )
-            return _split_pair(z) if pair else (z,)
-
         # XLA-TPU's X64 rewriter implements f64 all-reduce but NOT f64
         # reduce-scatter (probed on the current libtpu: "While rewriting
         # computation to not contain X64 element types ... not
@@ -1844,6 +1838,58 @@ class JaxTpuEngine(PageRankEngine):
             or jax.default_backend() != "tpu"
         )
         blk = n_vs // ndev
+
+        # Sparse boundary exchange (ISSUE 8): resolve whether this
+        # build runs the halo-exchange step instead of the dense
+        # all_gather + reduce-scatter below. Downgrades (logged +
+        # recorded in layout_info) keep the solve correct on layouts
+        # the sparse form does not cover: the multi-dispatch stripes
+        # (its per-stripe executables consume replicated z planes) and
+        # TPU backends with a 64-bit exchanged dtype (the same X64
+        # rewrite gap class as the reduce-scatter above — ppermute/psum
+        # of f64 payloads is exactly what the rewriter lacks).
+        halo = bool(cfg.halo_exchange)
+        halo_note = None
+        if halo and multi_dispatch:
+            halo, halo_note = False, "multi_dispatch"
+        if halo and jax.default_backend() == "tpu" and (
+            jnp.dtype(self._inv_out.dtype).itemsize == 8
+            or jnp.dtype(accum).itemsize == 8
+        ):
+            halo, halo_note = False, "wide_dtype_tpu"
+        if halo_note:
+            obs_log.warn(
+                f"halo_exchange downgraded to the dense exchange "
+                f"({halo_note})"
+            )
+            self._layout = dict(self._layout, halo=f"off:{halo_note}")
+        if halo:
+            self._setup_vs_halo(
+                n_stripes=n_stripes, sz=sz, group=group, pair=pair,
+                accum=accum, ids=ids, n_vs=n_vs, padv=padv, blk=blk,
+                total_z=total_z, use_rs=use_rs,
+                accumulate_stripes=accumulate_stripes, vs_tail=vs_tail,
+            )
+            return
+        from pagerank_tpu.parallel import comms as comms_lib
+
+        self._set_comms_model(comms_lib.model_dense(
+            ndev, blk, jnp.dtype(self._inv_out.dtype).itemsize,
+            jnp.dtype(accum).itemsize, use_rs,
+        ))
+
+        def gather_z(r_l, inv_l):
+            """Steps 1-2: sharded prescale + tiled all_gather; returns
+            the gather plane tuple (split AFTER the gather in pair mode
+            so one f64 vector crosses ICI, not two f32 planes plus a
+            second launch)."""
+            z_l = r_l.astype(inv_l.dtype) * inv_l
+            z = jax.lax.all_gather(z_l, axis, tiled=True)  # [n_vs]
+            if total_z > n_vs:
+                z = jnp.concatenate(
+                    [z, jnp.zeros(total_z - n_vs, z.dtype)]
+                )
+            return _split_pair(z) if pair else (z,)
 
         def merge_scatter(total):
             """Step 4: pad the merged block accumulator to the sharded
@@ -1883,7 +1929,7 @@ class JaxTpuEngine(PageRankEngine):
         )
         self._inv_in_args = True
         self._step_core = step_core
-        self._step_fn = jax.jit(step_core, donate_argnums=(0,))
+        self._step_fn = self._jit_step(step_core)
         self._fused_cache = {}
         self.last_run_metrics = {
             "l1_delta": np.zeros(0, self._accum_dtype),
@@ -1898,6 +1944,194 @@ class JaxTpuEngine(PageRankEngine):
                 ids=ids, n_vs=n_vs, padv=padv, gather_z=gather_z,
                 merge_scatter=merge_scatter,
             )
+
+    def _setup_vs_halo(self, *, n_stripes, sz, group, pair, accum, ids,
+                       n_vs, padv, blk, total_z, use_rs,
+                       accumulate_stripes, vs_tail):
+        """Sparse boundary exchange for the vertex-sharded step
+        (ISSUE 8; config.halo_exchange; Zhao & Canny, arXiv:1312.3020).
+
+        The plain vertex-sharded step all_gathers the WHOLE z vector
+        and reduce-scatters the FULL-width contribution merge every
+        iteration — O(n) wire bytes per chip regardless of how little
+        of the remote state this chip's edges actually touch. Here the
+        exchange is restricted to the build-time BOUNDARY
+        (parallel/partition.build_halo_plan, derived once from the
+        packed slot tables; static int32 tables ride as runtime
+        arguments — no per-iteration host work, no embedded constants):
+
+          1. z_local = r_local * inv_local            (as dense)
+          2. head = psum(masked own slice of [0, K))  — the top-K
+             in-degree prefix nearly every shard reads on a power-law
+             graph, replicated with ONE small all-reduce instead of
+             being repeated in every point-to-point pair set;
+          3. tail boundary z moves point-to-point: one ppermute round
+             per ring offset with a static per-round width, each
+             device gathering its send set from z_local and scattering
+             received entries into a sparse z image (entries nobody
+             here reads stay zero — the gathers never touch them, so
+             the per-stripe contributions are BIT-IDENTICAL to the
+             dense path's, tests/test_halo.py);
+          4. per-stripe gathers (identical kernels — accumulate_stripes
+             is THE one spelling shared with every other mode);
+          5. the merge returns only each writer's contribution WINDOWS:
+             rows are dst-sorted and evenly row-sharded, so a device's
+             nonzero partials form one contiguous flat band — each
+             (writer -> owner) overlap moves as one ppermute window and
+             scatter-adds into the owner's block (exactly-once: window
+             overrun past an owner's block lands in a trash band and
+             the same values reach the next owner in its own round).
+             A position's partials may regroup vs the reduce-scatter
+             (<= 1 ulp in accum dtype) — the one legitimate numerics
+             divergence from the dense mode.
+
+        Per-iteration wire bytes: head all-reduce + boundary payloads
+        + band windows — the comms model (parallel/comms.py) publishes
+        both this and the dense comparator through the metrics
+        registry, and `comms.bytes_exchanged` accumulates per step.
+        Latency caveat: the rounds serialize up to 2*(ndev-1) small
+        collectives where the dense path issues 2 large ones — a
+        bandwidth/latency trade that pays exactly when the boundary is
+        sparse (docs/PERF_NOTES.md "Sparse boundary exchange")."""
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        ndev = mesh.devices.size
+        zd = jnp.dtype(self._inv_out.dtype)
+
+        from pagerank_tpu.parallel import comms as comms_lib
+        from pagerank_tpu.parallel.partition import build_halo_plan
+
+        with obs_trace.span("engine/halo_plan"):
+            # One-time host pull of the placed slot/rank tables: the
+            # plan needs exact read sets, and build_device graphs only
+            # exist on device. Build-time cost, never per-iteration.
+            src_host = [np.asarray(jax.device_get(s)) for s in self._src]
+            rk_host = [np.asarray(jax.device_get(r))
+                       for r in self._row_block]
+            ids_host = [np.asarray(jax.device_get(i)) for i in ids]
+            plan = build_halo_plan(
+                src_host, rk_host, ids_host, ndev=ndev, n_vs=n_vs,
+                sz=sz, group=group, head_k=cfg.halo_head,
+                z_item=zd.itemsize,
+                accum_item=jnp.dtype(accum).itemsize, rs_merge=use_rs,
+            )
+        self._halo_plan = plan
+        self._set_comms_model(comms_lib.model_sparse(plan))
+        RR, WR = plan.read_rounds, plan.write_rounds
+        nread = len(RR)
+        K = plan.head_k
+        wmax = max((r.width for r in WR), default=0)
+
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+        dshard = mesh_lib.edge_sharding(mesh)
+        halo_args, halo_specs = [], []
+        for si, gi in zip(plan.send_idx, plan.recv_ids):
+            halo_args += [jax.device_put(si, shard2d),
+                          jax.device_put(gi, shard2d)]
+            halo_specs += [P(axis, None), P(axis, None)]
+        for ws, wr in zip(plan.wsend_start, plan.wrecv_start):
+            halo_args += [jax.device_put(ws, dshard),
+                          jax.device_put(wr, dshard)]
+            halo_specs += [P(axis), P(axis)]
+        n_halo = len(halo_args)
+
+        def gather_z_sparse(r_l, inv_l, halo):
+            """Steps 1-3: sharded prescale + head psum + tail ppermute
+            rounds into the sparse z image (the [n_vs]+trash vector
+            whose READ positions carry exactly the dense z's bits)."""
+            z_l = r_l.astype(zd) * inv_l  # [blk]
+            z_le = jnp.concatenate([z_l, jnp.zeros(1, zd)])
+            me = jax.lax.axis_index(axis)
+            zf = jnp.zeros(n_vs + 1, zd)
+            zf = jax.lax.dynamic_update_slice(zf, z_l, (me * blk,))
+            for i, rnd in enumerate(RR):
+                si = halo[2 * i][0]  # [width] owner-local send indices
+                gi = halo[2 * i + 1][0]  # [width] global landing ids
+                recv = jax.lax.ppermute(z_le[si], axis, perm=rnd.perm)
+                # Real landings are unique (one owner per vertex);
+                # pads land on the n_vs trash slot with zero payload.
+                zf = zf.at[gi].add(recv)
+            if K:
+                idx = jnp.arange(K, dtype=jnp.int32)
+                pos = idx - me * blk
+                vals = z_le[jnp.clip(pos, 0, blk)]
+                mask = (pos >= 0) & (pos < blk)
+                head = jax.lax.psum(
+                    jnp.where(mask, vals, jnp.zeros((), zd)), axis
+                )
+                # Exactly one owner contributed each entry (psum of
+                # x + zeros = x bitwise), so overwriting the owner's
+                # own copy is a no-op and every reader sees the same
+                # bits the dense all_gather would deliver.
+                zf = jax.lax.dynamic_update_slice(zf, head, (0,))
+            z = zf[:n_vs]
+            if total_z > n_vs:
+                z = jnp.concatenate(
+                    [z, jnp.zeros(total_z - n_vs, zd)]
+                )
+            return _split_pair(z) if pair else (z,)
+
+        def merge_sparse(total, halo):
+            """Step 5: own-block slice + per-offset band windows. Each
+            writer ships one static-width window per active offset;
+            owners scatter-add received windows (overrun lands in the
+            [blk, blk+wmax) trash band, underrun ships zeros)."""
+            flat = total.reshape(-1)  # [n_state]
+            if padv:
+                flat = jnp.concatenate([flat, jnp.zeros(padv, accum)])
+            me = jax.lax.axis_index(axis)
+            own = jax.lax.dynamic_slice_in_dim(flat, me * blk, blk)
+            if not WR:
+                return own
+            flat_ext = jnp.concatenate([flat, jnp.zeros(wmax, accum)])
+            buf = jnp.zeros(blk + wmax, accum)
+            for j, rnd in enumerate(WR):
+                ws = halo[2 * nread + 2 * j][0]  # global window start
+                wr = halo[2 * nread + 2 * j + 1][0]  # local landing
+                win = jax.lax.dynamic_slice_in_dim(flat_ext, ws,
+                                                   rnd.width)
+                recv = jax.lax.ppermute(win, axis, perm=rnd.perm)
+                buf = buf.at[
+                    wr + jnp.arange(rnd.width, dtype=jnp.int32)
+                ].add(recv)
+            return own + buf[:blk]
+
+        def vs_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+            halo, stripes = rest[:n_halo], rest[n_halo:]
+            zs = gather_z_sparse(r_l, inv_l, halo)
+            total = accumulate_stripes(zs, stripes)
+            contrib_l = merge_sparse(total, halo)
+            return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+
+        step_core = shard_map(
+            vs_body,
+            mesh=mesh,
+            in_specs=(P(axis),) * 5 + tuple(halo_specs)
+            + (P(axis, None), P(axis), P()) * n_stripes,
+            out_specs=(P(axis), P(), P()),
+        )
+
+        self._contrib_args = tuple(halo_args) + tuple(
+            a for triple in zip(self._src, self._row_block, ids)
+            for a in triple
+        )
+        self._inv_in_args = True
+        self._step_core = step_core
+        self._step_fn = self._jit_step(step_core)
+        self._fused_cache = {}
+        self.last_run_metrics = {
+            "l1_delta": np.zeros(0, self._accum_dtype),
+            "dangling_mass": np.zeros(0, self._accum_dtype),
+        }
+        self._layout = dict(self._layout, form="vs_halo",
+                            halo=plan.summary())
+        obs_log.info(
+            f"sparse boundary exchange: head K={K}, {nread} read + "
+            f"{len(WR)} write round(s), model "
+            f"{plan.sparse_bytes_per_iter():,} vs dense "
+            f"{plan.dense_bytes_per_iter():,} B/chip/iter"
+        )
 
     def _setup_multi_dispatch_vs(self, *, n_stripes, sz, gw, group, pair,
                                  accum, num_blocks, chunks, num_present,
@@ -2235,7 +2469,7 @@ class JaxTpuEngine(PageRankEngine):
                 out_specs=(P(axis), P(), P()),
             )
             self._step_core = step_core
-            self._step_fn = jax.jit(step_core, donate_argnums=(0,))
+            self._step_fn = self._jit_step(step_core)
             return
 
         # -- multi-dispatch form (past SCAN_STRIPE_UNITS) ------------------
@@ -2387,6 +2621,52 @@ class JaxTpuEngine(PageRankEngine):
             "dangling_mass": np.zeros(0, self._accum_dtype),
         }
 
+    # -- comms accounting (ISSUE 8; parallel/comms.py) ---------------------
+
+    def _jit_step(self, step_core):
+        """jit the fused step with the rank donation routed through the
+        ``usable_donations`` pre-filter (same protocol as
+        utils/compile_cache.stage_call): a donation whose aval cannot
+        match an output never aliases — it only emits the 'Some donated
+        buffers were not usable' lowering warning, the class that sat
+        in the MULTICHIP_r05 tail. The structural half is contract
+        PTC003 (extended to the vertex-sharded forms)."""
+        from pagerank_tpu.utils.compile_cache import usable_donations
+
+        donate = usable_donations(step_core, self._device_args(), (0,))
+        if donate != (0,):
+            obs_log.warn(
+                "rank-buffer donation is not consumable for this step "
+                "form; lowering without it"
+            )
+        return jax.jit(step_core, donate_argnums=donate)
+
+    def _set_comms_model(self, model) -> None:
+        """Adopt a per-iteration comms model (parallel/comms.py):
+        publish its gauges and keep the per-step counter feed."""
+        from pagerank_tpu.parallel import comms as comms_lib
+
+        self._comms_model = model
+        self._comms_bytes_per_iter = int(model.get("bytes_per_iter") or 0)
+        self._comms_counter = comms_lib.register(model)
+
+    def comms_model(self) -> Optional[Dict[str, object]]:
+        """The resolved per-iteration exchange byte model of this build
+        (dense or sparse vertex-sharded), or None when the form has no
+        per-vertex exchange (replicated modes). Static per build — the
+        exchange tables are static, so the model IS the per-iteration
+        measurement; bench legs and MULTICHIP artifacts embed it."""
+        m = self._comms_model
+        return dict(m) if m else None
+
+    def _note_comms(self, iters: int = 1) -> None:
+        """Accumulate ``comms.bytes_exchanged`` for ``iters`` executed
+        iterations — called from every run form's dispatch site."""
+        if self._comms_counter is not None and iters > 0:
+            self._comms_counter.inc(
+                self._comms_bytes_per_iter * int(iters)
+            )
+
     # -- iteration --------------------------------------------------------
 
     def _device_step(self):
@@ -2406,8 +2686,10 @@ class JaxTpuEngine(PageRankEngine):
                 self._r, *parts, *self._ms_ids,
                 self._dangling, self._zero_in, self._valid,
             )
+            self._note_comms(1)
             return delta, m
         self._r, delta, m = self._step_fn(*self._device_args())
+        self._note_comms(1)
         return delta, m
 
     def step(self) -> Dict[str, float]:
@@ -2476,7 +2758,15 @@ class JaxTpuEngine(PageRankEngine):
                 mass, ids, entered = tail(r2, core_args[vi], prev_ids)
                 return r2, delta, m, mass, ids, entered
 
-            fn = jax.jit(probed, donate_argnums=(0,))
+            from pagerank_tpu.utils.compile_cache import usable_donations
+
+            donate = usable_donations(
+                probed,
+                (*self._device_args(),
+                 jax.ShapeDtypeStruct((k,), jnp.int32)),
+                (0,),
+            )
+            fn = jax.jit(probed, donate_argnums=donate)
             self._fused_cache[key] = fn
         return fn
 
@@ -2517,6 +2807,7 @@ class JaxTpuEngine(PageRankEngine):
             self._r, delta, m, mass, ids, entered = fn(
                 *self._device_args(), prev_dev
             )
+            self._note_comms(1)
         d_h, m_h, mass_h, ent_h, ids_np = jax.device_get(
             (delta, m, mass, entered, ids)
         )
@@ -2648,6 +2939,7 @@ class JaxTpuEngine(PageRankEngine):
         fused = self._get_fused(k)
         self._r, (deltas, masses) = fused(*self._device_args())
         self.iteration = total
+        self._note_comms(k)
         self.last_run_metrics = {"l1_delta": deltas, "dangling_mass": masses}
         return self.ranks()
 
@@ -2689,7 +2981,9 @@ class JaxTpuEngine(PageRankEngine):
             return self.run_fused_chunked(num_iters=total, every=1, tol=tol)
         fused = self._get_fused_tol(k, float(tol))
         self._r, i_done, delta, mass = fused(*self._device_args())
-        self.iteration += int(jax.device_get(i_done))
+        done = int(jax.device_get(i_done))
+        self.iteration += done
+        self._note_comms(done)
         self.last_run_metrics = {
             "l1_delta": jnp.reshape(delta, (1,)),
             "dangling_mass": jnp.reshape(mass, (1,)),
@@ -2757,6 +3051,7 @@ class JaxTpuEngine(PageRankEngine):
                 fused = self._get_fused(k)
                 self._r, (deltas, masses) = fused(*self._device_args())
                 self.iteration += k
+                self._note_comms(k)
             ds.append(deltas)
             ms.append(masses)
             if watchdog is not None:

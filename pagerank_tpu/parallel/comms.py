@@ -1,0 +1,109 @@
+"""Per-iteration communication accounting for the sharded solve
+(ISSUE 8; docs/PERF_NOTES.md "Sparse boundary exchange").
+
+The XLA cost model (obs/costs.py) accounts a compiled program's FLOPs
+and HBM bytes but is blind to what crosses the INTERCONNECT — the axis
+the sparse boundary exchange optimizes. This module is that ledger's
+comms-side counterpart: a static byte model per exchange mode (derived
+once at build from the engine's resolved layout / halo plan, never
+measured per iteration — the tables are static, so the model IS the
+measurement) plus the live instruments every solve publishes through
+the PR 4/5 registry and exporter:
+
+  - ``comms.bytes_exchanged``   counter, modeled wire bytes sent by
+                                 this chip, accumulated per iteration;
+  - ``comms.bytes_per_iter``    gauge, the per-iteration rate;
+  - ``comms.dense_bytes_per_iter`` gauge, what the DENSE exchange
+                                 (all_gather + full-width merge) would
+                                 move — the standing comparator;
+  - ``comms.halo_fraction``     gauge, tail boundary entries over the
+                                 dense all_gather's remote entries
+                                 (sparse mode only);
+  - ``comms.head_k``            gauge, replicated head size (sparse).
+
+Byte convention (shared with parallel/partition.HaloPlan): bytes SENT
+per chip per iteration under the standard ring lowering —
+all_gather/reduce_scatter of an n-element vector cost
+``(ndev-1) * n/ndev`` sends per chip, an all-reduce twice that, a
+ppermute exactly its payload.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pagerank_tpu.obs import metrics as obs_metrics
+
+
+def dense_exchange_bytes(ndev: int, blk: int, z_item: int,
+                         accum_item: int, rs_merge: bool = True) -> int:
+    """Modeled bytes sent per chip per iteration by the dense
+    vertex-sharded exchange: one tiled all_gather of the z shard plus
+    the full-width contribution merge (reduce-scatter; ``rs_merge``
+    False models the psum + local-slice fallback backends without a
+    wide reduce-scatter take — engines/jax_engine.py)."""
+    if ndev <= 1:
+        return 0
+    merge = (ndev - 1) * blk * accum_item
+    if not rs_merge:
+        merge *= 2
+    return int((ndev - 1) * blk * z_item + merge)
+
+
+def model_dense(ndev: int, blk: int, z_item: int, accum_item: int,
+                rs_merge: bool = True) -> dict:
+    """Comms model record for the dense vertex-sharded step."""
+    dense = dense_exchange_bytes(ndev, blk, z_item, accum_item, rs_merge)
+    return {
+        "mode": "dense",
+        "bytes_per_iter": dense,
+        "dense_bytes_per_iter": dense,
+        "sparse_bytes_per_iter": None,
+        "halo_fraction": None,
+        "head_k": None,
+    }
+
+
+def model_sparse(plan) -> dict:
+    """Comms model record for a halo-exchange step, from its build-time
+    :class:`pagerank_tpu.parallel.partition.HaloPlan`."""
+    return {
+        "mode": "sparse",
+        "bytes_per_iter": plan.sparse_bytes_per_iter(),
+        "dense_bytes_per_iter": plan.dense_bytes_per_iter(),
+        "sparse_bytes_per_iter": plan.sparse_bytes_per_iter(),
+        "halo_fraction": plan.halo_fraction,
+        "head_k": plan.head_k,
+    }
+
+
+def register(model: dict) -> Optional[obs_metrics.Counter]:
+    """Publish a comms model through the central registry (gauges) and
+    return the ``comms.bytes_exchanged`` counter the solve loop feeds
+    per iteration. None for an empty model (single device: nothing
+    crosses the wire, and a zero-rate counter would just be noise)."""
+    if not model or not model.get("bytes_per_iter"):
+        return None
+    obs_metrics.gauge(
+        "comms.bytes_per_iter",
+        "modeled wire bytes sent per chip per solve iteration",
+    ).set(model["bytes_per_iter"])
+    obs_metrics.gauge(
+        "comms.dense_bytes_per_iter",
+        "what the dense all_gather+reduce-scatter exchange would send",
+    ).set(model["dense_bytes_per_iter"])
+    if model.get("halo_fraction") is not None:
+        obs_metrics.gauge(
+            "comms.halo_fraction",
+            "tail boundary entries / the dense all_gather's remote "
+            "entries",
+        ).set(model["halo_fraction"])
+    if model.get("head_k") is not None:
+        obs_metrics.gauge(
+            "comms.head_k", "replicated high in-degree head size"
+        ).set(model["head_k"])
+    return obs_metrics.counter(
+        "comms.bytes_exchanged",
+        "modeled wire bytes sent by this chip, accumulated per "
+        "iteration",
+    )

@@ -125,6 +125,22 @@ class DeviceHealthMonitor:
         self._t_last: Optional[float] = None
         self.slow_steps = 0
         self.last_skew: Optional[float] = None
+        # Eager registration (ISSUE 8 satellite): a HEALTHY sharded
+        # solve must still expose the elastic instruments through the
+        # Prometheus exporter — a dashboard keyed on
+        # elastic_straggler_skew reads the 1.0 no-skew baseline, not a
+        # missing series, until the first slow step overwrites it.
+        obs_metrics.counter(
+            "elastic.slow_steps",
+            "steps slower than straggler_factor x the step-time "
+            "EWMA (completed — telemetry only, never a rescue)",
+        )
+        g = obs_metrics.gauge(
+            "elastic.straggler_skew",
+            "latest slow step's wall / step-time EWMA",
+        )
+        if g.value is None:
+            g.set(1.0)
 
     def reset(self) -> None:
         """Re-baseline after a rescue: the fresh engine's first steps

@@ -204,6 +204,31 @@ class PageRankConfig:
     # prescale path).
     vertex_sharded: bool = False
 
+    # Sparse boundary exchange (ISSUE 8; Zhao & Canny, arXiv:1312.3020;
+    # parallel/partition.build_halo_plan): replace the vertex-sharded
+    # step's DENSE exchange (all_gather of the whole z vector + a
+    # full-width reduce-scatter merge) with a build-time halo plan —
+    # the top-K high in-degree HEAD is replicated with one small psum,
+    # the tail boundary moves point-to-point over static ppermute
+    # rounds, and the contribution merge returns only each writer's
+    # band windows — so per-iteration exchanged bytes scale with the
+    # BOUNDARY size instead of n. The gather inputs are bit-identical
+    # to the dense path (tests/test_halo.py); only the merge regroups
+    # (rounding-level). Requires vertex_sharded + the ell kernel; the
+    # plain (non-vs_bounded) mode only — vs_bounded has its own
+    # owner-computes exchange. Downgrades to the dense exchange (with
+    # a logged note) on multi-dispatch layouts and on TPU backends
+    # with a 64-bit exchanged dtype (the X64 rewriter gap class).
+    halo_exchange: bool = False
+
+    # Head-replication K for halo_exchange: -1 = auto (the relabeled
+    # in-degree prefix whose replication MINIMIZES the modeled
+    # exchange bytes over the exact build-time pair sets —
+    # parallel/partition.auto_head_k; may honestly resolve to 0 on
+    # mild graphs), 0 = none, > 0 = explicit (rounded up to a
+    # multiple of 128).
+    halo_head: int = -1
+
     # Bounded-transient vertex sharding (VERDICT r4 #1 / ROADMAP
     # "Engine"): destination-partitioned slot rows + per-stripe z
     # broadcast. The plain vertex-sharded mode shards the PERSISTENT
@@ -304,6 +329,20 @@ class PageRankConfig:
             )
         if self.vs_bounded and not self.vertex_sharded:
             raise ValueError("vs_bounded requires vertex_sharded")
+        if self.halo_exchange:
+            if not self.vertex_sharded:
+                raise ValueError("halo_exchange requires vertex_sharded")
+            if self.vs_bounded:
+                raise ValueError(
+                    "halo_exchange targets the plain vertex-sharded "
+                    "exchange; vs_bounded has its own owner-computes "
+                    "exchange"
+                )
+        if self.halo_head < -1:
+            raise ValueError(
+                f"halo_head must be -1 (auto), 0 (off), or positive, "
+                f"got {self.halo_head}"
+            )
         if self.wide_accum not in ("auto", "pair", "native"):
             raise ValueError(f"unknown wide_accum mode: {self.wide_accum!r}")
         if self.stream_dtype not in ("", "bfloat16"):

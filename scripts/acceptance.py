@@ -43,8 +43,10 @@ Beyond those, the cheap smokes run FIRST in the default order: D
 (partition-centric layout: a windowed solve with --probe-every plus
 the contract-sweep coverage assertion — ISSUE 6), L (elastic rescue:
 an 8-fake-device chaos run with one injected device kill that must
-finish on the surviving mesh and match the oracle — ISSUE 7), F
-(fault injection).
+finish on the surviving mesh and match the oracle — ISSUE 7), M
+(sparse boundary exchange: an 8-fake-device halo solve gated on
+oracle parity AND measured exchanged bytes below the dense model —
+ISSUE 8), F (fault injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -155,9 +157,20 @@ CONFIGS = {
               seed=5,
               label="elastic-rescue smoke (8-fake-device chaos, "
                     "one device kill)"),
+    # Sparse-boundary-exchange smoke (ISSUE 8): an 8-fake-device
+    # vertex-sharded solve through the halo exchange at small R-MAT
+    # scale — the step must run the vs_halo form, final ranks must
+    # match the f64 CPU oracle at the standing f32 gate, and the
+    # MEASURED per-iteration exchanged bytes (the static model the
+    # comms.bytes_exchanged counter accumulates) must be strictly
+    # below the dense all_gather+reduce-scatter model's. Runs
+    # in-process on a multi-device CPU backend, else re-invokes
+    # itself in a subprocess like L.
+    "M": dict(kind="halo", scale=12, iters=12,
+              label="sparse-exchange smoke (8-fake-device halo solve)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "F", "A", "B", "T", "P", "E",
-                "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "F", "A", "B", "T", "P",
+                "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -577,45 +590,56 @@ ELASTIC_SMOKE_BUDGET_S = 3.0
 ELASTIC_F32_GATE = 1e-4
 
 
+def _fake_mesh_subprocess(key: str, kind: str, child_var: str):
+    """Re-invoke one smoke in a subprocess with the 8-fake-CPU-device
+    flags and adopt the child's record — shared by every smoke that
+    needs a multi-device CPU mesh this process's backend cannot host
+    (a live TPU, or fewer than 2 devices: L, M). ``child_var`` is the
+    recursion guard env var."""
+    import subprocess
+
+    spec = CONFIGS[key]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    if env.get(child_var):
+        raise RuntimeError(
+            f"{kind} smoke child still lacks a multi-device CPU "
+            "backend; refusing to recurse"
+        )
+    env[child_var] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--only", key,
+         "--no-append", "--no-analysis"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    sys.stderr.write(proc.stderr)
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])[0]
+    except Exception:
+        return {"config": key, "kind": kind,
+                "label": spec["label"], "passed": False,
+                "error": f"child rc={proc.returncode}"}
+
+
 def run_elastic_smoke(key: str):
     """ISSUE-7 gate: seed-deterministic device kill mid-solve on the
     8-fake-device CPU mesh -> classify -> teardown -> re-shard ->
     warm-start -> FINISH; rank parity vs the f64 oracle at the f32
     gate; `elastic/rescue` span + `elastic.*` counters in the run
     report; under ELASTIC_SMOKE_BUDGET_S. When this process's backend
-    cannot host the fake mesh (a live TPU, or fewer than 2 devices),
-    the smoke re-invokes itself in a subprocess with the fake-device
-    flags and adopts the child's record."""
+    cannot host the fake mesh, the smoke re-invokes itself in a
+    subprocess with the fake-device flags and adopts the child's
+    record (_fake_mesh_subprocess)."""
     import jax
 
     spec = CONFIGS[key]
     if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
-        import subprocess
-
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (
-            env.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
-        if env.get("PAGERANK_ELASTIC_SMOKE_CHILD"):
-            raise RuntimeError(
-                "elastic smoke child still lacks a multi-device CPU "
-                "backend; refusing to recurse"
-            )
-        env["PAGERANK_ELASTIC_SMOKE_CHILD"] = "1"
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--only", key,
-             "--no-append", "--no-analysis"],
-            env=env, capture_output=True, text=True, timeout=600,
-        )
-        sys.stderr.write(proc.stderr)
-        try:
-            return json.loads(proc.stdout.strip().splitlines()[-1])[0]
-        except Exception:
-            return {"config": key, "kind": "elastic",
-                    "label": spec["label"], "passed": False,
-                    "error": f"child rc={proc.returncode}"}
+        return _fake_mesh_subprocess(key, "elastic",
+                                     "PAGERANK_ELASTIC_SMOKE_CHILD")
 
     import shutil
     import tempfile
@@ -729,6 +753,106 @@ def run_elastic_smoke(key: str):
         f"{'OK' if rescue_span else 'MISSING'}; counters "
         f"{sorted(elastic_counters)}; {t_run:.2f}s vs budget "
         f"{ELASTIC_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+# Budget for the sparse-exchange smoke (seconds, timed around the
+# solve loop itself — the build/plan and the f64 oracle pass are
+# excluded, the first step's compile is not): a 12-iteration f32
+# vertex-sharded solve on 4096 vertices over 8 fake CPU devices.
+HALO_SMOKE_BUDGET_S = 3.0
+
+
+def run_halo_smoke(key: str):
+    """ISSUE-8 gate: the sparse boundary exchange end to end on the
+    8-fake-device CPU mesh — vs_halo dispatch form, oracle L1 at the
+    standing f32 gate, measured exchanged bytes strictly below the
+    dense model, `comms.*` gauges + counter in the registry, under
+    HALO_SMOKE_BUDGET_S. Re-invokes itself in a subprocess with the
+    fake-device flags when this backend can't host the mesh
+    (_fake_mesh_subprocess, same protocol as smoke L)."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        return _fake_mesh_subprocess(key, "halo",
+                                     "PAGERANK_HALO_SMOKE_CHILD")
+
+    from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
+                              ReferenceCpuEngine, build_graph, obs)
+    from pagerank_tpu.obs import metrics as obs_metrics
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    scale, iters = spec["scale"], spec["iters"]
+    ndev = min(8, len(jax.devices()))
+    src, dst = rmat_edges(scale, 8, seed=4)
+    g = build_graph(src, dst, n=1 << scale)
+    obs.get_registry().reset()
+    cfg = PageRankConfig(num_iters=iters, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev,
+                         vertex_sharded=True, halo_exchange=True)
+    eng = JaxTpuEngine(cfg).build(g)
+    form = eng.layout_info().get("form")
+    cm = eng.comms_model() or {}
+    ctr = obs_metrics.counter("comms.bytes_exchanged")
+    c0 = ctr.value
+    t0 = time.perf_counter()
+    ranks = eng.run_fast()
+    t_run = time.perf_counter() - t0
+    measured = int(ctr.value - c0)
+
+    oracle = ReferenceCpuEngine(
+        PageRankConfig(num_iters=iters, dtype="float64",
+                       accum_dtype="float64")
+    ).build(g).run()
+    l1 = float(np.abs(ranks - oracle).sum()) / float(
+        np.abs(oracle).sum())
+
+    sparse = int(cm.get("sparse_bytes_per_iter") or 0)
+    dense = int(cm.get("dense_bytes_per_iter") or 0)
+    counters = obs.get_registry().snapshot().get("counters", {})
+    gauges = obs.get_registry().snapshot().get("gauges", {})
+    comms_visible = ("comms.bytes_exchanged" in counters
+                     and "comms.halo_fraction" in gauges)
+    passed = bool(
+        form == "vs_halo"
+        and l1 <= ELASTIC_F32_GATE
+        and 0 < sparse < dense
+        and measured == sparse * iters
+        and comms_visible
+        and t_run <= HALO_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "halo",
+        "label": spec["label"],
+        "scale": scale,
+        "iters": iters,
+        "devices": ndev,
+        "form": form,
+        "normalized_l1": l1,
+        "gate": ELASTIC_F32_GATE,
+        "sparse_bytes_per_iter": sparse,
+        "dense_bytes_per_iter": dense,
+        "measured_bytes": measured,
+        "halo_fraction": cm.get("halo_fraction"),
+        "head_k": cm.get("head_k"),
+        "comms_metrics_ok": comms_visible,
+        "seconds": t_run,
+        "budget_s": HALO_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] sparse exchange on {ndev} fake devices (scale "
+        f"{scale}, {iters} iters): form {form}; oracle L1 {l1:.3e} vs "
+        f"gate {ELASTIC_F32_GATE:g}; bytes/iter {sparse:,} sparse < "
+        f"{dense:,} dense ({'OK' if 0 < sparse < dense else 'BAD'}), "
+        f"measured {measured:,}; comms metrics "
+        f"{'OK' if comms_visible else 'MISSING'}; {t_run:.2f}s vs "
+        f"budget {HALO_SMOKE_BUDGET_S:g}s -> "
         f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
@@ -1316,7 +1440,7 @@ def main(argv=None) -> int:
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
                "faults": run_fault_smoke, "obs": run_obs_smoke,
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
-               "elastic": run_elastic_smoke}
+               "elastic": run_elastic_smoke, "halo": run_halo_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

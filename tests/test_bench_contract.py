@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -152,6 +154,65 @@ def test_bench_build_only_reports_stage_breakdown(tmp_path):
         assert set(stages) >= stage_keys, stages
         assert all(stages[k] >= 0 for k in stage_keys)
         assert rec[leg]["num_edges"] > 0
+
+
+def test_multichip_json_contract(tmp_path):
+    """--multichip (ISSUE 8): the promoted MULTICHIP_*.json schema —
+    per-leg edges/s/chip, scaling efficiency vs the single-chip leg,
+    dense-vs-sparse exchanged-bytes model + accumulated counter, the
+    oracle-parity accuracy leg, and the env fingerprint, in ONE JSON
+    line over the 8-fake-device CPU mesh."""
+    env = _env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    iters = 2
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--multichip",
+         "--scale", "10", "--iters", str(iters), "--warmup", "1"],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, r.stdout
+    rec = json.loads(json_lines[0])
+    assert set(rec) == {"metric", "value", "unit", "n_devices", "scale",
+                        "iters", "single_chip", "dense_exchange",
+                        "sparse_exchange", "scaling_efficiency",
+                        "scaling_efficiency_dense", "exchanged_bytes",
+                        "device_view", "accuracy", "env"}
+    assert len(rec["device_view"]) == 8
+    assert rec["metric"] == "multichip_edges_per_sec_per_chip"
+    assert rec["n_devices"] == 8
+    for leg in ("single_chip", "dense_exchange", "sparse_exchange"):
+        rec_l = rec[leg]
+        assert rec_l["value"] > 0 and rec_l["ms_per_iter"] > 0
+        _assert_costs_block(rec_l["costs"])
+        _assert_layout_block(rec_l["layout"])
+    assert rec["single_chip"]["n_devices"] == 1
+    assert rec["sparse_exchange"]["layout"]["form"] == "vs_halo"
+    assert rec["dense_exchange"]["layout"]["form"] == "vertex_sharded"
+    # Headline value IS the sparse leg's rate; efficiency is per-chip
+    # rate retained vs the single-chip leg.
+    assert rec["value"] == rec["sparse_exchange"]["value"]
+    assert rec["scaling_efficiency"] == pytest.approx(
+        rec["sparse_exchange"]["value"] / rec["single_chip"]["value"]
+    )
+    # Comms accounting: the counter accumulates exactly the static
+    # model per timed iteration, and the model carries both sides.
+    cm = rec["sparse_exchange"]["comms"]
+    assert cm["mode"] == "sparse"
+    assert cm["sparse_bytes_per_iter"] >= 0
+    assert cm["dense_bytes_per_iter"] > 0
+    assert rec["sparse_exchange"]["bytes_exchanged"] == \
+        iters * cm["bytes_per_iter"]
+    assert rec["dense_exchange"]["comms"]["mode"] == "dense"
+    xb = rec["exchanged_bytes"]
+    assert set(xb) == {"sparse_model_per_iter", "dense_model_per_iter",
+                       "sparse_below_dense", "halo_fraction", "head_k"}
+    acc = rec["accuracy"]
+    assert acc["scale"] == 10 and acc["iters"] == iters
+    assert 0 <= acc["normalized_l1_vs_f64_oracle"] < 1e-3
+    assert isinstance(acc["sparse_below_dense"], bool)
+    assert rec["env"]["jax_version"] and rec["env"]["backend"] == "cpu"
 
 
 def test_graft_entry_contract():

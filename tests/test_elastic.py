@@ -607,6 +607,51 @@ def test_rescue_abandons_blocked_warm_start_scan(tmp_path):
     assert l1 <= 1e-4
 
 
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device fake mesh")
+def test_rescue_rebuilds_halo_tables_for_surviving_mesh(tmp_path):
+    """ISSUE-8 satellite: a sparse-exchange (halo) solve that loses a
+    device must come back with the halo plan REBUILT for the degraded
+    mesh — same-ndev tables would index the wrong blocks. The rescued
+    run's plan must equal a fresh build's at the surviving device
+    count, and the final ranks must still match the oracle."""
+    g = _graph()
+    iters = 12
+    ndev0 = min(8, NDEV)
+    cfg = _f32_cfg(ndev0, iters).replace(
+        vertex_sharded=True, halo_exchange=True,
+    )
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference")
+    sched = DeviceFaultSchedule(seed=5, kill={6: 1})
+    runner = _runner(g, cfg, snap, sched, max_rescues=2)
+    plan0 = runner.engine._halo_plan
+    assert plan0.ndev == ndev0
+
+    ranks = runner.run(
+        on_iteration=lambda i, info: snap.save(i + 1,
+                                               runner.engine.ranks())
+    )
+    assert runner.rescues == 1
+    assert runner.engine.mesh.devices.size == ndev0 - 1
+    plan1 = runner.engine._halo_plan
+    assert plan1 is not plan0 and plan1.ndev == ndev0 - 1
+    assert plan1.n_vs % (128 * (ndev0 - 1)) == 0
+    # The rescued engine's plan is exactly what a fresh build over the
+    # same degraded mesh derives — tables included, not just shapes.
+    fresh = JaxTpuEngine(
+        cfg.replace(num_devices=ndev0 - 1),
+        devices=list(runner.engine.mesh.devices.reshape(-1)),
+    ).build(g)
+    plan2 = fresh._halo_plan
+    assert plan1.summary() == plan2.summary()
+    for a, b in zip(plan1.send_idx, plan2.send_idx):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(plan1.wsend_start, plan2.wsend_start):
+        np.testing.assert_array_equal(a, b)
+    oracle = _oracle(g, iters)
+    l1 = np.abs(ranks - oracle).sum() / np.abs(oracle).sum()
+    assert l1 <= 1e-4  # the standing f32-grade gate
+
+
 def test_watchdog_classifies_the_solve_mesh_only(monkeypatch):
     """Classification must probe the SOLVE MESH's devices (the
     device_source), not every visible chip — a wedged device the

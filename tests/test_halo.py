@@ -1,0 +1,417 @@
+"""Sparse boundary exchange (ISSUE 8; parallel/partition.build_halo_plan
++ engines/jax_engine._setup_vs_halo; docs/PERF_NOTES.md "Sparse
+boundary exchange").
+
+Three layers, all on the 8-fake-device CPU mesh:
+
+- the HALO BUILDER against a numpy reference: per-device read sets
+  decoded independently from the packed slot tables on random AND
+  R-MAT graphs, table consistency (send rows == receive rows, pads
+  inert), full coverage (every remote read is head-replicated or
+  arrives in exactly one round), and write-band windows covering every
+  (writer, owner) overlap;
+- STEP PARITY vs the dense psum_scatter path: the gather inputs are
+  bit-identical by construction, so full runs must agree to (at most)
+  contribution-merge regrouping — pinned bit-exact where the dense
+  mode itself is deterministic;
+- the COMMS accounting: model-minimizing head K, counter accumulation,
+  and the comms.*/elastic.* names visible through the Prometheus
+  exporter (ROADMAP [scale] leftover).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.parallel import partition
+from pagerank_tpu.utils.synth import rmat_edges
+
+NDEV = len(jax.devices())
+
+needs_mesh = pytest.mark.skipif(NDEV < 8, reason="needs 8 fake devices")
+
+
+def _random_graph(n=512, e=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+def _rmat_graph(scale=11, ef=8, seed=1):
+    src, dst = rmat_edges(scale, edge_factor=ef, seed=seed)
+    return build_graph(src, dst, n=1 << scale)
+
+
+def _cfg(**kw):
+    base = dict(num_iters=8, dtype="float32", accum_dtype="float32",
+                num_devices=min(8, NDEV), vertex_sharded=True)
+    base.update(kw)
+    return PageRankConfig(**base)
+
+
+def _halo_engine(graph, **kw):
+    return JaxTpuEngine(_cfg(halo_exchange=True, **kw)).build(graph)
+
+
+# -- halo builder vs numpy reference ---------------------------------------
+
+
+def _reference_read_sets(src_host, ndev, sz, group):
+    """Independent decode of each device's read set: a plain python
+    loop over every slot word (the oracle the vectorized builder is
+    checked against)."""
+    log2g = group.bit_length() - 1
+    out = [set() for _ in range(ndev)]
+    for s, ss in enumerate(src_host):
+        rows = ss.shape[0]
+        rpd = rows // ndev
+        for d in range(ndev):
+            for w in np.asarray(ss[d * rpd:(d + 1) * rpd]).reshape(-1):
+                local = int(w) >> log2g
+                if local < sz:
+                    out[d].add(s * sz + local)
+    return [np.array(sorted(x), np.int64) for x in out]
+
+
+@needs_mesh
+@pytest.mark.parametrize("graph_fn", [_random_graph, _rmat_graph])
+def test_read_sets_match_numpy_reference(graph_fn):
+    eng = _halo_engine(graph_fn())
+    plan = eng._halo_plan
+    sz = eng._layout["stripe_span"]
+    group = eng._layout["group"]
+    src_host = [np.asarray(jax.device_get(s)) for s in eng._src]
+    got = partition.device_read_sets(
+        src_host, ndev=plan.ndev, sz=sz, group=group
+    )
+    want = _reference_read_sets(src_host, plan.ndev, sz, group)
+    for d in range(plan.ndev):
+        np.testing.assert_array_equal(got[d], want[d])
+
+
+@needs_mesh
+@pytest.mark.parametrize("graph_fn", [_random_graph, _rmat_graph])
+def test_halo_tables_cover_every_remote_read_exactly_once(graph_fn):
+    """Coverage + consistency: every remote read id is either in the
+    replicated head or arrives in EXACTLY one round's receive row; the
+    sender's local indices match the receiver's global ids; pads are
+    inert (send pad = blk zero slot, recv pad = n_vs trash)."""
+    eng = _halo_engine(graph_fn())
+    plan = eng._halo_plan
+    ndev, blk, n_vs, K = plan.ndev, plan.blk, plan.n_vs, plan.head_k
+    sz = eng._layout["stripe_span"]
+    group = eng._layout["group"]
+    src_host = [np.asarray(jax.device_get(s)) for s in eng._src]
+    reads = partition.device_read_sets(
+        src_host, ndev=ndev, sz=sz, group=group
+    )
+    recv_by_dev = [[] for _ in range(ndev)]
+    for rnd, send, recv in zip(plan.read_rounds, plan.send_idx,
+                               plan.recv_ids):
+        assert send.shape == recv.shape == (ndev, rnd.width)
+        senders = {s: t for s, t in rnd.perm}
+        for d in range(ndev):
+            row = recv[d][recv[d] < n_vs]
+            # A device with no inbound link this round receives only
+            # zeros — its recv row must be all-trash.
+            src_dev = (d - rnd.offset) % ndev
+            if senders.get(src_dev) != d:
+                assert row.size == 0
+                continue
+            # Receiver's global ids == sender's local ids + owner base.
+            srow = send[src_dev][send[src_dev] < blk]
+            np.testing.assert_array_equal(
+                row, srow.astype(np.int64) + src_dev * blk
+            )
+            # Tail only: never own-block, never head.
+            assert np.all(row // blk == src_dev) and src_dev != d
+            assert np.all(row >= K)
+            recv_by_dev[d].append(row)
+    for d in range(ndev):
+        got = (np.concatenate(recv_by_dev[d]) if recv_by_dev[d]
+               else np.zeros(0, np.int64))
+        # Exactly once: no duplicates across rounds.
+        assert np.unique(got).size == got.size
+        want = reads[d]
+        want = want[(want // blk != d) & (want >= K)]
+        np.testing.assert_array_equal(np.sort(got), want)
+
+
+@needs_mesh
+def test_write_windows_cover_every_band_overlap():
+    """Every (writer, owner) overlap of a device's contribution band
+    must be covered by exactly one round's window: start at the
+    overlap's low end, width >= the overlap, landing at the owner's
+    matching local offset."""
+    eng = _halo_engine(_rmat_graph())
+    plan = eng._halo_plan
+    ndev, blk, n_vs = plan.ndev, plan.blk, plan.n_vs
+    rk_host = [np.asarray(jax.device_get(r)) for r in eng._row_block]
+    # Recompute bands from the engine's own placed tables (the present
+    # ids ride at the tail of the contrib args, after the halo tables).
+    ids_host = []
+    n_halo = 2 * len(plan.read_rounds) + 2 * len(plan.write_rounds)
+    stripe_args = eng._contrib_args[n_halo:]
+    for s in range(len(eng._src)):
+        ids_host.append(np.asarray(jax.device_get(stripe_args[3 * s + 2])))
+    bands = partition.device_write_bands(
+        rk_host, ids_host, ndev=ndev, n_vs=n_vs
+    )
+    rounds = {r.offset: (r, ws, wr) for r, ws, wr in
+              zip(plan.write_rounds, plan.wsend_start, plan.wrecv_start)}
+    for d, (lo, hi) in enumerate(bands):
+        for p in range(ndev):
+            if p == d:
+                continue
+            s_lo, s_hi = max(lo, p * blk), min(hi, (p + 1) * blk)
+            if s_lo >= s_hi:
+                continue
+            rnd, ws, wr = rounds[p - d]
+            assert (d, p) in rnd.perm
+            assert ws[d] == s_lo
+            assert rnd.width >= s_hi - s_lo
+            assert wr[p] == s_lo - p * blk
+
+
+@needs_mesh
+def test_auto_head_k_minimizes_model():
+    """The model-driven K rule: the auto K's modeled bytes are <= the
+    no-replication plan's and <= a sampled explicit alternative's."""
+    g = _rmat_graph(scale=12, ef=8, seed=2)
+    auto = _halo_engine(g)
+    k0 = _halo_engine(g, halo_head=0)
+    alt = _halo_engine(g, halo_head=4096)
+    b_auto = auto._halo_plan.sparse_bytes_per_iter()
+    assert b_auto <= k0._halo_plan.sparse_bytes_per_iter()
+    assert b_auto <= alt._halo_plan.sparse_bytes_per_iter()
+    # And the sparse model must beat the dense exchange on a power-law
+    # graph at this geometry (the whole point).
+    assert b_auto < auto._halo_plan.dense_bytes_per_iter()
+
+
+# -- step parity vs the dense psum_scatter path ----------------------------
+
+
+@needs_mesh
+@pytest.mark.parametrize("graph_fn", [_random_graph, _rmat_graph])
+def test_full_run_matches_dense_exchange_f32(graph_fn):
+    """f32 full runs: the gather inputs are bit-identical and the f32
+    round absorbs merge regrouping — bit-equal ranks (the same
+    contract the dense vertex-sharded mode holds vs replicated)."""
+    g = graph_fn()
+    r_dense = JaxTpuEngine(_cfg()).build(g).run()
+    r_halo = _halo_engine(g).run()
+    np.testing.assert_array_equal(r_halo, r_dense)
+
+
+@needs_mesh
+def test_full_run_matches_dense_exchange_pair_striped():
+    """The striped pair layout (f32 storage, pair-f64 accumulation)
+    through the halo exchange vs the dense path."""
+    class _TinyStripes(JaxTpuEngine):
+        def _stripe_max(self):
+            return 256
+
+        def _stripe_target(self):
+            return 256
+
+    g = _rmat_graph(scale=10)
+    cfg = _cfg(accum_dtype="float64", wide_accum="pair", num_iters=4)
+    r_dense = _TinyStripes(cfg).build(g).run_fast()
+    eng = _TinyStripes(cfg.replace(halo_exchange=True)).build(g)
+    assert eng.layout_info()["form"] == "vs_halo"
+    assert len(eng._src) > 1  # really striped
+    np.testing.assert_allclose(
+        np.float64(eng.run_fast()), np.float64(r_dense),
+        rtol=1e-6, atol=1e-12,
+    )
+
+
+@needs_mesh
+def test_fused_and_probed_forms_match_stepwise():
+    g = _rmat_graph()
+    r_step = _halo_engine(g).run_fast()
+    fused = _halo_engine(g)
+    np.testing.assert_array_equal(fused.run_fused(), r_step)
+    probed = _halo_engine(g, probe_every=2)
+    r_p = probed.run()
+    np.testing.assert_array_equal(r_p, r_step)
+
+
+@needs_mesh
+def test_f64_storage_matches_dense_to_rounding():
+    g = _rmat_graph(scale=10)
+    cfg = _cfg(dtype="float64", accum_dtype="float64", num_iters=6)
+    r_dense = JaxTpuEngine(cfg).build(g).run_fast()
+    r_halo = JaxTpuEngine(
+        cfg.replace(halo_exchange=True)
+    ).build(g).run_fast()
+    # Only the contribution merge may regroup (<= 1 ulp/iteration).
+    np.testing.assert_array_almost_equal_nulp(r_halo, r_dense, nulp=8)
+
+
+@needs_mesh
+def test_snapshot_resume_roundtrip(tmp_path):
+    from pagerank_tpu.utils.snapshot import Snapshotter, resume_engine
+
+    g = _rmat_graph()
+    eng = _halo_engine(g)
+    eng.run_fast(num_iters=3)
+    snap = Snapshotter(str(tmp_path), g.fingerprint(), "reference",
+                       mesh_meta=eng.snapshot_meta())
+    snap.save(3, eng.ranks())
+    e2 = _halo_engine(g)
+    assert resume_engine(e2, snap) == 3
+    np.testing.assert_array_equal(e2.ranks(), eng.ranks())
+    r_full = e2.run_fast()
+    np.testing.assert_array_equal(r_full, eng.run_fast())
+
+
+# -- downgrades + validation -----------------------------------------------
+
+
+@needs_mesh
+def test_multi_dispatch_layout_downgrades_to_dense():
+    class _TinyScan(JaxTpuEngine):
+        def _stripe_max(self):
+            return 256
+
+        def _stripe_target(self):
+            return 256
+
+        SCAN_STRIPE_UNITS = 0
+
+    g = _rmat_graph(scale=10)
+    eng = _TinyScan(_cfg(halo_exchange=True)).build(g)
+    info = eng.layout_info()
+    assert info["form"] == "vs_multi_dispatch"
+    assert info["halo"] == "off:multi_dispatch"
+    assert eng._halo_plan is None
+    r = eng.run_fast()
+    r_dense = _TinyScan(_cfg()).build(g).run_fast()
+    np.testing.assert_array_equal(r, r_dense)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="requires vertex_sharded"):
+        PageRankConfig(halo_exchange=True).validate()
+    with pytest.raises(ValueError, match="vs_bounded"):
+        PageRankConfig(vertex_sharded=True, vs_bounded=True,
+                       halo_exchange=True).validate()
+    with pytest.raises(ValueError, match="halo_head"):
+        PageRankConfig(halo_head=-2).validate()
+    PageRankConfig(vertex_sharded=True, halo_exchange=True,
+                   halo_head=256).validate()
+
+
+def test_single_device_halo_is_exact_and_silent():
+    g = _random_graph()
+    cfg = _cfg(num_devices=1, halo_exchange=True)
+    eng = JaxTpuEngine(cfg).build(g)
+    plan = eng._halo_plan
+    assert plan.ndev == 1 and not plan.read_rounds \
+        and not plan.write_rounds
+    assert eng.comms_model()["bytes_per_iter"] == 0
+    r = eng.run_fast()
+    r_dense = JaxTpuEngine(
+        _cfg(num_devices=1)
+    ).build(g).run_fast()
+    np.testing.assert_array_equal(r, r_dense)
+
+
+# -- comms accounting + exporter wiring ------------------------------------
+
+
+@needs_mesh
+def test_comms_counter_accumulates_per_iteration():
+    obs_metrics.get_registry().reset()
+    g = _rmat_graph()
+    eng = _halo_engine(g)
+    per = eng.comms_model()["bytes_per_iter"]
+    assert per > 0
+    ctr = obs_metrics.counter("comms.bytes_exchanged")
+    c0 = ctr.value
+    eng.run_fast(num_iters=5)
+    assert ctr.value - c0 == 5 * per
+    # Fused dispatch counts the same model per iteration.
+    e2 = _halo_engine(g)
+    c1 = ctr.value
+    e2.run_fused(num_iters=4)
+    assert ctr.value - c1 == 4 * e2.comms_model()["bytes_per_iter"]
+    # Probed iterations count too (step_probed's single-program
+    # branch dispatches outside _device_step).
+    e3 = _halo_engine(g, probe_every=2)
+    c2 = ctr.value
+    e3.run()
+    assert ctr.value - c2 == 8 * e3.comms_model()["bytes_per_iter"]
+
+
+@needs_mesh
+def test_dense_mode_reports_comms_model_too():
+    g = _rmat_graph()
+    eng = JaxTpuEngine(_cfg()).build(g)
+    cm = eng.comms_model()
+    assert cm["mode"] == "dense" and cm["bytes_per_iter"] > 0
+    assert cm["sparse_bytes_per_iter"] is None
+    # Replicated forms have no per-vertex exchange to model.
+    rep = JaxTpuEngine(
+        PageRankConfig(num_iters=2, num_devices=min(8, NDEV))
+    ).build(g)
+    assert rep.comms_model() is None
+
+
+@needs_mesh
+def test_watchdog_heartbeats_through_sparse_path():
+    """ROADMAP [scale] leftover: an armed stall watchdog receives one
+    heartbeat per completed sparse-exchange step (engine.run's feed),
+    so a wedged halo solve is diagnosable like every other form."""
+    from pagerank_tpu.obs import live as obs_live
+
+    wd = obs_live.StallWatchdog(timeout_s=600.0,
+                                interrupt=lambda: None)
+    obs_live.arm_watchdog(wd)
+    try:
+        eng = _halo_engine(_rmat_graph(), num_iters=4)
+        eng.run()
+    finally:
+        obs_live.disarm_watchdog()
+    # engine.run feeds the 0-based iteration BEFORE the counter
+    # advances — the final heartbeat of a 4-iteration run carries 3.
+    assert wd.last_iteration == 3
+    assert wd.stalls == 0
+
+
+@needs_mesh
+def test_cost_reports_cover_sparse_step():
+    """The XLA cost ledger harvests the vs_halo step program like any
+    single-program form (bench legs embed it per leg)."""
+    from pagerank_tpu.obs import costs as obs_costs
+
+    obs_costs.reset()
+    eng = _halo_engine(_rmat_graph())
+    reports = eng.cost_reports()
+    assert "step" in reports
+    assert reports["step"]["peak_bytes"] is None \
+        or reports["step"]["peak_bytes"] > 0
+
+
+@needs_mesh
+def test_comms_and_elastic_metrics_visible_in_exporter():
+    """ROADMAP [scale] leftover: comms.* and elastic.* instruments
+    render through the Prometheus exporter during a sharded
+    sparse-exchange solve."""
+    from pagerank_tpu.obs.live import render_prometheus
+    from pagerank_tpu.parallel.elastic import DeviceHealthMonitor
+
+    obs_metrics.get_registry().reset()
+    g = _rmat_graph()
+    eng = _halo_engine(g)
+    DeviceHealthMonitor()  # registers the elastic straggler gauges
+    eng.run_fast(num_iters=3)
+    text = render_prometheus()
+    for name in ("comms_bytes_exchanged", "comms_bytes_per_iter",
+                 "comms_dense_bytes_per_iter", "comms_halo_fraction",
+                 "comms_head_k", "elastic_straggler_skew"):
+        assert name in text, name
