@@ -47,6 +47,14 @@ a tunneled TPU the host->device link is orders of magnitude slower than
 HBM, and shipping packed edge arrays dominates wall-clock. Only a PRNG
 seed and two sizing scalars cross the link. --host-build restores the
 host ingest path (what a real edge-list run would exercise).
+
+Every emit carries ``schema_version`` (BENCH_SCHEMA_VERSION) and the
+workload geometry, and ``--out`` / ``--history`` write the canonical
+record / append it to the perf-history ledger directly (ISSUE 9;
+docs/OBSERVABILITY.md "Perf history & gating") — the legacy
+``{n, cmd, rc, tail, parsed}`` tail-scrape wrapper is dead on the
+emit side, though the ledger keeps reading the checked-in r01-r05
+wrappers.
 """
 
 import argparse
@@ -58,6 +66,13 @@ import time
 import numpy as np
 
 NORTH_STAR_EDGES_PER_SEC_PER_CHIP = 1.47e9 * 50 / 60 / 8
+
+# Version of bench.py's OWN JSON schemas (couple, single, --build-only,
+# --multichip). 1 was the implicit pre-ISSUE-9 era: those artifacts
+# carry no version field at all, and the perf-history ledger
+# (pagerank_tpu/obs/history.py) still ingests them; 2 adds this field
+# plus the workload geometry (scale/iters/edge_factor) to every emit.
+BENCH_SCHEMA_VERSION = 2
 
 # The per-stage device-build breakdown schema (--build-only; also
 # checked by scripts/acceptance.py's build smoke). Stage walls include
@@ -183,6 +198,37 @@ def _enable_compile_cache():
     enable_compile_cache(
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     )
+
+
+def _emit(out: dict, args) -> None:
+    """THE one bench output path (ISSUE 9): stamp the schema version,
+    print the ONE JSON line the driver contract requires, and write
+    the canonical artifacts directly — ``--out`` saves the record
+    itself (strict JSON: the BENCH_r*.json shape going forward,
+    replacing the legacy ``{n, cmd, rc, tail, parsed}`` tail-scrape
+    wrapper the r01-r05 files carry; the perf-history ledger keeps
+    accepting the old shape), and ``--history`` normalizes the record
+    into the append-only perf ledger (pagerank_tpu/obs/history.py) —
+    couple, single, --build-only, and --multichip runs alike."""
+    from pagerank_tpu.obs.report import _json_safe
+
+    out["schema_version"] = BENCH_SCHEMA_VERSION
+    line = json.dumps(_json_safe(out), allow_nan=False)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"wrote bench record to {args.out}", file=sys.stderr)
+    if args.history:
+        from pagerank_tpu.obs import history as history_mod
+
+        source = os.path.basename(args.out) if args.out else "bench"
+        rec = history_mod.normalize_result(json.loads(line),
+                                           source=source)
+        added = history_mod.append_record(args.history, rec)
+        print(("appended record to" if added
+               else "record already in (content-hash dedupe)")
+              + f" perf ledger {args.history}", file=sys.stderr)
 
 
 def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
@@ -594,8 +640,9 @@ def run_multichip(args):
             if acc_sm is not None else None
         ),
     }
+    out["edge_factor"] = args.edge_factor
     out["env"] = _env_fingerprint()
-    print(json.dumps(out))
+    _emit(out, args)
 
 
 def main(argv=None):
@@ -656,6 +703,19 @@ def main(argv=None):
                    help="R-MAT scale of the standing accuracy probe")
     p.add_argument("--no-accuracy", action="store_true",
                    help="skip the standing accuracy field")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="ALSO write the JSON record here, directly "
+                        "(ISSUE 9: the canonical BENCH_r*.json shape — "
+                        "no more tail-scraped {n,cmd,rc,tail,parsed} "
+                        "wrapper; the perf-history ledger still "
+                        "ingests the legacy r01-r05 wrappers)")
+    p.add_argument("--history", default=None, metavar="LEDGER",
+                   help="auto-append this run, normalized to the "
+                        "canonical RunRecord, to the append-only perf "
+                        "ledger (pagerank_tpu/obs/history.py; couple, "
+                        "single, --build-only, and --multichip runs "
+                        "alike). Inspect with `python -m "
+                        "pagerank_tpu.obs history trend LEDGER`")
     args = p.parse_args(argv)
 
     _enable_compile_cache()
@@ -704,7 +764,7 @@ def main(argv=None):
                    "pair_warm_over_f32":
                        pair_warm["build_s"] / f32["build_s"]}
         out["env"] = _env_fingerprint()
-        print(json.dumps(out))
+        _emit(out, args)
         return
 
     if args.dtype is not None:
@@ -719,11 +779,14 @@ def main(argv=None):
             "build_s": rate["build_s"],
             "costs": rate["costs"],
             "layout": rate["layout"],
+            "scale": args.scale,
+            "iters": args.iters,
+            "edge_factor": args.edge_factor,
         }
         if not args.no_accuracy:
             out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
         out["env"] = _env_fingerprint()
-        print(json.dumps(out))
+        _emit(out, args)
         return
 
     # Couple mode: the headline is the ACCURACY-GRADE config's rate
@@ -762,6 +825,9 @@ def main(argv=None):
         "fast_f32": f32_rate,  # carries its own "costs" block
         "partitioned_f32": part_rate,
         "fast_bf16": bf16_rate,
+        "scale": args.scale,
+        "iters": args.iters,
+        "edge_factor": args.edge_factor,
     }
     if not args.host_build and args.kernel != "coo":
         # LAST, so the rebuild cannot perturb the rate legs; warm by
@@ -778,7 +844,7 @@ def main(argv=None):
         out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters,
                                        with_bf16=True)
     out["env"] = _env_fingerprint()
-    print(json.dumps(out))
+    _emit(out, args)
 
 
 if __name__ == "__main__":

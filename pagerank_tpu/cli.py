@@ -235,6 +235,16 @@ def build_parser() -> argparse.ArgumentParser:
                     "(obs.profiler_session: stopped on every exit path, "
                     "recorded as a 'profile' span when tracing)")
     ob.add_argument(
+        "--history", default=None, metavar="LEDGER",
+        help="perf-history ledger (obs/history.py; docs/OBSERVABILITY"
+        ".md 'Perf history & gating'): arm the history.* baseline-"
+        "delta gauges from the ledger's median for this run's leg — a "
+        "RUNNING solve shows %% vs baseline through the live exporter "
+        "— and append this run's normalized RunRecord after the "
+        "solve. A missing ledger just means no baseline yet; the "
+        "append creates it",
+    )
+    ob.add_argument(
         "--probe-every", type=int, default=0, metavar="K",
         help="compute convergence probes every K iterations — L1 "
         "residual, rank mass, top-k churn — on device inside the "
@@ -725,6 +735,84 @@ def _robustness_summary(args, engine, guard) -> dict:
     }
 
 
+def _arm_history_baseline(ledger_path, cfg, graph, num_chips) -> None:
+    """--history, the live half (ISSUE 9): read the perf ledger, take
+    the robust baseline (median of the trailing window) of
+    edges/s/chip for THIS run's leg within THIS environment class
+    (baselines never mix backends — the r5 lesson), and arm the
+    ``history.*`` gauges so every iteration publishes % vs baseline
+    through the exporter. Advisory only: an unreadable or empty
+    ledger just means no baseline."""
+    from pagerank_tpu.obs import history as history_mod
+    from pagerank_tpu.obs import live as obs_live
+
+    try:
+        records = history_mod.read_ledger(ledger_path)
+    except ValueError as e:
+        print(f"pagerank_tpu: perf ledger unreadable ({e}); no "
+              "baseline armed", file=sys.stderr)
+        return
+    klass = ...
+    try:
+        import jax
+
+        devs = jax.devices()
+        klass = (jax.default_backend(),
+                 devs[0].device_kind if devs else None)
+    except Exception as e:  # backend down: baseline unscoped, loudly
+        print(f"pagerank_tpu: backend probe failed ({e!r}); history "
+              "baseline compares across all environment classes",
+              file=sys.stderr)
+    leg = history_mod.leg_name_for_config(cfg)
+    pts = history_mod.series(records, leg, "edges_per_sec_per_chip",
+                             klass=klass)
+    vals = [v for _, v in pts][-history_mod.DEFAULT_DETECTION["window"]:]
+    if not vals:
+        print(f"pagerank_tpu: perf ledger {ledger_path} has no "
+              f"'{leg}' records for this environment; no baseline "
+              "armed", file=sys.stderr)
+        return
+    med, _mad = history_mod.median_mad(vals)
+    obs_live.arm_history_baseline(obs_live.HistoryBaseline(
+        leg=leg, baseline_eps=med, num_edges=int(graph.num_edges),
+        num_chips=num_chips, n_baseline=len(vals)))
+
+
+def _append_history_record(args, cfg, graph, summary, robustness,
+                           tracer, report=None) -> None:
+    """--history, the durable half: this run, normalized to the
+    canonical RunRecord (via its flight-recorder report — the same
+    shape `obs report` consumes; the report --run-report already built
+    is reused rather than re-serialized), appended to the ledger.
+    Best-effort: a full solve must never die writing its own
+    history."""
+    from pagerank_tpu.obs import history as history_mod
+
+    if report is None:
+        report = obs.build_run_report(
+            config=cfg,
+            tracer=tracer,
+            registry=obs.get_registry(),
+            summary=summary,
+            robustness=robustness,
+            extra={
+                "graph": {"n": int(graph.n),
+                          "num_edges": int(graph.num_edges)},
+                "engine": args.engine,
+            },
+        )
+    try:
+        rec = history_mod.normalize_result(report, source="cli")
+        added = history_mod.append_record(args.history, rec)
+    except (OSError, ValueError) as e:
+        print(f"pagerank_tpu: perf-history append failed: {e!r}",
+              file=sys.stderr)
+        return
+    print(("appended run record to" if added
+           else "run record already in")
+          + f" perf ledger {args.history}", file=sys.stderr)
+
+
 def _export_observability(args, tracer, cfg, graph, metrics, summary,
                           robustness, probes=None, error=None) -> None:
     """Write the --trace export and/or --run-report artifact
@@ -736,34 +824,38 @@ def _export_observability(args, tracer, cfg, graph, metrics, summary,
     key. The ``costs`` section comes from the process cost ledger
     (obs/costs.py) by default; ``probes`` adds the convergence-probe
     history as its own section (fused runs' probe records don't ride
-    the per-iteration history)."""
+    the per-iteration history). Returns the report dict when one was
+    built (None otherwise) so --history can reuse it instead of
+    serializing the registry/span/cost state a second time."""
     if args.trace:
         tracer.export(args.trace)
         print(f"wrote trace to {args.trace}", file=sys.stderr)
-    if args.run_report:
-        extra = {
-            "graph": (
-                {"n": int(graph.n), "num_edges": int(graph.num_edges)}
-                if graph is not None else None
-            ),
-            "engine": args.engine,
-            "fused": bool(args.fused),
-            "failed": error is not None,
-            "probes": probes.history if probes is not None else [],
-        }
-        if error is not None:
-            extra["error"] = repr(error)
-        report = obs.build_run_report(
-            config=cfg,
-            tracer=tracer,
-            registry=obs.get_registry(),
-            history=metrics.history if metrics is not None else [],
-            summary=summary,
-            robustness=robustness,
-            extra=extra,
-        )
-        obs.write_run_report(args.run_report, report)
-        print(f"wrote run report to {args.run_report}", file=sys.stderr)
+    if not args.run_report:
+        return None
+    extra = {
+        "graph": (
+            {"n": int(graph.n), "num_edges": int(graph.num_edges)}
+            if graph is not None else None
+        ),
+        "engine": args.engine,
+        "fused": bool(args.fused),
+        "failed": error is not None,
+        "probes": probes.history if probes is not None else [],
+    }
+    if error is not None:
+        extra["error"] = repr(error)
+    report = obs.build_run_report(
+        config=cfg,
+        tracer=tracer,
+        registry=obs.get_registry(),
+        history=metrics.history if metrics is not None else [],
+        summary=summary,
+        robustness=robustness,
+        extra=extra,
+    )
+    obs.write_run_report(args.run_report, report)
+    print(f"wrote run report to {args.run_report}", file=sys.stderr)
+    return report
 
 
 def _export_failure(ctx, err) -> None:
@@ -814,6 +906,7 @@ def main(argv=None) -> int:
         # leaked watchdog thread would bark at an idle process).
         obs.disable_tracing()
         obs.disarm_watchdog()
+        obs.disarm_history_baseline()
 
 
 def _main(argv, ctx) -> int:
@@ -1020,6 +1113,10 @@ def _main(argv, ctx) -> int:
         graph.num_edges, num_chips, log_every=args.log_every, jsonl_path=args.jsonl
     )
     ctx["metrics"] = metrics
+    if args.history:
+        # Baseline-delta gauges for the live exporter (ISSUE 9): the
+        # running solve publishes history.* % -vs-ledger-baseline.
+        _arm_history_baseline(args.history, cfg, graph, num_chips)
 
     dumper = None
     if args.dump_text_dir:
@@ -1373,14 +1470,23 @@ def _main(argv, ctx) -> int:
     # config, span summary, metrics snapshot, per-iteration history,
     # cost model, robustness counters. Diff two with
     # `python -m pagerank_tpu.obs report A.json B.json`.
-    if args.run_report and args.engine == "jax":
+    if (args.run_report or args.history) and args.engine == "jax":
         # Fill the cost ledger with the step program's XLA cost model
         # (the fused executables harvested at their compile already);
-        # best-effort by contract — cost_reports never raises.
+        # best-effort by contract — cost_reports never raises. The
+        # perf-history record needs it too: bytes/edge is the ledger's
+        # program-change attribution axis.
         engine.cost_reports()
-    _export_observability(args, tracer, cfg, graph, metrics,
-                          summary=summary, robustness=rb_summary,
-                          probes=probes)
+    report = _export_observability(args, tracer, cfg, graph, metrics,
+                                   summary=summary,
+                                   robustness=rb_summary,
+                                   probes=probes)
+    if args.history:
+        # Durable half of --history: this run's canonical RunRecord
+        # appended to the perf ledger (content-hash deduped; reuses
+        # the --run-report build when both flags are set).
+        _append_history_record(args, cfg, graph, summary, rb_summary,
+                               tracer, report=report)
 
     if args.out:
         names = ids.names if ids is not None else None

@@ -30,6 +30,12 @@ ISSUE 5 adds the live/predictive half:
     text exporter (atomic textfile + HTTP endpoint) and the stall
     watchdog that makes hung collectives loud.
 
+ISSUE 9 adds the longitudinal half — the **perf-regression sentry**
+(obs/history.py): a canonical RunRecord ledger over every bench /
+MULTICHIP / run-report artifact, robust (median+MAD) per-(leg, metric)
+baselines with program-change vs env-drift attribution, and the CI
+gate ``python -m pagerank_tpu.obs history ingest|trend|gate``.
+
 Plus :func:`profiler_session` (obs/profiler.py), the jax.profiler
 lifecycle as a tracer-composed context manager, and :mod:`obs.log`,
 the sanctioned stderr channel for library diagnostics (lint PTL007).
@@ -38,12 +44,16 @@ Import cost: stdlib only (jax is imported lazily inside the functions
 that need it), so any utils module can depend on obs without cycles.
 """
 
-from pagerank_tpu.obs import costs
+from pagerank_tpu.obs import costs, history
 from pagerank_tpu.obs.live import (
+    HistoryBaseline,
     MetricsExporter,
     StallWatchdog,
+    arm_history_baseline,
     arm_watchdog,
+    disarm_history_baseline,
     disarm_watchdog,
+    get_history_baseline,
     get_watchdog,
     render_prometheus,
 )
@@ -77,10 +87,15 @@ from pagerank_tpu.obs.trace import (
 
 __all__ = [
     "costs",
+    "history",
+    "HistoryBaseline",
     "MetricsExporter",
     "StallWatchdog",
+    "arm_history_baseline",
     "arm_watchdog",
+    "disarm_history_baseline",
     "disarm_watchdog",
+    "get_history_baseline",
     "get_watchdog",
     "render_prometheus",
     "ConvergenceProbes",

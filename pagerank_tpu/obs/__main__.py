@@ -1,29 +1,46 @@
 """``python -m pagerank_tpu.obs`` — inspect run flight-recorder
-artifacts.
+artifacts and the perf-history ledger.
 
-  report A.json          pretty-print one run report
-  report A.json B.json   diff two reports (phase-by-phase wall and
-                         rate deltas; environment differences called
-                         out first so backend drift is separable from
-                         code regressions — docs/OBSERVABILITY.md)
+  report A.json            pretty-print one run report
+  report A.json B.json     diff two reports (phase-by-phase wall and
+                           rate deltas; environment differences called
+                           out first so backend drift is separable
+                           from code regressions — docs/OBSERVABILITY.md)
+  report A.json --against-history LEDGER
+                           diff A against the ledger's robust baseline
+                           for its dispatch form (same env-drift-first
+                           rendering)
 
-Exit codes: 0 ok, 2 usage/unreadable input.
+  history ingest LEDGER FILE...   normalize + append result artifacts
+                                  (BENCH/MULTICHIP/run_report shapes,
+                                  legacy wrappers included; content-
+                                  hash dedupe)
+  history trend LEDGER            ASCII per-(leg, metric) series with
+                                  robust baselines + newest-record
+                                  flags (--json for the records)
+  history gate LEDGER             the CI perf gate: budgets +
+                                  program-change regressions fail
+                                  (exit 1); env-drift warns and passes
+
+Exit codes: 0 ok, 1 gate violation, 2 usage/unreadable input.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
+from pagerank_tpu.obs import history as history_mod
 from pagerank_tpu.obs import report as report_mod
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m pagerank_tpu.obs",
-        description="Run-report tooling for the observability layer "
-        "(docs/OBSERVABILITY.md).",
+        description="Run-report and perf-history tooling for the "
+        "observability layer (docs/OBSERVABILITY.md).",
     )
     sub = p.add_subparsers(dest="command", required=True)
     rp = sub.add_parser(
@@ -34,19 +51,99 @@ def build_parser() -> argparse.ArgumentParser:
     rp.add_argument("--json", action="store_true",
                     help="emit the loaded report (or {'a','b'} pair) "
                     "as JSON instead of the human rendering")
+    rp.add_argument(
+        "--against-history", default=None, metavar="LEDGER",
+        help="diff ONE report against the perf-history ledger's "
+        "baseline for its dispatch form (median of the trailing "
+        "window) — the pairwise env-drift-first diff, with the ledger "
+        "standing in for run A",
+    )
+    hp = sub.add_parser(
+        "history",
+        help="perf-history ledger: ingest results, render the trend, "
+        "run the CI perf gate (docs/OBSERVABILITY.md 'Perf history & "
+        "gating')",
+    )
+    hsub = hp.add_subparsers(dest="history_command", required=True)
+    ing = hsub.add_parser(
+        "ingest", help="normalize result JSONs into the ledger "
+        "(append-only, content-hash deduped)")
+    ing.add_argument("ledger", metavar="LEDGER.jsonl")
+    ing.add_argument("files", nargs="+", metavar="RESULT.json",
+                     help="bench couple/single/--build-only JSON, "
+                     "MULTICHIP JSON (dryrun or promoted), "
+                     "run_report.json, or a legacy {n,cmd,rc,tail,"
+                     "parsed} wrapper")
+    ing.add_argument("--json", action="store_true",
+                     help="emit {'added','deduped'} as JSON")
+    tr = hsub.add_parser(
+        "trend", help="ASCII per-(leg, metric) series over the ledger "
+        "with robust baselines and newest-record flags")
+    tr.add_argument("ledger", metavar="LEDGER.jsonl")
+    tr.add_argument("--json", action="store_true",
+                    help="emit the ledger records as JSON instead of "
+                    "the table")
+    tr.add_argument("--budgets", default=None, metavar="BUDGETS.json",
+                    help="read detection knobs (window/threshold/"
+                    "min_samples) from this perf_budgets file")
+    ga = hsub.add_parser(
+        "gate", help="the CI perf gate: exits 1 on a budget breach or "
+        "a program-change regression; env-drift flags warn and pass")
+    ga.add_argument("ledger", metavar="LEDGER.jsonl")
+    ga.add_argument("--budgets", default=None, metavar="BUDGETS.json",
+                    help="perf_budgets.json: absolute env-scoped "
+                    "floors/ceilings + detection knobs (default: "
+                    "MAD detection only)")
+    ga.add_argument("--record", default=None, metavar="RESULT.json",
+                    help="gate this result artifact against the "
+                    "ledger instead of the ledger's own newest record "
+                    "(the artifact is normalized, not appended)")
+    ga.add_argument("--json", action="store_true",
+                    help="emit the GateResult as JSON")
     return p
 
 
-def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+def _load_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _cmd_report(args) -> int:
     if len(args.paths) > 2:
         print("report takes one or two files", file=sys.stderr)
+        return 2
+    if args.against_history and len(args.paths) != 1:
+        print("--against-history diffs exactly one report",
+              file=sys.stderr)
         return 2
     try:
         reports = [report_mod.load_report(p) for p in args.paths]
     except (OSError, json.JSONDecodeError) as e:
         print(f"obs report: cannot load report: {e}", file=sys.stderr)
         return 2
+    if args.against_history:
+        try:
+            records = history_mod.read_ledger(args.against_history)
+        except ValueError as e:
+            print(f"obs report: {e}", file=sys.stderr)
+            return 2
+        leg = history_mod.leg_name_for_config(
+            reports[0].get("config") or {})
+        baseline, n = history_mod.baseline_pseudo_report(
+            records, leg, env=reports[0].get("environment"))
+        if baseline is None:
+            print(f"obs report: ledger {args.against_history} has no "
+                  f"'{leg}' records to baseline against",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({"baseline": baseline, "b": reports[0]},
+                             indent=2, allow_nan=False))
+            return 0
+        print(f"against history: leg '{leg}', baseline = median of "
+              f"{n} ledger record(s) (A = baseline, B = this run)")
+        print(report_mod.diff_reports(baseline, reports[0]))
+        return 0
     if args.json:
         doc = (reports[0] if len(reports) == 1
                else {"a": reports[0], "b": reports[1]})
@@ -57,6 +154,70 @@ def main(argv=None) -> int:
     else:
         print(report_mod.diff_reports(reports[0], reports[1]))
     return 0
+
+
+def _cmd_history(args) -> int:
+    try:
+        if args.history_command == "ingest":
+            added, deduped = history_mod.ingest_paths(args.ledger,
+                                                      args.files)
+            if args.json:
+                print(json.dumps({"added": added, "deduped": deduped},
+                                 allow_nan=False))
+            else:
+                print(f"ingested {added} record(s) into {args.ledger}"
+                      + (f" ({deduped} duplicate(s) skipped)"
+                         if deduped else ""))
+            return 0
+        # trend/gate READ the ledger: a missing path is a usage error
+        # (a mistyped ledger in CI must not gate green on "empty"),
+        # while `ingest` legitimately creates it.
+        records = history_mod.read_ledger(args.ledger)
+        if not records and not os.path.exists(args.ledger):
+            print(f"obs history: no such ledger: {args.ledger}",
+                  file=sys.stderr)
+            return 2
+        budgets = (history_mod.load_budgets(args.budgets)
+                   if args.budgets else None)
+        if args.history_command == "trend":
+            if args.json:
+                print(json.dumps(records, indent=2, allow_nan=False))
+            else:
+                print(history_mod.render_trend(
+                    records,
+                    detection=(budgets or {}).get("detection")))
+            return 0
+        # gate
+        if args.record:
+            rec = history_mod.normalize_result(
+                _load_json(args.record), source=args.record)
+            records = list(records) + [rec]
+        res = history_mod.evaluate_gate(records, budgets)
+        if args.json:
+            print(json.dumps(res.to_dict(), indent=2, allow_nan=False))
+        else:
+            for line in res.notes:
+                print(f"gate: {line}")
+            for line in res.improvements:
+                print(f"gate: IMPROVEMENT {line}")
+            for line in res.drift_warnings:
+                print(f"gate: WARNING {line}")
+            for line in res.violations:
+                print(f"gate: FAIL {line}")
+            print("gate: " + ("PASS" if res.ok else "FAIL")
+                  + (f" ({len(res.drift_warnings)} drift warning(s))"
+                     if res.drift_warnings else ""))
+        return 0 if res.ok else 1
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"obs history: {e}", file=sys.stderr)
+        return 2
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "report":
+        return _cmd_report(args)
+    return _cmd_history(args)
 
 
 if __name__ == "__main__":

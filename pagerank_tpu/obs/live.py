@@ -27,6 +27,7 @@ watchdog is disarmed (the same discipline as the no-op tracer).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -119,7 +120,10 @@ def update_solve_gauges(iteration: int, info: dict,
     """Publish one iteration's headline scalars as registry gauges (the
     live exporter's per-iteration feed) and file the step wall into the
     ``solve.step_seconds`` histogram — whose p50/p90/p99 the exporter
-    and run report surface."""
+    and run report surface. With a perf-history baseline armed
+    (``arm_history_baseline``; CLI ``--history``), also publishes the
+    ``history.*`` baseline-delta gauges so a RUNNING solve shows its
+    % vs the ledger's baseline, not just absolute rates."""
     obs_metrics.gauge(
         "solve.iteration", "iterations completed by the current solve"
     ).set(iteration + 1)
@@ -136,6 +140,72 @@ def update_solve_gauges(iteration: int, info: dict,
             "solve.step_seconds_ms",
             "per-iteration wall clock, milliseconds",
         ).record(seconds * 1e3)
+        b = _HISTORY_BASELINE
+        if b is not None and seconds > 0:
+            b.publish(seconds)
+
+
+# -- perf-history baseline deltas (obs/history.py; ISSUE 9) -----------------
+
+
+@dataclasses.dataclass
+class HistoryBaseline:
+    """A ledger-derived throughput baseline armed for the current
+    solve: per-step seconds become edges/s/chip against the baseline
+    median for this run's leg, published as ``history.*`` gauges every
+    iteration. The disarmed hot path pays one ``is None`` check (the
+    watchdog/tracer discipline)."""
+
+    leg: str
+    baseline_eps: float       # ledger median edges/s/chip for the leg
+    num_edges: int
+    num_chips: int = 1
+    n_baseline: int = 0       # ledger samples behind the median
+
+    def publish(self, seconds: float) -> None:
+        eps = self.num_edges / seconds / max(1, self.num_chips)
+        obs_metrics.gauge(
+            "history.baseline_edges_per_sec_per_chip",
+            "perf-ledger baseline (median edges/s/chip) for this "
+            "run's leg",
+        ).set(self.baseline_eps)
+        obs_metrics.gauge(
+            "history.edges_per_sec_per_chip",
+            "this run's latest per-iteration edges/s/chip",
+        ).set(eps)
+        if self.baseline_eps > 0:
+            obs_metrics.gauge(
+                "history.vs_baseline_pct",
+                "latest iteration rate vs the perf-ledger baseline, "
+                "percent (negative = slower than baseline)",
+            ).set((eps / self.baseline_eps - 1.0) * 100.0)
+
+
+_HISTORY_BASELINE: Optional[HistoryBaseline] = None
+
+
+def arm_history_baseline(baseline: HistoryBaseline) -> HistoryBaseline:
+    """Install the baseline the solve gauges publish deltas against
+    (one per process, like the watchdog)."""
+    global _HISTORY_BASELINE
+    _HISTORY_BASELINE = baseline
+    obs_log.info(
+        f"perf-history baseline armed: leg '{baseline.leg}' at "
+        f"{baseline.baseline_eps:.4g} edges/s/chip "
+        f"(median of {baseline.n_baseline} ledger record(s))"
+    )
+    return baseline
+
+
+def disarm_history_baseline() -> Optional[HistoryBaseline]:
+    global _HISTORY_BASELINE
+    prev = _HISTORY_BASELINE
+    _HISTORY_BASELINE = None
+    return prev
+
+
+def get_history_baseline() -> Optional[HistoryBaseline]:
+    return _HISTORY_BASELINE
 
 
 class MetricsExporter:

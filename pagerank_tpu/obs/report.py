@@ -344,7 +344,11 @@ def diff_reports(a: dict, b: dict) -> str:
         deltas = []
         for key, tag in (("flops_per_iter", "flops"),
                          ("bytes_per_iter", "hbm"),
-                         ("peak_bytes", "peak")):
+                         ("peak_bytes", "peak"),
+                         # The size-normalized axis the perf-history
+                         # ledger baselines on (obs/history.py) — a
+                         # pseudo-baseline report may carry ONLY this.
+                         ("bytes_per_edge", "B/edge")):
             va, vb = fa.get(key), fb.get(key)
             if va == vb:
                 continue

@@ -46,7 +46,9 @@ an 8-fake-device chaos run with one injected device kill that must
 finish on the surviving mesh and match the oracle — ISSUE 7), M
 (sparse boundary exchange: an 8-fake-device halo solve gated on
 oracle parity AND measured exchanged bytes below the dense model —
-ISSUE 8), F (fault injection).
+ISSUE 8), N (perf sentry: a fresh bench result through the history
+ledger + the noise-aware CI gate, regression-vs-drift attribution —
+ISSUE 9), F (fault injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -168,9 +170,22 @@ CONFIGS = {
     # itself in a subprocess like L.
     "M": dict(kind="halo", scale=12, iters=12,
               label="sparse-exchange smoke (8-fake-device halo solve)"),
+    # Perf-sentry smoke (ISSUE 9; obs/history.py): a fresh scale-14
+    # bench result is ingested into a TEMP COPY of the checked-in
+    # ledger via `bench.py --history`; `obs history gate` against the
+    # checked-in perf_budgets.json must PASS in under
+    # HISTORY_GATE_BUDGET_S. Then, on a baseline built from the fresh
+    # record, an env-fingerprint-only drift (wall moved, cost model
+    # flat, jax version bumped) must exit 0 WITH a drift warning,
+    # while an injected regression (wall + cost model moved) must
+    # exit nonzero classified program-change — the two failure modes
+    # the r5 incident could only separate by hand.
+    "N": dict(kind="history", scale=14, iters=3,
+              label="perf-sentry smoke (ledger ingest + noise-aware "
+                    "gate)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "F", "A", "B", "T", "P",
-                "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "F", "A", "B", "T",
+                "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -859,6 +874,144 @@ def run_halo_smoke(key: str):
     return rec
 
 
+# Budget for the perf-sentry GATE run (seconds): reading a ~10-record
+# ledger + per-(leg, metric) median/MAD math is milliseconds; 2s is
+# the ISSUE-9 acceptance bound and still catches an accidentally
+# quadratic detector. The fresh bench run itself is NOT under this
+# budget (it compiles real programs).
+HISTORY_GATE_BUDGET_S = 2.0
+
+
+def run_history_smoke(key: str):
+    """ISSUE-9 gate: the perf-regression sentry end to end. A fresh
+    scale-14 single-config bench (subprocess, real bench.py) appends
+    itself to a TEMP COPY of the checked-in ledger via ``--history``;
+    `obs history gate --budgets perf_budgets.json` must PASS under
+    HISTORY_GATE_BUDGET_S. Then, with a baseline built from the fresh
+    record (3 jittered clones), an env-fingerprint-only drift record
+    (rate -20%, cost model flat, jax version bumped) must gate CLEAN
+    with a drift warning, while an injected regression (rate -50%,
+    cost model moved, env identical) must exit nonzero classified
+    program-change — regression-vs-drift as exit codes, not hand
+    analysis."""
+    import copy
+    import shutil
+    import subprocess
+    import tempfile
+
+    from pagerank_tpu.obs import history as history_mod
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    spec = CONFIGS[key]
+    scale, iters = spec["scale"], spec["iters"]
+    budgets_path = os.path.join(REPO, "perf_budgets.json")
+    work = tempfile.mkdtemp(prefix="pagerank_hist_")
+    try:
+        ledger = os.path.join(work, "PERF_HISTORY.jsonl")
+        shutil.copy(os.path.join(REPO, "PERF_HISTORY.jsonl"), ledger)
+        n_seed = len(history_mod.read_ledger(ledger))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--scale", str(scale), "--dtype", "float32",
+             "--iters", str(iters), "--warmup", "1", "--host-build",
+             "--no-accuracy", "--history", ledger],
+            capture_output=True, text=True, timeout=600,
+        )
+        records = history_mod.read_ledger(ledger)
+        ingested = proc.returncode == 0 and len(records) == n_seed + 1
+
+        t0 = time.perf_counter()
+        rc_fresh = obs_main(["history", "gate", ledger,
+                             "--budgets", budgets_path])
+        t_gate = time.perf_counter() - t0
+
+        # Baseline for the fresh record's environment class: three
+        # jittered clones (rate +-0.2/0.4%, cost + env identical).
+        fresh = records[-1]
+        budgets = history_mod.load_budgets(budgets_path)
+
+        def variant(src_rec, name, eps_factor, cost_factor=1.0,
+                    env_patch=None):
+            rec = copy.deepcopy(src_rec)
+            rec["source"] = name
+            rec.pop("content_hash", None)
+            rec.pop("ingested_unix", None)
+            leg = rec["legs"]["fast_f32"]
+            leg["edges_per_sec_per_chip"] *= eps_factor
+            if "cost_bytes_per_edge" in leg:
+                leg["cost_bytes_per_edge"] *= cost_factor
+            if env_patch:
+                rec["env"].update(env_patch)
+            rec["content_hash"] = history_mod.content_hash(rec)
+            return rec
+
+        for i in (1, 2, 3):
+            history_mod.append_record(
+                ledger, variant(fresh, f"clone{i}", 1.0 + 0.002 * i))
+
+        # Env-fingerprint-only drift: must WARN and pass.
+        drift = variant(fresh, "drift", 0.80,
+                        env_patch={"jax_version": "0.0.0+smoke-drift",
+                                   "jaxlib_version": "0.0.0+smoke"})
+        history_mod.append_record(ledger, drift)
+        rc_drift = obs_main(["history", "gate", ledger,
+                             "--budgets", budgets_path])
+        res_drift = history_mod.evaluate_gate(
+            history_mod.read_ledger(ledger), budgets)
+        drift_flag = [c for c in res_drift.changes
+                      if c.flagged and c.leg == "fast_f32"
+                      and c.metric == "edges_per_sec_per_chip"]
+        drift_ok = (rc_drift == 0 and bool(res_drift.drift_warnings)
+                    and bool(drift_flag)
+                    and drift_flag[0].classification == "env-drift")
+
+        # Injected regression: wall AND cost model moved, env
+        # identical — must FAIL, classified program-change.
+        prog = variant(fresh, "regression", 0.50, cost_factor=2.0)
+        history_mod.append_record(ledger, prog)
+        rc_prog = obs_main(["history", "gate", ledger,
+                            "--budgets", budgets_path])
+        res_prog = history_mod.evaluate_gate(
+            history_mod.read_ledger(ledger), budgets)
+        prog_flag = [c for c in res_prog.changes
+                     if c.flagged and c.leg == "fast_f32"
+                     and c.metric == "edges_per_sec_per_chip"]
+        prog_ok = (rc_prog == 1 and bool(prog_flag)
+                   and prog_flag[0].classification == "program-change")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    passed = bool(ingested and rc_fresh == 0
+                  and t_gate <= HISTORY_GATE_BUDGET_S
+                  and drift_ok and prog_ok)
+    rec = {
+        "config": key,
+        "kind": "history",
+        "label": spec["label"],
+        "scale": scale,
+        "iters": iters,
+        "fresh_record_ingested": ingested,
+        "fresh_gate_rc": rc_fresh,
+        "gate_seconds": t_gate,
+        "gate_budget_s": HISTORY_GATE_BUDGET_S,
+        "env_drift_warns_and_passes": drift_ok,
+        "program_change_fails": prog_ok,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] fresh scale-{scale} bench "
+        f"{'ingested' if ingested else 'NOT INGESTED'}; gate "
+        f"{'PASS' if rc_fresh == 0 else 'FAIL'} in {t_gate:.2f}s vs "
+        f"budget {HISTORY_GATE_BUDGET_S:g}s; env-drift record "
+        f"{'warned+passed' if drift_ok else 'MISHANDLED'}; injected "
+        f"regression "
+        f"{'failed as program-change' if prog_ok else 'MISSED'} -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def run_partitioned_smoke(key: str):
     """ISSUE-6 gate: a short solve on the partition-centric layout —
     the jax engine through the CLI with an explicit --partition-span
@@ -1440,7 +1593,8 @@ def main(argv=None) -> int:
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
                "faults": run_fault_smoke, "obs": run_obs_smoke,
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
-               "elastic": run_elastic_smoke, "halo": run_halo_smoke}
+               "elastic": run_elastic_smoke, "halo": run_halo_smoke,
+               "history": run_history_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

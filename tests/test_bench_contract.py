@@ -55,11 +55,16 @@ def _assert_layout_block(layout, form=None):
 def test_bench_json_contract_couple_mode(tmp_path):
     """Default (couple) mode: pair-f64 headline + f32 secondary + the
     partition-centric legs (ISSUE 6) + the standing scale-N accuracy
-    field, all in ONE JSON line."""
+    field, all in ONE JSON line — which --out writes verbatim as the
+    canonical artifact (no {n,cmd,rc,tail,parsed} wrapper) and
+    --history appends, normalized, to the perf ledger (ISSUE 9)."""
+    out_path = str(tmp_path / "BENCH_fresh.json")
+    ledger = str(tmp_path / "ledger.jsonl")
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--scale", "10",
          "--iters", "2", "--warmup", "1", "--host-build",
-         "--accuracy-scale", "12"],
+         "--accuracy-scale", "12", "--out", out_path,
+         "--history", ledger],
         capture_output=True, text=True, env=_env(), timeout=600,
     )
     assert r.returncode == 0, r.stderr[-800:]
@@ -68,7 +73,23 @@ def test_bench_json_contract_couple_mode(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "build_s", "costs", "layout", "fast_f32",
-                        "partitioned_f32", "fast_bf16", "accuracy", "env"}
+                        "partitioned_f32", "fast_bf16", "accuracy", "env",
+                        "scale", "iters", "edge_factor", "schema_version"}
+    # Every bench emit is versioned now (ISSUE 9 satellite); the
+    # unversioned r01-r05 artifacts still ingest into the ledger.
+    assert rec["schema_version"] >= 2
+    assert rec["scale"] == 10 and rec["iters"] == 2
+    # --out wrote the SAME canonical record directly (strict JSON).
+    with open(out_path) as f:
+        assert json.load(f) == rec
+    # --history appended one normalized RunRecord with the couple legs.
+    with open(ledger) as f:
+        lines = [json.loads(l) for l in f.read().splitlines() if l]
+    assert len(lines) == 1
+    legs = lines[0]["legs"]
+    assert {"pair_f64", "fast_f32", "partitioned_f32",
+            "fast_bf16"} <= set(legs)
+    assert legs["pair_f64"]["edges_per_sec_per_chip"] == rec["value"]
     assert rec["build_s"] > 0 and rec["fast_f32"]["build_s"] > 0
     # Every leg carries the XLA cost-model block (ISSUE 5) and the
     # resolved-layout record (ISSUE 6).
@@ -116,7 +137,10 @@ def test_bench_json_contract_single_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "costs", "layout", "env"}
+                        "build_s", "costs", "layout", "env",
+                        "scale", "iters", "edge_factor",
+                        "schema_version"}
+    assert rec["schema_version"] >= 2
     # The environment fingerprint makes future BENCH_r*.json cells
     # comparable across backend drift (ISSUE 4; obs/report.py).
     assert rec["env"]["jax_version"] and rec["env"]["backend"]
@@ -142,7 +166,8 @@ def test_bench_build_only_reports_stage_breakdown(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "scale", "pair", "f32",
                         "pair_warm", "pair_over_f32", "pair_warm_over_f32",
-                        "env"}
+                        "env", "schema_version"}
+    assert rec["schema_version"] >= 2
     assert rec["metric"] == "build_s" and rec["unit"] == "s"
     assert rec["value"] == rec["pair"]["build_s"] > 0
     assert rec["pair_over_f32"] > 0 and rec["pair_warm_over_f32"] > 0
@@ -178,7 +203,9 @@ def test_multichip_json_contract(tmp_path):
                         "iters", "single_chip", "dense_exchange",
                         "sparse_exchange", "scaling_efficiency",
                         "scaling_efficiency_dense", "exchanged_bytes",
-                        "device_view", "accuracy", "env"}
+                        "device_view", "accuracy", "env", "edge_factor",
+                        "schema_version"}
+    assert rec["schema_version"] >= 2
     assert len(rec["device_view"]) == 8
     assert rec["metric"] == "multichip_edges_per_sec_per_chip"
     assert rec["n_devices"] == 8

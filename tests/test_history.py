@@ -1,0 +1,535 @@
+"""Perf-regression sentry (ISSUE 9; pagerank_tpu/obs/history.py):
+lossless ingest of every historical result schema, content-hash
+dedupe, robust (median+MAD) change detection with program-change vs
+env-drift vs noise attribution, gate exit codes, strict JSON, the
+trend rendering over the checked-in PERF_HISTORY.jsonl, and the live
+history.* baseline-delta gauges."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from pagerank_tpu.obs import history as H
+from pagerank_tpu.obs import live as obs_live
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.obs.__main__ import main as obs_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BENCH_FILES = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+MULTICHIP_FILES = sorted(glob.glob(os.path.join(REPO, "MULTICHIP*.json")))
+PERF_HISTORY = os.path.join(REPO, "PERF_HISTORY.jsonl")
+PERF_BUDGETS = os.path.join(REPO, "perf_budgets.json")
+
+CPU_ENV = {
+    "jax_version": "0.4.37", "jaxlib_version": "0.4.36",
+    "backend": "cpu", "device_kind": "cpu", "device_count": 1,
+    "x64": False, "git_rev": "abc1234", "python": "3.10.16",
+    "platform": "linux-test", "process_count": 1,
+}
+
+
+def make_rec(eps, cost=100.0, env=CPU_ENV, leg="fast_f32", source="synth",
+             accuracy=None, scale=14):
+    """One synthetic couple-shaped RunRecord via the real normalizer —
+    the detection tests exercise the same ingest path real artifacts
+    take."""
+    doc = {
+        "metric": "edges_per_sec_per_chip",
+        "value": eps / 2,  # pair headline; the leg under test is f32
+        "unit": "edges/s/chip",
+        "vs_baseline": 1.0,
+        "fast_f32": {
+            "value": eps,
+            "vs_baseline": 1.0,
+            "costs": {"step": {"bytes_per_edge": cost,
+                               "seconds_per_iter": 0.1}},
+        },
+        "env": dict(env),
+        "schema_version": 2,
+        "scale": scale,
+    }
+    if accuracy is not None:
+        doc["accuracy"] = {"config": "pair-f64",
+                           "normalized_l1_vs_f64_oracle": accuracy}
+    return H.normalize_result(doc, source=source)
+
+
+# -- ingest: every checked-in schema, losslessly ----------------------------
+
+
+def test_checked_in_artifacts_exist():
+    assert len(BENCH_FILES) == 5 and len(MULTICHIP_FILES) == 6
+
+
+def test_ingest_all_checked_in_files_lossless(tmp_path):
+    """Every BENCH_r* and MULTICHIP* file in the repo ingests without
+    error, keeps its headline values bit-exact, and lands once."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    added, deduped = H.ingest_paths(ledger, BENCH_FILES + MULTICHIP_FILES)
+    assert added == len(BENCH_FILES) + len(MULTICHIP_FILES)
+    assert deduped == 0
+    records = H.read_ledger(ledger)
+    by_source = {r["source"]: r for r in records}
+    # r01: legacy single-mode wrapper -> the f32 leg, value bit-exact.
+    r01 = by_source["BENCH_r01.json"]
+    src = json.load(open(BENCH_FILES[0]))
+    assert r01["kind"] == "bench_single" and r01["legacy"]
+    assert r01["legs"]["f32"]["edges_per_sec_per_chip"] == \
+        src["parsed"]["value"]
+    # r05: legacy couple wrapper -> pair + f32 legs, accuracy attached.
+    r05 = by_source["BENCH_r05.json"]
+    src5 = json.load(open(os.path.join(REPO, "BENCH_r05.json")))["parsed"]
+    assert r05["legs"]["pair_f64"]["edges_per_sec_per_chip"] == \
+        src5["value"]
+    assert r05["legs"]["fast_f32"]["edges_per_sec_per_chip"] == \
+        src5["fast_f32"]["value"]
+    assert r05["legs"]["pair_f64"]["build_warm_s"] == src5["build_warm_s"]
+    assert r05["legs"]["pair_f64"]["accuracy_l1"] == \
+        src5["accuracy"]["normalized_l1_vs_f64_oracle"]
+    # The promoted multichip schema: all three legs + comms + cost +
+    # accuracy on the sparse leg.
+    r06 = by_source["MULTICHIP_SPARSE_r06.json"]
+    src6 = json.load(open(os.path.join(REPO, "MULTICHIP_SPARSE_r06.json")))
+    assert r06["kind"] == "multichip"
+    for key, leg in (("single_chip", "multichip_single"),
+                     ("dense_exchange", "multichip_dense"),
+                     ("sparse_exchange", "multichip_sparse")):
+        assert r06["legs"][leg]["edges_per_sec_per_chip"] == \
+            src6[key]["value"]
+    assert r06["legs"]["multichip_sparse"]["comms_bytes_per_iter"] == \
+        src6["sparse_exchange"]["comms"]["bytes_per_iter"]
+    assert r06["legs"]["multichip_sparse"]["cost_bytes_per_edge"] == \
+        src6["sparse_exchange"]["costs"]["step"]["bytes_per_edge"]
+    assert r06["legs"]["multichip_sparse"]["accuracy_l1"] == \
+        src6["accuracy"]["normalized_l1_vs_f64_oracle"]
+    assert r06["env"]["backend"] == "cpu"
+    # The dryrun wrappers ingest as their own kind (lossless: nothing
+    # invents legs for a run that measured none).
+    assert by_source["MULTICHIP_r05.json"]["kind"] == "multichip_dryrun"
+    assert by_source["MULTICHIP_r05.json"]["legs"] == {}
+
+
+def test_run_report_ingests(tmp_path):
+    from pagerank_tpu import PageRankConfig
+    from pagerank_tpu.obs.report import build_run_report
+
+    report = build_run_report(
+        config=PageRankConfig(),
+        summary={"edges_per_sec_per_chip": 1.5e8,
+                 "mean_iter_seconds": 0.2},
+        costs={"step": {"bytes_per_edge": 123.0}},
+    )
+    rec = H.normalize_result(report, source="run_report.json")
+    assert rec["kind"] == "run_report"
+    leg = rec["legs"]["fast_f32"]  # default-config leg name
+    assert leg["edges_per_sec_per_chip"] == 1.5e8
+    assert leg["seconds_per_iter"] == 0.2
+    assert leg["cost_bytes_per_edge"] == 123.0
+    assert rec["env"]  # the report's own fingerprint rides along
+
+
+def test_unrecognized_shape_raises():
+    with pytest.raises(ValueError, match="unrecognized"):
+        H.normalize_result({"hello": 1}, source="x.json")
+
+
+def test_content_hash_dedupe(tmp_path):
+    ledger = str(tmp_path / "ledger.jsonl")
+    added, deduped = H.ingest_paths(ledger, [BENCH_FILES[0]])
+    assert (added, deduped) == (1, 0)
+    added, deduped = H.ingest_paths(ledger, [BENCH_FILES[0]])
+    assert (added, deduped) == (0, 1)
+    assert len(H.read_ledger(ledger)) == 1
+    # Same content under a DIFFERENT source stays: each round is a
+    # sample even when values coincide.
+    doc = json.load(open(BENCH_FILES[0]))
+    rec = H.normalize_result(doc, source="BENCH_other.json")
+    assert H.append_record(ledger, rec)
+    assert len(H.read_ledger(ledger)) == 2
+
+
+def test_ledger_is_strict_json(tmp_path):
+    """allow_nan=False discipline: a NaN smuggled into a result is
+    stored as null, and every ledger line parses under a
+    constant-rejecting JSON reader (the obs emitter contract)."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    rec = make_rec(float("nan"), cost=float("inf"))
+    assert rec["legs"]["fast_f32"].get("edges_per_sec_per_chip") is None \
+        or "edges_per_sec_per_chip" not in rec["legs"]["fast_f32"]
+    H.append_record(ledger, rec)
+
+    def no_const(name):
+        raise ValueError(f"non-spec JSON constant {name!r}")
+
+    with open(ledger) as f:
+        for line in f:
+            json.loads(line, parse_constant=no_const)
+
+
+# -- robust detection + attribution -----------------------------------------
+
+
+def _records(*eps_cost_env):
+    return [make_rec(e, cost=c, env=v, source=f"s{i}")
+            for i, (e, c, v) in enumerate(eps_cost_env)]
+
+
+BASE = [(3.50e8, 100.0, CPU_ENV), (3.52e8, 100.0, CPU_ENV),
+        (3.48e8, 100.0, CPU_ENV), (3.51e8, 100.0, CPU_ENV),
+        (3.49e8, 100.0, CPU_ENV)]
+
+
+def test_within_noise_wobble_is_clean():
+    records = _records(*BASE, (3.47e8, 100.0, CPU_ENV))
+    changes = H.detect_changes(records)
+    assert changes  # the series was evaluable...
+    assert not [c for c in changes if c.flagged]  # ...and clean
+    res = H.evaluate_gate(records)
+    assert res.ok and not res.drift_warnings
+
+
+def test_throughput_drop_with_cost_motion_is_program_change():
+    """The injected 10% f32 drop WITH a moved cost model: flagged as a
+    regression and attributed to the program."""
+    records = _records(*BASE, (3.15e8, 130.0, CPU_ENV))
+    flagged = [c for c in H.detect_changes(records) if c.flagged]
+    drops = [c for c in flagged
+             if c.metric == "edges_per_sec_per_chip"
+             and c.leg == "fast_f32"]
+    assert drops and drops[0].direction == "regression"
+    assert drops[0].classification == "program-change"
+    assert "cost model moved" in drops[0].evidence
+    res = H.evaluate_gate(records)
+    assert not res.ok and any("REGRESSION" in v for v in res.violations)
+
+
+def test_throughput_drop_same_env_flat_cost_is_program_change():
+    """Wall moved, cost flat, environment provably identical: what
+    remains is the code axis (obs report's 'code or load' banner)."""
+    records = _records(*BASE, (3.15e8, 100.0, CPU_ENV))
+    drops = [c for c in H.detect_changes(records)
+             if c.flagged and c.metric == "edges_per_sec_per_chip"]
+    assert drops and drops[0].classification == "program-change"
+    assert "environment identical" in drops[0].evidence
+
+
+def test_jax_version_only_drift_is_env_drift_and_passes_gate():
+    """Wall moved, cost model flat, jax/jaxlib fingerprint drifted:
+    classified env-drift — a warning, never a gate failure."""
+    drift_env = dict(CPU_ENV, jax_version="0.5.0", jaxlib_version="0.5.0")
+    records = _records(*BASE, (3.15e8, 100.0, drift_env))
+    drops = [c for c in H.detect_changes(records)
+             if c.flagged and c.metric == "edges_per_sec_per_chip"]
+    assert drops and drops[0].classification == "env-drift"
+    assert "jax_version" in drops[0].evidence
+    res = H.evaluate_gate(records)
+    assert res.ok
+    assert any("DRIFT" in w for w in res.drift_warnings)
+
+
+def test_improvement_is_reported_not_gated():
+    records = _records(*BASE, (4.3e8, 100.0, CPU_ENV))
+    res = H.evaluate_gate(records)
+    assert res.ok and any("improvement" in i.lower() or "+"
+                          in i for i in res.improvements)
+
+
+def test_min_samples_handling():
+    """Two baseline points cannot define noise: no flag, whatever the
+    delta — the gate notes it instead of guessing."""
+    records = _records(*BASE[:2], (1.0e8, 100.0, CPU_ENV))
+    assert H.detect_changes(records) == []
+    res = H.evaluate_gate(records)
+    assert res.ok
+
+
+def test_baselines_never_mix_env_classes():
+    """A CPU record is not a regression of a TPU series (the r5
+    hand-separation, structural): different (backend, device_kind)
+    classes do not baseline each other, and legacy fingerprint-less
+    records only baseline other legacy records."""
+    tpu_env = dict(CPU_ENV, backend="tpu", device_kind="TPU v5e")
+    records = _records(*[(e, c, tpu_env) for e, c, _ in BASE],
+                       (1.0e7, 100.0, CPU_ENV))
+    assert H.detect_changes(records) == []  # no same-class history
+
+
+def test_direction_awareness_build_seconds():
+    """build_s is an 'up is bad' metric: the same relative move flips
+    direction."""
+    docs = []
+    for i, b in enumerate((30.0, 30.5, 29.8, 30.2, 30.1, 45.0)):
+        doc = {
+            "metric": "edges_per_sec_per_chip", "value": 2.6e8,
+            "unit": "edges/s/chip", "vs_baseline": 1.0,
+            "fast_f32": {"value": 3.5e8, "build_s": b},
+            "env": dict(CPU_ENV), "schema_version": 2,
+        }
+        docs.append(H.normalize_result(doc, source=f"b{i}"))
+    flagged = [c for c in H.detect_changes(docs)
+               if c.flagged and c.metric == "build_s"]
+    assert flagged and flagged[0].direction == "regression"
+
+
+# -- budgets + gate CLI -----------------------------------------------------
+
+
+def test_budget_floor_violation_fails_gate(tmp_path):
+    records = _records(*BASE)
+    budgets = {"budgets": [
+        {"leg": "fast_f32", "metric": "edges_per_sec_per_chip",
+         "min": 4.0e8, "env": {"backend": "cpu"}},
+    ]}
+    res = H.evaluate_gate(records, budgets)
+    assert not res.ok and "below budget min" in res.violations[0]
+
+
+def test_env_scoped_budget_skips_other_backends():
+    """A TPU floor never fires on a CPU record — and never on a legacy
+    record whose fingerprint was never written."""
+    budgets = {"budgets": [
+        {"leg": "fast_f32", "metric": "edges_per_sec_per_chip",
+         "min": 9.9e9, "env": {"backend": "tpu"}},
+    ]}
+    assert H.evaluate_gate(_records(*BASE), budgets).ok
+    legacy = H.normalize_result(
+        json.load(open(os.path.join(REPO, "BENCH_r05.json"))),
+        source="BENCH_r05.json")
+    assert H.evaluate_gate([legacy], budgets).ok
+
+
+def test_accuracy_budget_ceiling():
+    rec = make_rec(3.5e8, accuracy=1e-3)
+    budgets = {"budgets": [
+        {"leg": "pair_f64", "metric": "accuracy_l1", "max": 1e-6},
+    ]}
+    res = H.evaluate_gate([rec], budgets)
+    assert not res.ok and "above budget max" in res.violations[0]
+
+
+def test_gate_cli_exit_codes(tmp_path, capsys):
+    ledger = str(tmp_path / "ledger.jsonl")
+    for r in _records(*BASE):
+        H.append_record(ledger, r)
+    assert obs_main(["history", "gate", ledger]) == 0
+    H.append_record(ledger, make_rec(3.0e8, cost=140.0, source="drop"))
+    assert obs_main(["history", "gate", ledger]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "program-change" in out
+    assert obs_main(["history", "gate", str(tmp_path / "x"),
+                     "--budgets", str(tmp_path / "missing.json")]) == 2
+
+
+def test_gate_cli_json(tmp_path, capsys):
+    ledger = str(tmp_path / "ledger.jsonl")
+    for r in _records(*BASE, (3.0e8, 140.0, CPU_ENV)):
+        H.append_record(ledger, r)
+    rc = obs_main(["history", "gate", ledger, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["ok"] is False
+    assert any(c["flagged"] for c in doc["changes"])
+
+
+def test_ingest_cli(tmp_path, capsys):
+    ledger = str(tmp_path / "ledger.jsonl")
+    rc = obs_main(["history", "ingest", ledger] + BENCH_FILES)
+    assert rc == 0
+    assert "ingested 5 record(s)" in capsys.readouterr().out
+    rc = obs_main(["history", "ingest", ledger, BENCH_FILES[0],
+                   "--json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out) == \
+        {"added": 0, "deduped": 1}
+
+
+# -- the checked-in ledger + budgets ----------------------------------------
+
+
+def test_checked_in_perf_history_renders_every_leg(capsys):
+    """The ISSUE-9 acceptance rendering: `trend PERF_HISTORY.jsonl`
+    carries EVERY leg with its edges/s/chip series — the r1-r5
+    single-chip rounds (pair-f64 + f32), the partition-centric legs,
+    and the promoted multichip dense/sparse legs. The r1->r5 f32
+    plateau is mechanically present."""
+    assert os.path.exists(PERF_HISTORY), "PERF_HISTORY.jsonl not checked in"
+    rc = obs_main(["history", "trend", PERF_HISTORY])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for leg in ("pair_f64", "f32", "fast_f32", "partitioned_f32",
+                "fast_bf16", "multichip_dense", "multichip_sparse",
+                "multichip_single"):
+        assert f"{leg} edges/s/chip" in out, (leg, out)
+    # The plateau read: r01's f32 cell and r05's fast_f32 cell both
+    # render at the known ~3.5e8 values.
+    assert "r01=3.478e+08" in out
+    assert "r05=3.534e+08" in out
+
+
+def test_checked_in_ledger_records_are_deduped_and_versioned():
+    records = H.read_ledger(PERF_HISTORY)
+    hashes = [r["content_hash"] for r in records]
+    assert len(hashes) == len(set(hashes))
+    assert all(r["schema_version"] == H.LEDGER_SCHEMA_VERSION
+               for r in records)
+    legs = {leg for r in records for leg in r["legs"]}
+    assert {"pair_f64", "f32", "fast_f32", "partitioned_f32",
+            "fast_bf16", "multichip_dense", "multichip_sparse"} <= legs
+
+
+def test_checked_in_gate_passes(capsys):
+    """The standing CI gate over the checked-in ledger and budgets
+    must pass — this is the state every future TPU session is gated
+    against."""
+    assert os.path.exists(PERF_BUDGETS), "perf_budgets.json not checked in"
+    rc = obs_main(["history", "gate", PERF_HISTORY,
+                   "--budgets", PERF_BUDGETS])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS" in out
+
+
+def test_checked_in_budgets_catch_injected_regression(tmp_path):
+    """A synthetic 'next TPU session regressed' record against the
+    checked-in budgets: the TPU floor fires only on a TPU-classed
+    record."""
+    budgets = H.load_budgets(PERF_BUDGETS)
+    tpu_env = dict(CPU_ENV, backend="tpu", device_kind="TPU v5e")
+    slow = make_rec(1.0e8, env=tpu_env, scale=23)  # under the 3.0e8 floor
+    records = H.read_ledger(PERF_HISTORY) + [slow]
+    res = H.evaluate_gate(records, budgets)
+    assert not res.ok
+    assert any("fast_f32" in v for v in res.violations)
+    # The SAME slow rate at smoke scale is out of the floors' scope
+    # (min_scale): throughput budgets are headline-geometry statements.
+    small = make_rec(1.0e8, env=tpu_env, scale=14)
+    assert H.evaluate_gate(H.read_ledger(PERF_HISTORY) + [small],
+                           budgets).ok
+
+
+# -- obs report --against-history -------------------------------------------
+
+
+def test_report_against_history(tmp_path, capsys):
+    from pagerank_tpu import PageRankConfig
+    from pagerank_tpu.obs.report import build_run_report, write_run_report
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    for r in _records(*BASE):
+        H.append_record(ledger, r)
+    report = build_run_report(
+        config=PageRankConfig(),
+        summary={"edges_per_sec_per_chip": 3.0e8,
+                 "mean_iter_seconds": 0.1},
+        costs={"step": {"bytes_per_edge": 100.0}},
+    )
+    path = str(tmp_path / "run_report.json")
+    write_run_report(path, report)
+    rc = obs_main(["report", path, "--against-history", ledger])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "against history: leg 'fast_f32'" in out
+    # The env-drift-first rendering is reused verbatim: the banner
+    # line about environment and the rate-delta section both appear.
+    assert "environment" in out
+    assert "rate deltas:" in out
+    # Unknown-leg ledger: clean usage error, not a traceback.
+    rc = obs_main(["report", path, "--against-history",
+                   str(tmp_path / "empty.jsonl")])
+    assert rc == 2
+
+
+# -- live baseline-delta gauges ---------------------------------------------
+
+
+def test_history_gauges_published_when_armed():
+    reg = obs_metrics.get_registry()
+    reg.reset()
+    obs_live.arm_history_baseline(obs_live.HistoryBaseline(
+        leg="fast_f32", baseline_eps=2.0e8, num_edges=1_000_000,
+        num_chips=1, n_baseline=5))
+    try:
+        # 1M edges in 10ms = 1e8 edges/s/chip = -50% vs baseline.
+        obs_live.update_solve_gauges(0, {"l1_delta": 0.1}, seconds=0.01)
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["history.baseline_edges_per_sec_per_chip"] == 2.0e8
+        assert gauges["history.edges_per_sec_per_chip"] == \
+            pytest.approx(1.0e8)
+        assert gauges["history.vs_baseline_pct"] == pytest.approx(-50.0)
+        text = obs_live.render_prometheus(reg)
+        assert "pagerank_history_vs_baseline_pct" in text
+    finally:
+        obs_live.disarm_history_baseline()
+        reg.reset()
+
+
+def test_history_gauges_silent_when_disarmed():
+    reg = obs_metrics.get_registry()
+    reg.reset()
+    obs_live.disarm_history_baseline()
+    obs_live.update_solve_gauges(0, {}, seconds=0.01)
+    assert not any(n.startswith("history.")
+                   for n in reg.snapshot()["gauges"])
+    reg.reset()
+
+
+def test_leg_name_for_config_vocabulary():
+    from pagerank_tpu import PageRankConfig
+
+    assert H.leg_name_for_config(PageRankConfig()) == "fast_f32"
+    assert H.leg_name_for_config(PageRankConfig(
+        dtype="float64", accum_dtype="float64", wide_accum="pair",
+    )) == "pair_f64"
+    assert H.leg_name_for_config(PageRankConfig(
+        partition_span=512)) == "partitioned_f32"
+    assert H.leg_name_for_config(PageRankConfig(
+        partition_span=512, stream_dtype="bfloat16")) == "fast_bf16"
+    assert H.leg_name_for_config(PageRankConfig(
+        vertex_sharded=True)) == "multichip_dense"
+    assert H.leg_name_for_config(PageRankConfig(
+        vertex_sharded=True, halo_exchange=True)) == "multichip_sparse"
+    # f64 naming must agree with _leg_name_from_layout's vocabulary:
+    # the CLI can't set wide_accum (stays "auto", pair on TPU), so its
+    # f64 runs join the headline pair_f64 series; only explicit NATIVE
+    # wide accumulation is the separate "f64" series.
+    assert H.leg_name_for_config(PageRankConfig(
+        dtype="float64", accum_dtype="float64")) == "pair_f64"
+    assert H.leg_name_for_config(PageRankConfig(
+        dtype="float64", accum_dtype="float64",
+        wide_accum="native")) == "f64"
+    assert H._leg_name_from_layout(
+        {"pair": True, "accum_dtype": "float64"}) == "pair_f64"
+    assert H._leg_name_from_layout(
+        {"pair": False, "accum_dtype": "float64"}) == "f64"
+
+
+def test_cli_help_renders_with_history_flag():
+    """argparse %-formats help strings: a bare '%' in the --history
+    help crashed `--help` with ValueError (review finding)."""
+    from pagerank_tpu.cli import build_parser
+
+    assert "--history" in build_parser().format_help()
+
+
+def test_unreadable_ledger_raises_not_empty(tmp_path):
+    """A ledger that exists but can't be read as a file must RAISE —
+    a CI gate going green on an IsADirectoryError would be the silent
+    failure this module exists to prevent. Only a MISSING path reads
+    as the empty ledger."""
+    d = tmp_path / "ledger_dir"
+    d.mkdir()
+    with pytest.raises(OSError):
+        H.read_ledger(str(d))
+    assert H.read_ledger(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_gate_missing_ledger_is_usage_error(tmp_path, capsys):
+    """trend/gate on a mistyped ledger path exit 2, never PASS-on-
+    empty; ingest still creates a fresh ledger."""
+    missing = str(tmp_path / "nope.jsonl")
+    assert obs_main(["history", "gate", missing]) == 2
+    assert obs_main(["history", "trend", missing]) == 2
+    capsys.readouterr()
+    assert obs_main(["history", "ingest", missing, BENCH_FILES[0]]) == 0
