@@ -479,12 +479,17 @@ def run_accuracy(scale: int = 20, iters: int = 50, with_bf16: bool = False,
 def _mc_leg(graph, *, ndev, iters, warmup, halo, label):
     """One multichip rate leg: a vertex-sharded f32 solve over ``ndev``
     devices through the dense or sparse (halo) exchange. Returns the
-    leg dict: edges/s/chip, cost + layout + comms blocks, and the
+    leg dict: edges/s/chip, cost + layout + comms blocks, the
     actually-accumulated ``comms.bytes_exchanged`` delta for the timed
     iterations (the model is static, so delta == iters * model — the
-    equality is part of what the schema test pins)."""
+    equality is part of what the schema test pins), and the
+    comms-vs-compute ``attribution`` block (ISSUE 10): fenced
+    exchange-only vs full-step sub-dispatch timing + achieved wire
+    bytes/s against the model — the is-it-exchange-bound verdict the
+    next TPU session reads first."""
     from pagerank_tpu import PageRankConfig
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+    from pagerank_tpu.obs import devices as obs_devices
     from pagerank_tpu.obs import metrics as obs_metrics
 
     cfg = PageRankConfig(
@@ -504,12 +509,28 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label):
         engine._device_step()
     engine.fence()
     dt = time.perf_counter() - t0
-    eps_chip = graph.num_edges * iters / dt / ndev
-    print(
-        f"multichip[{label}]: {iters} iters on {ndev} device(s): "
-        f"{dt / iters * 1e3:.2f} ms/iter, {eps_chip:.4g} edges/s/chip",
-        file=sys.stderr,
+    # Counter delta read BEFORE attribution: the attribution's own
+    # timing steps legitimately accumulate bytes too, and the schema
+    # test pins delta == iters * model for the TIMED loop.
+    bytes_exchanged = int(ctr.value - c0)
+    attribution = obs_devices.attribute_exchange(
+        engine, iters=max(2, min(iters, 10)), warmup=1
     )
+    eps_chip = graph.num_edges * iters / dt / ndev
+    line = (
+        f"multichip[{label}]: {iters} iters on {ndev} device(s): "
+        f"{dt / iters * 1e3:.2f} ms/iter, {eps_chip:.4g} edges/s/chip"
+    )
+    if attribution and attribution.get("exchange_fraction") is not None:
+        line += (
+            f"; exchange {attribution['exchange_s'] * 1e3:.2f} ms "
+            f"({attribution['exchange_fraction']:.0%} of step"
+            + (f", {attribution['achieved_bytes_per_sec'] / 1e9:.2f} "
+               f"GB/s achieved"
+               if attribution.get("achieved_bytes_per_sec") else "")
+            + ")"
+        )
+    print(line, file=sys.stderr)
     leg = {
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
@@ -519,7 +540,11 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label):
         "costs": _leg_costs(engine, dt / iters, graph.num_edges),
         "layout": engine.layout_info(),
         "comms": engine.comms_model(),
-        "bytes_exchanged": int(ctr.value - c0),
+        "bytes_exchanged": bytes_exchanged,
+        # Comms-vs-compute wall attribution (ISSUE 10; obs/devices):
+        # None on layouts without an exchange-only program
+        # (multi-dispatch downgrades).
+        "attribution": attribution,
     }
     del engine
     return leg
@@ -645,6 +670,57 @@ def run_multichip(args):
     _emit(out, args)
 
 
+def _preflight(args) -> bool:
+    """bench --preflight (ISSUE 10): run the OOM fit check at the
+    geometry this invocation is ABOUT to build, before any device
+    allocation. Couple mode checks the headline pair-f64 config (the
+    fattest resident set of the couple's legs); --dtype checks that
+    config; --multichip checks the vertex-sharded solve over the leg
+    mesh (host-built graph — the build stages don't gate). Prints the
+    per-stage table to stderr; returns whether it fits."""
+    from pagerank_tpu.obs import devices as obs_devices
+
+    if args.multichip:
+        # Model the mesh the legs will ACTUALLY run on: run_multichip
+        # clamps to the visible devices, and a wider modeled mesh
+        # would shard the residency thinner than reality — a preflight
+        # that passes a run that then OOMs. The run's FIRST leg is a
+        # single-chip solve of the same graph (full-width tables and
+        # state on one chip, ~ndev x the sharded residency) — gate
+        # that too, or the fattest leg slips past the check.
+        import jax
+
+        ndev = min(args.multichip_devices, len(jax.devices()))
+        res_single = obs_devices.fit_check(
+            args.scale, edge_factor=args.edge_factor,
+            ndev=1, vertex_sharded=True,
+            device_build=False,
+        )
+        print(obs_devices.render_fit(res_single), file=sys.stderr)
+        if not res_single.fits:
+            return False
+        res = obs_devices.fit_check(
+            args.scale, edge_factor=args.edge_factor,
+            ndev=ndev, vertex_sharded=True,
+            device_build=False,
+        )
+    else:
+        dtype = args.dtype or "float64"
+        wide = "auto" if args.dtype else "pair"
+        res = obs_devices.fit_check(
+            args.scale, edge_factor=args.edge_factor,
+            dtype=dtype, wide_accum=wide,
+            device_build=not args.host_build,
+            # The invocation's own layout flags (the gate must model
+            # the build this run executes; plan_build applies the
+            # same mode gating the legs do).
+            stripe_size=args.stripe_size, lane_group=args.lane_group,
+            partition_span=args.partition_span,
+        )
+    print(obs_devices.render_fit(res), file=sys.stderr)
+    return res.fits
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--scale", type=int, default=23,
@@ -716,9 +792,25 @@ def main(argv=None):
                         "single, --build-only, and --multichip runs "
                         "alike). Inspect with `python -m "
                         "pagerank_tpu.obs history trend LEDGER`")
+    p.add_argument("--preflight", action="store_true",
+                   help="OOM-preflight fit check (ISSUE 10; "
+                        "obs/devices.fit_check) BEFORE anything "
+                        "allocates: abstract-eval the build+step at "
+                        "this run's geometry against per-chip HBM "
+                        "(bytes_limit or the device-kind table) and "
+                        "exit 2 with the per-stage table when it "
+                        "provably does not fit — a 75 s scale-24 "
+                        "build should never be how we learn the "
+                        "answer")
     args = p.parse_args(argv)
 
+    # Cache BEFORE the preflight: its AOT stage compiles are the same
+    # programs the build will compile — repeat preflights (the
+    # gate-then-run workflow) and the run itself share the entries.
     _enable_compile_cache()
+
+    if args.preflight and not _preflight(args):
+        sys.exit(2)
 
     if args.multichip:
         run_multichip(args)

@@ -281,6 +281,27 @@ def build_parser() -> argparse.ArgumentParser:
         "daemon thread",
     )
     ob.add_argument(
+        "--device-sample-every", type=int, default=0, metavar="K",
+        help="sample per-device HBM stats every K completed solve "
+        "steps (obs/devices.DeviceSampler; ISSUE 10): device.<id>.* "
+        "gauges through the live exporter, per-device counter tracks "
+        "in the --trace Chrome export, and the HBM high-water mark in "
+        "the run report (failure-marked reports included — the OOM "
+        "post-mortem evidence). 0 (default) disarms: the solve loop "
+        "makes zero sampler calls, and reports still carry a one-shot "
+        "boundary sample",
+    )
+    ob.add_argument(
+        "--preflight", action="store_true",
+        help="OOM-preflight fit check before building (ISSUE 10; "
+        "obs/devices.fit_check): abstract-eval the build+step at this "
+        "run's geometry against per-chip HBM (bytes_limit or the "
+        "device-kind table) and exit 3 with the per-stage table when "
+        "it provably does not fit. Synthetic specs check BEFORE any "
+        "graph work; file inputs check after the host parse, before "
+        "the engine build (the device-allocation gate either way)",
+    )
+    ob.add_argument(
         "--stall-timeout", type=float, default=None, metavar="SECONDS",
         help="arm the stall watchdog: if no solve step completes "
         "within SECONDS, log a loud diagnostic (last-completed "
@@ -432,6 +453,11 @@ def reject_ppr_incompatible_flags(args) -> None:
             ("--metrics-textfile", args.metrics_textfile is not None),
             ("--metrics-port", args.metrics_port is not None),
             ("--stall-timeout", args.stall_timeout is not None),
+            # The sampler hooks PageRankEngine.run (the global-
+            # PageRank loop); the PPR engine's chunked dispatch never
+            # reads it — reject rather than silently not sample.
+            ("--device-sample-every", bool(args.device_sample_every)),
+            ("--preflight", args.preflight),
             # PprJaxEngine builds replicated [n, k] state and its own
             # stripe layout; the memory-scaling mode and the lane-group
             # override are not implemented there (VERDICT r4 weak #2).
@@ -554,31 +580,31 @@ def load_graph(args):
             "--device-build or --synthetic"
         )
     if args.synthetic:
-        kind, _, rest = args.synthetic.partition(":")
+        # THE shared spec parser (also the --preflight geometry
+        # source) — one grammar, one set of defaults.
+        geo = _parse_synthetic_geometry(args.synthetic)
+        if geo is None:
+            raise SystemExit(f"unknown synthetic spec {args.synthetic!r}")
+        kind, n, e, scale = geo
         if kind == "rmat":
-            scale = int(rest or 20)
             if args.device_build:
                 from pagerank_tpu.ops import device_build as db
 
                 src, dst = db.rmat_edges_device(scale, seed=0)
-                return _device_build_graph(args, src, dst, 1 << scale), None
-            from pagerank_tpu.utils import synth
-
-            src, dst = synth.rmat_edges(scale)
-            return build_graph(src, dst, n=1 << scale), None
-        if kind == "uniform":
-            n_s, _, e_s = rest.partition(":")
-            n, e = int(n_s), int(e_s or 16 * int(n_s))
-            if args.device_build:
-                from pagerank_tpu.ops import device_build as db
-
-                src, dst = db.uniform_edges_device(n, e, seed=0)
                 return _device_build_graph(args, src, dst, n), None
             from pagerank_tpu.utils import synth
 
-            src, dst = synth.uniform_edges(n, e)
+            src, dst = synth.rmat_edges(scale)
             return build_graph(src, dst, n=n), None
-        raise SystemExit(f"unknown synthetic spec {args.synthetic!r}")
+        if args.device_build:
+            from pagerank_tpu.ops import device_build as db
+
+            src, dst = db.uniform_edges_device(n, e, seed=0)
+            return _device_build_graph(args, src, dst, n), None
+        from pagerank_tpu.utils import synth
+
+        src, dst = synth.uniform_edges(n, e)
+        return build_graph(src, dst, n=n), None
 
     fmt = args.format
     path = args.input
@@ -891,6 +917,57 @@ def _export_failure(ctx, err) -> None:
               f"failed: {e2!r}", file=sys.stderr)
 
 
+def _parse_synthetic_geometry(spec: str):
+    """(kind, n, raw num_edges, scale-or-None) from a --synthetic
+    spec, or None when the spec is unrecognized/malformed. THE one
+    spelling of the spec grammar and its defaults (rmat scale 20, 16
+    edges/vertex — utils/synth's edge_factor): load_graph dispatches
+    on it AND --preflight gates on it, so the two can never disagree
+    about what geometry a spec means."""
+    kind, _, rest = spec.partition(":")
+    try:
+        if kind == "rmat":
+            scale = int(rest or 20)
+            return "rmat", 1 << scale, 16 << scale, scale
+        if kind == "uniform":
+            n_s, _, e_s = rest.partition(":")
+            n = int(n_s)
+            return "uniform", n, int(e_s or 16 * n), None
+    except ValueError:
+        return None
+    return None
+
+
+def _run_preflight(args, n: int, num_edges: int, scale,
+                   device_build: bool) -> None:
+    """--preflight (ISSUE 10): the OOM fit check at THIS run's
+    geometry — exits 3 with the per-stage table when per-chip HBM
+    provably cannot hold it, so a doomed scale-24/25 run dies in
+    seconds instead of after a 75 s build."""
+    from pagerank_tpu.obs import devices as obs_devices
+
+    ndev = args.num_devices
+    if ndev is None and args.vertex_sharded:
+        import jax
+
+        ndev = len(jax.devices())
+    res = obs_devices.fit_check(
+        scale if device_build else None, n=n, num_edges=num_edges,
+        ndev=ndev or 1, dtype=args.dtype,
+        accum_dtype=args.accum_dtype or args.dtype,
+        vertex_sharded=bool(args.vertex_sharded),
+        vs_bounded=bool(args.vs_bounded),
+        device_build=device_build,
+        # The run's OWN layout flags: the gate must model the build
+        # the run executes, not the default layout's.
+        lane_group=args.lane_group or 0,
+        partition_span=args.partition_span,
+    )
+    print(obs_devices.render_fit(res), file=sys.stderr)
+    if not res.fits:
+        raise SystemExit(3)
+
+
 def main(argv=None) -> int:
     ctx = {}
     try:
@@ -899,13 +976,15 @@ def main(argv=None) -> int:
         _export_failure(ctx, e)
         raise
     finally:
-        # The process-global tracer (and an armed watchdog) must never
-        # outlive the run that enabled it — success, failure, and
-        # SystemExit alike (tests drive main() in-process; a leaked
-        # tracer would silently accumulate the next run's spans, and a
-        # leaked watchdog thread would bark at an idle process).
+        # The process-global tracer (and an armed watchdog or device
+        # sampler) must never outlive the run that enabled it —
+        # success, failure, and SystemExit alike (tests drive main()
+        # in-process; a leaked tracer would silently accumulate the
+        # next run's spans, and a leaked watchdog thread would bark at
+        # an idle process).
         obs.disable_tracing()
         obs.disarm_watchdog()
+        obs.disarm_sampler()
         obs.disarm_history_baseline()
 
 
@@ -976,6 +1055,13 @@ def _main(argv, ctx) -> int:
             return 2
     if args.ppr_sources:
         reject_ppr_incompatible_flags(args)
+    if args.device_sample_every < 0:
+        print("--device-sample-every must be >= 0", file=sys.stderr)
+        return 2
+    if args.preflight and args.engine != "jax":
+        print("--preflight sizes against device HBM; it requires "
+              "--engine jax", file=sys.stderr)
+        return 2
     # Observability state is per-run, never inherited: a previous
     # in-process main() call (tests drive the CLI this way) must not
     # leak its tracer, counters, or cost ledger into this one.
@@ -985,6 +1071,15 @@ def _main(argv, ctx) -> int:
     tracer = (obs.enable_tracing() if (args.trace or args.run_report)
               else obs.get_tracer())
     ctx["tracer"] = tracer
+    if args.preflight and args.synthetic:
+        # Synthetic geometry is knowable from the spec alone: the fit
+        # check runs BEFORE any graph work — the whole point (a
+        # device-built scale-25 graph IS the allocation being gated).
+        geo = _parse_synthetic_geometry(args.synthetic)
+        if geo is not None:
+            _kind, n_syn, e_syn, scale_syn = geo
+            _run_preflight(args, n_syn, e_syn, scale_syn,
+                           device_build=args.device_build)
     t0 = time.perf_counter()
     with obs.span("ingest/load", input=args.input or args.synthetic):
         try:
@@ -996,6 +1091,13 @@ def _main(argv, ctx) -> int:
             raise SystemExit(str(e))
     t_load = time.perf_counter() - t0
     ctx["graph"] = graph
+    if args.preflight and not args.synthetic:
+        # File inputs: the geometry exists only after the host parse;
+        # the check still precedes the ENGINE build — the device-
+        # allocation gate (solve residency; the host build already
+        # happened, so the build-pipeline stages don't apply).
+        _run_preflight(args, graph.n, graph.num_edges, None,
+                       device_build=False)
     print(
         f"graph: {graph.n:,} vertices, {graph.num_edges:,} edges, "
         f"{int(graph.dangling_mask.sum()):,} dangling ({t_load:.2f}s load)",
@@ -1191,6 +1293,24 @@ def _main(argv, ctx) -> int:
             args.stall_timeout, action=args.stall_action,
             device_source=device_source,
         )
+
+    # Device-plane sampler (obs/devices.py; ISSUE 10): armed ONLY on
+    # explicit opt-in — engine.run reads it once per run, and the
+    # disarmed hot loop makes zero sampler calls (the tracer
+    # discipline). Run reports still embed a one-shot boundary sample
+    # when disarmed (obs/report.build_run_report).
+    if args.device_sample_every:
+        # Sample the SOLVE MESH's devices (the watchdog's
+        # device_source discipline): on a shared host the watermark
+        # must not attribute a foreign job's HBM peak to this run.
+        # Resolved per sweep — None (pre-build boundary samples, the
+        # CPU engine) degrades to every visible device.
+        sample_source = None
+        if args.engine == "jax":
+            def sample_source():
+                return list(_eng().mesh.devices.reshape(-1))
+        obs.arm_sampler(obs.DeviceSampler(
+            every=args.device_sample_every, devices=sample_source))
 
     # Live metrics exporter (obs/live.py): atomic Prometheus textfile
     # per iteration and/or an HTTP /metrics endpoint.

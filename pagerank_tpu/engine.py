@@ -16,6 +16,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from pagerank_tpu.graph import Graph
+from pagerank_tpu.obs import devices as obs_devices
 from pagerank_tpu.obs import live as obs_live
 from pagerank_tpu.obs import metrics as obs_metrics
 from pagerank_tpu.obs import trace as obs_trace
@@ -179,10 +180,13 @@ class PageRankEngine(abc.ABC):
         # pins); enabled, each step is a solve/step span.
         tracer = obs_trace.get_tracer()
         trace_steps = tracer.enabled
-        # Watchdog and probes read ONCE per run, same discipline as the
-        # tracer: disarmed/off, the loop body adds one `is None` check
-        # and one `False and` short-circuit per iteration.
+        # Watchdog, device sampler, and probes read ONCE per run, same
+        # discipline as the tracer: disarmed/off, the loop body adds
+        # one `is None` check and one `False and` short-circuit per
+        # iteration (the sampler's booby-trap contract,
+        # tests/test_devices.py).
         watchdog = obs_live.get_watchdog()
+        sampler = obs_devices.get_sampler()
         probing = probes is not None and probes.enabled
         probe_ids = None
         while self.iteration < total:
@@ -199,6 +203,10 @@ class PageRankEngine(abc.ABC):
                 info = self.step()
             if watchdog is not None:
                 watchdog.heartbeat(self.iteration)
+            if sampler is not None:
+                # Per-device HBM samples at the armed cadence
+                # (obs/devices.DeviceSampler; ISSUE 10).
+                sampler.on_step(self.iteration)
             i = self.iteration
             reason = None
             if rb.health_checks:

@@ -123,6 +123,13 @@ class JaxTpuEngine(PageRankEngine):
         self._comms_counter = None
         self._comms_bytes_per_iter = 0
         self._halo_plan = None
+        # Exchange-only sub-program for comms-vs-compute wall
+        # attribution (ISSUE 10; obs/devices.attribute_exchange): the
+        # vertex-sharded setups stash the un-jitted body here; it is
+        # jitted LAZILY on first attribution use, so a solve that never
+        # attributes pays nothing — not even a compile.
+        self._exchange_core = None
+        self._exchange_fn = None
 
     # -- build ------------------------------------------------------------
 
@@ -132,6 +139,13 @@ class JaxTpuEngine(PageRankEngine):
         # bench.py --build-only alongside the device builder's stage
         # timings.
         self.build_timings = {}
+        # A REBUILD must drop the previous layout's exchange-only
+        # program: the jitted fn closes over the old mesh/state width,
+        # and a layout without an exchange (replicated/multi-dispatch)
+        # must not inherit one — the vs setups reassign _exchange_core
+        # when they apply.
+        self._exchange_core = None
+        self._exchange_fn = None
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
         )
@@ -1930,6 +1944,14 @@ class JaxTpuEngine(PageRankEngine):
         self._inv_in_args = True
         self._step_core = step_core
         self._step_fn = self._jit_step(step_core)
+        if not multi_dispatch:
+            self._exchange_core = self._make_exchange_core(
+                gather_z_fn=lambda r_l, inv_l, rest: gather_z(r_l, inv_l),
+                merge_fn=lambda flat, rest: merge_scatter(flat),
+                n_state_flat=n_vs - padv, accum=accum,
+                in_specs=(P(axis),) * 5
+                + (P(axis, None), P(axis), P()) * n_stripes,
+            )
         self._fused_cache = {}
         self.last_run_metrics = {
             "l1_delta": np.zeros(0, self._accum_dtype),
@@ -2119,6 +2141,14 @@ class JaxTpuEngine(PageRankEngine):
         self._inv_in_args = True
         self._step_core = step_core
         self._step_fn = self._jit_step(step_core)
+        self._exchange_core = self._make_exchange_core(
+            gather_z_fn=lambda r_l, inv_l, rest: gather_z_sparse(
+                r_l, inv_l, rest[:n_halo]),
+            merge_fn=lambda flat, rest: merge_sparse(flat, rest[:n_halo]),
+            n_state_flat=n_vs - padv, accum=accum,
+            in_specs=(P(axis),) * 5 + tuple(halo_specs)
+            + (P(axis, None), P(axis), P()) * n_stripes,
+        )
         self._fused_cache = {}
         self.last_run_metrics = {
             "l1_delta": np.zeros(0, self._accum_dtype),
@@ -2666,6 +2696,112 @@ class JaxTpuEngine(PageRankEngine):
             self._comms_counter.inc(
                 self._comms_bytes_per_iter * int(iters)
             )
+
+    # -- comms-vs-compute attribution (ISSUE 10; obs/devices.py) -----------
+
+    def _make_exchange_core(self, *, gather_z_fn, merge_fn, n_state_flat,
+                            accum, in_specs):
+        """The EXCHANGE-ONLY sub-program of a vertex-sharded step: the
+        same z exchange (all_gather, or head psum + ppermute rounds)
+        and the same contribution merge (reduce-scatter / band
+        windows), with the per-stripe gathers — the compute — replaced
+        by a zero accumulator. Timing this program against the full
+        step attributes the iteration wall between wire and compute
+        (obs/devices.attribute_exchange): the Sparse Allreduce line of
+        work (arXiv:1312.3020) only pays when comms time is measured
+        SEPARATELY from compute, and fake CPU devices can't model ICI
+        — only a fenced sub-dispatch on the real mesh can.
+
+        The zero accumulator carries one element seeded from the
+        gathered z plane so XLA cannot dead-code-eliminate the gather
+        half; the collectives move their full static widths regardless
+        (the payloads are static-shaped). Accepts the FULL step
+        argument tuple (``_device_args``) so dispatch needs no
+        argument re-prep; the stripe tables are simply unused.
+        ``check_vma=False``: the varying-mesh-axes checker cannot see
+        through the dependency-seed epilogue (the same reason
+        _setup_multi_dispatch_vs's prescale disables it)."""
+        mesh = self._mesh
+        axis = self.config.mesh_axis
+
+        def exchange_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+            zs = gather_z_fn(r_l, inv_l, rest)
+            flat = jnp.zeros(n_state_flat, accum).at[0].add(
+                zs[0][0].astype(accum)
+            )
+            contrib_l = merge_fn(flat, rest)
+            return contrib_l[:1]
+
+        return shard_map(
+            exchange_body, mesh=mesh, in_specs=in_specs,
+            out_specs=P(axis), check_vma=False,
+        )
+
+    def has_exchange_program(self) -> bool:
+        """Whether this build can time its exchange separately (the
+        fused vertex-sharded forms; multi-dispatch layouts and
+        replicated modes cannot)."""
+        return self._exchange_core is not None
+
+    def _exchange_step(self):
+        """One dispatch of the exchange-only sub-program over the live
+        step arguments; returns a tiny device array to fence on.
+        Compiled lazily on first call — a run that never attributes
+        never lowers it (the attribution-off transparency contract,
+        tests/test_devices.py booby trap)."""
+        if self._exchange_core is None:
+            raise RuntimeError(
+                "this layout has no exchange-only program "
+                "(replicated or multi-dispatch form)"
+            )
+        if self._exchange_fn is None:
+            with obs_trace.span("engine/compile", form="exchange_only"):
+                self._exchange_fn = jax.jit(self._exchange_core)
+        return self._exchange_fn(*self._device_args())
+
+    def time_exchange_split(self, iters: int = 10, warmup: int = 2):
+        """Fenced sub-dispatch timing for comms-vs-compute attribution
+        (obs/devices.attribute_exchange): ``(exchange_s_per_iter,
+        step_s_per_iter)``, each measured over ``iters`` dispatches
+        behind its own warmup and closed by the honest scalar
+        device_get fence (block_until_ready is not honest on tunneled
+        backends — the module's measurement protocol). The step half
+        ADVANCES the solve state (the rank buffer is donated through
+        the timing steps), so the pre-timing rank vector and iteration
+        count are restored afterward — attribution is a probe, never a
+        mutation; the comms.bytes_exchanged counter DOES count the
+        timing steps (they really moved those bytes), so callers that
+        assert counter/model equality must read their deltas before
+        attributing."""
+        import time
+
+        if iters < 1:
+            raise ValueError(f"iters must be >= 1, got {iters}")
+        r0, it0 = jnp.copy(self._r), self.iteration
+        try:
+            out = None
+            for _ in range(max(0, warmup)):
+                out = self._exchange_step()
+            if out is not None:
+                jax.device_get(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = self._exchange_step()
+            jax.device_get(out)
+            exchange_s = (time.perf_counter() - t0) / iters
+
+            for _ in range(max(0, warmup)):
+                self._device_step()
+            self.fence()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                self._device_step()
+            self.fence()
+            step_s = (time.perf_counter() - t0) / iters
+        finally:
+            self._r = r0
+            self.iteration = it0
+        return exchange_s, step_s
 
     # -- iteration --------------------------------------------------------
 

@@ -36,6 +36,13 @@ MULTICHIP / run-report artifact, robust (median+MAD) per-(leg, metric)
 baselines with program-change vs env-drift attribution, and the CI
 gate ``python -m pagerank_tpu.obs history ingest|trend|gate``.
 
+ISSUE 10 adds the **device plane** (obs/devices.py): the structured
+per-device HBM sampler (``device.<id>.*`` gauges, per-device Chrome
+counter tracks, the run report's OOM-forensics watermark),
+comms-vs-compute wall attribution for the sharded step
+(``comms.exchange_fraction`` / ``comms.achieved_bytes_per_sec``), and
+the OOM-preflight fit check (``python -m pagerank_tpu.obs fit``).
+
 Plus :func:`profiler_session` (obs/profiler.py), the jax.profiler
 lifecycle as a tracer-composed context manager, and :mod:`obs.log`,
 the sanctioned stderr channel for library diagnostics (lint PTL007).
@@ -44,7 +51,13 @@ Import cost: stdlib only (jax is imported lazily inside the functions
 that need it), so any utils module can depend on obs without cycles.
 """
 
-from pagerank_tpu.obs import costs, history
+from pagerank_tpu.obs import costs, devices, history
+from pagerank_tpu.obs.devices import (
+    DeviceSampler,
+    arm_sampler,
+    disarm_sampler,
+    get_sampler,
+)
 from pagerank_tpu.obs.live import (
     HistoryBaseline,
     MetricsExporter,
@@ -87,7 +100,12 @@ from pagerank_tpu.obs.trace import (
 
 __all__ = [
     "costs",
+    "devices",
     "history",
+    "DeviceSampler",
+    "arm_sampler",
+    "disarm_sampler",
+    "get_sampler",
     "HistoryBaseline",
     "MetricsExporter",
     "StallWatchdog",

@@ -1,5 +1,5 @@
 """``python -m pagerank_tpu.obs`` — inspect run flight-recorder
-artifacts and the perf-history ledger.
+artifacts, the perf-history ledger, and the OOM-preflight fit check.
 
   report A.json            pretty-print one run report
   report A.json B.json     diff two reports (phase-by-phase wall and
@@ -22,7 +22,17 @@ artifacts and the perf-history ledger.
                                   program-change regressions fail
                                   (exit 1); env-drift warns and passes
 
-Exit codes: 0 ok, 1 gate violation, 2 usage/unreadable input.
+  fit --scale N [--ndev D]        OOM preflight (ISSUE 10): abstract-
+                                  eval the build+step at the target
+                                  geometry (XLA memory_analysis per
+                                  stage, NOTHING allocates), compare
+                                  per-chip peaks against bytes_limit /
+                                  the device-kind HBM table, and exit
+                                  nonzero with the per-stage table
+                                  when it provably does not fit
+
+Exit codes: 0 ok, 1 gate violation / does not fit, 2 usage/unreadable
+input.
 """
 
 from __future__ import annotations
@@ -100,7 +110,86 @@ def build_parser() -> argparse.ArgumentParser:
                     "(the artifact is normalized, not appended)")
     ga.add_argument("--json", action="store_true",
                     help="emit the GateResult as JSON")
+    fp = sub.add_parser(
+        "fit",
+        help="OOM-preflight fit check (ISSUE 10; obs/devices.py): "
+        "will the device build + solve at this geometry fit per-chip "
+        "HBM? Exits 1 with the per-stage table when it won't — "
+        "BEFORE any real allocation",
+    )
+    fp.add_argument("--scale", type=int, required=True,
+                    help="R-MAT scale (2^scale vertices, "
+                    "edge_factor<<scale raw edges) — the bench/ROADMAP "
+                    "geometry vocabulary")
+    fp.add_argument("--ndev", type=int, default=1,
+                    help="target device count; >1 implies the "
+                    "vertex-sharded (memory-scaling) solve")
+    fp.add_argument("--vs-bounded", action="store_true",
+                    help="size the owner-computes bounded mode "
+                    "(config.vs_bounded): per-chip step transients "
+                    "O(stripe_span + N/ndev) instead of O(N); "
+                    "implies --host-build (the mode requires a "
+                    "host-built graph)")
+    fp.add_argument("--edge-factor", type=int, default=16)
+    fp.add_argument("--dtype", default="float32")
+    fp.add_argument("--accum-dtype", default=None,
+                    help="defaults to --dtype")
+    fp.add_argument("--wide-accum", default="auto",
+                    choices=["auto", "pair", "native"])
+    fp.add_argument("--host-build", action="store_true",
+                    help="skip the device-build pipeline stages (the "
+                    "graph arrives host-built; only the solve "
+                    "residency gates)")
+    fp.add_argument("--hbm-gb", type=float, default=None,
+                    help="explicit per-chip HBM limit in GiB "
+                    "(default: live bytes_limit, else the device-kind "
+                    "capacity table, else 16 GiB v5e-class)")
+    fp.add_argument("--device-kind", default=None,
+                    help="size against this device kind's published "
+                    "HBM capacity (e.g. 'TPU v4') instead of the "
+                    "attached device")
+    fp.add_argument("--headroom", type=float, default=None,
+                    help="fraction of the limit usable after runtime "
+                    "reserve (default 0.9)")
+    fp.add_argument("--json", action="store_true",
+                    help="emit the FitResult as JSON")
     return p
+
+
+def _cmd_fit(args) -> int:
+    from pagerank_tpu.obs import devices as devices_mod
+
+    kwargs = {}
+    if args.hbm_gb is not None:
+        if args.hbm_gb <= 0:
+            print("obs fit: --hbm-gb must be positive", file=sys.stderr)
+            return 2
+        kwargs["limit_bytes"] = int(args.hbm_gb * (1 << 30))
+    if args.headroom is not None:
+        if not 0 < args.headroom <= 1:
+            print("obs fit: --headroom must be in (0, 1]",
+                  file=sys.stderr)
+            return 2
+        kwargs["headroom"] = args.headroom
+    try:
+        res = devices_mod.fit_check(
+            args.scale, ndev=args.ndev, edge_factor=args.edge_factor,
+            dtype=args.dtype, accum_dtype=args.accum_dtype,
+            wide_accum=args.wide_accum,
+            vertex_sharded=True if args.vs_bounded else None,
+            vs_bounded=args.vs_bounded,
+            device_build=not (args.host_build or args.vs_bounded),
+            device_kind=args.device_kind, **kwargs,
+        )
+    except ValueError as e:
+        print(f"obs fit: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report_mod._json_safe(res.to_json()),
+                         indent=2, allow_nan=False))
+    else:
+        print(devices_mod.render_fit(res))
+    return 0 if res.fits else 1
 
 
 def _load_json(path: str):
@@ -217,6 +306,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "report":
         return _cmd_report(args)
+    if args.command == "fit":
+        return _cmd_fit(args)
     return _cmd_history(args)
 
 

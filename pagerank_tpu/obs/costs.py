@@ -67,6 +67,35 @@ def hbm_peak_bytes_per_s(device_kind: Optional[str]) -> Optional[float]:
     return None
 
 
+#: Published per-chip HBM CAPACITY, bytes, keyed like
+#: :data:`HBM_PEAK_BYTES_PER_S` — the LIMIT side of the OOM-preflight
+#: fit check (ISSUE 10; obs/devices.fit_check) when no live device
+#: reports ``bytes_limit`` (CPU test substrate, or sizing a run for a
+#: TPU that isn't attached yet). v3 is per-core (the unit jax exposes
+#: as a device).
+HBM_CAPACITY_BYTES = {
+    "tpu v6": 32 << 30,
+    "tpu v5p": 95 << 30,
+    "tpu v5": 16 << 30,  # v5e ("TPU v5 lite" / "TPU v5e")
+    "tpu v4": 32 << 30,
+    "tpu v3": 16 << 30,
+    "tpu v2": 8 << 30,
+}
+
+
+def hbm_capacity_bytes(device_kind: Optional[str]) -> Optional[int]:
+    """Per-chip HBM capacity for a ``device_kind`` string (same
+    longest-substring match as the roofline table), or None when the
+    kind is unknown."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key in sorted(HBM_CAPACITY_BYTES, key=len, reverse=True):
+        if key in kind:
+            return HBM_CAPACITY_BYTES[key]
+    return None
+
+
 @dataclass
 class CostReport:
     """One compiled program's static cost model (+ optional measured
@@ -186,6 +215,30 @@ def harvest(form: str, compiled, *, num_edges: Optional[int] = None,
     if record:
         record_report(report)
     return report
+
+
+def harvest_abstract(form: str, fn, args, *, static_kwargs=None,
+                     donate_argnums=(), num_edges: Optional[int] = None,
+                     ) -> CostReport:
+    """Harvest a program's cost/memory model WITHOUT executing or
+    allocating it: AOT-lower ``fn`` over abstract ``args``
+    (ShapeDtypeStructs are fine — nothing is device_put) and read the
+    compiled handle's analyses. The OOM-preflight fit check
+    (obs/devices.fit_check) runs the whole device-build pipeline
+    through this at the TARGET geometry before any real buffer exists.
+    Unlike :func:`harvest` this does NOT record into the ledger (a
+    what-if geometry must not overwrite the live run's model) and DOES
+    propagate compile errors — a stage that cannot even lower at the
+    target shapes is itself a preflight verdict the caller reports."""
+    import functools
+
+    import jax
+
+    if static_kwargs:
+        fn = functools.partial(fn, **static_kwargs)
+    compiled = jax.jit(fn, donate_argnums=tuple(donate_argnums)).lower(
+        *args).compile()
+    return harvest(form, compiled, num_edges=num_edges, record=False)
 
 
 # -- process-global ledger --------------------------------------------------

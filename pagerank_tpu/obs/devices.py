@@ -1,0 +1,750 @@
+"""Device-plane observability (ISSUE 10): per-device HBM telemetry,
+comms-vs-compute wall attribution, and the OOM-preflight fit check.
+
+The next TPU session opens on three questions the rest of the obs
+stack cannot answer: *is the sharded step exchange-bound or
+compute-bound* (the Sparse Allreduce trade, arXiv:1312.3020, only pays
+when comms time is measured separately from compute), *which chip is
+the straggler and why* (per-device evidence, not one aggregate), and
+*will scale 24/25 even fit in HBM before we pay a 75 s build* (the
+FPGA streaming-SpMV paper, arXiv:2009.10443, sizes layout choices
+against a memory roofline — which needs the memory numbers FIRST).
+This module is that device plane, in three pieces:
+
+  - :class:`DeviceSampler` — a structured per-device sampler over
+    ``parallel/mesh.device_stats()`` (typed; None-tolerant on CPU):
+    ``device.<id>.*`` exporter gauges, per-device HBM counter tracks
+    in the Chrome trace (one Perfetto lane per chip), and a
+    high-water mark kept across the run that the run report embeds —
+    **failure-marked reports included**, so an OOM post-mortem has
+    evidence. Process-global arm/disarm like the watchdog: DISARMED,
+    the solve hot loop makes ZERO sampler calls per iteration (the
+    tracer's booby-trap contract, tests/test_devices.py).
+  - :func:`attribute_exchange` — comms-vs-compute wall attribution
+    for the vertex-sharded/halo step: fenced sub-dispatch timing of
+    the engine's exchange-only program vs the full step (the honest
+    scalar-device_get fence discipline, engines/jax_engine.py),
+    combined with the parallel/comms.py byte model into
+    ``comms.achieved_bytes_per_sec`` and ``comms.exchange_fraction``
+    gauges and the per-leg ``attribution`` block of
+    ``bench.py --multichip``.
+  - :func:`fit_check` — the OOM preflight: abstract-eval the device
+    build pipeline at the TARGET geometry (AOT lowering over
+    ShapeDtypeStructs — XLA's own ``memory_analysis`` per stage, via
+    obs/costs.harvest_abstract; nothing allocates) plus an analytic
+    per-chip solve-residency model, compared against per-chip
+    ``bytes_limit`` (or the device-kind HBM capacity table when no
+    accelerator is attached). ``python -m pagerank_tpu.obs fit
+    --scale N [--ndev D]`` exits nonzero with the per-stage table
+    before any real allocation; ``bench.py --preflight`` and the CLI
+    ``--preflight`` run the same check before building.
+
+Import cost: stdlib + obs modules only (jax and parallel/mesh are
+imported lazily inside the functions that need them), so obs/__init__
+can re-export this module without dragging a backend in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional, Sequence
+
+from pagerank_tpu.obs import costs as obs_costs
+from pagerank_tpu.obs import log as obs_log
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.obs import trace as obs_trace
+
+# -- per-device sampler ------------------------------------------------------
+
+#: Chrome-trace pid base for per-device counter tracks: device id d
+#: renders on pid TRACK_PID_BASE + d, above the kernel's maximum
+#: pid_max (2^22 on Linux), so the HBM lanes can never collide with
+#: the process's own span rows in Perfetto.
+TRACK_PID_BASE = 1 << 23
+
+
+class DeviceSampler:
+    """Structured per-device memory sampler.
+
+    Each :meth:`sample` reads ``mesh.device_stats()`` once and fans the
+    typed records out to every device-plane surface:
+
+      - ``device.<id>.bytes_in_use`` / ``.bytes_limit`` /
+        ``.peak_bytes`` registry gauges — registered EAGERLY (the name
+        exists in the snapshot even when a CPU backend reports None;
+        an unset gauge publishes no sample, and the exporter output
+        still strict-parses);
+      - a ``device.<id>.hbm`` counter point on the active tracer's
+        per-device track (Chrome ``ph:"C"``, one Perfetto lane per
+        chip) — skipped entirely when tracing is off;
+      - the cross-run high-water mark (:meth:`watermark`) the run
+        report embeds, folded with the backend's own
+        ``peak_bytes_in_use`` when it keeps one.
+
+    ``on_step(iteration)`` is the engine.run hook: it samples at the
+    ``every`` cadence. The hook only runs when a sampler is ARMED
+    (:func:`arm_sampler`); disarmed, engine.run reads
+    :func:`get_sampler` once per run and the loop body makes zero
+    sampler calls (the no-op tracer discipline)."""
+
+    def __init__(self, every: int = 1, devices: Optional[Sequence] = None):
+        if every < 1:
+            raise ValueError(f"sample cadence must be >= 1, got {every}")
+        self.every = int(every)
+        # A sequence pins the device set; a zero-arg CALLABLE resolves
+        # it at each sweep (the watchdog's device_source idiom — the
+        # solve mesh only exists after build, and tracks the rebuilt
+        # engine after an elastic rescue). None sweeps every visible
+        # device. The watermark must report the chips THIS run uses —
+        # on a shared host, a foreign job's HBM peak in our OOM
+        # post-mortem is worse than no watermark at all.
+        self._devices = (devices if devices is None or callable(devices)
+                         else list(devices))
+        self.samples = 0
+        self.last: List = []
+        #: Per-device high-water ``bytes_in_use`` across every sample
+        #: of this sampler's life (plus the backend's own peak field).
+        self.peak_bytes: Dict[int, int] = {}
+
+    def sample(self, iteration: Optional[int] = None) -> List:
+        """One sweep over the devices; returns the typed
+        :class:`~pagerank_tpu.parallel.mesh.DeviceStats` list. Never
+        raises past the stats read itself degrading to None fields —
+        telemetry must not fail a run."""
+        from pagerank_tpu.parallel import mesh as mesh_lib
+
+        devs = self._devices
+        if callable(devs):
+            try:
+                devs = list(devs())
+            except Exception:
+                # Pre-build boundary samples (or a source reading a
+                # torn-down engine) degrade to the full sweep — a
+                # telemetry source must never fail a run.
+                devs = None
+        stats = mesh_lib.device_stats(devs)
+        self.samples += 1
+        self.last = stats
+        tracer = obs_trace.get_tracer()
+        for s in stats:
+            # Eager registration: the per-device names exist in the
+            # registry snapshot from the first sample even when every
+            # value is None (CPU) — the same discipline as the elastic
+            # monitor's eager elastic.* registration.
+            g_use = obs_metrics.gauge(
+                f"device.{s.id}.bytes_in_use",
+                f"live HBM bytes in use on device {s.id}",
+            )
+            g_lim = obs_metrics.gauge(
+                f"device.{s.id}.bytes_limit",
+                f"HBM byte limit the backend reports for device {s.id}",
+            )
+            g_peak = obs_metrics.gauge(
+                f"device.{s.id}.peak_bytes",
+                f"high-water HBM bytes observed on device {s.id} "
+                f"(max of sampled bytes_in_use and the backend's own "
+                f"peak counter)",
+            )
+            if s.bytes_limit is not None:
+                g_lim.set(s.bytes_limit)
+            peak = self.peak_bytes.get(s.id)
+            for candidate in (s.bytes_in_use, s.peak_bytes_in_use):
+                if candidate is not None:
+                    peak = candidate if peak is None else max(peak,
+                                                              candidate)
+            if s.bytes_in_use is not None:
+                g_use.set(s.bytes_in_use)
+            if peak is not None:
+                self.peak_bytes[s.id] = peak
+                g_peak.set(peak)
+            if tracer.enabled:
+                # Counter points only when the backend reported real
+                # byte values: a CPU run must not fill the trace with
+                # empty HBM lanes (the ts axis already orders samples;
+                # no iteration field needed).
+                values = {
+                    k: v for k, v in (
+                        ("bytes_in_use", s.bytes_in_use),
+                        ("bytes_limit", s.bytes_limit),
+                    ) if v is not None
+                }
+                if values:
+                    tracer.add_counter(
+                        f"device.{s.id}.hbm", values,
+                        track=TRACK_PID_BASE + s.id,
+                        track_label=(
+                            f"device {s.platform}:{s.id} ({s.kind})"
+                        ),
+                    )
+        if self.peak_bytes:
+            obs_metrics.gauge(
+                "device.hbm_high_water_bytes",
+                "max HBM bytes_in_use observed on any device this run",
+            ).set(max(self.peak_bytes.values()))
+        return stats
+
+    def on_step(self, iteration: int) -> None:
+        """engine.run's per-completed-step hook (armed samplers only):
+        sample at the ``every`` cadence, starting from the first
+        step."""
+        if iteration % self.every == 0:
+            self.sample(iteration)
+
+    def watermark(self) -> dict:
+        """The run report's ``devices`` section: the high-water mark,
+        per-device peaks, and the LAST full sample — the OOM-forensics
+        record a failure-marked report carries (cli._export_observability
+        embeds this on the failure path too)."""
+        overall = max(self.peak_bytes.values()) if self.peak_bytes else None
+        return {
+            "samples": self.samples,
+            "hbm_high_water_bytes": overall,
+            "per_device_peak_bytes": {
+                str(k): v for k, v in sorted(self.peak_bytes.items())
+            },
+            "last": [s.to_json() for s in self.last],
+        }
+
+
+_SAMPLER: Optional[DeviceSampler] = None
+
+
+def get_sampler() -> Optional[DeviceSampler]:
+    """The armed sampler, or None (the default — engine.run reads this
+    once per run; disarmed, the hot loop makes zero sampler calls)."""
+    return _SAMPLER
+
+
+def arm_sampler(sampler: DeviceSampler) -> DeviceSampler:
+    """Install ``sampler`` as the process sampler (one per process,
+    like the watchdog) and take an immediate baseline sample."""
+    global _SAMPLER
+    _SAMPLER = sampler
+    sampler.sample()
+    return sampler
+
+
+def disarm_sampler() -> Optional[DeviceSampler]:
+    global _SAMPLER
+    prev = _SAMPLER
+    _SAMPLER = None
+    return prev
+
+
+def report_section(sample_now: bool = True) -> Optional[dict]:
+    """The ``devices`` section every run report carries (success AND
+    failure paths): the armed sampler's watermark — refreshed with one
+    final sample so the report's last record reflects teardown-time
+    state — or, with no sampler armed, a one-shot sample (still real
+    OOM evidence, just without in-run history). Never raises: a report
+    must be writable when the backend is the thing that broke."""
+    try:
+        s = get_sampler()
+        if s is None:
+            s = DeviceSampler()
+            s.sample()
+        elif sample_now:
+            s.sample()
+        return s.watermark()
+    except Exception as e:  # a broken backend must not block the report
+        return {"error": repr(e)}
+
+
+# -- comms-vs-compute attribution -------------------------------------------
+
+
+def attribute_exchange(engine, iters: int = 10, warmup: int = 2,
+                       ) -> Optional[dict]:
+    """Wall attribution of the vertex-sharded step: time the engine's
+    EXCHANGE-ONLY sub-program (the same all_gather / head-psum +
+    ppermute rounds and the same merge collectives, compute replaced
+    by a zero accumulator — engines/jax_engine._make_exchange_core)
+    against the full step, both under the honest scalar-device_get
+    fence, and combine with the static comms byte model
+    (parallel/comms.py):
+
+      - ``exchange_s`` / ``compute_s`` / ``step_s`` (per iteration);
+      - ``exchange_fraction`` = exchange / step — the is-it-wire-bound
+        verdict, published as the ``comms.exchange_fraction`` gauge;
+      - ``achieved_bytes_per_sec`` = modeled wire bytes per iteration
+        over the measured exchange seconds — what the interconnect
+        actually delivered, published as
+        ``comms.achieved_bytes_per_sec``. On fake CPU devices this is
+        shared-memory bandwidth, not ICI — the number is honest about
+        WHERE it was measured (the env fingerprint rides every
+        artifact that embeds this block).
+
+    Returns None when the engine has no exchange-only program
+    (replicated modes, multi-dispatch layouts). Out-of-band by
+    construction: nothing here touches the solve hot loop, and the
+    engine's exchange program is compiled lazily on the first call —
+    attribution off costs zero calls AND zero compiles (the
+    transparency contract, tests/test_devices.py)."""
+    has = getattr(engine, "has_exchange_program", None)
+    if has is None or not has():
+        return None
+    exchange_s, step_s = engine.time_exchange_split(
+        iters=iters, warmup=warmup
+    )
+    model = engine.comms_model() or {}
+    model_bytes = model.get("bytes_per_iter") or 0
+    # Clamped like compute_s: the two walls are measured independently
+    # and at dispatch-overhead-dominated toy geometries timing noise
+    # can push the raw ratio past 1 — a fraction is a fraction.
+    fraction = (min(1.0, exchange_s / step_s)) if step_s > 0 else None
+    achieved = (model_bytes / exchange_s
+                if exchange_s > 0 and model_bytes else None)
+    out = {
+        "iters": int(iters),
+        "exchange_s": exchange_s,
+        "step_s": step_s,
+        "compute_s": max(0.0, step_s - exchange_s),
+        "exchange_fraction": fraction,
+        "model_bytes_per_iter": int(model_bytes) if model_bytes else None,
+        "achieved_bytes_per_sec": achieved,
+        "mode": model.get("mode"),
+    }
+    if fraction is not None:
+        obs_metrics.gauge(
+            "comms.exchange_fraction",
+            "measured exchange wall over the full step wall "
+            "(vertex-sharded attribution)",
+        ).set(fraction)
+    if achieved is not None:
+        obs_metrics.gauge(
+            "comms.achieved_bytes_per_sec",
+            "modeled exchange bytes over the measured exchange "
+            "seconds — delivered interconnect bandwidth",
+        ).set(achieved)
+    return out
+
+
+# -- OOM-preflight fit check -------------------------------------------------
+
+#: Slot-row estimate slack over the raw-edge lower bound e/128: ELL
+#: rows pad to the max lane-group run per (stripe, 128-dst block), and
+#: R-MAT skew makes hub blocks ragged — measured slots/edge lands
+#: 1.1-1.5 at bench geometries (docs/PERF_NOTES.md "Partition-centric
+#: restage"); 1.6 upper-bounds it (soundness pinned by
+#: tests/test_devices.py::test_fit_slot_row_estimate_upper_bounds_real_build).
+SLOT_ROW_SLACK = 1.6
+
+#: Fit-check limit of last resort when nothing is attached and no kind
+#: was named: the v5e-class 16 GiB chip the repo's measured numbers
+#: come from (BASELINE.md).
+DEFAULT_FIT_LIMIT_BYTES = 16 << 30
+DEFAULT_FIT_HEADROOM = 0.9  # runtime/framework reserve off the top
+
+
+@dataclasses.dataclass
+class FitStage:
+    """One stage of the preflight table: the modeled per-chip peak
+    bytes and where the number came from (``xla`` = AOT-compiled
+    memory_analysis at the target shapes; ``model`` = the documented
+    analytic formula; ``unknown`` = the backend compiled the stage but
+    reports no memory analysis — surfaced, never blocking; ``error`` =
+    the stage cannot even lower at this geometry, which is itself a
+    does-not-fit verdict)."""
+
+    stage: str
+    bytes: Optional[int]
+    source: str
+    detail: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FitResult:
+    fits: bool
+    limit_bytes: int
+    limit_source: str
+    headroom: float
+    n: int
+    num_edges: int
+    ndev: int
+    dtype: str
+    accum_dtype: str
+    vertex_sharded: bool
+    stages: List[FitStage] = dataclasses.field(default_factory=list)
+    scale: Optional[int] = None
+
+    @property
+    def effective_limit(self) -> float:
+        return self.limit_bytes * self.headroom
+
+    @property
+    def peak_stage(self) -> Optional[FitStage]:
+        known = [s for s in self.stages if s.bytes is not None]
+        return max(known, key=lambda s: s.bytes) if known else None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["effective_limit_bytes"] = self.effective_limit
+        peak = self.peak_stage
+        d["peak_stage"] = peak.stage if peak else None
+        d["peak_bytes"] = peak.bytes if peak else None
+        return d
+
+
+def _gib(v) -> str:
+    return f"{v / (1 << 30):.2f} GiB" if v is not None else "-"
+
+
+def resolve_hbm_limit(limit_bytes: Optional[int] = None,
+                      device_kind: Optional[str] = None):
+    """(per-chip limit bytes, source string), resolved in evidence
+    order: an explicit byte limit, an EXPLICIT ``device_kind`` through
+    the capacity table (``--device-kind`` exists precisely to size for
+    a chip that is NOT attached — it must beat whatever happens to be
+    plugged in), the live backend's own ``bytes_limit`` (minimum over
+    devices — the most constrained chip gates the mesh), the attached
+    device's kind through the table, and finally the documented
+    v5e-class default."""
+    if limit_bytes:
+        return int(limit_bytes), "explicit"
+    if device_kind:
+        cap = obs_costs.hbm_capacity_bytes(device_kind)
+        if cap is not None:
+            return int(cap), f"device-kind table ({device_kind})"
+        obs_log.warn(
+            f"fit check: device kind {device_kind!r} is not in the "
+            f"HBM capacity table; falling back to live/default limits"
+        )
+    kind = None
+    try:
+        from pagerank_tpu.parallel import mesh as mesh_lib
+
+        stats = mesh_lib.device_stats()
+        limits = [s.bytes_limit for s in stats if s.bytes_limit]
+        if limits:
+            return int(min(limits)), "device bytes_limit"
+        # The same sweep already carries the attached kind — no second
+        # jax.devices() pass for the table fallback.
+        kind = stats[0].kind if stats else None
+    except Exception as e:  # no backend: fall through to the default
+        obs_log.info(f"fit check: no live device limits "
+                     f"({type(e).__name__}); using the capacity table")
+    cap = obs_costs.hbm_capacity_bytes(kind)
+    if cap is not None:
+        return int(cap), f"device-kind table (attached {kind})"
+    return DEFAULT_FIT_LIMIT_BYTES, "default (TPU v5e-class 16 GiB)"
+
+
+def estimate_slot_rows(num_edges: int, n_padded: int, n_stripes: int,
+                       ) -> int:
+    """Upper-bound estimate of the packed slot-row count (the one
+    build quantity that is data-dependent — build_ell_device syncs it
+    off device): the raw-edge lower bound ceil(e/128) times
+    :data:`SLOT_ROW_SLACK`, plus one row per (stripe, 128-dst block)
+    for ragged-tail padding."""
+    num_blocks = max(1, n_padded // 128)
+    return (int(math.ceil(num_edges * SLOT_ROW_SLACK / 128))
+            + max(1, n_stripes) * num_blocks)
+
+
+def _build_stage_reports(cfg, n: int, num_edges: int, scale: Optional[int],
+                         group: int, stripe: int) -> List[FitStage]:
+    """Abstract-eval the device-build pipeline at the target geometry:
+    the REAL stage programs (ops/device_build) AOT-lowered over
+    ShapeDtypeStructs — XLA's own memory_analysis per stage, no
+    allocation (obs/costs.harvest_abstract). The scatter stage uses the
+    estimated row count (the only host-synced quantity)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pagerank_tpu.ops import device_build as db
+
+    sds = jax.ShapeDtypeStruct
+    n_padded = -(-n // 128) * 128
+    sz = min(stripe, n_padded) if stripe else n_padded
+    n_stripes = -(-n_padded // sz) if n_padded else 1
+    stripe_arg = sz if n_stripes > 1 else 0
+    num_blocks = n_padded // 128
+    e = sds((num_edges,), jnp.int32)
+    stages: List[FitStage] = []
+
+    def add(name, fn, args, donate=(), static=None, detail=""):
+        try:
+            rep = obs_costs.harvest_abstract(
+                f"build/{name}", fn, args, static_kwargs=static,
+                donate_argnums=donate,
+            )
+            if rep.peak_bytes is not None:
+                stages.append(FitStage(
+                    stage=f"build/{name}", bytes=rep.peak_bytes,
+                    source="xla", detail=detail,
+                ))
+            else:
+                # The backend compiled the stage but reports no memory
+                # analysis (older jaxlib / bare PJRT plugins): an
+                # UNKNOWN, not a verdict — telemetry degradation must
+                # never hard-block a run (the module contract; only
+                # "error" stages, which could not even lower, force
+                # does-not-fit).
+                stages.append(FitStage(
+                    stage=f"build/{name}", bytes=None, source="unknown",
+                    detail=(detail + " — backend reports no "
+                            "memory_analysis").strip(" —"),
+                ))
+        except Exception as err:
+            stages.append(FitStage(
+                stage=f"build/{name}", bytes=None, source="error",
+                detail=f"{type(err).__name__}: {str(err)[:160]}",
+            ))
+
+    # The same int32-capacity guards the real builder enforces: a
+    # geometry the packer would refuse is a preflight verdict, not a
+    # compile crash.
+    if n_stripes > 1 and n_stripes * n_padded > np.iinfo(np.int32).max:
+        stages.append(FitStage(
+            stage="build/sort", bytes=None, source="error",
+            detail=f"striped sort key overflows int32 ({n_stripes} "
+                   f"stripes x n_padded {n_padded}) — the device build "
+                   f"refuses this geometry (build_ell_device)",
+        ))
+        return stages
+
+    if scale is not None:
+        key_aval = jax.eval_shape(
+            lambda: jax.random.key(0, impl="rbg"))
+
+        add("gen",
+            functools.partial(db._rmat_gen, scale=scale,
+                              n_edges=num_edges),
+            (key_aval, sds((), jnp.float32), sds((), jnp.float32),
+             sds((), jnp.float32)),
+            detail=f"R-MAT gen, {num_edges:,} raw edges")
+    add("in_degree", functools.partial(db._raw_in_degree, n=n), (e,),
+        detail="raw in-degree scatter-add")
+    add("relabel", db._relabel_perm, (sds((n,), jnp.int32),),
+        detail="stable in-degree relabel sort")
+    add("sort",
+        functools.partial(db._relabel_sort, n_padded=n_padded,
+                          stripe_size=stripe_arg),
+        (e, e, sds((n,), jnp.int32)), donate=(0, 1),
+        detail="THE composite-key full-edge sort")
+    add("slots",
+        functools.partial(db._slot_coords, n=n, n_padded=n_padded,
+                          weight_dtype=jnp.dtype(cfg.dtype), group=group,
+                          stripe_size=stripe_arg, with_weights=False),
+        (e, e), donate=(0, 1),
+        detail="slot coordinates + dedup flags")
+    rows_est = estimate_slot_rows(num_edges, n_padded, n_stripes)
+    log2g = group.bit_length() - 1
+    add("scatter",
+        functools.partial(db._scatter_slots, rows_total=rows_est,
+                          num_blocks=num_blocks, n_stripes=n_stripes,
+                          fill=sz << log2g),
+        (e, e, sds((num_edges,), jnp.int8),
+         sds((n_stripes * num_blocks,), jnp.int32)),
+        detail=f"slot-plane scatter at ~{rows_est:,} estimated rows "
+               f"(slack {SLOT_ROW_SLACK})")
+    return stages
+
+
+def _solve_stage_report(cfg, n: int, num_edges: int, ndev: int,
+                        vertex_sharded: bool, stripe: int = 0) -> FitStage:
+    """Analytic per-chip residency of the solve: the packed tables and
+    per-vertex state (edge/vertex-sharded over the mesh in the
+    vertex-sharded mode), plus the step's transient gathered-z image
+    and merge accumulators. A MODEL, not an XLA harvest — the step
+    program only exists after an engine build, which is exactly the
+    allocation the preflight must precede. Formula (per chip):
+
+      tables     = rows_est*128*4 + rows_est*4         [/ ndev sharded]
+      vertexstate= n_padded * (dtype + z_item + 3)     [/ ndev sharded]
+      z image    = 2 * n_padded * z_item   (gathered z is FULL-width
+                   per chip in both the dense AND halo exchange — the
+                   halo saves wire bytes, not the z image)
+      merge      = 2 * n_padded * accum_item
+
+    ``rows_est`` counts the STRIPED table (one pad row per (stripe,
+    dst block)): ``stripe`` is the planned span when the caller has
+    one (device builds), 0 re-derives the engine's own striping rule
+    — the host packer ignores explicit spans, so the plan's stripe=0
+    there must not collapse the model to a single stripe (a scale-24
+    table near the ceiling carries hundreds of MB of stripe padding).
+
+    ``cfg.vs_bounded`` (owner-computes dst partitioning) replaces the
+    full-width transients with their bounded forms — z planes of one
+    stripe span plus the zero-extended local shard, and the local
+    [num_blocks/ndev, 128] accumulator — the O(stripe_span + N/ndev)
+    contract of ``_setup_ell_vs_bounded``; modeling the plain mode
+    there would refuse exactly the geometries the flag exists to fit.
+
+    The vertex-sharded step's z image is what caps scale per chip —
+    the reason --ndev matters even though per-vertex state shards."""
+    import numpy as np
+
+    from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+
+    n_padded = -(-n // 128) * 128
+    pair = JaxTpuEngine.resolve_pair(cfg)
+    z_item = JaxTpuEngine.gather_z_item(cfg, pair)
+    dt_item = np.dtype(cfg.dtype).itemsize
+    ac_item = np.dtype(cfg.accum_dtype).itemsize
+    fast_cap, stripe_target = JaxTpuEngine.stripe_limits(z_item, pair)
+    if stripe:
+        sz = min(stripe, n_padded)
+    elif n_padded > fast_cap:
+        sz = min(JaxTpuEngine.occupancy_span(
+            stripe_target, n_padded, num_edges, pair, z_item), n_padded)
+    else:
+        sz = n_padded
+    n_stripes = max(1, -(-n_padded // sz)) if n_padded else 1
+    rows_est = estimate_slot_rows(num_edges, n_padded, n_stripes)
+    share = ndev if vertex_sharded and ndev > 1 else 1
+    tables = (rows_est * 128 * 4 + rows_est * 4) // share
+    vertex_state = n_padded * (dt_item + z_item + 3) // share
+    bounded = bool(vertex_sharded and getattr(cfg, "vs_bounded", False))
+    if bounded:
+        local = n_padded // share
+        z_image = 2 * (sz + local) * z_item
+        merge = 2 * local * ac_item
+    else:
+        z_image = 2 * n_padded * z_item
+        merge = 2 * n_padded * ac_item
+    total = tables + vertex_state + z_image + merge
+    return FitStage(
+        stage="solve/step", bytes=int(total), source="model",
+        detail=(f"tables {_gib(tables)} + state {_gib(vertex_state)} "
+                f"+ z image {_gib(z_image)} + merge {_gib(merge)}"
+                + (f" (vs-bounded over {ndev})" if bounded
+                   else f" (vertex-sharded over {ndev})" if share > 1
+                   else "")),
+    )
+
+
+def fit_check(scale: Optional[int] = None, *, n: Optional[int] = None,
+              num_edges: Optional[int] = None, edge_factor: int = 16,
+              ndev: int = 1, dtype: str = "float32",
+              accum_dtype: Optional[str] = None,
+              wide_accum: str = "auto",
+              vertex_sharded: Optional[bool] = None,
+              vs_bounded: bool = False,
+              device_build: bool = True,
+              stripe_size: int = 0, lane_group: int = 0,
+              partition_span: int = 0,
+              limit_bytes: Optional[int] = None,
+              device_kind: Optional[str] = None,
+              headroom: float = DEFAULT_FIT_HEADROOM) -> FitResult:
+    """The OOM preflight: will (build +) solve at this geometry fit in
+    per-chip HBM? Pass ``scale`` for the bench R-MAT geometry
+    (``2^scale`` vertices, ``edge_factor << scale`` raw edges) or
+    explicit ``n``/``num_edges`` (a loaded graph — the CLI's
+    ``--preflight``). ``vertex_sharded`` defaults to ``ndev > 1`` (the
+    memory-scaling mode a multi-chip run means); ``vs_bounded`` sizes
+    the owner-computes bounded step instead of the plain mode's
+    full-width transients. ``device_build=False``
+    skips the build-pipeline stages (host-built graphs: host RAM is
+    not this check's axis).
+
+    Nothing allocates: build stages are AOT-lowered over abstract
+    shapes, the solve stage is an analytic model, and the limit comes
+    from live ``bytes_limit`` / the device-kind capacity table
+    (:func:`resolve_hbm_limit`). The verdict is per STAGE — the table
+    names which stage busts the budget, which is what decides between
+    "bigger mesh", "host build", or "don't bother"."""
+    from pagerank_tpu import PageRankConfig
+    from pagerank_tpu.ops.device_build import plan_build
+
+    if scale is None and n is None:
+        raise ValueError("fit_check needs scale= or n=")
+    if n is None:
+        n = 1 << scale
+    if num_edges is None:
+        num_edges = (edge_factor << scale if scale is not None
+                     else edge_factor * n)
+    if vertex_sharded is None:
+        vertex_sharded = ndev > 1
+    cfg = PageRankConfig(
+        num_iters=1, dtype=dtype, accum_dtype=accum_dtype or dtype,
+        wide_accum=wide_accum, vertex_sharded=vertex_sharded,
+        vs_bounded=vs_bounded,
+        num_devices=ndev if vertex_sharded else None,
+    ).validate()
+    # THE shared planner at the CALLER's layout flags (stripe/group/
+    # partition span) — the preflight must gate the build the run will
+    # actually execute, not the default layout's.
+    group, stripe, _part = plan_build(
+        cfg, n, num_edges=num_edges, host=not device_build,
+        stripe_size=stripe_size, lane_group=lane_group,
+        partition_span=partition_span,
+    )
+    limit, limit_source = resolve_hbm_limit(limit_bytes, device_kind)
+
+    t0 = time.perf_counter()
+    stages: List[FitStage] = []
+    if device_build:
+        # The device build is a SINGLE-chip pipeline regardless of the
+        # solve mesh (ops/device_build packs on one device; multichip
+        # bench legs host-build and pass device_build=False) — so its
+        # stages gate at full width even when ndev > 1. Skipping them
+        # for a wide mesh would pass a preflight whose build then OOMs
+        # — the exact failure this check exists to prevent.
+        stages += _build_stage_reports(
+            cfg, n, num_edges, scale, group, stripe)
+    stages.append(_solve_stage_report(cfg, n, num_edges, ndev,
+                                      vertex_sharded, stripe))
+    effective = limit * headroom
+    # Verdict: every MEASURED stage must fit and nothing may have
+    # ERRORED (a stage that cannot lower at this geometry is a
+    # refusal); "unknown" stages — the backend reported no memory
+    # analysis — do not block (degraded telemetry is not an OOM).
+    fits = bool(stages) and not any(
+        s.source == "error" for s in stages
+    ) and all(
+        s.bytes <= effective for s in stages if s.bytes is not None
+    )
+    res = FitResult(
+        fits=fits, limit_bytes=limit, limit_source=limit_source,
+        headroom=headroom, n=n, num_edges=num_edges, ndev=ndev,
+        dtype=str(cfg.dtype), accum_dtype=str(cfg.accum_dtype),
+        vertex_sharded=vertex_sharded, stages=stages, scale=scale,
+    )
+    obs_log.info(
+        f"fit check: {len(stages)} stage(s) in "
+        f"{time.perf_counter() - t0:.2f}s -> "
+        f"{'fits' if fits else 'DOES NOT FIT'}"
+    )
+    return res
+
+
+def render_fit(res: FitResult) -> str:
+    """The per-stage preflight table (what ``obs fit`` prints and the
+    CLI shows before refusing a doomed build)."""
+    head = (f"OOM preflight: "
+            + (f"scale {res.scale} " if res.scale is not None else "")
+            + f"({res.n:,} vertices, ~{res.num_edges:,} raw edges), "
+            f"{res.ndev} device(s), {res.dtype}/{res.accum_dtype}"
+            + (", vertex-sharded" if res.vertex_sharded else ""))
+    lines = [head,
+             f"per-chip limit {_gib(res.limit_bytes)} "
+             f"[{res.limit_source}] x headroom {res.headroom:g} = "
+             f"{_gib(res.effective_limit)}"]
+    w = max((len(s.stage) for s in res.stages), default=5)
+    effective = res.effective_limit
+    for s in res.stages:
+        if s.bytes is None:
+            verdict = "ERROR" if s.source == "error" else "?"
+        else:
+            verdict = "ok" if s.bytes <= effective else "OVER"
+        lines.append(
+            f"  {s.stage:<{w}}  {_gib(s.bytes):>12}  {s.source:<5}  "
+            f"{verdict:<5}"
+            + (f"  {s.detail}" if s.detail else "")
+        )
+    peak = res.peak_stage
+    lines.append(
+        ("FITS" if res.fits else "DOES NOT FIT")
+        + (f": peak stage {peak.stage} at {_gib(peak.bytes)} vs "
+           f"{_gib(effective)}" if peak else ": no stage evaluated")
+    )
+    return "\n".join(lines)

@@ -68,6 +68,13 @@ LEG_METRICS = (
     "accuracy_l1",
     "cost_bytes_per_edge",
     "comms_bytes_per_iter",
+    # ISSUE 10: the multichip legs' per-chip-rate-retained figure
+    # (recorded since r06 but invisible in the trend until now), and
+    # the comms-vs-compute attribution axes — the r06+ trend carries
+    # whether the sharded step is exchange-bound.
+    "scaling_efficiency",
+    "exchange_fraction",
+    "comms_achieved_bytes_per_sec",
 )
 
 #: Which direction is BAD, per metric (direction-aware thresholds:
@@ -80,6 +87,9 @@ METRIC_BAD_DIRECTION = {
     "accuracy_l1": "up",
     "cost_bytes_per_edge": "up",
     "comms_bytes_per_iter": "up",
+    "scaling_efficiency": "down",
+    "exchange_fraction": "up",
+    "comms_achieved_bytes_per_sec": "down",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -151,6 +161,15 @@ def _rate_leg(d: dict) -> dict:
     cb = _num(comms.get("bytes_per_iter"))
     if cb is not None:
         leg["comms_bytes_per_iter"] = cb
+    # Comms-vs-compute attribution block (ISSUE 10; bench --multichip
+    # legs since r10): the exchange-bound verdict joins the series.
+    att = d.get("attribution") or {}
+    ef = _num(att.get("exchange_fraction"))
+    if ef is not None:
+        leg["exchange_fraction"] = ef
+    ab = _num(att.get("achieved_bytes_per_sec"))
+    if ab is not None:
+        leg["comms_achieved_bytes_per_sec"] = ab
     if isinstance(d.get("layout"), dict):
         leg["layout"] = _json_safe(d["layout"])
     nd = d.get("n_devices")
@@ -254,10 +273,17 @@ def _normalize_multichip(doc: dict, rec: dict) -> None:
     l1 = _num(acc.get("normalized_l1_vs_f64_oracle"))
     if l1 is not None and "multichip_sparse" in legs:
         legs["multichip_sparse"]["accuracy_l1"] = l1
-    for k in ("scaling_efficiency", "scaling_efficiency_dense"):
+    # scaling_efficiency joins the LEG metrics (ISSUE 10 satellite:
+    # the field existed since r06 but was invisible in the trend) AND
+    # stays in extras — already-ingested ledger records carry only the
+    # extras spelling, and metric_value() reads both.
+    for k, leg in (("scaling_efficiency", "multichip_sparse"),
+                   ("scaling_efficiency_dense", "multichip_dense")):
         v = _num(doc.get(k))
         if v is not None:
             rec["extras"][k] = v
+            if leg in legs:
+                legs[leg]["scaling_efficiency"] = v
 
 
 def _normalize_build_only(doc: dict, rec: dict) -> None:
@@ -292,6 +318,13 @@ def _normalize_run_report(doc: dict, rec: dict) -> None:
     cb = _num(gauges.get("comms.bytes_per_iter"))
     if cb is not None:
         leg["comms_bytes_per_iter"] = cb
+    for gauge_key, metric in (
+        ("comms.exchange_fraction", "exchange_fraction"),
+        ("comms.achieved_bytes_per_sec", "comms_achieved_bytes_per_sec"),
+    ):
+        v = _num(gauges.get(gauge_key))
+        if v is not None:
+            leg[metric] = v
     if leg:
         rec["legs"][leg_name_for_config(cfg)] = leg
     iters = cfg.get("num_iters") if isinstance(cfg, dict) else None
@@ -467,7 +500,17 @@ def env_class(rec: dict) -> Optional[Tuple]:
 
 
 def metric_value(rec: dict, leg: str, metric: str) -> Optional[float]:
-    return _num((rec.get("legs") or {}).get(leg, {}).get(metric))
+    v = _num((rec.get("legs") or {}).get(leg, {}).get(metric))
+    if v is None and metric == "scaling_efficiency":
+        # Back-compat: records ingested before ISSUE 10 carry the
+        # multichip scaling figure only under extras (the r06 ledger
+        # rows) — the series must not fork on ingest vintage.
+        extras = rec.get("extras") or {}
+        if leg == "multichip_sparse":
+            v = _num(extras.get("scaling_efficiency"))
+        elif leg == "multichip_dense":
+            v = _num(extras.get("scaling_efficiency_dense"))
+    return v
 
 
 def series(records: Sequence[dict], leg: str, metric: str,
@@ -767,6 +810,9 @@ _METRIC_SHORT = {
     "accuracy_l1": "accuracy L1",
     "cost_bytes_per_edge": "cost B/edge",
     "comms_bytes_per_iter": "comms B/iter",
+    "scaling_efficiency": "scaling eff",
+    "exchange_fraction": "exch frac",
+    "comms_achieved_bytes_per_sec": "achieved B/s",
 }
 
 

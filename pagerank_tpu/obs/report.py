@@ -45,10 +45,13 @@ from pagerank_tpu.utils import fsio
 SCHEMA_VERSION = 1
 
 #: Top-level keys every run report carries (schema-stability contract,
-#: tests/test_obs.py::test_cli_run_report_schema).
+#: tests/test_obs.py::test_cli_run_report_schema). ``devices`` (ISSUE
+#: 10) is the device-plane section: per-device HBM watermark + last
+#: sample — present on FAILURE-marked reports too (OOM forensics).
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
     "metrics", "iterations", "summary", "robustness", "costs",
+    "devices",
 )
 
 
@@ -141,6 +144,7 @@ def build_run_report(
     summary: Optional[dict] = None,
     robustness: Optional[dict] = None,
     costs: Optional[dict] = None,
+    devices: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Assemble the report dict. Every section is optional — a bench
@@ -149,11 +153,19 @@ def build_run_report(
     so consumers never key-error across producers. ``costs`` defaults
     to the cost-accounting ledger (obs/costs.py): the per-compiled-form
     FLOPs/HBM-bytes/peak-allocation model — ISSUE 5's "did the model
-    change or just the wall time" axis."""
+    change or just the wall time" axis. ``devices`` defaults to the
+    device plane's watermark section (obs/devices.report_section):
+    the HBM high-water mark + last per-device sample — the evidence
+    an OOM post-mortem reads, embedded in failure-marked reports
+    too."""
     if costs is None:
         from pagerank_tpu.obs import costs as costs_mod
 
         costs = costs_mod.ledger_snapshot()
+    if devices is None:
+        from pagerank_tpu.obs import devices as devices_mod
+
+        devices = devices_mod.report_section()
     report = {
         "schema_version": SCHEMA_VERSION,
         "created_unix": time.time(),
@@ -166,6 +178,7 @@ def build_run_report(
         "summary": _json_safe(summary or {}),
         "robustness": _json_safe(robustness or {}),
         "costs": _json_safe(costs or {}),
+        "devices": _json_safe(devices or {}),
     }
     if extra:
         report.update(_json_safe(extra))
@@ -252,6 +265,17 @@ def render_report(report: dict) -> str:
         lines.append(
             "robustness: "
             + ", ".join(f"{k}={v}" for k, v in rb.items() if v)
+        )
+    dv = report.get("devices") or {}
+    if dv.get("hbm_high_water_bytes") is not None:
+        per_dev = dv.get("per_device_peak_bytes") or {}
+        lines.append(
+            f"devices: HBM high water "
+            f"{dv['hbm_high_water_bytes'] / 1e9:.2f}GB over "
+            f"{dv.get('samples', 0)} sample(s)"
+            + (f", per device " + ", ".join(
+                f"{k}={v / 1e9:.2f}GB" for k, v in per_dev.items())
+               if per_dev else "")
         )
     mets = report.get("metrics") or {}
     counters = mets.get("counters") or {}
@@ -370,6 +394,37 @@ def diff_reports(a: dict, b: dict) -> str:
     elif qa or qb:
         lines.append("cost model: identical (wall deltas above are "
                      "execution, not program, changes)")
+
+    # Device-plane deltas (ISSUE 10): the comms attribution gauges
+    # (exchange fraction, achieved wire bytes/s) and the per-run HBM
+    # high-water mark — "did the exchange get slower or did we start
+    # running closer to the memory ceiling" as a mechanical diff.
+    ga = (a.get("metrics") or {}).get("gauges") or {}
+    gb = (b.get("metrics") or {}).get("gauges") or {}
+    comms_keys = sorted(
+        k for k in set(ga) | set(gb)
+        if k.startswith("comms.") and ga.get(k) != gb.get(k)
+    )
+    comms_lines = []
+    for k in comms_keys:
+        va, vb = ga.get(k), gb.get(k)
+        rel = _rel(va, vb)
+        comms_lines.append(
+            f"  {k}: {_fmt_qty(va)} -> {_fmt_qty(vb)}"
+            + (f"  ({rel:+.1%})" if rel is not None else "")
+        )
+    da = (a.get("devices") or {}).get("hbm_high_water_bytes")
+    db = (b.get("devices") or {}).get("hbm_high_water_bytes")
+    if da != db and (da is not None or db is not None):
+        rel = _rel(da, db)
+        comms_lines.append(
+            f"  hbm_high_water_bytes: {_fmt_qty(da)} -> {_fmt_qty(db)}"
+            + (f"  ({rel:+.1%})" if rel is not None else "")
+        )
+    if comms_lines:
+        lines.append("device-plane deltas (comms attribution + HBM "
+                     "watermark):")
+        lines.extend(comms_lines)
 
     ca = (a.get("metrics") or {}).get("counters") or {}
     cb = (b.get("metrics") or {}).get("counters") or {}

@@ -137,10 +137,18 @@ class NullTracer:
     def add_event(self, name: str, **attrs) -> None:
         pass
 
+    def add_counter(self, name: str, values: Dict[str, float],
+                    track: Optional[int] = None,
+                    track_label: Optional[str] = None) -> None:
+        pass
+
     def spans(self) -> List[Span]:
         return []
 
     def events(self) -> List[dict]:
+        return []
+
+    def counters(self) -> List[dict]:
         return []
 
     def summary(self) -> Dict[str, dict]:
@@ -163,6 +171,8 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: List[Span] = []
         self._events: List[dict] = []
+        self._counters: List[dict] = []
+        self._track_labels: Dict[int, str] = {}
         self._local = threading.local()
         self._next_id = 0
 
@@ -233,6 +243,28 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
+    def add_counter(self, name: str, values: Dict[str, float],
+                    track: Optional[int] = None,
+                    track_label: Optional[str] = None) -> None:
+        """Record a sampled counter point (Chrome ``ph: "C"``) — the
+        per-device HBM tracks (ISSUE 10; obs/devices.DeviceSampler).
+        ``track`` pins the sample to its own pid lane in the Chrome
+        export so Perfetto renders one counter track PER DEVICE
+        instead of mixing every chip into the process row;
+        ``track_label`` names the lane once (a ``process_name``
+        metadata event)."""
+        rec = {
+            "type": "counter",
+            "name": name,
+            "ts_s": time.perf_counter() - self.epoch_pc,
+            "track": track,
+            "values": dict(values),
+        }
+        with self._lock:
+            self._counters.append(rec)
+            if track is not None and track_label:
+                self._track_labels.setdefault(track, track_label)
+
     # -- views ------------------------------------------------------------
 
     def spans(self) -> List[Span]:
@@ -242,6 +274,10 @@ class Tracer:
     def events(self) -> List[dict]:
         with self._lock:
             return list(self._events)
+
+    def counters(self) -> List[dict]:
+        with self._lock:
+            return list(self._counters)
 
     def summary(self) -> Dict[str, dict]:
         """Per-name aggregation (count / total / mean / max seconds),
@@ -295,6 +331,8 @@ class Tracer:
                 f.write(json.dumps(sp.to_json()) + "\n")
             for ev in self.events():
                 f.write(json.dumps(ev) + "\n")
+            for c in self.counters():
+                f.write(json.dumps(c) + "\n")
 
     def chrome_events(self) -> List[dict]:
         """Chrome trace-event list: complete ("X") events for spans,
@@ -323,6 +361,28 @@ class Tracer:
                 "tid": ev["tid"],
                 "s": "t",
                 "args": ev["attrs"],
+            })
+        # Counter samples: tracked counters (per-device HBM) render on
+        # their OWN pid lane, named once by a process_name metadata
+        # event, so Perfetto shows one track per device; untracked
+        # counters ride the process pid.
+        with self._lock:
+            labels = dict(self._track_labels)
+        for track, label in sorted(labels.items()):
+            out.append({
+                "name": "process_name",
+                "ph": "M",
+                "pid": track,
+                "args": {"name": label},
+            })
+        for c in self.counters():
+            out.append({
+                "name": c["name"],
+                "cat": c["name"].split("/", 1)[0].split(".", 1)[0],
+                "ph": "C",
+                "ts": c["ts_s"] * 1e6,
+                "pid": c["track"] if c["track"] is not None else pid,
+                "args": c["values"],
             })
         return out
 

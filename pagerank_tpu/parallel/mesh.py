@@ -10,8 +10,9 @@ DCN (multi-host).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -148,25 +149,85 @@ def surviving_devices(dead_ids, devices: Optional[Sequence] = None):
     return out
 
 
-def device_view() -> Sequence[str]:
-    """One human line per visible device — id, kind, process, and (when
-    the backend reports it) live HBM use — the per-device evidence the
-    stall watchdog prints when a multichip solve wedges (obs/live.py).
-    Memory stats are best-effort: CPU devices and older plugins return
-    None, and a diagnostic must never fail gathering itself."""
-    lines = []
-    for d in jax.devices():
-        line = f"{d.platform}:{d.id} ({d.device_kind}, proc {d.process_index})"
+@dataclasses.dataclass
+class DeviceStats:
+    """One device's identity + live memory sample — the STRUCTURED form
+    of the old ``device_view()`` string (ISSUE 10): the watchdog line,
+    the ``device.<id>.*`` exporter gauges, the Chrome-trace HBM counter
+    tracks, and the run report's OOM-forensics watermark all render
+    from this one record. Memory fields are None-tolerant by contract:
+    CPU devices and older PJRT plugins report nothing
+    (``memory_stats()`` returns None or raises), and a diagnostic must
+    never fail gathering its own evidence."""
+
+    id: int
+    platform: str
+    kind: str
+    process_index: int
+    bytes_in_use: Optional[int] = None
+    bytes_limit: Optional[int] = None
+    #: The backend's OWN peak watermark when it keeps one
+    #: (``peak_bytes_in_use``); the sampler keeps a cross-sample
+    #: watermark on top for backends that don't.
+    peak_bytes_in_use: Optional[int] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _opt_int(stats: Optional[dict], key: str) -> Optional[int]:
+    if not stats:
+        return None
+    v = stats.get(key)
+    try:
+        return int(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def device_stats(devices: Optional[Sequence] = None) -> List[DeviceStats]:
+    """Typed per-device samples (id, kind, process, HBM use/limit/peak)
+    for ``devices`` (default: every visible device). THE one source of
+    truth for per-device evidence — ``device_view()`` renders its
+    strings from this, obs/devices.DeviceSampler feeds gauges, trace
+    counter tracks, and the run-report watermark from it. Never raises;
+    every memory field degrades to None independently."""
+    out = []
+    for d in devices if devices is not None else jax.devices():
         try:
             stats = d.memory_stats()
         except Exception:
             stats = None
-        if stats:
-            used = stats.get("bytes_in_use")
-            limit = stats.get("bytes_limit")
-            if used is not None:
-                line += f" hbm {used / 1e9:.2f}GB"
-                if limit:
-                    line += f"/{limit / 1e9:.2f}GB"
-        lines.append(line)
-    return lines
+        out.append(DeviceStats(
+            id=int(d.id),
+            platform=str(d.platform),
+            kind=str(d.device_kind),
+            process_index=int(d.process_index),
+            bytes_in_use=_opt_int(stats, "bytes_in_use"),
+            bytes_limit=_opt_int(stats, "bytes_limit"),
+            peak_bytes_in_use=_opt_int(stats, "peak_bytes_in_use"),
+        ))
+    return out
+
+
+def _render_device_line(s: DeviceStats) -> str:
+    """One watchdog line from one :class:`DeviceStats` — byte-identical
+    to the historical ``device_view()`` formatting (pinned by
+    tests/test_devices.py::test_device_view_renders_from_device_stats):
+    the hbm clause appears only when ``bytes_in_use`` is known, the
+    limit only when truthy."""
+    line = f"{s.platform}:{s.id} ({s.kind}, proc {s.process_index})"
+    if s.bytes_in_use is not None:
+        line += f" hbm {s.bytes_in_use / 1e9:.2f}GB"
+        if s.bytes_limit:
+            line += f"/{s.bytes_limit / 1e9:.2f}GB"
+    return line
+
+
+def device_view(devices: Optional[Sequence] = None) -> Sequence[str]:
+    """One human line per visible device — id, kind, process, and (when
+    the backend reports it) live HBM use — the per-device evidence the
+    stall watchdog prints when a multichip solve wedges (obs/live.py).
+    A rendering of :func:`device_stats` (one source of truth; the
+    string output is pinned byte-identical by a regression test)."""
+    return [_render_device_line(s) for s in device_stats(devices)]

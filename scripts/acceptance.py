@@ -48,7 +48,10 @@ finish on the surviving mesh and match the oracle — ISSUE 7), M
 oracle parity AND measured exchanged bytes below the dense model —
 ISSUE 8), N (perf sentry: a fresh bench result through the history
 ledger + the noise-aware CI gate, regression-vs-drift attribution —
-ISSUE 9), F (fault injection).
+ISSUE 9), O (device plane: an 8-fake-device ATTRIBUTED halo solve —
+comms-vs-compute attribution block, per-device sampler gauges, and
+the OOM-preflight fit check passing at scale 14 while refusing an
+absurd scale — ISSUE 10), F (fault injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -183,9 +186,20 @@ CONFIGS = {
     "N": dict(kind="history", scale=14, iters=3,
               label="perf-sentry smoke (ledger ingest + noise-aware "
                     "gate)"),
+    # Device-plane smoke (ISSUE 10; obs/devices.py): an 8-fake-device
+    # ATTRIBUTED halo solve — the comms-vs-compute attribution block
+    # must be present and self-consistent, the per-device sampler
+    # gauges must be registered and the exporter output must
+    # strict-parse, and the OOM-preflight fit check must PASS at
+    # scale 14 and FAIL (exit-style verdict) at an absurd scale — the
+    # instrument panel the next TPU session reads first.
+    "O": dict(kind="devices", scale=12, iters=8, fit_ok_scale=14,
+              fit_bad_scale=26,
+              label="device-plane smoke (attributed multichip + "
+                    "sampler + fit check)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "F", "A", "B", "T",
-                "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "F", "A", "B",
+                "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1012,6 +1026,140 @@ def run_history_smoke(key: str):
     return rec
 
 
+# Budget for the device-plane smoke (seconds, timed around the
+# attributed solve + attribution probe — the build and the two fit
+# checks are excluded; the fit checks are sub-3s themselves and
+# recorded separately): an 8-iteration f32 halo solve on 4096 vertices
+# over 8 fake CPU devices plus ~20 timing sub-dispatches.
+DEVICES_SMOKE_BUDGET_S = 3.0
+
+
+def run_devices_smoke(key: str):
+    """ISSUE-10 gate: the device plane end to end on the 8-fake-device
+    CPU mesh — an ATTRIBUTED halo solve (attribution block present and
+    self-consistent vs the comms model, comms.exchange_fraction /
+    comms.achieved_bytes_per_sec gauges published), the per-device
+    sampler armed through engine.run (device.<id>.* gauge names
+    registered, exporter output strict-parses despite the CPU
+    backend's all-None stats), and the OOM-preflight fit check passing
+    at scale 14 while REFUSING an absurd scale. Subprocess fallback
+    when this backend can't fake the mesh (smoke L/M protocol)."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        return _fake_mesh_subprocess(key, "devices",
+                                     "PAGERANK_DEVICES_SMOKE_CHILD")
+
+    from pagerank_tpu import (JaxTpuEngine, PageRankConfig, build_graph,
+                              obs)
+    from pagerank_tpu.obs import devices as obs_devices
+    from pagerank_tpu.obs import live as obs_live
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    try:
+        from test_telemetry import assert_prometheus_syntax
+    finally:
+        sys.path.pop(0)
+
+    scale, iters = spec["scale"], spec["iters"]
+    ndev = min(8, len(jax.devices()))
+    src, dst = rmat_edges(scale, 8, seed=4)
+    g = build_graph(src, dst, n=1 << scale)
+    obs.get_registry().reset()
+    obs.disarm_sampler()
+    cfg = PageRankConfig(num_iters=iters, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev,
+                         vertex_sharded=True, halo_exchange=True)
+    eng = JaxTpuEngine(cfg).build(g)
+    cm = eng.comms_model() or {}
+    obs.arm_sampler(obs.DeviceSampler(every=2))
+    try:
+        t0 = time.perf_counter()
+        eng.run()
+        att = obs_devices.attribute_exchange(eng, iters=4, warmup=1)
+        t_run = time.perf_counter() - t0
+    finally:
+        sampler = obs.disarm_sampler()
+
+    snap = obs.get_registry().snapshot()
+    gauges = snap["gauges"]
+    att_ok = bool(
+        att is not None
+        and att["mode"] == "sparse"
+        and att["exchange_s"] > 0
+        # No step_s >= exchange_s assertion: the walls are measured
+        # independently and toy geometries are dispatch-overhead-
+        # dominated — the FRACTION is clamped to [0, 1] instead.
+        and att["step_s"] > 0
+        and 0 <= att["exchange_fraction"] <= 1
+        and att["model_bytes_per_iter"] == cm.get("bytes_per_iter")
+        and att["achieved_bytes_per_sec"] > 0
+        and gauges.get("comms.exchange_fraction")
+        == att["exchange_fraction"]
+        and "comms.achieved_bytes_per_sec" in gauges
+    )
+    sampled_ids = sorted(
+        int(k.split(".")[1]) for k in gauges
+        if k.startswith("device.") and k.endswith(".bytes_in_use")
+    )
+    try:
+        assert_prometheus_syntax(obs_live.render_prometheus())
+        prom_ok = True
+    except AssertionError:
+        prom_ok = False
+    sampler_ok = bool(
+        sampled_ids == list(range(ndev))
+        and sampler is not None
+        and sampler.samples >= iters // 2
+        and prom_ok
+    )
+    fit_ok = obs_devices.fit_check(spec["fit_ok_scale"])
+    fit_bad = obs_devices.fit_check(spec["fit_bad_scale"])
+    fit_verdicts_ok = bool(fit_ok.fits and not fit_bad.fits)
+
+    passed = bool(att_ok and sampler_ok and fit_verdicts_ok
+                  and t_run <= DEVICES_SMOKE_BUDGET_S)
+    rec = {
+        "config": key,
+        "kind": "devices",
+        "label": spec["label"],
+        "scale": scale,
+        "iters": iters,
+        "devices": ndev,
+        "attribution": {k: att.get(k) for k in (
+            "exchange_s", "step_s", "exchange_fraction",
+            "achieved_bytes_per_sec", "mode")} if att else None,
+        "attribution_ok": att_ok,
+        "sampler_ok": sampler_ok,
+        "sampled_devices": sampled_ids,
+        "fit_ok_scale": spec["fit_ok_scale"],
+        "fit_bad_scale": spec["fit_bad_scale"],
+        "fit_verdicts_ok": fit_verdicts_ok,
+        "seconds": t_run,
+        "budget_s": DEVICES_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] attributed halo solve on {ndev} fake devices (scale "
+        f"{scale}, {iters} iters): attribution "
+        f"{'OK' if att_ok else 'BAD'}"
+        + (f" (exchange {att['exchange_fraction']:.0%} of step)"
+           if att else "")
+        + f"; sampler {'OK' if sampler_ok else 'BAD'} "
+        f"(devices {sampled_ids}, exporter "
+        f"{'parses' if prom_ok else 'BROKEN'}); fit scale "
+        f"{spec['fit_ok_scale']} {'fits' if fit_ok.fits else 'REFUSED'} "
+        f"/ scale {spec['fit_bad_scale']} "
+        f"{'refused' if not fit_bad.fits else 'ACCEPTED (BAD)'}; "
+        f"{t_run:.2f}s vs budget {DEVICES_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def run_partitioned_smoke(key: str):
     """ISSUE-6 gate: a short solve on the partition-centric layout —
     the jax engine through the CLI with an explicit --partition-span
@@ -1490,7 +1638,14 @@ def append_baseline(recs) -> None:
         f"{r['mass_normalized_l1']:.3e} | {r['gate']:g} | "
         f"{'PASS' if r['passed'] else 'FAIL'} | "
         f"{r['edges_per_sec_per_chip']:.3g} |\n"
-        for r in recs if r.get("kind") not in ("ppr", "e2e", "build", "faults")
+        for r in recs if r.get("kind") not in ("ppr", "e2e", "build",
+                                               "faults")
+        # Smoke records (obs/live/partitioned/elastic/halo/history/
+        # devices) gate their own axes and don't carry the oracle-table
+        # columns; only key-complete records join the accuracy table.
+        and {"scale", "num_edges", "normalized_l1",
+             "mass_normalized_l1", "gate",
+             "edges_per_sec_per_chip"} <= set(r)
     ]
     text = _append_table(
         text,
@@ -1594,7 +1749,8 @@ def main(argv=None) -> int:
                "faults": run_fault_smoke, "obs": run_obs_smoke,
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
                "elastic": run_elastic_smoke, "halo": run_halo_smoke,
-               "history": run_history_smoke}
+               "history": run_history_smoke,
+               "devices": run_devices_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

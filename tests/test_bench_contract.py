@@ -39,6 +39,27 @@ def _env():
     return env
 
 
+def _assert_attribution_block(att, multi_device):
+    """The per-leg comms-vs-compute attribution block (ISSUE 10;
+    obs/devices.attribute_exchange): fenced exchange-only vs full-step
+    wall split plus achieved wire bytes/s against the static comms
+    model. Every vertex-sharded leg carries it; multi-device legs must
+    carry real numbers (the single-chip leg's model bytes are 0, so
+    its achieved rate is legitimately null)."""
+    assert isinstance(att, dict), att
+    for key in ("iters", "exchange_s", "step_s", "compute_s",
+                "exchange_fraction", "model_bytes_per_iter",
+                "achieved_bytes_per_sec", "mode"):
+        assert key in att, (key, att)
+    assert att["exchange_s"] > 0 and att["step_s"] > 0
+    assert att["compute_s"] >= 0
+    assert 0 <= att["exchange_fraction"] <= 1
+    if multi_device:
+        assert att["model_bytes_per_iter"] > 0
+        assert att["achieved_bytes_per_sec"] > 0
+        assert att["mode"] in ("dense", "sparse")
+
+
 def _assert_layout_block(layout, form=None):
     """Every rate leg records the RESOLVED kernel/layout/autotune
     decisions (ISSUE 6) so BENCH_r*.json cells are attributable to a
@@ -214,7 +235,15 @@ def test_multichip_json_contract(tmp_path):
         assert rec_l["value"] > 0 and rec_l["ms_per_iter"] > 0
         _assert_costs_block(rec_l["costs"])
         _assert_layout_block(rec_l["layout"])
+        # Comms-vs-compute attribution per leg (ISSUE 10).
+        _assert_attribution_block(rec_l["attribution"],
+                                  multi_device=leg != "single_chip")
     assert rec["single_chip"]["n_devices"] == 1
+    # The attribution must agree with the leg's own comms model.
+    assert rec["sparse_exchange"]["attribution"]["mode"] == "sparse"
+    assert rec["sparse_exchange"]["attribution"]["model_bytes_per_iter"] \
+        == rec["sparse_exchange"]["comms"]["bytes_per_iter"]
+    assert rec["dense_exchange"]["attribution"]["mode"] == "dense"
     assert rec["sparse_exchange"]["layout"]["form"] == "vs_halo"
     assert rec["dense_exchange"]["layout"]["form"] == "vertex_sharded"
     # Headline value IS the sparse leg's rate; efficiency is per-chip

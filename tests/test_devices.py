@@ -1,0 +1,703 @@
+"""Device-plane observability tests (ISSUE 10; docs/OBSERVABILITY.md
+"Device plane"): the structured per-device sampler (typed
+device_stats, gauge/watermark/trace fan-out, CPU None-degradation),
+comms-vs-compute attribution (schema, state restoration, gauges), the
+OOM-preflight fit check (verdicts, exit codes, estimate soundness),
+and the off-by-default transparency booby trap."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from pagerank_tpu import PageRankConfig, build_graph, make_engine, obs
+from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+from pagerank_tpu.obs import costs as obs_costs
+from pagerank_tpu.obs import devices as obs_devices
+from pagerank_tpu.obs import live as obs_live
+from pagerank_tpu.obs import trace as obs_trace
+from pagerank_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Process-global tracer/registry/sampler must never leak between
+    tests (the obs-test discipline)."""
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    obs_costs.reset()
+    obs.disarm_sampler()
+    yield
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    obs_costs.reset()
+    obs.disarm_sampler()
+
+
+class _FakeDevice:
+    """A stub device whose memory_stats reports like a TPU PJRT client
+    — the CPU test substrate reports nothing, so the value-carrying
+    paths need a fake."""
+
+    def __init__(self, id=0, stats=None, kind="TPU v99 fake",
+                 platform="tpu"):
+        self.id = id
+        self.platform = platform
+        self.device_kind = kind
+        self.process_index = 0
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def _graph(n=400, e=3200, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+# -- structured device stats + the device_view refactor ---------------------
+
+
+def test_device_stats_typed_and_none_tolerant():
+    """CPU devices report no memory stats: every memory field is None,
+    identity fields are real (the None-tolerance contract)."""
+    stats = mesh_lib.device_stats()
+    assert len(stats) == len(jax.devices())
+    for s, d in zip(stats, jax.devices()):
+        assert s.id == d.id and s.platform == d.platform
+        assert s.kind == d.device_kind
+        assert s.bytes_in_use is None and s.bytes_limit is None
+        assert s.peak_bytes_in_use is None
+        assert json.dumps(s.to_json())  # strict-JSON-able
+
+
+def test_device_stats_reads_memory_fields():
+    fake = _FakeDevice(id=3, stats={"bytes_in_use": 7 << 20,
+                                    "bytes_limit": 16 << 30,
+                                    "peak_bytes_in_use": 9 << 20})
+    (s,) = mesh_lib.device_stats([fake])
+    assert s.bytes_in_use == 7 << 20
+    assert s.bytes_limit == 16 << 30
+    assert s.peak_bytes_in_use == 9 << 20
+
+
+def test_device_stats_survives_raising_memory_stats():
+    fake = _FakeDevice(id=1, stats=RuntimeError("plugin gone"))
+    (s,) = mesh_lib.device_stats([fake])
+    assert s.id == 1 and s.bytes_in_use is None
+
+
+def test_device_view_renders_from_device_stats():
+    """The ISSUE-10 refactor pin: device_view's string output is
+    byte-identical to the historical hand-rolled formatting across
+    every branch — no stats, use-only, and use+limit."""
+    def legacy(d):
+        line = f"{d.platform}:{d.id} ({d.device_kind}, " \
+               f"proc {d.process_index})"
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            used = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            if used is not None:
+                line += f" hbm {used / 1e9:.2f}GB"
+                if limit:
+                    line += f"/{limit / 1e9:.2f}GB"
+        return line
+
+    fakes = [
+        _FakeDevice(id=0, stats=None),
+        _FakeDevice(id=1, stats=RuntimeError("x")),
+        _FakeDevice(id=2, stats={"bytes_in_use": 1234567890}),
+        _FakeDevice(id=3, stats={"bytes_in_use": 8 << 30,
+                                 "bytes_limit": 16 << 30}),
+        _FakeDevice(id=4, stats={"bytes_limit": 16 << 30}),
+    ]
+    assert list(mesh_lib.device_view(fakes)) == [legacy(f) for f in fakes]
+    # And the real backend's rendering (CPU: identity-only lines).
+    assert list(mesh_lib.device_view()) == [
+        legacy(d) for d in jax.devices()
+    ]
+
+
+# -- the sampler ------------------------------------------------------------
+
+
+def test_sampler_gauges_watermark_and_cpu_degradation():
+    """On value-reporting devices the sampler publishes device.<id>.*
+    gauges and keeps the high-water mark across samples (folding the
+    backend's own peak counter); on CPU the gauge NAMES register but
+    stay unset — and the exporter output still strict-parses (the
+    satellite's degradation contract)."""
+    from test_telemetry import assert_prometheus_syntax
+
+    fake = _FakeDevice(id=5, stats={"bytes_in_use": 100,
+                                    "bytes_limit": 1000})
+    sampler = obs_devices.DeviceSampler(devices=[fake])
+    sampler.sample()
+    fake._stats = {"bytes_in_use": 700, "bytes_limit": 1000,
+                   "peak_bytes_in_use": 900}
+    sampler.sample()
+    fake._stats = {"bytes_in_use": 50, "bytes_limit": 1000}
+    sampler.sample()
+    g = obs.get_registry().snapshot()["gauges"]
+    assert g["device.5.bytes_in_use"] == 50
+    assert g["device.5.bytes_limit"] == 1000
+    assert g["device.5.peak_bytes"] == 900  # backend peak folded in
+    assert g["device.hbm_high_water_bytes"] == 900
+    wm = sampler.watermark()
+    assert wm["samples"] == 3
+    assert wm["hbm_high_water_bytes"] == 900
+    assert wm["per_device_peak_bytes"] == {"5": 900}
+    assert wm["last"][0]["bytes_in_use"] == 50
+    assert_prometheus_syntax(obs_live.render_prometheus())
+
+    # CPU degradation: names registered, values unset, still parseable.
+    obs.get_registry().reset()
+    cpu_sampler = obs_devices.DeviceSampler()
+    cpu_sampler.sample()
+    snap = obs.get_registry().snapshot()["gauges"]
+    assert "device.0.bytes_in_use" in snap
+    assert snap["device.0.bytes_in_use"] is None
+    assert cpu_sampler.watermark()["hbm_high_water_bytes"] is None
+    assert_prometheus_syntax(obs_live.render_prometheus())
+
+
+def test_sampler_cadence_via_engine_run():
+    """An armed sampler is fed by engine.run at its cadence (the
+    watchdog-hook discipline); disarmed runs feed nothing."""
+    calls = []
+
+    class CountingSampler(obs_devices.DeviceSampler):
+        def sample(self, iteration=None):
+            calls.append(iteration)
+            return []
+
+    obs_devices.arm_sampler(CountingSampler(every=2))
+    calls.clear()  # drop the arm-time baseline sample
+    eng = make_engine("cpu", PageRankConfig(num_iters=6)).build(_graph())
+    eng.run()
+    assert calls == [0, 2, 4]
+
+
+def test_sampler_chrome_trace_track_schema(tmp_path):
+    """Per-device Chrome-trace tracks (the satellite's schema pin):
+    each sampled device gets counter events (ph "C") on its OWN pid
+    lane plus one process_name metadata event naming it; values are
+    the sampled byte fields. A no-value (CPU) device emits no counter
+    noise."""
+    tr = obs.enable_tracing()
+    fakes = [
+        _FakeDevice(id=0, stats={"bytes_in_use": 10, "bytes_limit": 99}),
+        _FakeDevice(id=1, stats=None),  # CPU-like: silent
+    ]
+    sampler = obs_devices.DeviceSampler(devices=fakes)
+    sampler.sample()
+    sampler.sample()
+    events = tr.chrome_events()
+    counters = [e for e in events if e["ph"] == "C"]
+    assert len(counters) == 2  # two samples x one value-reporting dev
+    for e in counters:
+        assert e["name"] == "device.0.hbm"
+        assert e["pid"] == obs_devices.TRACK_PID_BASE + 0
+        assert e["args"] == {"bytes_in_use": 10, "bytes_limit": 99}
+        assert isinstance(e["ts"], float)
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(metas) == 1
+    assert metas[0]["pid"] == obs_devices.TRACK_PID_BASE + 0
+    assert "tpu:0" in metas[0]["args"]["name"]
+    # The JSONL export carries the counters as strict-JSON lines.
+    path = str(tmp_path / "t.jsonl")
+    tr.export(path)
+    kinds = {json.loads(l)["type"] for l in open(path)}
+    assert "counter" in kinds
+
+
+def test_report_section_present_without_armed_sampler():
+    """Run reports carry the devices section even with no sampler
+    armed (one-shot boundary sample) — the failure-marked-report OOM
+    evidence must not depend on an opt-in flag."""
+    sec = obs_devices.report_section()
+    assert sec["samples"] == 1
+    assert len(sec["last"]) == len(jax.devices())
+    report = obs.build_run_report()
+    assert report["devices"]["samples"] >= 1
+
+
+# -- transparency booby trap ------------------------------------------------
+
+
+def test_sampler_and_attribution_off_zero_hot_loop_calls(monkeypatch):
+    """With no sampler armed and no attribution requested, a full
+    solve makes ZERO sampler/attribution calls (the tracer booby-trap
+    discipline applied to the device plane): every entry point is
+    trapped, and the exchange-only program is never even compiled."""
+
+    def boom(*a, **k):
+        raise AssertionError(
+            "device-plane machinery touched on a plain solve")
+
+    monkeypatch.setattr(obs_devices.DeviceSampler, "sample", boom)
+    monkeypatch.setattr(obs_devices.DeviceSampler, "on_step", boom)
+    monkeypatch.setattr(obs_devices, "attribute_exchange", boom)
+    monkeypatch.setattr(JaxTpuEngine, "_exchange_step", boom)
+    monkeypatch.setattr(JaxTpuEngine, "time_exchange_split", boom)
+    g = _graph(seed=3)
+    eng = make_engine("jax", PageRankConfig(
+        num_iters=3, num_devices=min(2, len(jax.devices())),
+        vertex_sharded=True)).build(g)
+    r = eng.run()
+    assert np.all(np.isfinite(r))
+    # Lazy-compile contract: the exchange program was never lowered.
+    assert eng._exchange_fn is None
+
+
+# -- comms-vs-compute attribution -------------------------------------------
+
+
+@pytest.mark.parametrize("halo", [False, True])
+def test_attribution_schema_and_state_restoration(halo):
+    ndev = min(4, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs a multi-device mesh")
+    g = _graph(n=512, e=4096, seed=7)
+    cfg = PageRankConfig(num_iters=4, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev,
+                         vertex_sharded=True, halo_exchange=halo)
+    eng = JaxTpuEngine(cfg).build(g)
+    # Attribution mid-run must not perturb the solve: a run with an
+    # attribution probe in the middle is bit-identical to one without.
+    eng2 = JaxTpuEngine(cfg).build(g)
+    r_clean = eng2.run_fast()
+    eng.run_fast(2)
+    att = obs_devices.attribute_exchange(eng, iters=3, warmup=1)
+    r_probed = eng.run_fast()
+    np.testing.assert_array_equal(r_clean, r_probed)
+    assert eng.iteration == 4
+
+    assert att["mode"] == ("sparse" if halo else "dense")
+    assert att["exchange_s"] > 0 and att["step_s"] > 0
+    assert att["compute_s"] >= 0
+    assert 0 <= att["exchange_fraction"] <= 1
+    assert att["model_bytes_per_iter"] > 0
+    assert att["achieved_bytes_per_sec"] > 0
+    gauges = obs.get_registry().snapshot()["gauges"]
+    assert gauges["comms.exchange_fraction"] == att["exchange_fraction"]
+    assert gauges["comms.achieved_bytes_per_sec"] == \
+        att["achieved_bytes_per_sec"]
+
+
+def test_attribution_none_on_replicated_layout():
+    eng = make_engine("jax", PageRankConfig(num_iters=2)).build(_graph())
+    assert not eng.has_exchange_program()
+    assert obs_devices.attribute_exchange(eng) is None
+
+
+# -- OOM-preflight fit check ------------------------------------------------
+
+
+def test_fit_check_passes_at_small_scale():
+    res = obs_devices.fit_check(14)
+    assert res.fits
+    stages = {s.stage for s in res.stages}
+    assert {"build/gen", "build/sort", "build/slots", "build/scatter",
+            "solve/step"} <= stages
+    # Build stages are XLA-harvested at the target shapes, the solve
+    # stage is the documented analytic model.
+    by_name = {s.stage: s for s in res.stages}
+    assert by_name["build/sort"].source == "xla"
+    assert by_name["build/sort"].bytes > 0
+    assert by_name["solve/step"].source == "model"
+    rendered = obs_devices.render_fit(res)
+    assert "FITS" in rendered and "build/sort" in rendered
+
+
+def test_fit_check_fails_at_impossible_scale():
+    """A geometry that provably exceeds per-chip HBM (the acceptance
+    criterion): scale 26 f32 against the 16 GiB v5e-class default —
+    the full-edge sort alone is ~20 GiB of arguments+outputs."""
+    res = obs_devices.fit_check(26)
+    assert not res.fits
+    over = [s for s in res.stages
+            if s.bytes is not None and s.bytes > res.effective_limit]
+    assert any(s.stage == "build/sort" for s in over)
+    assert "DOES NOT FIT" in obs_devices.render_fit(res)
+
+
+def test_fit_check_explicit_limit_and_sharded_scaling():
+    # A tiny explicit limit fails even a small geometry...
+    res = obs_devices.fit_check(14, limit_bytes=1 << 20)
+    assert not res.fits and res.limit_source == "explicit"
+    # ...and vertex-sharding over more chips shrinks the per-chip
+    # solve residency (tables + state shard; the z image does not).
+    r1 = obs_devices.fit_check(20, ndev=1, device_build=False,
+                               vertex_sharded=True)
+    r8 = obs_devices.fit_check(20, ndev=8, device_build=False,
+                               vertex_sharded=True)
+    s1 = {s.stage: s for s in r1.stages}["solve/step"].bytes
+    s8 = {s.stage: s for s in r8.stages}["solve/step"].bytes
+    assert s8 < s1
+
+
+def test_fit_check_refuses_int32_overflow_geometry():
+    """The same capacity guard the real builder enforces surfaces as a
+    preflight ERROR stage, not a crash: a striped sort key past int32
+    is a verdict."""
+    res = obs_devices.fit_check(n=1 << 28, num_edges=1 << 30,
+                                dtype="float64", accum_dtype="float64",
+                                wide_accum="pair")
+    errs = [s for s in res.stages if s.source == "error"]
+    assert not res.fits
+    assert any("int32" in s.detail for s in errs)
+
+
+def test_fit_slot_row_estimate_upper_bounds_real_build():
+    """Soundness of the one modeled build quantity: the slot-row
+    estimate must upper-bound what the real device build packs at the
+    same geometry THROUGH THE PLANNED LAYOUT (gauge build.slot_rows) —
+    fit_check models the plan_build layout, whose grouped lanes keep
+    slots/edge in the 1.1-1.4 band SLOT_ROW_SLACK covers (group=1
+    worst-case layouts are not what any planned build packs)."""
+    from pagerank_tpu.ops import device_build as db
+
+    for scale, ef in ((12, 8), (14, 16)):
+        cfg = PageRankConfig(num_iters=1).validate()
+        grp, stripe, _part = db.plan_build(cfg, 1 << scale,
+                                           num_edges=ef << scale)
+        src, dst = db.rmat_edges_device(scale, ef, seed=0)
+        obs.get_registry().reset()
+        db.build_ell_device(src, dst, n=1 << scale, group=grp,
+                            stripe_size=stripe, with_weights=False)
+        actual = obs.get_registry().snapshot()["gauges"][
+            "build.slot_rows"]
+        n_padded = 1 << scale
+        sz = min(stripe, n_padded) if stripe else n_padded
+        n_stripes = -(-n_padded // sz)
+        est = obs_devices.estimate_slot_rows(ef << scale, n_padded,
+                                             n_stripes)
+        assert est >= actual, (scale, est, actual)
+
+
+def test_obs_fit_cli_exit_codes(capsys):
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    assert obs_main(["fit", "--scale", "14"]) == 0
+    out = capsys.readouterr().out
+    assert "FITS" in out and "solve/step" in out
+    assert obs_main(["fit", "--scale", "26"]) == 1
+    assert "DOES NOT FIT" in capsys.readouterr().out
+    # --json emits a strict-JSON FitResult.
+    assert obs_main(["fit", "--scale", "14", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fits"] is True and doc["stages"]
+    # Usage errors exit 2.
+    assert obs_main(["fit", "--scale", "14", "--hbm-gb", "-1"]) == 2
+    assert obs_main(["fit", "--scale", "14", "--headroom", "2"]) == 2
+    capsys.readouterr()
+
+
+def test_fit_device_kind_table_lookup():
+    res = obs_devices.fit_check(14, device_kind="TPU v4")
+    assert res.limit_bytes == 32 << 30
+    assert "v4" in res.limit_source.lower() or "TPU v4" in res.limit_source
+    assert obs_costs.hbm_capacity_bytes("TPU v5 lite") == 16 << 30
+    assert obs_costs.hbm_capacity_bytes("unknown chip") is None
+
+
+def test_explicit_device_kind_beats_live_limit(monkeypatch):
+    """--device-kind exists to size for a chip that is NOT attached:
+    an explicit kind must win over whatever the live backend reports
+    (review finding: it used to be shadowed by bytes_limit)."""
+    live = [mesh_lib.DeviceStats(id=0, platform="tpu", kind="TPU v5e",
+                                 process_index=0, bytes_in_use=1,
+                                 bytes_limit=16 << 30)]
+    monkeypatch.setattr(mesh_lib, "device_stats", lambda d=None: live)
+    limit, source = obs_devices.resolve_hbm_limit(
+        device_kind="TPU v5p")
+    assert limit == 95 << 30 and "v5p" in source.lower()
+    # Without an explicit kind the live limit still wins.
+    limit, source = obs_devices.resolve_hbm_limit()
+    assert limit == 16 << 30 and source == "device bytes_limit"
+    # An unknown explicit kind warns and falls through to the live
+    # limit rather than silently defaulting.
+    limit, _source = obs_devices.resolve_hbm_limit(
+        device_kind="made-up chip")
+    assert limit == 16 << 30
+
+
+def test_fit_build_stages_gate_wide_meshes_too():
+    """Review finding: the device build is single-chip regardless of
+    the solve mesh — a scale-26 device build must be refused even at
+    --ndev 8 (it used to silently skip the build stages and pass)."""
+    res = obs_devices.fit_check(26, ndev=8, vertex_sharded=True)
+    assert not res.fits
+    assert any(s.stage == "build/sort" for s in res.stages)
+
+
+def test_synthetic_spec_parser_is_shared_with_load_graph():
+    """The preflight geometry parser and load_graph share ONE grammar:
+    defaults agree with the generators' (rmat scale 20, edge factor
+    16), and malformed specs are None (load_graph converts that to its
+    clean error)."""
+    from pagerank_tpu.cli import _parse_synthetic_geometry as parse
+
+    assert parse("rmat:14") == ("rmat", 1 << 14, 16 << 14, 14)
+    assert parse("rmat") == ("rmat", 1 << 20, 16 << 20, 20)
+    assert parse("uniform:1000:5000") == ("uniform", 1000, 5000, None)
+    assert parse("uniform:1000") == ("uniform", 1000, 16000, None)
+    assert parse("banana:3") is None
+    assert parse("uniform:abc") is None
+
+
+def test_cli_preflight_blocks_doomed_run(tmp_path):
+    """CLI --preflight: a geometry that cannot fit exits 3 BEFORE any
+    graph work; a healthy one proceeds and the run report carries the
+    devices section."""
+    from pagerank_tpu.cli import main
+
+    with pytest.raises(SystemExit) as ei:
+        main(["--synthetic", "rmat:26", "--device-build",
+              "--iters", "1", "--preflight", "--log-every", "0"])
+    assert ei.value.code == 3
+    report = str(tmp_path / "rr.json")
+    rc = main(["--synthetic", "rmat:8", "--iters", "2", "--preflight",
+               "--device-sample-every", "1", "--run-report", report,
+               "--log-every", "0"])
+    assert rc == 0
+    doc = json.load(open(report))
+    assert doc["devices"]["samples"] >= 2
+    assert "device.0.bytes_in_use" in doc["metrics"]["gauges"]
+    # The CLI tore the sampler back down on exit.
+    assert obs_devices.get_sampler() is None
+
+
+def test_bench_preflight_blocks(tmp_path):
+    import bench
+
+    with pytest.raises(SystemExit) as ei:
+        bench.main(["--scale", "26", "--preflight"])
+    assert ei.value.code == 2
+
+
+def test_bench_multichip_preflight_models_clamped_mesh(monkeypatch):
+    """Review finding: the multichip preflight must model the mesh the
+    legs ACTUALLY run on (run_multichip clamps to visible devices) —
+    an unclamped wider mesh shards the modeled residency thinner than
+    reality and passes runs that then OOM."""
+    import argparse
+
+    import bench
+
+    seen = {}
+    real = obs_devices.fit_check
+
+    def spy(*a, **k):
+        seen.update(k)
+        return real(*a, **k)
+
+    monkeypatch.setattr(obs_devices, "fit_check", spy)
+    args = argparse.Namespace(multichip=True, multichip_devices=64,
+                              scale=10, edge_factor=16, dtype=None,
+                              host_build=False)
+    assert bench._preflight(args)
+    assert seen["ndev"] == len(jax.devices())
+
+
+def test_fit_check_plans_at_caller_layout_flags(monkeypatch):
+    """Review finding: the preflight must gate the build the run will
+    ACTUALLY execute — explicit stripe/lane-group/partition-span flags
+    thread through to the shared planner (a default-layout gate could
+    refuse a build that fits under the user's striping, or pass one
+    that then OOMs)."""
+    from pagerank_tpu.ops import device_build as db
+
+    seen = {}
+    real = db.plan_build
+
+    def spy(cfg, n, **kw):
+        seen.update(kw)
+        return real(cfg, n, **kw)
+
+    # fit_check resolves plan_build from the module at call time, so
+    # patching the module attribute intercepts it.
+    monkeypatch.setattr(db, "plan_build", spy)
+    res = obs_devices.fit_check(14, stripe_size=512, lane_group=16,
+                                partition_span=0)
+    assert res.stages
+    assert seen["stripe_size"] == 512 and seen["lane_group"] == 16
+    # And an explicit partition span engages the partitioned geometry
+    # (the planner returns the span as the pack stripe).
+    obs_devices.fit_check(14, partition_span=512)
+    assert seen["partition_span"] == 512
+
+
+def test_exchange_program_reset_on_rebuild():
+    """Review finding: a rebuild must drop the previous layout's
+    exchange-only program — the jitted fn closes over the old
+    mesh/state width, and attribution after an in-place rebuild must
+    time the NEW build's exchange."""
+    ndev = min(4, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs a multi-device mesh")
+    cfg = PageRankConfig(num_iters=2, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev,
+                         vertex_sharded=True)
+    eng = JaxTpuEngine(cfg).build(_graph(n=512, e=4096, seed=1))
+    att1 = obs_devices.attribute_exchange(eng, iters=2, warmup=1)
+    assert att1 is not None and eng._exchange_fn is not None
+    # Rebuild on a DIFFERENT graph size: the stale jit must be gone,
+    # and attribution against the new build must work.
+    eng.build(_graph(n=1024, e=8192, seed=2))
+    assert eng._exchange_fn is None
+    att2 = obs_devices.attribute_exchange(eng, iters=2, warmup=1)
+    assert att2 is not None and att2["exchange_s"] > 0
+
+
+def test_fit_unknown_memory_analysis_does_not_block(monkeypatch):
+    """Review finding: a backend that compiles but reports no
+    memory_analysis degrades build stages to source='unknown' — they
+    are surfaced in the table but never force does-not-fit (telemetry
+    degradation is not an OOM; only 'error' stages refuse)."""
+    from pagerank_tpu.utils import jax_compat
+
+    monkeypatch.setattr(jax_compat, "compiled_memory_analysis",
+                        lambda compiled: None)
+    res = obs_devices.fit_check(14)
+    build = [s for s in res.stages if s.stage.startswith("build/")]
+    assert build and all(s.source == "unknown" and s.bytes is None
+                         for s in build)
+    assert res.fits  # the analytic solve stage still gates — and fits
+    rendered = obs_devices.render_fit(res)
+    assert "?" in rendered and "ERROR" not in rendered
+
+
+def test_solve_stage_models_striped_table_rows(monkeypatch):
+    """Review finding: the solve-residency model must count the SAME
+    striped table the build stages size — a hardcoded n_stripes=1
+    under-modeled the stripe-padding rows (one per (stripe, dst
+    block)), so a preflight near the HBM ceiling could pass a solve
+    that then OOMs on the real striped tables."""
+    calls = []
+    real = obs_devices.estimate_slot_rows
+
+    def spy(num_edges, n_padded, n_stripes):
+        calls.append(n_stripes)
+        return real(num_edges, n_padded, n_stripes)
+
+    monkeypatch.setattr(obs_devices, "estimate_slot_rows", spy)
+    obs_devices.fit_check(12, stripe_size=512, device_build=True)
+    # n_padded=4096 at stripe 512 -> 8 stripes; BOTH the build scatter
+    # sizing and the solve model must see them.
+    assert calls and all(c == 8 for c in calls), calls
+
+    # And the striped table is strictly bigger than a single-stripe
+    # read of the same geometry (the padding rows are real bytes).
+    cfg = PageRankConfig(num_iters=1, dtype="float32",
+                         accum_dtype="float32").validate()
+    striped = obs_devices._solve_stage_report(
+        cfg, 1 << 12, 16 << 12, 1, False, stripe=512)
+    flat = obs_devices._solve_stage_report(
+        cfg, 1 << 12, 16 << 12, 1, False, stripe=0)
+    assert striped.bytes > flat.bytes
+
+
+def test_fit_check_models_vs_bounded_transients():
+    """Review finding: --vs-bounded bounds per-chip step transients to
+    O(stripe_span + N/ndev) — the preflight must model THAT mode, not
+    refuse the geometry against the plain mode's full-width z image
+    and merge accumulators (the flag exists precisely for runs the
+    plain model busts)."""
+    plain = obs_devices.fit_check(20, ndev=8, vertex_sharded=True,
+                                  device_build=False)
+    bounded = obs_devices.fit_check(20, ndev=8, vertex_sharded=True,
+                                    vs_bounded=True, device_build=False)
+    s_plain = next(s for s in plain.stages if s.stage == "solve/step")
+    s_bound = next(s for s in bounded.stages if s.stage == "solve/step")
+    assert s_bound.bytes < s_plain.bytes
+    assert "vs-bounded" in s_bound.detail
+
+
+def test_cli_preflight_threads_vs_bounded(monkeypatch):
+    """The CLI gate models the run's OWN memory mode: --vs-bounded
+    reaches fit_check (a plain-mode verdict for a bounded run renders
+    the wrong answer in both directions)."""
+    import argparse
+
+    from pagerank_tpu import cli
+
+    seen = {}
+    real = obs_devices.fit_check
+
+    def spy(*a, **k):
+        seen.update(k)
+        return real(*a, **k)
+
+    monkeypatch.setattr(obs_devices, "fit_check", spy)
+    args = argparse.Namespace(
+        num_devices=2, vertex_sharded=True, vs_bounded=True,
+        dtype="float32", accum_dtype=None, lane_group=None,
+        partition_span=None,
+    )
+    cli._run_preflight(args, n=1 << 12, num_edges=16 << 12, scale=None,
+                       device_build=False)
+    assert seen["vs_bounded"] is True and seen["vertex_sharded"] is True
+
+
+def test_track_pid_base_clears_linux_pid_space():
+    """Review finding: per-device counter-track pids must never
+    collide with the real process pid in the Chrome trace — the base
+    sits above the kernel's maximum pid_max (2^22 on Linux)."""
+    assert obs_devices.TRACK_PID_BASE > 1 << 22
+
+
+def test_bench_multichip_preflight_gates_single_chip_leg(monkeypatch):
+    """Review finding: run_multichip's FIRST leg is a single-chip
+    solve (full-width tables/state on one chip, ~ndev x the sharded
+    residency) — the preflight must gate THAT geometry too, not just
+    the ndev-sharded legs, and must refuse before the sharded check
+    when it busts."""
+    import argparse
+
+    import bench
+
+    calls = []
+    real = obs_devices.fit_check
+
+    def spy(*a, **k):
+        calls.append(k.get("ndev"))
+        res = real(*a, **k)
+        if k.get("ndev") == 1:
+            res.fits = False
+        return res
+
+    monkeypatch.setattr(obs_devices, "fit_check", spy)
+    args = argparse.Namespace(multichip=True, multichip_devices=8,
+                              scale=10, edge_factor=16, dtype=None,
+                              host_build=False)
+    assert not bench._preflight(args)
+    assert calls == [1]  # refused on the single-chip leg, sharded never ran
+
+
+def test_sampler_resolves_callable_device_source():
+    """Review finding: the sampler must be narrowable to the SOLVE
+    MESH (a callable source, the watchdog idiom) so the watermark
+    never attributes a foreign job's HBM peak to this run; a source
+    that raises (pre-build boundary sample) degrades to the full
+    sweep instead of failing the run."""
+    s = obs_devices.DeviceSampler(every=1,
+                                  devices=lambda: jax.devices()[:1])
+    stats = s.sample()
+    assert len(stats) == 1 and stats[0].id == jax.devices()[0].id
+
+    def boom():
+        raise RuntimeError("engine not built")
+
+    degraded = obs_devices.DeviceSampler(every=1, devices=boom)
+    assert len(degraded.sample()) == len(jax.devices())

@@ -366,6 +366,58 @@ def test_checked_in_perf_history_renders_every_leg(capsys):
     # render at the known ~3.5e8 values.
     assert "r01=3.478e+08" in out
     assert "r05=3.534e+08" in out
+    # ISSUE 10 satellite: scaling_efficiency renders for the multichip
+    # legs ALREADY in the checked-in ledger — the pre-ISSUE-10 records
+    # carry it only under extras, and metric_value reads both
+    # spellings (no re-ingest, no forked series).
+    assert "multichip_sparse scaling eff" in out
+    assert "multichip_dense scaling eff" in out
+
+
+def test_scaling_efficiency_normalizes_into_legs():
+    """Fresh ingest of MULTICHIP_SPARSE_r06.json lands
+    scaling_efficiency ON the multichip legs (the ISSUE-10
+    normalization), agreeing with the artifact's top-level fields and
+    with the extras back-compat read."""
+    src = json.load(open(os.path.join(REPO, "MULTICHIP_SPARSE_r06.json")))
+    rec = H.normalize_result(src, source="MULTICHIP_SPARSE_r06.json")
+    assert rec["legs"]["multichip_sparse"]["scaling_efficiency"] == \
+        src["scaling_efficiency"]
+    assert rec["legs"]["multichip_dense"]["scaling_efficiency"] == \
+        src["scaling_efficiency_dense"]
+    # And the extras-only (pre-ISSUE-10 ledger) spelling reads through
+    # metric_value identically.
+    old = dict(rec, legs={
+        leg: {k: v for k, v in m.items() if k != "scaling_efficiency"}
+        for leg, m in rec["legs"].items()
+    })
+    assert H.metric_value(old, "multichip_sparse",
+                          "scaling_efficiency") == \
+        src["scaling_efficiency"]
+    assert H.metric_value(old, "multichip_dense",
+                          "scaling_efficiency") == \
+        src["scaling_efficiency_dense"]
+
+
+def test_attribution_block_normalizes_into_leg_metrics():
+    """A bench leg's attribution block (ISSUE 10) lands as the
+    exchange_fraction / comms_achieved_bytes_per_sec leg metrics, so
+    the r06+ trend carries the exchange-bound verdict."""
+    src = json.load(open(os.path.join(REPO, "MULTICHIP_SPARSE_r06.json")))
+    doc = json.loads(json.dumps(src))
+    doc["sparse_exchange"]["attribution"] = {
+        "iters": 10, "exchange_s": 0.002, "step_s": 0.005,
+        "compute_s": 0.003, "exchange_fraction": 0.4,
+        "model_bytes_per_iter": 5424,
+        "achieved_bytes_per_sec": 2.7e6, "mode": "sparse",
+    }
+    rec = H.normalize_result(doc, source="MULTICHIP_ATTR.json")
+    leg = rec["legs"]["multichip_sparse"]
+    assert leg["exchange_fraction"] == 0.4
+    assert leg["comms_achieved_bytes_per_sec"] == 2.7e6
+    assert "exchange_fraction" in H.LEG_METRICS
+    assert H.METRIC_BAD_DIRECTION["scaling_efficiency"] == "down"
+    assert H.METRIC_BAD_DIRECTION["exchange_fraction"] == "up"
 
 
 def test_checked_in_ledger_records_are_deduped_and_versioned():

@@ -131,6 +131,44 @@ def test_chrome_trace_event_schema(tmp_path):
             assert ev["args"]["message"] == "hello"
 
 
+def test_chrome_counter_track_schema(tmp_path):
+    """Per-device counter tracks (ISSUE 10): tracked add_counter
+    samples render as Chrome ``ph:"C"`` events on their OWN pid lane
+    with a ``process_name`` metadata event naming the lane, so
+    Perfetto shows one HBM track per device; untracked counters ride
+    the process pid. The NullTracer's add_counter is a no-op."""
+    tr = obs.enable_tracing()
+    tr.add_counter("device.0.hbm", {"bytes_in_use": 5},
+                   track=1 << 20, track_label="device tpu:0 (TPU v4)")
+    tr.add_counter("device.0.hbm", {"bytes_in_use": 9},
+                   track=1 << 20, track_label="device tpu:0 (TPU v4)")
+    tr.add_counter("loose.counter", {"v": 1})
+    events = tr.chrome_events()
+    tracked = [e for e in events
+               if e["ph"] == "C" and e["name"] == "device.0.hbm"]
+    assert [e["args"]["bytes_in_use"] for e in tracked] == [5, 9]
+    assert all(e["pid"] == 1 << 20 for e in tracked)
+    assert tracked[0]["ts"] <= tracked[1]["ts"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(metas) == 1  # one label per track, not per sample
+    assert metas[0]["args"]["name"] == "device tpu:0 (TPU v4)"
+    loose = [e for e in events if e["ph"] == "C"
+             and e["name"] == "loose.counter"]
+    import os
+
+    assert loose[0]["pid"] == os.getpid()
+    # JSONL export carries the counters as strict-JSON lines.
+    path = str(tmp_path / "c.jsonl")
+    tr.export(path)
+    counters = [_strict_loads(l) for l in open(path)
+                if _strict_loads(l).get("type") == "counter"]
+    assert len(counters) == 3
+    # Disabled tracing: add_counter is a silent no-op.
+    obs.disable_tracing()
+    obs_trace.get_tracer().add_counter("x", {"v": 1})
+    assert obs_trace.get_tracer().counters() == []
+
+
 def test_jsonl_trace_export_is_strict(tmp_path):
     tr = obs_trace.Tracer()
     with tr.span("a/b"):
@@ -491,6 +529,11 @@ def test_cli_failure_path_still_writes_artifacts(tmp_path, monkeypatch):
     assert report["spans"]["solve/step"]["count"] >= 2  # healthy steps
     assert report["metrics"]["counters"][
         "engine.health_check_failures"] >= 1
+    # OOM forensics (ISSUE 10): the FAILURE-marked report still
+    # carries the device-plane section with a teardown-time sample.
+    devices = report["devices"]
+    assert devices["samples"] >= 1
+    assert devices["last"] and devices["last"][0]["id"] == 0
     doc = _strict_loads(open(trace_path).read())
     assert doc["traceEvents"]
     assert obs_trace.get_tracer() is obs_trace.NULL_TRACER
