@@ -316,6 +316,10 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
     label = f"{dtype}" + (f"+{accum_dtype}-accum" if accum_dtype != dtype else "")
     if wide_accum == "pair":
         label += "+pair"
+    if part:
+        label += f"+span{part}"
+        if stream_dtype:
+            label += f"+{stream_dtype}"
     if build_only:
         del engine
         print(f"build[{label}]: warm rebuild {t_build:.1f}s "
@@ -345,7 +349,8 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         f"{dt / args.iters * 1e3:.2f} ms/iter, {eps_chip:.4g} edges/s/chip",
         file=sys.stderr,
     )
-    costs = _leg_costs(engine, dt / args.iters, num_edges)
+    costs, lowering = _leg_costs(engine, dt / args.iters, num_edges,
+                                 dump_hlo=args.dump_hlo, label=label)
     layout = engine.layout_info()
     del engine  # free HBM before the next config builds
     return {
@@ -362,23 +367,45 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         # layout — including a pallas probe fallback, the autotuned
         # chunk, and the partition-centric geometry when engaged.
         "layout": layout,
+        # The compiler-plane lowering verdict (ISSUE 11; obs/hlo.py):
+        # gather strategy, fusion count, collective multiset, the
+        # HLO-derived bytes/edge, and the structural fingerprint the
+        # perf-history ledger tracks. None when the backend reports
+        # no optimized HLO.
+        "lowering": lowering,
     }
 
 
-def _leg_costs(engine, seconds_per_iter, num_edges):
-    """One rate leg's cost block: reset the ledger (per-leg scoping —
-    a warm second leg must not inherit the first leg's stale stage
-    entries), harvest the step program(s), attach the measured
-    per-iteration wall, and snapshot. The wall attaches ONLY to the
-    whole-iteration 'step' program: on multi-dispatch layouts the
-    ledger holds prescale/stripe{i}/final instead, and dividing the
-    finalize program's bytes (a fraction of the iteration's traffic)
-    by the full wall would fabricate a too-low roofline fraction — the
-    per-program models stay unmeasured there (roofline null)."""
+def _leg_costs(engine, seconds_per_iter, num_edges, dump_hlo=None,
+               label=""):
+    """One rate leg's cost + lowering blocks: reset both ledgers
+    (per-leg scoping — a warm second leg must not inherit the first
+    leg's stale stage entries), harvest the step program(s) ONCE with
+    the compiler-plane inspector armed (ISSUE 11: the lowering reports
+    come off the same compiled handles as the cost model — zero extra
+    compiles), attach the measured per-iteration wall, and snapshot
+    both. The wall attaches ONLY to the whole-iteration 'step'
+    program: on multi-dispatch layouts the ledger holds
+    prescale/stripe{i}/final instead, and dividing the finalize
+    program's bytes (a fraction of the iteration's traffic) by the
+    full wall would fabricate a too-low roofline fraction — the
+    per-program models stay unmeasured there (roofline null).
+
+    Returns ``(costs, lowering)`` — ``lowering`` is the per-form
+    LoweringReport dict (gather strategy, fusion count, fingerprint,
+    hlo_bytes_per_edge), or ``None`` when the backend reports no HLO.
+    ``dump_hlo`` additionally writes each form's raw optimized HLO to
+    that directory as ``<label>.<form>.hlo`` for offline diffing."""
     from pagerank_tpu.obs import costs as obs_costs
+    from pagerank_tpu.obs import hlo as obs_hlo
 
     obs_costs.reset()
-    engine.cost_reports()
+    obs_hlo.reset()
+    obs_hlo.arm()
+    try:
+        engine.cost_reports()
+    finally:
+        obs_hlo.disarm()
     step = obs_costs.attach_measurement("step", seconds_per_iter,
                                         num_edges=num_edges)
     if step is not None and step.bytes_per_edge is not None:
@@ -386,7 +413,23 @@ def _leg_costs(engine, seconds_per_iter, num_edges):
         if step.roofline_fraction is not None:
             line += f", {step.roofline_fraction:.1%} of HBM roofline"
         print(line, file=sys.stderr)
-    return obs_costs.ledger_snapshot()
+    lowering = obs_hlo.ledger_snapshot() or None
+    whole = (lowering or {}).get("step") or (lowering or {}).get("final")
+    if whole is not None:
+        g = whole.get("gather") or {}
+        print(
+            f"lowering[{label or 'step'}]: gather "
+            f"{str(g.get('strategy', '?')).upper()}, "
+            f"{whole.get('fusion_count')} fusion(s), fingerprint "
+            f"{whole.get('fingerprint')}",
+            file=sys.stderr,
+        )
+    if dump_hlo:
+        written = obs_hlo.dump_texts(dump_hlo, prefix=label)
+        if written:
+            print(f"dumped {len(written)} HLO module(s) to {dump_hlo}",
+                  file=sys.stderr)
+    return obs_costs.ledger_snapshot(), lowering
 
 
 def run_accuracy(scale: int = 20, iters: int = 50, with_bf16: bool = False,
@@ -476,7 +519,7 @@ def run_accuracy(scale: int = 20, iters: int = 50, with_bf16: bool = False,
     return out
 
 
-def _mc_leg(graph, *, ndev, iters, warmup, halo, label):
+def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None):
     """One multichip rate leg: a vertex-sharded f32 solve over ``ndev``
     devices through the dense or sparse (halo) exchange. Returns the
     leg dict: edges/s/chip, cost + layout + comms blocks, the
@@ -531,13 +574,16 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label):
             + ")"
         )
     print(line, file=sys.stderr)
+    costs, lowering = _leg_costs(engine, dt / iters, graph.num_edges,
+                                 dump_hlo=dump_hlo, label=label)
     leg = {
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
         "n_devices": ndev,
         "ms_per_iter": dt / iters * 1e3,
         "build_s": t_build,
-        "costs": _leg_costs(engine, dt / iters, graph.num_edges),
+        "costs": costs,
+        "lowering": lowering,
         "layout": engine.layout_info(),
         "comms": engine.comms_model(),
         "bytes_exchanged": bytes_exchanged,
@@ -581,7 +627,8 @@ def run_multichip(args):
         f"({time.perf_counter() - t0:.1f}s host build)",
         file=sys.stderr,
     )
-    kw = dict(iters=args.iters, warmup=args.warmup)
+    kw = dict(iters=args.iters, warmup=args.warmup,
+              dump_hlo=args.dump_hlo)
     single = _mc_leg(graph, ndev=1, halo=False, label="single_chip", **kw)
     dense = _mc_leg(graph, ndev=ndev, halo=False, label="dense_exchange",
                     **kw)
@@ -792,6 +839,12 @@ def main(argv=None):
                         "single, --build-only, and --multichip runs "
                         "alike). Inspect with `python -m "
                         "pagerank_tpu.obs history trend LEDGER`")
+    p.add_argument("--dump-hlo", default=None, metavar="DIR",
+                   help="ALSO write every rate leg's optimized HLO "
+                        "modules to DIR as <leg>.<form>.hlo for "
+                        "offline diffing (ISSUE 11; obs/hlo.py) — the "
+                        "classified verdict rides the JSON's per-leg "
+                        "'lowering' block either way")
     p.add_argument("--preflight", action="store_true",
                    help="OOM-preflight fit check (ISSUE 10; "
                         "obs/devices.fit_check) BEFORE anything "
@@ -870,6 +923,7 @@ def main(argv=None):
             "vs_baseline": rate["vs_baseline"],
             "build_s": rate["build_s"],
             "costs": rate["costs"],
+            "lowering": rate["lowering"],
             "layout": rate["layout"],
             "scale": args.scale,
             "iters": args.iters,
@@ -913,6 +967,7 @@ def main(argv=None):
         "vs_baseline": pair_rate["vs_baseline"],
         "build_s": pair_rate["build_s"],
         "costs": pair_rate["costs"],  # headline (pair) leg's cost model
+        "lowering": pair_rate["lowering"],  # headline lowering verdict
         "layout": pair_rate["layout"],
         "fast_f32": f32_rate,  # carries its own "costs" block
         "partitioned_f32": part_rate,

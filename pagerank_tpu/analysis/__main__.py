@@ -88,6 +88,14 @@ def main(argv=None) -> int:
                        "callbacks, no f64, donation intact"),
         ):
             print(f"{rid}  [jaxpr ] {desc}")
+        for rid, desc in (
+            ("PTH001", "optimized-HLO gather strategy: native gather, "
+                       "never the while/scalar expansion"),
+            ("PTH002", "optimized-HLO fusion count within budget"),
+            ("PTH003", "no while-loop carrying gather-class traffic "
+                       "as scalar dynamic-slices"),
+        ):
+            print(f"{rid}  [hlo   ] {desc}")
         return 0
 
     allowlist_path = args.allowlist

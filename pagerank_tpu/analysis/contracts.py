@@ -45,7 +45,20 @@ flag), PTC007 probe transparency (the probe-enabled step —
 multiset of the plain step, add no host callback, no f64 under f32
 configs, and keep the rank donation consumable; on multi-dispatch
 layouts the standalone probe program must be collective- and
-callback-free). Waivers (with the root cause) live in
+callback-free).
+
+The PTH family (ISSUE 11; obs/hlo.py) checks the OPTIMIZED HLO the
+backend actually compiled, not the jaxpr: PTH001 gather strategy —
+every dispatch form's hot traffic must lower to a NATIVE gather op
+(never the while-loop/scalar dynamic-slice expansion, the documented
+"fast gather defeated" signature; PERF_NOTES "Scan bodies defeat the
+fast gather"); PTH002 fusion-count budget — a fusion blow-up marks a
+lowering class change; PTH003 no while-loop around the hot gather —
+no iteration program may carry gather-class traffic as scalar
+dynamic-slices inside a while body, even partially. Backends whose
+``Compiled`` exposes no HLO text degrade to a surfaced-but-non-
+blocking "unknown" verdict (obs_log), mirroring the device plane's
+memory_analysis handling. Waivers (with the root cause) live in
 analysis/allowlist.txt.
 """
 
@@ -550,6 +563,121 @@ def check_engine_form(form: Form) -> List[Finding]:
 
     # PTC007 — probe transparency (ISSUE 5).
     findings.extend(check_probe_form(engine, form))
+
+    # PTH001-003 — optimized-HLO lowering contracts (ISSUE 11).
+    findings.extend(check_hlo_form(engine, form))
+    return findings
+
+
+#: PTH002's per-program fusion ceiling at the contract geometry: every
+#: current form lands under ~20 fusions on the CPU backend (ell 8,
+#: partitioned 19, coo 5); 64 gives ~3x headroom while still catching
+#: a lowering class change (an unrolled/scalarized expansion multiplies
+#: fusions by the chunk or index count).
+PTH_FUSION_BUDGET = 64
+
+
+def _hlo_programs(engine):
+    """(label, Compiled) for every program one iteration dispatches —
+    the engine's own enumeration (`iteration_programs`, the one place
+    that knows the dispatch set and its argument threading — shared
+    with cost_reports so the contract can never inspect a program the
+    run doesn't dispatch). ``wrap_unjitted``: stage fns the engine
+    doesn't keep jitted (the vs-bounded multi-dispatch stripes) still
+    hold the hot gather, so the contract inspects those too. AOT
+    lowering only; nothing executes."""
+    return [(label, compiled) for label, compiled, _ne
+            in engine.iteration_programs(wrap_unjitted=True)]
+
+
+def check_hlo_form(engine, form: Form) -> List[Finding]:
+    """PTH001-003: the backend's OPTIMIZED HLO for every iteration
+    program of one built dispatch form, through the obs/hlo classifier.
+
+      - **PTH001** (gather strategy): no program may classify
+        ``expanded`` (the while-loop/scalar dynamic-slice emulation of
+        a gather — the exact lowering that measured 0.91e8 vs 3.33e8
+        edges/s/chip, PERF_NOTES "Scan bodies defeat the fast
+        gather"), and at least one program must carry a NATIVE hot
+        gather (every form's hot traffic is a slot-table gather —
+        including coo's rank gather).
+      - **PTH002** (fusion budget): per-program fusion count within
+        :data:`PTH_FUSION_BUDGET` — a blow-up marks a lowering class
+        change even when the gather survives.
+      - **PTH003** (no while-loop around the hot gather): NO program
+        may carry gather-class traffic as scalar float dynamic-slices
+        inside a while body, even alongside a surviving native gather
+        (a partial defeat — e.g. one stripe scalarized).
+
+    Degradation (the ISSUE-11 satellite, mirroring PR 10's
+    memory_analysis handling): a backend/jax whose ``Compiled``
+    raises from / returns empty ``as_text()`` yields an "unknown"
+    verdict — surfaced via obs_log, never a finding, and the
+    no-native-gather check is skipped (absence cannot be proven on a
+    backend that hides its HLO)."""
+    from pagerank_tpu.obs import hlo as obs_hlo
+    from pagerank_tpu.obs import log as obs_log
+    from pagerank_tpu.utils import jax_compat
+
+    findings: List[Finding] = []
+    any_native = False
+    any_unknown = False
+    for label, compiled in _hlo_programs(engine):
+        text = jax_compat.compiled_hlo_text(compiled)
+        if not text:
+            any_unknown = True
+            obs_log.info(
+                f"PTH: backend reports no optimized HLO for "
+                f"{form.name}/{label}; gather-strategy verdict "
+                f"unknown (non-blocking)"
+            )
+            continue
+        try:
+            rep = obs_hlo.inspect_text(f"{form.name}/{label}", text)
+        except Exception as e:  # a parser gap is an unknown, not a fail
+            any_unknown = True
+            obs_log.info(
+                f"PTH: lowering inspection failed for "
+                f"{form.name}/{label} ({type(e).__name__}); verdict "
+                f"unknown (non-blocking)"
+            )
+            continue
+        g = rep.gather
+        if g["strategy"] == "native":
+            any_native = True
+        if g["strategy"] == "expanded":
+            findings.append(_finding(
+                "PTH001",
+                f"hot gather lowered to a while-loop/scalar "
+                f"dynamic-slice expansion in '{label}' (sites: "
+                + ", ".join(g["expansion_sites"][:3])
+                + ") — the fast-gather-defeated signature",
+                form.name,
+            ))
+        if rep.fusion_count > PTH_FUSION_BUDGET:
+            findings.append(_finding(
+                "PTH002",
+                f"fusion count {rep.fusion_count} in '{label}' exceeds "
+                f"the budget {PTH_FUSION_BUDGET} — the lowering "
+                f"changed class",
+                form.name,
+            ))
+        if g["strategy"] != "expanded" and g["expansion_sites"]:
+            findings.append(_finding(
+                "PTH003",
+                f"while-loop carries gather-class traffic as scalar "
+                f"dynamic-slices in '{label}' "
+                f"({', '.join(g['expansion_sites'][:3])}) despite a "
+                f"surviving native gather — a partial defeat",
+                form.name,
+            ))
+    if not any_native and not any_unknown:
+        findings.append(_finding(
+            "PTH001",
+            "no iteration program carries a native hot gather (every "
+            "dispatch form's hot traffic is a slot-table gather)",
+            form.name,
+        ))
     return findings
 
 
@@ -681,14 +809,36 @@ def check_step_key_stability(ndev: int) -> List[Finding]:
         lowered = jax.jit(eng._step_core, donate_argnums=(0,)).lower(
             *eng._device_args()
         )
-        texts.append(lowered.as_text())
-    if texts[0] != texts[1]:
+        # as_text can raise / return empty on backends that keep their
+        # IR to themselves (bare PJRT plugins; the ISSUE-11 satellite)
+        # — degrade to a surfaced-but-non-blocking unknown verdict,
+        # never a crash of the whole contract sweep.
+        try:
+            text = lowered.as_text()
+        except Exception as e:
+            text = ""
+            from pagerank_tpu.obs import log as obs_log
+
+            obs_log.info(
+                f"PTC004: lowering text unavailable "
+                f"({type(e).__name__}); step-key stability verdict "
+                f"unknown (non-blocking)"
+            )
+        texts.append(text)
+    if all(texts) and texts[0] != texts[1]:
         findings.append(_finding(
             "PTC004",
             "step lowering differs across num_iters/tol configs: the "
             "iteration budget leaked into the compilation key",
             "step_key",
         ))
+    elif not all(texts):
+        from pagerank_tpu.obs import log as obs_log
+
+        obs_log.info(
+            "PTC004: step-key stability unverifiable on this backend "
+            "(empty lowering text) — skipped, not failed"
+        )
 
     # And the jitted step must hit its cache across repeated dispatches.
     eng = JaxTpuEngine(PageRankConfig(num_iters=4, num_devices=ndev)).build(g)

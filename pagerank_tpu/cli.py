@@ -302,6 +302,16 @@ def build_parser() -> argparse.ArgumentParser:
         "the engine build (the device-allocation gate either way)",
     )
     ob.add_argument(
+        "--dump-hlo", default=None, metavar="DIR",
+        help="after the solve, harvest the step program(s)' OPTIMIZED "
+        "HLO (obs/hlo.py; ISSUE 11) — gather-strategy classification, "
+        "fusion/collective structure, lowering fingerprint — into the "
+        "run report's `lowering` section and write the raw modules to "
+        "DIR as <form>.hlo for offline diffing. Off by default: a "
+        "plain run makes zero inspector calls (the tracer/sampler "
+        "booby-trap discipline); jax engine only",
+    )
+    ob.add_argument(
         "--stall-timeout", type=float, default=None, metavar="SECONDS",
         help="arm the stall watchdog: if no solve step completes "
         "within SECONDS, log a loud diagnostic (last-completed "
@@ -1068,6 +1078,7 @@ def _main(argv, ctx) -> int:
     obs.disable_tracing()
     obs.get_registry().reset()
     obs.costs.reset()
+    obs.hlo.reset()
     tracer = (obs.enable_tracing() if (args.trace or args.run_report)
               else obs.get_tracer())
     ctx["tracer"] = tracer
@@ -1590,6 +1601,31 @@ def _main(argv, ctx) -> int:
     # config, span summary, metrics snapshot, per-iteration history,
     # cost model, robustness counters. Diff two with
     # `python -m pagerank_tpu.obs report A.json B.json`.
+    if args.dump_hlo and args.engine == "jax":
+        # Compiler plane (ISSUE 11; obs/hlo.py): harvest the step
+        # program(s)' optimized-HLO lowering reports (arming the
+        # inspector around ONE cost_reports pass — same compiled
+        # handles, zero extra compiles; this also fills the cost
+        # ledger, so the cost_reports call below is a ledger hit) and
+        # dump the raw modules for offline diffing. The classified
+        # reports ride the run report's `lowering` section and the
+        # --history RunRecord's lowering fingerprint. After the solve
+        # by design: a lowering harvest must never sit on the hot path.
+        try:
+            reports = engine.lowering_reports()
+            written = obs.hlo.dump_texts(args.dump_hlo)
+            whole = reports.get("step") or reports.get("final")
+            verdict = ((whole.get("gather") or {}).get("strategy")
+                       if whole else None)
+            print(
+                f"dumped {len(written)} optimized-HLO module(s) to "
+                f"{args.dump_hlo}"
+                + (f"; gather lowering: {verdict}" if verdict else ""),
+                file=sys.stderr,
+            )
+        except Exception as e:  # telemetry must not fail the solve
+            print(f"pagerank_tpu: HLO dump failed ({e!r})",
+                  file=sys.stderr)
     if (args.run_report or args.history) and args.engine == "jax":
         # Fill the cost ledger with the step program's XLA cost model
         # (the fused executables harvested at their compile already);

@@ -61,6 +61,7 @@ from pagerank_tpu import graph as graph_mod
 from pagerank_tpu.engine import PageRankEngine, register_engine
 from pagerank_tpu.graph import Graph
 from pagerank_tpu.obs import costs as obs_costs
+from pagerank_tpu.obs import hlo as obs_hlo
 from pagerank_tpu.obs import live as obs_live
 from pagerank_tpu.obs import log as obs_log
 from pagerank_tpu.obs import trace as obs_trace
@@ -130,6 +131,7 @@ class JaxTpuEngine(PageRankEngine):
         # attributes pays nothing — not even a compile.
         self._exchange_core = None
         self._exchange_fn = None
+        self._lowering_cache = None
 
     # -- build ------------------------------------------------------------
 
@@ -143,9 +145,12 @@ class JaxTpuEngine(PageRankEngine):
         # program: the jitted fn closes over the old mesh/state width,
         # and a layout without an exchange (replicated/multi-dispatch)
         # must not inherit one — the vs setups reassign _exchange_core
-        # when they apply.
+        # when they apply. Same for the previous program's lowering
+        # reports: the memo is per-engine-PER-BUILD, never the shared
+        # process ledger (a rebuilt engine must re-classify).
         self._exchange_core = None
         self._exchange_fn = None
+        self._lowering_cache = None
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
         )
@@ -2983,48 +2988,109 @@ class JaxTpuEngine(PageRankEngine):
         whole_form = "step" if self._ms_stripe is None else "final"
         if not refresh and obs_costs.get_report(whole_form) is not None:
             return obs_costs.ledger_snapshot()
-        ne = (int(self.graph.num_edges)
-              if self.graph is not None and self.graph.num_edges else None)
         try:
-            if self._ms_stripe is None:
-                with obs_trace.span("engine/compile", form="cost_step"):
-                    compiled = jax.jit(
-                        self._step_core, donate_argnums=(0,)
-                    ).lower(*self._device_args()).compile()
-                obs_costs.harvest("step", compiled, num_edges=ne)
-            else:
-                pres_args = (self._r, self._inv_out)
-                with obs_trace.span("engine/compile", form="cost_ms"):
-                    if hasattr(self._ms_prescale, "lower"):
-                        obs_costs.harvest(
-                            "prescale",
-                            self._ms_prescale.lower(*pres_args).compile(),
-                        )
-                    zs = jax.eval_shape(self._ms_prescale, *pres_args)
-                    parts = []
-                    for s, fn in enumerate(self._ms_stripe_fns):
-                        stripe_args = (*zs, self._src[s],
-                                       self._row_block[s])
-                        if hasattr(fn, "lower"):
-                            obs_costs.harvest(
-                                f"stripe{s}",
-                                fn.lower(*stripe_args).compile(),
-                            )
-                        parts.append(jax.eval_shape(fn, *stripe_args))
-                    final_args = (self._r, *parts, *self._ms_ids,
-                                  self._dangling, self._zero_in,
-                                  self._valid)
-                    obs_costs.harvest(
-                        "final",
-                        self._ms_final.lower(*final_args).compile(),
-                        num_edges=ne,
-                    )
+            for label, compiled, ne in self.iteration_programs():
+                obs_costs.harvest(label, compiled, num_edges=ne)
+                # Compiler plane (ISSUE 11): SAME compiled handle, so
+                # arming the inspector costs zero extra compiles.
+                obs_hlo.maybe_inspect(label, compiled, num_edges=ne)
         except Exception as e:  # accounting never fails a run
             obs_log.warn(
                 f"cost harvest unavailable ({type(e).__name__}: "
                 f"{str(e)[:120]})"
             )
         return obs_costs.ledger_snapshot()
+
+    def iteration_programs(self, wrap_unjitted: bool = False):
+        """``(label, Compiled, num_edges)`` for every program ONE
+        iteration dispatches — the whole-iteration ``step`` on
+        single-program layouts, ``prescale``/``stripe{i}``/``final``
+        on multi-dispatch ones. AOT lowering only (nothing executes;
+        stripe inputs come from ``jax.eval_shape``), and the handles
+        are the ones :meth:`cost_reports` and the PTH lowering
+        contracts (analysis/contracts.check_hlo_form) both inspect —
+        the ONE place that knows the dispatch set and its argument
+        threading. ``num_edges`` attaches only to the whole-iteration
+        form (per-program models stay unmeasured on multi-dispatch —
+        see cost_reports).
+
+        ``wrap_unjitted`` additionally ``jax.jit``-wraps stage fns the
+        engine doesn't keep jitted (the vs-bounded multi-dispatch
+        stripes) so their programs can be inspected too; cost_reports
+        keeps the default (skip them) so its ledger shape is
+        unchanged."""
+        ne = (int(self.graph.num_edges)
+              if self.graph is not None and self.graph.num_edges else None)
+
+        def lower(fn, args):
+            if not hasattr(fn, "lower"):
+                fn = jax.jit(fn)
+            return fn.lower(*args).compile()
+
+        if self._ms_stripe is None:
+            with obs_trace.span("engine/compile", form="cost_step"):
+                compiled = jax.jit(
+                    self._step_core, donate_argnums=(0,)
+                ).lower(*self._device_args()).compile()
+            return [("step", compiled, ne)]
+        out = []
+        pres_args = (self._r, self._inv_out)
+        with obs_trace.span("engine/compile", form="cost_ms"):
+            if wrap_unjitted or hasattr(self._ms_prescale, "lower"):
+                out.append(("prescale",
+                            lower(self._ms_prescale, pres_args), None))
+            zs = jax.eval_shape(self._ms_prescale, *pres_args)
+            parts = []
+            for s, fn in enumerate(self._ms_stripe_fns):
+                stripe_args = (*zs, self._src[s], self._row_block[s])
+                if wrap_unjitted or hasattr(fn, "lower"):
+                    out.append((f"stripe{s}",
+                                lower(fn, stripe_args), None))
+                parts.append(jax.eval_shape(fn, *stripe_args))
+            final_args = (self._r, *parts, *self._ms_ids,
+                          self._dangling, self._zero_in, self._valid)
+            out.append(("final", lower(self._ms_final, final_args), ne))
+        return out
+
+    def lowering_reports(self, refresh: bool = False) -> Dict[str, dict]:
+        """Harvest the step program(s)' OPTIMIZED-HLO lowering reports
+        (obs/hlo.py; ISSUE 11) — gather-strategy classification,
+        fusion/collective structure, bf16-stream verification, the
+        HLO-derived traffic estimate — and return the lowering-ledger
+        snapshot (the per-leg ``lowering`` block of bench JSON and the
+        run report's ``lowering`` section).
+
+        Arms the inspector around ONE :meth:`cost_reports` pass, so
+        the lowering harvest reuses the exact compiled handles the
+        cost harvest holds: zero extra compiles. Out-of-band by
+        contract — never called from the hot loop, and a disarmed run
+        never reaches this method (the booby-trap discipline).
+
+        Note the forced cost re-harvest refiles the cost ledger's
+        reports WITHOUT any previously attached measurement — callers
+        that attach a measured wall (bench) must harvest lowering
+        FIRST (or simply arm the inspector before their own
+        cost_reports call, which is what bench._leg_costs does).
+
+        The repeat-call memo is PER-ENGINE (``_lowering_cache``,
+        dropped by ``_begin_build`` on a rebuild): the process-global
+        hlo ledger is shared across engines, so memoizing on it would
+        hand a second engine (or an in-place rebuild on a new graph)
+        the FIRST program's verdict — the staleness class the
+        exchange-only jit already guards against."""
+        cache = getattr(self, "_lowering_cache", None)
+        if not refresh and cache is not None:
+            return cache
+        was_armed = obs_hlo.armed()
+        obs_hlo.arm()
+        try:
+            self.cost_reports(refresh=True)
+        finally:
+            if not was_armed:
+                obs_hlo.disarm()
+        snap = obs_hlo.ledger_snapshot()
+        self._lowering_cache = snap
+        return snap
 
     def run_fast(self, num_iters: Optional[int] = None) -> np.ndarray:
         """Benchmark loop: no per-iteration host sync; one honest scalar
@@ -3286,6 +3352,10 @@ class JaxTpuEngine(PageRankEngine):
                 "fused_tol", fused, iters=k,
                 num_edges=int(self.graph.num_edges) if self.graph else None,
             )
+            obs_hlo.maybe_inspect(
+                "fused_tol", fused,
+                num_edges=int(self.graph.num_edges) if self.graph else None,
+            )
             self._fused_cache[key] = fused
         return fused
 
@@ -3311,6 +3381,10 @@ class JaxTpuEngine(PageRankEngine):
             # divide by k, so chunked runs (several k's) agree.
             obs_costs.harvest(
                 "fused_scan", fused, iters=k,
+                num_edges=int(self.graph.num_edges) if self.graph else None,
+            )
+            obs_hlo.maybe_inspect(
+                "fused_scan", fused,
                 num_edges=int(self.graph.num_edges) if self.graph else None,
             )
             self._fused_cache[k] = fused

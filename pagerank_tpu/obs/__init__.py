@@ -43,6 +43,16 @@ comms-vs-compute wall attribution for the sharded step
 (``comms.exchange_fraction`` / ``comms.achieved_bytes_per_sec``), and
 the OOM-preflight fit check (``python -m pagerank_tpu.obs fit``).
 
+ISSUE 11 adds the **compiler plane** (obs/hlo.py): optimized-HLO
+lowering inspection per compiled dispatch form — gather-strategy
+classification (native vs while-loop/scalar expansion, the "fast
+gather defeated" signature), fusion/collective structure, bf16-stream
+verification, an HLO-derived traffic estimate reconciled against the
+analytic cost model, and a lowering fingerprint carried through the
+perf-history ledger. Surfaced via ``engine.lowering_reports()``,
+bench/CLI ``--dump-hlo``, contracts PTH001-003, and
+``python -m pagerank_tpu.obs hlo``.
+
 Plus :func:`profiler_session` (obs/profiler.py), the jax.profiler
 lifecycle as a tracer-composed context manager, and :mod:`obs.log`,
 the sanctioned stderr channel for library diagnostics (lint PTL007).
@@ -51,7 +61,7 @@ Import cost: stdlib only (jax is imported lazily inside the functions
 that need it), so any utils module can depend on obs without cycles.
 """
 
-from pagerank_tpu.obs import costs, devices, history
+from pagerank_tpu.obs import costs, devices, history, hlo
 from pagerank_tpu.obs.devices import (
     DeviceSampler,
     arm_sampler,
@@ -102,6 +112,7 @@ __all__ = [
     "costs",
     "devices",
     "history",
+    "hlo",
     "DeviceSampler",
     "arm_sampler",
     "disarm_sampler",

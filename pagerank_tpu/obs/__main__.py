@@ -31,8 +31,23 @@ artifacts, the perf-history ledger, and the OOM-preflight fit check.
                                   nonzero with the per-stage table
                                   when it provably does not fit
 
-Exit codes: 0 ok, 1 gate violation / does not fit, 2 usage/unreadable
-input.
+  hlo --form F [--scale N]        compiler-plane inspection (ISSUE 11;
+                                  obs/hlo.py): build the named
+                                  dispatch form(s) at the target
+                                  geometry, harvest the OPTIMIZED HLO
+                                  of every iteration program, and
+                                  print the lowering verdict — gather
+                                  strategy (native vs while/scalar
+                                  expansion), fusion count, collective
+                                  multiset, bf16-stream presence,
+                                  HLO-derived bytes/edge, fingerprint.
+                                  Exit 1 when any program classifies
+                                  EXPANDED (the fast-gather-defeated
+                                  signature); --dump-hlo DIR writes
+                                  the raw modules for offline diffing
+
+Exit codes: 0 ok, 1 gate violation / does not fit / defeated gather,
+2 usage/unreadable input.
 """
 
 from __future__ import annotations
@@ -153,7 +168,94 @@ def build_parser() -> argparse.ArgumentParser:
                     "reserve (default 0.9)")
     fp.add_argument("--json", action="store_true",
                     help="emit the FitResult as JSON")
+    hp2 = sub.add_parser(
+        "hlo",
+        help="compiler-plane lowering inspection (ISSUE 11; "
+        "obs/hlo.py): classify the gather strategy / fusion "
+        "structure of a dispatch form's optimized HLO — the "
+        "'did XLA keep the fast gather' verdict read BEFORE a "
+        "TPU session instead of hand-diffing HLO dumps",
+    )
+    hp2.add_argument(
+        "--form", default="default", metavar="FORM",
+        help="dispatch form(s) to inspect: comma-separated names from "
+        "{default, pair, partitioned, partitioned_bf16, coo, "
+        "vertex_sharded, vs_halo}, or 'all'",
+    )
+    hp2.add_argument("--scale", type=int, default=14,
+                     help="R-MAT scale of the host-built probe graph "
+                     "(default 14 — sub-second on CPU, big enough "
+                     "that the hot gather is unambiguous)")
+    hp2.add_argument("--edge-factor", type=int, default=16)
+    hp2.add_argument("--json", action="store_true",
+                     help="emit {form: {program: LoweringReport}} as "
+                     "strict JSON")
+    hp2.add_argument("--dump-hlo", default=None, metavar="DIR",
+                     help="also write every inspected program's raw "
+                     "optimized HLO to DIR as <form>.<program>.hlo")
     return p
+
+
+def _cmd_hlo(args) -> int:
+    from pagerank_tpu.obs import hlo as hlo_mod
+
+    alias = {"ell": "default", "fast_bf16": "partitioned_bf16"}
+    names = (
+        # --form all: one entry per DISTINCT program (alias targets).
+        [n for n in hlo_mod.FORM_CHOICES if n not in alias]
+        if args.form == "all"
+        else [f.strip() for f in args.form.split(",") if f.strip()])
+    # Fail the usage error BEFORE any graph builds — a typo'd form at
+    # --scale 22 must not cost minutes of R-MAT host work first.
+    unknown = [n for n in names if n not in hlo_mod.FORM_CHOICES]
+    if unknown or not names:
+        print(
+            "obs hlo: unknown dispatch form(s) "
+            + (", ".join(repr(n) for n in unknown) or "(none given)")
+            + " (choices: " + ", ".join(hlo_mod.FORM_CHOICES) + ")",
+            file=sys.stderr,
+        )
+        return 2
+    # Build each distinct program once (default/ell and
+    # partitioned_bf16/fast_bf16 are aliases) but emit EVERY requested
+    # name — `--form ell,default` returns both keys, sharing one
+    # snapshot.
+    built, out, defeated = {}, {}, []
+    for form in names:
+        canon = alias.get(form, form)
+        if canon not in built:
+            try:
+                built[canon] = hlo_mod.inspect_form(
+                    canon, args.scale, edge_factor=args.edge_factor)
+            except ValueError as e:
+                print(f"obs hlo: {e}", file=sys.stderr)
+                return 2
+            if args.dump_hlo:
+                hlo_mod.dump_texts(args.dump_hlo, prefix=canon)
+        snapshot = built[canon]
+        if form in out:
+            continue  # same name listed twice
+        out[form] = snapshot
+        for prog, rep in snapshot.items():
+            if (rep.get("gather") or {}).get("strategy") == "expanded":
+                defeated.append(f"{form}/{prog}")
+    if args.json:
+        print(json.dumps(report_mod._json_safe(out), indent=2,
+                         allow_nan=False))
+    else:
+        for form, snapshot in out.items():
+            if not snapshot:
+                print(f"{form}: backend reports no optimized HLO "
+                      f"(verdict unknown)")
+            for prog in sorted(snapshot):
+                rep = dict(snapshot[prog])
+                rep["form"] = f"{form}/{prog}"
+                print(hlo_mod.render_report(rep))
+    if defeated:
+        print("obs hlo: DEFEATED gather lowering in: "
+              + ", ".join(defeated), file=sys.stderr)
+        return 1
+    return 0
 
 
 def _cmd_fit(args) -> int:
@@ -308,6 +410,8 @@ def main(argv=None) -> int:
         return _cmd_report(args)
     if args.command == "fit":
         return _cmd_fit(args)
+    if args.command == "hlo":
+        return _cmd_hlo(args)
     return _cmd_history(args)
 
 

@@ -75,6 +75,12 @@ LEG_METRICS = (
     "scaling_efficiency",
     "exchange_fraction",
     "comms_achieved_bytes_per_sec",
+    # ISSUE 11: the compiler plane's HLO-derived traffic estimate —
+    # reconciles the analytic cost model against what the optimized
+    # HLO actually schedules (legs also carry the non-numeric
+    # ``lowering_fingerprint`` / ``gather_strategy`` the trend and
+    # classifier read).
+    "hlo_bytes_per_edge",
 )
 
 #: Which direction is BAD, per metric (direction-aware thresholds:
@@ -90,6 +96,7 @@ METRIC_BAD_DIRECTION = {
     "scaling_efficiency": "down",
     "exchange_fraction": "up",
     "comms_achieved_bytes_per_sec": "down",
+    "hlo_bytes_per_edge": "up",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -172,10 +179,37 @@ def _rate_leg(d: dict) -> dict:
         leg["comms_achieved_bytes_per_sec"] = ab
     if isinstance(d.get("layout"), dict):
         leg["layout"] = _json_safe(d["layout"])
+    # Compiler-plane block (ISSUE 11; bench legs since r11): the
+    # whole-iteration form's lowering fingerprint + gather verdict
+    # joins the series, so a jax/libtpu upgrade that changes the
+    # LOWERING is attributable as program-change, not noise. Pre-
+    # ISSUE-11 artifacts simply lack the key (back-compat: no
+    # re-ingest, the series starts when the instrument did).
+    _leg_lowering(d.get("lowering"), leg)
     nd = d.get("n_devices")
     if isinstance(nd, int):
         leg["n_devices"] = nd
     return leg
+
+
+def _leg_lowering(lowering, leg: dict) -> None:
+    """Fold one per-form ``lowering`` block (obs/hlo.ledger_snapshot
+    shape) into canonical leg metrics: the WHOLE-ITERATION form's
+    fingerprint, gather strategy, and HLO bytes/edge."""
+    if not isinstance(lowering, dict):
+        return
+    whole = lowering.get("step") or lowering.get("final") or {}
+    if not isinstance(whole, dict):
+        return
+    fp = whole.get("fingerprint")
+    if isinstance(fp, str) and fp:
+        leg["lowering_fingerprint"] = fp
+    strategy = (whole.get("gather") or {}).get("strategy")
+    if isinstance(strategy, str):
+        leg["gather_strategy"] = strategy
+    hb = _num(whole.get("hlo_bytes_per_edge"))
+    if hb is not None:
+        leg["hlo_bytes_per_edge"] = hb
 
 
 def _leg_name_from_layout(layout: Optional[dict], default: str = "f32") -> str:
@@ -325,6 +359,7 @@ def _normalize_run_report(doc: dict, rec: dict) -> None:
         v = _num(gauges.get(gauge_key))
         if v is not None:
             leg[metric] = v
+    _leg_lowering(doc.get("lowering"), leg)
     if leg:
         rec["legs"][leg_name_for_config(cfg)] = leg
     iters = cfg.get("num_iters") if isinstance(cfg, dict) else None
@@ -586,6 +621,12 @@ def classify_change(target: dict, baseline: Sequence[dict],
       1. the leg's cost model (bytes/edge) moved vs its baseline
          median ⇒ **program-change** (the compiled program itself
          costs differently — XLA's model is deterministic);
+      1b. the leg's LOWERING FINGERPRINT (obs/hlo.py; ISSUE 11) moved
+         vs the baseline consensus ⇒ **program-change** — the compiler
+         emitted a structurally different program (a jax/libtpu
+         upgrade that changes the lowering is a program change even
+         when the analytic cost model is flat, e.g. a defeated
+         gather);
       2. cost flat (or unmeasurable) and the env fingerprint drifted
          within the class ⇒ **env-drift**;
       3. cost flat and the baseline never recorded a fingerprint ⇒
@@ -607,6 +648,19 @@ def classify_change(target: dict, baseline: Sequence[dict],
             return ("program-change",
                     f"cost model moved: {med:.1f} -> {cost_now:.1f} "
                     f"B/edge ({(cost_now - med) / med:+.1%})")
+    fp_now = (target.get("legs") or {}).get(leg, {}).get(
+        "lowering_fingerprint")
+    fp_base = _mode([
+        (r.get("legs") or {}).get(leg, {}).get("lowering_fingerprint")
+        for r in baseline
+    ])
+    if fp_now and fp_base and fp_now != fp_base:
+        strat = (target.get("legs") or {}).get(leg, {}).get(
+            "gather_strategy")
+        return ("program-change",
+                f"lowering fingerprint moved: {fp_base} -> {fp_now} — "
+                f"the compiler emitted a different program shape"
+                + (f" (gather now {strat})" if strat else ""))
     t_env = target.get("env") or {}
     drifted = []
     baseline_known = False
@@ -813,6 +867,7 @@ _METRIC_SHORT = {
     "scaling_efficiency": "scaling eff",
     "exchange_fraction": "exch frac",
     "comms_achieved_bytes_per_sec": "achieved B/s",
+    "hlo_bytes_per_edge": "hlo B/edge",
 }
 
 
@@ -877,6 +932,31 @@ def render_trend(records: Sequence[dict],
         for label, n, med, mad, cells in rows:
             lines.append(f"{label:<{w}}  {n:>2}  {_fmt(med):>10}  "
                          f"{_fmt(mad):>9}  {cells}")
+    # Lowering fingerprints (ISSUE 11): the compiler-plane series —
+    # a fingerprint change next to a rate shift attributes the shift
+    # to the emitted program (a jax/libtpu lowering change), the
+    # attribution the MAD classifier also applies mechanically.
+    low_rows = []
+    for leg in leg_names:
+        fps = [
+            (i, (r.get("legs") or {}).get(leg, {}).get(
+                "lowering_fingerprint"))
+            for i, r in enumerate(records)
+        ]
+        fps = [(i, f) for i, f in fps if isinstance(f, str) and f]
+        if not fps:
+            continue
+        cells = " ".join(
+            f"{record_label(records[i], i)}={f[:8]}" for i, f in fps
+        )
+        changed = len({f for _, f in fps}) > 1
+        low_rows.append(f"  {leg}: {cells}"
+                        + ("  << LOWERING CHANGED" if changed else ""))
+    if low_rows:
+        lines.append("")
+        lines.append("lowering fingerprints (optimized-HLO structure "
+                     "per leg):")
+        lines.extend(low_rows)
     changes = detect_changes(records, detection)
     flagged = [c for c in changes if c.flagged]
     lines.append("")

@@ -1,0 +1,797 @@
+"""Compiler-plane observability (ISSUE 11): optimized-HLO inspection.
+
+The two staged perf wins (the partition-centric restage and the sparse
+halo) are gated on one documented unknown — PERF_NOTES records that an
+in-body ``dynamic_slice`` table once lost XLA's fast-gather lowering,
+and the partitioned window is exactly an in-body dynamic slice. Until
+now the only instrument that could answer "did the compiler do what
+the cost model assumes" was a TPU wall-clock. This module is the
+missing third plane of the obs stack (perf history → device plane →
+**compiler plane**): it harvests the OPTIMIZED HLO of every compiled
+dispatch form (``compiled.as_text()`` via the ``utils/jax_compat``
+degrade-to-None shim) and parses it into a typed
+:class:`LoweringReport` —
+
+  - **op histogram** + fusion/while counts of the scheduled module;
+  - **gather-strategy classification**: ``native`` (a real ``gather``
+    op carries the hot traffic), ``expanded`` (the while-loop /
+    scalar-dynamic-slice emulation — the exact "fast gather defeated"
+    signature), or ``none``;
+  - the **hot gather's** facts: output size, table operand dtype and
+    the NARROWEST float dtype in its operand chain (``bf16`` there is
+    the mechanical "the bf16 stream actually reaches the gather"
+    verification for the ``fast_bf16`` leg), whether it sits inside a
+    while body;
+  - the **collective multiset** with operand byte widths — the wire
+    shape of the program, comparable across jax upgrades;
+  - an **entry-schedule traffic estimate** (operand + output bytes of
+    every scheduled entry instruction; fusion internals stay in
+    registers, so the call-site bytes are the honest HBM proxy),
+    reconciled against the analytic obs/costs model as the
+    ``cost.<form>.hlo_bytes_per_edge`` gauge;
+  - a structural **fingerprint** (op histogram + gather strategy +
+    fusion count + collective multiset) carried per leg in the
+    perf-history RunRecords, so a jax/libtpu upgrade that changes the
+    lowering is attributed as program-change, not noise
+    (obs/history.classify_change).
+
+Harvest is LAZY and booby-trapped like the tracer and the device
+sampler: the inspector is DISARMED by default, every compile point
+guards on :func:`armed` (zero inspector calls, zero extra compiles on
+a plain run — tests/test_hlo.py traps every entry point), and arming
+reuses the SAME compiled handles the cost-accounting harvest already
+holds. Consumers: ``engine.lowering_reports()``, the per-leg
+``lowering`` block in bench JSON, the run report's ``lowering``
+section (diffed by ``obs report``), contracts PTH001-003
+(analysis/contracts.py), and ``python -m pagerank_tpu.obs hlo``.
+
+Import cost: stdlib + obs.metrics/obs.log only (jax stays lazy), so
+obs/__init__ re-exports this module without dragging a backend in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from pagerank_tpu.obs import log as obs_log
+from pagerank_tpu.obs import metrics as obs_metrics
+
+#: Bytes per element by HLO dtype token. Extend here if a new dtype
+#: ever shows up in a lowering; unknown tokens yield None bytes (an
+#: unreported size, never a zero).
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_FLOAT_DTYPES = ("bf16", "f16", "f32", "f64")
+
+#: A gather only counts as the HOT gather when its output reaches this
+#: many elements — index fix-ups and probe top-k gathers are not the
+#: slot-table traffic the classifier is about.
+HOT_GATHER_MIN_ELEMENTS = 128
+
+#: A while loop is an expansion CANDIDATE only past this trip bound:
+#: the engine's own chunk scans run tens of trips at contract
+#: geometries, while a scalarized gather loops once per index
+#: (thousands+). Below the bound a scalar slice is loop bookkeeping.
+EXPANSION_MIN_TRIPS = 256
+
+#: "Scalar" for the expansion signature: a float dynamic-slice /
+#: dynamic-update-slice moving at most this many elements per trip.
+#: The chunk scans' smallest float slices move a full 128-lane row.
+SCALAR_SLICE_MAX_ELEMENTS = 8
+
+#: Ops that only re-view or move a buffer — walking the hot gather's
+#: table operand back through these finds the dtype the table is
+#: actually STREAMED at (the bf16 verification), without crediting
+#: recomputation.
+_VIEW_OPS = {
+    "convert", "bitcast", "copy", "reshape", "slice", "dynamic-slice",
+    "pad", "transpose", "broadcast", "get-tuple-element",
+}
+
+#: Cross-device collectives as they appear in optimized HLO (the
+#: async-pair start forms included; done forms carry no new operands).
+_COLLECTIVE_OPS = {
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "all-reduce-start", "all-gather-start",
+    "collective-permute-start",
+}
+
+#: Entry-schedule opcodes that move no HBM bytes of their own.
+_FREE_OPS = {"parameter", "constant", "tuple", "get-tuple-element",
+             "bitcast", "iota"}
+
+
+# -- HLO text parsing --------------------------------------------------------
+
+
+@dataclass
+class HloInstr:
+    """One parsed instruction line of an HLO module text."""
+
+    name: str
+    opcode: str
+    dtype: Optional[str]          # None for tuple-typed results
+    shape: Tuple[int, ...]
+    #: [(dtype, shape, %name)] per typed operand in source order.
+    operands: List[Tuple[Optional[str], Tuple[int, ...], str]]
+    attrs: str                    # raw text after the operand list
+    computation: str
+    #: Integer literal of a scalar ``constant(N)`` — the while-trip
+    #: bound extraction reads these off condition computations.
+    literal: Optional[int] = None
+
+    @property
+    def out_elements(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def out_bytes(self) -> Optional[int]:
+        w = DTYPE_BYTES.get(self.dtype or "")
+        return None if w is None else w * self.out_elements
+
+
+@dataclass
+class ParsedModule:
+    """An HLO module as computations of instructions, plus the call
+    edges the expansion detector walks (fusion ``calls=``, while
+    ``body=``/``condition=``, reduce ``to_apply=``)."""
+
+    computations: Dict[str, List[HloInstr]] = field(default_factory=dict)
+    entry: Optional[str] = None
+    calls: Dict[str, List[str]] = field(default_factory=dict)
+
+    def instructions(self):
+        for instrs in self.computations.values():
+            yield from instrs
+
+    def producer(self, computation: str, name: str) -> Optional[HloInstr]:
+        for i in self.computations.get(computation, ()):
+            if i.name == name:
+                return i
+        return None
+
+    def reachable(self, root: str) -> List[str]:
+        """Computation names reachable from ``root`` through call
+        edges, root included."""
+        seen, stack = [], [root]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.append(c)
+            stack.extend(self.calls.get(c, ()))
+        return seen
+
+
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s*\(.*\{\s*$")
+_TYPE_TOK = r"(?:[a-z]+[0-9]*)\[[0-9,]*\](?:\{[^}]*\})?"
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*"
+    r"(\((?:[^()]|\([^()]*\))*\)|" + _TYPE_TOK + r"|[a-z]+[0-9]*\[\])"
+    r"\s+([a-z][\w\-]*)\((.*)$"
+)
+_OPERAND_RE = re.compile(r"(" + _TYPE_TOK + r"|[a-z]+[0-9]*\[\])\s+"
+                         r"%([\w.\-]+)")
+_CALL_RE = re.compile(r"(?:calls|body|condition|to_apply)=%([\w.\-]+)")
+
+
+def _parse_type(tok: str) -> Tuple[Optional[str], Tuple[int, ...]]:
+    """'f32[4096,128]{1,0}' -> ('f32', (4096, 128)); tuple types ->
+    (None, ())."""
+    m = re.match(r"([a-z]+[0-9]*)\[([0-9,]*)\]", tok)
+    if not m:
+        return None, ()
+    dims = tuple(int(d) for d in m.group(2).split(",") if d)
+    return m.group(1), dims
+
+
+def _split_operands(rest: str) -> Tuple[str, str]:
+    """Split the text after the opening '(' into (operand list, trailing
+    attrs) at the matching close paren."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i], rest[i + 1:]
+    return rest, ""
+
+
+def parse_hlo_text(text: str) -> ParsedModule:
+    """Parse one HLO module text (the ``as_text()`` of an optimized /
+    scheduled module) into a :class:`ParsedModule`. Tolerant by
+    construction: unrecognized lines are skipped — the classifier
+    works off what parses, and the degrade path is the caller's."""
+    mod = ParsedModule()
+    comp = None
+    for raw in text.splitlines():
+        if not raw.strip() or raw.lstrip().startswith("//"):
+            continue
+        if not raw.startswith(" "):
+            m = _COMP_RE.match(raw.strip())
+            if m:
+                comp = m.group(2)
+                mod.computations.setdefault(comp, [])
+                if m.group(1):
+                    mod.entry = comp
+            continue
+        if comp is None:
+            continue
+        m = _INSTR_RE.match(raw)
+        if not m:
+            continue
+        name, type_tok, opcode, rest = m.groups()
+        dtype, shape = _parse_type(type_tok)
+        operand_text, attrs = _split_operands(rest)
+        operands = [
+            (*_parse_type(t), n)
+            for t, n in _OPERAND_RE.findall(operand_text)
+        ]
+        literal = None
+        if opcode == "constant":
+            lm = re.match(r"\s*(-?\d+)\s*$", operand_text)
+            if lm:
+                literal = int(lm.group(1))
+        instr = HloInstr(name=name, opcode=opcode, dtype=dtype,
+                         shape=shape, operands=operands,
+                         attrs=attrs, computation=comp, literal=literal)
+        mod.computations[comp].append(instr)
+        for callee in _CALL_RE.findall(attrs):
+            mod.calls.setdefault(comp, []).append(callee)
+    return mod
+
+
+# -- analysis ----------------------------------------------------------------
+
+
+def op_histogram(mod: ParsedModule) -> Dict[str, int]:
+    hist: Dict[str, int] = {}
+    for i in mod.instructions():
+        hist[i.opcode] = hist.get(i.opcode, 0) + 1
+    return hist
+
+
+def collective_multiset(mod: ParsedModule) -> List[dict]:
+    """One record per collective instruction: the op, the widest
+    operand's byte count (None when the dtype is unknown), and its
+    dtype — the wire shape ``obs report`` / the history fingerprint
+    compare across upgrades."""
+    out = []
+    for i in mod.instructions():
+        if i.opcode not in _COLLECTIVE_OPS:
+            continue
+        best_bytes, best_dtype = None, None
+        for dt, shape, _name in i.operands:
+            w = DTYPE_BYTES.get(dt or "")
+            if w is None:
+                continue
+            n = 1
+            for d in shape:
+                n *= d
+            b = w * n
+            if best_bytes is None or b > best_bytes:
+                best_bytes, best_dtype = b, dt
+        out.append({"op": i.opcode, "operand_bytes": best_bytes,
+                    "dtype": best_dtype})
+    return sorted(out, key=lambda r: (r["op"], -(r["operand_bytes"] or 0)))
+
+
+def _while_trip_bound(mod: ParsedModule, wh: HloInstr) -> Optional[int]:
+    """Best-effort trip bound of a while op: the largest integer
+    constant in its condition computation (the counter compare's
+    bound). None when the condition doesn't parse to one."""
+    m = re.search(r"condition=%([\w.\-]+)", wh.attrs)
+    if not m:
+        return None
+    best = None
+    for i in mod.computations.get(m.group(1), ()):
+        if i.opcode == "constant" and i.literal is not None:
+            best = i.literal if best is None else max(best, i.literal)
+    return best
+
+
+def expansion_sites(mod: ParsedModule) -> List[str]:
+    """While bodies carrying gather-class traffic as SCALAR float
+    dynamic-slices — the emulated-gather lowering (one trip per index,
+    a scalar table load + scalar result update each). Returns the body
+    computation names; empty = no expansion anywhere.
+
+    A scalarized SCATTER loop (CPU XLA expands scatter-add this way —
+    coo's merge at contract geometries) shares the scalar-load +
+    scalar-store skeleton but read-modify-writes its target: the
+    dynamic-update-slice's destination buffer is ALSO read by a scalar
+    dynamic-slice in the same computation. A defeated gather's output
+    is write-only inside the loop. Only write-only scalar stores count
+    — scatter expansion is a different (and on CPU, expected) lowering,
+    not the fast-gather-defeated signature."""
+    sites = []
+    for wh in mod.instructions():
+        if wh.opcode != "while":
+            continue
+        m = re.search(r"body=%([\w.\-]+)", wh.attrs)
+        if not m:
+            continue
+        trips = _while_trip_bound(mod, wh)
+        if trips is not None and trips < EXPANSION_MIN_TRIPS:
+            continue
+        scalar_load = False
+        #: (computation, source buffer name) of every scalar float load
+        #: — the RMW discriminator keys on these.
+        load_sources = set()
+        #: (computation, target buffer name) of every scalar float store.
+        store_targets = []
+        for comp in mod.reachable(m.group(1)):
+            for i in mod.computations.get(comp, ()):
+                if (i.opcode == "dynamic-slice"
+                        and i.dtype in _FLOAT_DTYPES
+                        and i.out_elements <= SCALAR_SLICE_MAX_ELEMENTS):
+                    scalar_load = True
+                    if i.operands:
+                        load_sources.add((comp, i.operands[0][2]))
+                if (i.opcode == "dynamic-update-slice"
+                        and i.dtype in _FLOAT_DTYPES):
+                    # The dus RESULT is the whole buffer — scalarness
+                    # lives in the UPDATE operand (operand 1).
+                    upd = (i.operands[1] if len(i.operands) > 1
+                           else None)
+                    if (upd is not None and upd[0] in _FLOAT_DTYPES
+                            and _prod(upd[1])
+                            <= SCALAR_SLICE_MAX_ELEMENTS):
+                        store_targets.append((comp, i.operands[0][2]))
+        write_only_store = any(t not in load_sources
+                               for t in store_targets)
+        # An UNKNOWN trip bound still counts when both halves of the
+        # signature are present — a real expansion's bound is the
+        # (dynamic) index count, which often doesn't parse.
+        if scalar_load and write_only_store:
+            sites.append(m.group(1))
+    return sorted(set(sites))
+
+
+def _stream_dtype(mod: ParsedModule, gather: HloInstr) -> Optional[str]:
+    """The NARROWEST float dtype in the hot gather's table operand
+    chain (walked back through view/convert ops inside the gather's
+    own computation). ``bf16`` here is the mechanical proof that the
+    reduced-precision stream actually reaches the gather — the
+    fast_bf16 verification PERF_NOTES could only promise."""
+    if not gather.operands:
+        return None
+    dt, _shape, name = gather.operands[0]
+    best = dt if dt in _FLOAT_DTYPES else None
+
+    def width(d):
+        return DTYPE_BYTES.get(d or "", 1 << 30)
+
+    for _hop in range(8):
+        prod = mod.producer(gather.computation, name)
+        if prod is None or prod.opcode not in _VIEW_OPS:
+            break
+        if prod.dtype in _FLOAT_DTYPES and (
+            best is None or width(prod.dtype) < width(best)
+        ):
+            best = prod.dtype
+        for odt, _os, oname in prod.operands:
+            if odt in _FLOAT_DTYPES and (
+                best is None or width(odt) < width(best)
+            ):
+                best = odt
+            name = oname  # follow the first typed operand
+            break
+        else:
+            break
+    return best
+
+
+def _while_reachable(mod: ParsedModule) -> set:
+    """Computations reachable from any while BODY (the in-loop set)."""
+    out = set()
+    for wh in mod.instructions():
+        if wh.opcode != "while":
+            continue
+        m = re.search(r"body=%([\w.\-]+)", wh.attrs)
+        if m:
+            out.update(mod.reachable(m.group(1)))
+    return out
+
+
+def classify_gather(mod: ParsedModule) -> dict:
+    """The gather-strategy verdict of one module:
+
+      - ``native``: at least one real ``gather`` op at hot-traffic
+        size — XLA kept the gather a gather;
+      - ``expanded``: no hot native gather, but a while-loop/scalar
+        dynamic-slice expansion site exists — the "fast gather
+        defeated" signature;
+      - ``none``: neither (a program with no gather-class traffic,
+        e.g. a prescale).
+
+    Plus the hot gather's facts when present (size, table dtype, the
+    narrowest streamed float dtype, in-while placement, slice sizes).
+    """
+    gathers = [i for i in mod.instructions() if i.opcode == "gather"]
+    hot = None
+    for g in gathers:
+        if g.out_elements < HOT_GATHER_MIN_ELEMENTS:
+            continue
+        if hot is None or (g.out_bytes or 0) > (hot.out_bytes or 0):
+            hot = g
+    sites = expansion_sites(mod)
+    if hot is None:
+        strategy = "expanded" if sites else "none"
+    else:
+        strategy = "native"
+    out = {
+        "strategy": strategy,
+        "n_gathers": len(gathers),
+        "expansion_sites": sites,
+        "hot_gather": None,
+    }
+    if hot is not None:
+        table = hot.operands[0] if hot.operands else (None, (), "")
+        m = re.search(r"slice_sizes=\{([0-9,]*)\}", hot.attrs)
+        out["hot_gather"] = {
+            "computation": hot.computation,
+            "output_elements": hot.out_elements,
+            "output_bytes": hot.out_bytes,
+            "table_dtype": table[0],
+            "table_elements": _prod(table[1]),
+            "stream_dtype": _stream_dtype(mod, hot),
+            "slice_sizes": ([int(d) for d in m.group(1).split(",") if d]
+                            if m else None),
+            "in_while": hot.computation in _while_reachable(mod),
+        }
+    return out
+
+
+def _prod(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def entry_traffic_bytes(mod: ParsedModule) -> Optional[float]:
+    """Operand + output bytes of every scheduled ENTRY instruction
+    (parameters/constants/views excluded). Fusion internals live in
+    registers, so the call-site bytes of the entry schedule are the
+    HLO-derived HBM-traffic estimate the ``hlo_bytes_per_edge`` gauge
+    reconciles against the analytic cost model. While bodies count
+    once (trip counts are not modeled) — an ESTIMATE, stated as such.
+    None when the module has no parsed entry computation."""
+    if mod.entry is None:
+        return None
+    total = 0
+    for i in mod.computations.get(mod.entry, ()):
+        if i.opcode in _FREE_OPS:
+            continue
+        b = i.out_bytes
+        if b is not None:
+            total += b
+        for dt, shape, _name in i.operands:
+            w = DTYPE_BYTES.get(dt or "")
+            if w is not None:
+                total += w * _prod(shape)
+    return float(total)
+
+
+# -- the typed report --------------------------------------------------------
+
+
+@dataclass
+class LoweringReport:
+    """One compiled program's lowering facts (strict-JSON shaped via
+    :meth:`to_json`). ``text`` keeps the raw HLO for ``--dump-hlo``
+    offline diffing but never enters JSON artifacts."""
+
+    form: str
+    op_histogram: Dict[str, int] = field(default_factory=dict)
+    fusion_count: int = 0
+    while_count: int = 0
+    gather: dict = field(default_factory=dict)
+    collectives: List[dict] = field(default_factory=list)
+    hlo_bytes: Optional[float] = None
+    num_edges: Optional[int] = None
+    text: Optional[str] = field(default=None, repr=False)
+
+    @property
+    def hlo_bytes_per_edge(self) -> Optional[float]:
+        if self.hlo_bytes is None or not self.num_edges:
+            return None
+        return self.hlo_bytes / self.num_edges
+
+    @property
+    def fingerprint(self) -> str:
+        """Short structural hash: op histogram + gather strategy/dtypes
+        + fusion count + collective multiset. Stable across re-compiles
+        of the same program; moves when the LOWERING moves — the
+        program-change attribution signal obs/history carries per
+        leg."""
+        g = self.gather or {}
+        hg = g.get("hot_gather") or {}
+        body = {
+            "ops": sorted(self.op_histogram.items()),
+            "fusions": self.fusion_count,
+            "whiles": self.while_count,
+            "strategy": g.get("strategy"),
+            "table_dtype": hg.get("table_dtype"),
+            "stream_dtype": hg.get("stream_dtype"),
+            "slice_sizes": hg.get("slice_sizes"),
+            "collectives": [(c["op"], c["dtype"], c["operand_bytes"])
+                            for c in self.collectives],
+        }
+        return hashlib.sha256(
+            json.dumps(body, sort_keys=True).encode()
+        ).hexdigest()[:12]
+
+    def to_json(self) -> dict:
+        out = {k: v for k, v in dataclasses.asdict(self).items()
+               if k != "text"}
+        out["hlo_bytes_per_edge"] = self.hlo_bytes_per_edge
+        out["fingerprint"] = self.fingerprint
+        return out
+
+
+def inspect_text(form: str, text: str, *, num_edges: Optional[int] = None,
+                 record: bool = False) -> LoweringReport:
+    """Parse + classify one HLO module text into a
+    :class:`LoweringReport` (the pure core — tests and the contract
+    checker feed synthetic texts through here)."""
+    mod = parse_hlo_text(text)
+    hist = op_histogram(mod)
+    report = LoweringReport(
+        form=form,
+        op_histogram=hist,
+        fusion_count=hist.get("fusion", 0),
+        while_count=hist.get("while", 0),
+        gather=classify_gather(mod),
+        collectives=collective_multiset(mod),
+        hlo_bytes=entry_traffic_bytes(mod),
+        num_edges=num_edges,
+        text=text,
+    )
+    if record:
+        record_report(report)
+    return report
+
+
+def inspect_compiled(form: str, compiled, *,
+                     num_edges: Optional[int] = None,
+                     record: bool = True) -> Optional[LoweringReport]:
+    """Harvest one AOT-compiled program's optimized HLO into the
+    ledger. Never raises, never compiles: the text comes off the
+    ALREADY-COMPILED handle via the jax_compat shim, and backends that
+    report no HLO degrade to a logged None (the same contract as the
+    cost/memory harvest — telemetry cannot fail a run)."""
+    from pagerank_tpu.utils import jax_compat
+
+    text = jax_compat.compiled_hlo_text(compiled)
+    if not text:
+        obs_log.info(
+            f"lowering inspection: backend reports no optimized HLO "
+            f"for '{form}' (verdict unknown)"
+        )
+        return None
+    try:
+        report = inspect_text(form, text, num_edges=num_edges)
+    except Exception as e:  # a parser gap must not fail a run
+        obs_log.warn(
+            f"lowering inspection failed for '{form}' "
+            f"({type(e).__name__}: {str(e)[:120]})"
+        )
+        return None
+    if record:
+        record_report(report)
+    return report
+
+
+# -- arming + the process ledger --------------------------------------------
+
+_ARMED = False
+_LEDGER: Dict[str, LoweringReport] = {}
+
+
+def armed() -> bool:
+    """Whether the compile points harvest lowering reports. DISARMED
+    (the default), a run makes ZERO inspector calls and ZERO extra
+    compiles — the tracer/sampler booby-trap discipline
+    (tests/test_hlo.py traps every entry point)."""
+    return _ARMED
+
+
+def arm() -> None:
+    global _ARMED
+    _ARMED = True
+
+
+def disarm() -> None:
+    global _ARMED
+    _ARMED = False
+
+
+def maybe_inspect(form: str, compiled, *,
+                  num_edges: Optional[int] = None) -> None:
+    """The compile-point hook (stage_call, the engine's fused/step
+    compiles): a bare armed-flag read when disarmed — no inspector
+    call, no text fetch."""
+    if _ARMED:
+        inspect_compiled(form, compiled, num_edges=num_edges)
+
+
+def record_report(report: LoweringReport) -> LoweringReport:
+    """File under the form (last write wins, like the cost ledger) and
+    publish the reconciliation gauge when the report carries both an
+    HLO traffic estimate and an edge count."""
+    _LEDGER[report.form] = report
+    bpe = report.hlo_bytes_per_edge
+    if bpe is not None:
+        obs_metrics.gauge(
+            f"cost.{report.form}.hlo_bytes_per_edge",
+            f"optimized-HLO entry-schedule bytes per edge of the "
+            f"'{report.form}' program (reconciles the analytic cost "
+            f"model)",
+        ).set(bpe)
+    return report
+
+
+def get_report(form: str) -> Optional[LoweringReport]:
+    return _LEDGER.get(form)
+
+
+def ledger_snapshot() -> Dict[str, dict]:
+    """``{form: LoweringReport.to_json()}``, stable key order — the
+    per-leg ``lowering`` block of bench JSON and the run report's
+    ``lowering`` section."""
+    return {form: _LEDGER[form].to_json() for form in sorted(_LEDGER)}
+
+
+def dump_texts(directory: str, prefix: str = "") -> List[str]:
+    """Write every ledgered report's raw HLO text to
+    ``directory/[prefix.]<form>.hlo`` for offline diffing (bench/CLI
+    ``--dump-hlo``). Returns the written paths."""
+    import os
+
+    os.makedirs(directory, exist_ok=True)
+    written = []
+    for form in sorted(_LEDGER):
+        rep = _LEDGER[form]
+        if not rep.text:
+            continue
+        stem = (f"{prefix}." if prefix else "") + form.replace("/", "_")
+        path = os.path.join(directory, stem + ".hlo")
+        with open(path, "w") as f:
+            f.write(rep.text)
+        written.append(path)
+    return written
+
+
+def reset() -> None:
+    """Drop the ledger and disarm — one run's lowering reports must
+    not bleed into the next in-process run (cli.main resets at entry
+    alongside the metrics registry and the cost ledger)."""
+    global _ARMED
+    _LEDGER.clear()
+    _ARMED = False
+
+
+# -- form inspection (the `obs hlo` CLI + acceptance smoke) ------------------
+
+#: Dispatch-form vocabulary ``python -m pagerank_tpu.obs hlo --form``
+#: accepts (a deliberate subset of the contract sweep's: the forms a
+#: TPU session actually benches). ``default`` is the plain replicated
+#: ELL step.
+FORM_CHOICES = ("default", "ell", "pair", "partitioned",
+                "partitioned_bf16", "fast_bf16", "coo",
+                "vertex_sharded", "vs_halo")
+
+
+def _form_config(form: str, n: int, ndev: int):
+    """PageRankConfig for one named dispatch form at an n-vertex
+    geometry (the quarter-range fallback span keeps the partitioned
+    forms running at small scales, mirroring bench's dedicated legs)."""
+    from pagerank_tpu import PageRankConfig
+
+    n_padded = -(-n // 128) * 128
+    span = max(128, (n_padded // 4) & ~127)
+    kw = {
+        "default": {}, "ell": {},
+        "pair": dict(dtype="float64", accum_dtype="float64",
+                     wide_accum="pair"),
+        "partitioned": dict(partition_span=span),
+        "partitioned_bf16": dict(partition_span=span,
+                                 stream_dtype="bfloat16"),
+        "fast_bf16": dict(partition_span=span, stream_dtype="bfloat16"),
+        "coo": dict(kernel="coo"),
+        "vertex_sharded": dict(vertex_sharded=True, num_devices=ndev),
+        "vs_halo": dict(vertex_sharded=True, halo_exchange=True,
+                        halo_head=128, num_devices=ndev),
+    }.get(form)
+    if kw is None:
+        raise ValueError(
+            f"unknown dispatch form {form!r} (choices: "
+            + ", ".join(FORM_CHOICES) + ")"
+        )
+    return PageRankConfig(num_iters=2, **kw)
+
+
+def inspect_form(form: str, scale: int, edge_factor: int = 16,
+                 seed: int = 0) -> Dict[str, dict]:
+    """Build one named dispatch form on an R-MAT graph at ``scale``
+    and return its lowering-ledger snapshot (the ``obs hlo`` CLI core;
+    the acceptance smoke calls this directly). Host-built graph — the
+    instrument must run on any backend, CPU included."""
+    import jax
+
+    from pagerank_tpu import build_graph
+    from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    ndev = min(2, len(jax.devices()))
+    # Resolve the config FIRST: an unknown form name must raise before
+    # the R-MAT build (minutes of host work at real scales), and the
+    # geometry inputs (n = 1 << scale) are known without it.
+    cfg = _form_config(form, 1 << scale, ndev)
+    src, dst = rmat_edges(scale, edge_factor, seed=seed)
+    g = build_graph(src, dst, n=1 << scale)
+    engine = JaxTpuEngine(cfg).build(g)
+    reset()
+    return engine.lowering_reports()
+
+
+# -- human rendering ---------------------------------------------------------
+
+
+def render_report(report) -> str:
+    """One form's verdict as the ``obs hlo`` CLI prints it. Accepts a
+    :class:`LoweringReport` or its :meth:`~LoweringReport.to_json`
+    dict (the CLI renders snapshots after the per-form ledger reset)."""
+    rep = report.to_json() if isinstance(report, LoweringReport) else report
+    g = rep.get("gather") or {}
+    hg = g.get("hot_gather") or {}
+    lines = [
+        f"{rep.get('form')}: gather "
+        f"{str(g.get('strategy', '?')).upper()}"
+        + (f" ({hg['output_elements']:,} el out, table "
+           f"{hg.get('table_dtype')}, streamed "
+           f"{hg.get('stream_dtype')}"
+           + (", in while body" if hg.get("in_while") else "")
+           + ")" if hg else "")
+    ]
+    if g.get("expansion_sites"):
+        lines.append(
+            "  EXPANSION sites (while-loop scalar dynamic-slice): "
+            + ", ".join(g["expansion_sites"])
+        )
+    lines.append(
+        f"  fusions {rep.get('fusion_count')}, whiles "
+        f"{rep.get('while_count')}, fingerprint {rep.get('fingerprint')}"
+    )
+    if rep.get("collectives"):
+        parts = [
+            f"{c['op']}({c['dtype']}, "
+            + (f"{c['operand_bytes']:,}B" if c["operand_bytes"]
+               is not None else "?")
+            + ")"
+            for c in rep["collectives"]
+        ]
+        lines.append("  collectives: " + ", ".join(parts))
+    bpe = rep.get("hlo_bytes_per_edge")
+    if bpe is not None:
+        lines.append(f"  entry-schedule traffic ~{bpe:.1f} B/edge "
+                     f"(vs the analytic cost model's bytes_per_edge)")
+    return "\n".join(lines)

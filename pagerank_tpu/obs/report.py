@@ -48,10 +48,13 @@ SCHEMA_VERSION = 1
 #: tests/test_obs.py::test_cli_run_report_schema). ``devices`` (ISSUE
 #: 10) is the device-plane section: per-device HBM watermark + last
 #: sample — present on FAILURE-marked reports too (OOM forensics).
+#: ``lowering`` (ISSUE 11) is the compiler-plane section: per-form
+#: optimized-HLO lowering reports (obs/hlo.py) — empty unless the
+#: inspector was armed (``--dump-hlo`` / ``engine.lowering_reports``).
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
     "metrics", "iterations", "summary", "robustness", "costs",
-    "devices",
+    "devices", "lowering",
 )
 
 
@@ -145,6 +148,7 @@ def build_run_report(
     robustness: Optional[dict] = None,
     costs: Optional[dict] = None,
     devices: Optional[dict] = None,
+    lowering: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Assemble the report dict. Every section is optional — a bench
@@ -166,6 +170,13 @@ def build_run_report(
         from pagerank_tpu.obs import devices as devices_mod
 
         devices = devices_mod.report_section()
+    if lowering is None:
+        # Compiler plane (ISSUE 11): whatever the armed inspector
+        # harvested this run — empty on a disarmed (default) run, so
+        # the section costs nothing unless asked for.
+        from pagerank_tpu.obs import hlo as hlo_mod
+
+        lowering = hlo_mod.ledger_snapshot()
     report = {
         "schema_version": SCHEMA_VERSION,
         "created_unix": time.time(),
@@ -179,6 +190,7 @@ def build_run_report(
         "robustness": _json_safe(robustness or {}),
         "costs": _json_safe(costs or {}),
         "devices": _json_safe(devices or {}),
+        "lowering": _json_safe(lowering or {}),
     }
     if extra:
         report.update(_json_safe(extra))
@@ -259,6 +271,24 @@ def render_report(report: dict) -> str:
                    if c.get("bytes_per_edge") is not None else "")
                 + (f"  roofline {c['roofline_fraction']:.1%}"
                    if c.get("roofline_fraction") is not None else "")
+            )
+    low = report.get("lowering") or {}
+    if low:
+        lines.append("lowering (optimized HLO per compiled form):")
+        w = max(len(n) for n in low)
+        for form in sorted(low):
+            r = low[form]
+            g = r.get("gather") or {}
+            hg = g.get("hot_gather") or {}
+            lines.append(
+                f"  {form:<{w}}  gather "
+                f"{str(g.get('strategy', '?')).upper():<8}"
+                f"  fusions {r.get('fusion_count', 0):<3}"
+                + (f"  stream {hg['stream_dtype']}"
+                   if hg.get("stream_dtype") else "")
+                + (f"  {r['hlo_bytes_per_edge']:.1f} hloB/edge"
+                   if r.get("hlo_bytes_per_edge") is not None else "")
+                + f"  fp {r.get('fingerprint')}"
             )
     rb = report.get("robustness") or {}
     if any(rb.values()):
@@ -394,6 +424,47 @@ def diff_reports(a: dict, b: dict) -> str:
     elif qa or qb:
         lines.append("cost model: identical (wall deltas above are "
                      "execution, not program, changes)")
+
+    # Compiler-plane deltas (ISSUE 11): per-form lowering changes —
+    # gather strategy, fusion count, the structural fingerprint. A
+    # moved fingerprint with identical code/env means the COMPILER
+    # changed the program (a jax/libtpu upgrade), which is exactly the
+    # attribution the r5-class incidents needed.
+    la, lb = a.get("lowering") or {}, b.get("lowering") or {}
+    low_lines = []
+    for form in sorted(set(la) | set(lb)):
+        fa, fb = la.get(form) or {}, lb.get(form) or {}
+        if not fa:
+            low_lines.append(f"  {form}: only in B")
+            continue
+        if not fb:
+            low_lines.append(f"  {form}: only in A")
+            continue
+        deltas = []
+        ga = (fa.get("gather") or {}).get("strategy")
+        gb_ = (fb.get("gather") or {}).get("strategy")
+        if ga != gb_:
+            deltas.append(f"gather {ga} -> {gb_}")
+        if fa.get("fusion_count") != fb.get("fusion_count"):
+            deltas.append(f"fusions {fa.get('fusion_count')} -> "
+                          f"{fb.get('fusion_count')}")
+        ha = ((fa.get("gather") or {}).get("hot_gather") or {})
+        hb = ((fb.get("gather") or {}).get("hot_gather") or {})
+        if ha.get("stream_dtype") != hb.get("stream_dtype"):
+            deltas.append(f"stream {ha.get('stream_dtype')} -> "
+                          f"{hb.get('stream_dtype')}")
+        if not deltas and fa.get("fingerprint") != fb.get("fingerprint"):
+            deltas.append(f"fingerprint {fa.get('fingerprint')} -> "
+                          f"{fb.get('fingerprint')}")
+        if deltas:
+            low_lines.append(f"  {form}: " + ", ".join(deltas))
+    if low_lines:
+        lines.append("lowering deltas (the COMPILER changed the "
+                     "program shape):")
+        lines.extend(low_lines)
+    elif la or lb:
+        lines.append("lowering: identical (the compiler emitted the "
+                     "same program shape)")
 
     # Device-plane deltas (ISSUE 10): the comms attribution gauges
     # (exchange fraction, achieved wire bytes/s) and the per-run HBM

@@ -221,8 +221,13 @@ def stage_call(name: str, fn, args, *, static_key=(), donate_argnums=(),
         # build SHOULD cost" model the run report and `obs report`
         # diffs carry. Harvest never raises (degrades to None fields).
         from pagerank_tpu.obs import costs as obs_costs
+        from pagerank_tpu.obs import hlo as obs_hlo
 
         obs_costs.harvest("build/" + name, exe)
+        # Compiler-plane harvest (ISSUE 11; obs/hlo.py): same compiled
+        # handle, zero extra compiles — and a bare flag read when the
+        # inspector is disarmed (the booby-trap contract).
+        obs_hlo.maybe_inspect("build/" + name, exe)
         if timings is not None:
             timings["compile_s"] = (
                 timings.get("compile_s", 0.0) + time.perf_counter() - t0

@@ -103,6 +103,30 @@ def compiled_memory_analysis(compiled):
     return out or None
 
 
+def compiled_hlo_text(compiled):
+    """The OPTIMIZED (post-pass, scheduled) HLO text of an AOT
+    ``Compiled`` object, or None when this backend / jax version does
+    not expose one — ``as_text()`` first (the modern surface), then
+    ``hlo_modules()[0].to_string()`` (older jaxlibs / bare PJRT
+    handles). Same degrade-to-None contract as the cost/memory shims:
+    the compiler-plane inspector (obs/hlo.py) must never fail a run
+    on a backend that keeps its HLO to itself."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        text = None  # fall through to the legacy surface
+    if isinstance(text, str) and text.strip():
+        return text
+    try:
+        mods = compiled.hlo_modules()
+        text = mods[0].to_string() if mods else None
+    except Exception:
+        return None
+    if isinstance(text, str) and text.strip():
+        return text
+    return None
+
+
 def pallas_tpu_compiler_params(**kw):
     """`pltpu.CompilerParams` (jax >= 0.6) / `pltpu.TPUCompilerParams`
     (jax 0.4.x) — renamed class, and the older one lacks some fields

@@ -51,7 +51,10 @@ ledger + the noise-aware CI gate, regression-vs-drift attribution —
 ISSUE 9), O (device plane: an 8-fake-device ATTRIBUTED halo solve —
 comms-vs-compute attribution block, per-device sampler gauges, and
 the OOM-preflight fit check passing at scale 14 while refusing an
-absurd scale — ISSUE 10), F (fault injection).
+absurd scale — ISSUE 10), Q (compiler plane: `obs hlo` over the
+default + partitioned forms — a gather-strategy classification per
+form, strict JSON, no EXPANDED verdict — ISSUE 11), F (fault
+injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -197,9 +200,20 @@ CONFIGS = {
               fit_bad_scale=26,
               label="device-plane smoke (attributed multichip + "
                     "sampler + fit check)"),
+    # Compiler-plane smoke (ISSUE 11; obs/hlo.py) — key Q because P
+    # was already the config-5 PPR stand-in: `obs hlo` over the
+    # default + partitioned dispatch forms at scale 14 must emit a
+    # gather-strategy classification for EACH form as strict JSON,
+    # exit 0 (no form classifies EXPANDED — the fast-gather-defeated
+    # signature the instrument exists to catch), and come in under
+    # HLO_SMOKE_BUDGET_S — the verdict a TPU session reads BEFORE
+    # spending chip time.
+    "Q": dict(kind="hlo", scale=14, forms="default,partitioned",
+              label="compiler-plane smoke (optimized-HLO gather "
+                    "verdict, default + partitioned)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "F", "A", "B",
-                "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "F", "A",
+                "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1160,6 +1174,89 @@ def run_devices_smoke(key: str):
     return rec
 
 
+# Budget for the compiler-plane smoke (seconds, timed around the two
+# in-process `obs hlo` form inspections — interpreter/jax import is
+# paid by the acceptance process already): building + AOT-lowering two
+# scale-14 dispatch forms on CPU plus the text parse is well under a
+# second each; 2s is the ISSUE-11 acceptance bound and still catches
+# an accidentally-eager harvest (e.g. a per-iteration inspector call).
+HLO_SMOKE_BUDGET_S = 2.0
+
+
+def run_hlo_smoke(key: str):
+    """ISSUE-11 gate: the compiler plane end to end — `python -m
+    pagerank_tpu.obs hlo` over the default + partitioned dispatch
+    forms at scale 14 must classify the gather lowering of EACH form
+    (the "did XLA keep the fast gather" verdict a TPU session reads
+    before spending chip time), the emitted JSON must strict-parse
+    with a per-form strategy + structural fingerprint, the exit code
+    must be 0 (no EXPANDED verdict anywhere), and the whole inspection
+    must land under HLO_SMOKE_BUDGET_S."""
+    import contextlib
+    import io
+
+    from pagerank_tpu import obs
+    from pagerank_tpu.obs import hlo as hlo_mod
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    spec = CONFIGS[key]
+    scale, forms = spec["scale"], spec["forms"]
+    obs.get_registry().reset()
+    hlo_mod.reset()
+    buf = io.StringIO()
+    t0 = time.perf_counter()
+    with contextlib.redirect_stdout(buf):
+        rc = obs_main(["hlo", "--form", forms, "--scale", str(scale),
+                       "--json"])
+    t_run = time.perf_counter() - t0
+    hlo_mod.reset()
+
+    strategies, json_ok = {}, False
+    try:
+        doc = json.loads(buf.getvalue(), parse_constant=lambda c: (
+            (_ for _ in ()).throw(ValueError(f"non-strict constant {c}"))
+        ))
+        json_ok = set(doc) == set(forms.split(","))
+        for form, snapshot in doc.items():
+            whole = snapshot.get("step") or snapshot.get("final") or {}
+            strategies[form] = (whole.get("gather") or {}).get("strategy")
+    except ValueError:
+        pass
+    classified = bool(strategies) and all(
+        s in ("native", "expanded", "none") for s in strategies.values()
+    )
+    # The standing expectation on every current form, not just
+    # not-EXPANDED: the hot traffic must actually be a native gather.
+    native = bool(strategies) and all(
+        s == "native" for s in strategies.values())
+
+    passed = bool(rc == 0 and json_ok and classified and native
+                  and t_run <= HLO_SMOKE_BUDGET_S)
+    rec = {
+        "config": key,
+        "kind": "hlo",
+        "label": spec["label"],
+        "scale": scale,
+        "forms": forms,
+        "exit_code": rc,
+        "strict_json": json_ok,
+        "gather_strategies": strategies,
+        "seconds": t_run,
+        "budget_s": HLO_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] obs hlo over {forms} at scale {scale}: rc {rc}, "
+        f"strict JSON {'OK' if json_ok else 'BAD'}, verdicts "
+        + (", ".join(f"{f}={s}" for f, s in strategies.items())
+           if strategies else "NONE")
+        + f"; {t_run:.2f}s vs budget {HLO_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def run_partitioned_smoke(key: str):
     """ISSUE-6 gate: a short solve on the partition-centric layout —
     the jax engine through the CLI with an explicit --partition-span
@@ -1750,7 +1847,7 @@ def main(argv=None) -> int:
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
                "elastic": run_elastic_smoke, "halo": run_halo_smoke,
                "history": run_history_smoke,
-               "devices": run_devices_smoke}
+               "devices": run_devices_smoke, "hlo": run_hlo_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

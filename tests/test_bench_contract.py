@@ -31,6 +31,36 @@ def _assert_costs_block(costs):
         assert costs["step"]["seconds_per_iter"] > 0
 
 
+def _assert_lowering_block(lowering, expect_native=False):
+    """The per-leg compiler-plane block (ISSUE 11; obs/hlo.py):
+    per-compiled-form LoweringReport dicts — gather-strategy verdict,
+    fusion/while counts, the structural fingerprint the perf-history
+    ledger tracks, and the HLO-derived bytes/edge reconciliation.
+    None-tolerant as a WHOLE: a backend whose Compiled exposes no
+    optimized HLO reports None, never a fabricated block. With
+    ``expect_native`` (the CPU test substrate, where HLO text is
+    known-available) the whole-iteration program must additionally
+    classify NATIVE — the PTH001 invariant riding the bench schema."""
+    if lowering is None:
+        return
+    assert isinstance(lowering, dict) and lowering
+    assert "step" in lowering or "final" in lowering, sorted(lowering)
+    for form, rep in lowering.items():
+        g = rep["gather"]
+        assert g["strategy"] in ("native", "expanded", "none"), (form, g)
+        assert isinstance(g["expansion_sites"], list)
+        assert isinstance(rep["fingerprint"], str) and rep["fingerprint"]
+        assert rep["fusion_count"] >= 0 and rep["while_count"] >= 0
+        bpe = rep["hlo_bytes_per_edge"]
+        assert bpe is None or bpe >= 0, (form, bpe)
+        # Raw HLO text never enters JSON artifacts (--dump-hlo is the
+        # offline channel).
+        assert "text" not in rep, form
+    if expect_native:
+        whole = lowering.get("step") or lowering.get("final")
+        assert whole["gather"]["strategy"] == "native", whole["gather"]
+
+
 def _env():
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
@@ -93,9 +123,10 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "costs", "layout", "fast_f32",
-                        "partitioned_f32", "fast_bf16", "accuracy", "env",
-                        "scale", "iters", "edge_factor", "schema_version"}
+                        "build_s", "costs", "layout", "lowering",
+                        "fast_f32", "partitioned_f32", "fast_bf16",
+                        "accuracy", "env", "scale", "iters",
+                        "edge_factor", "schema_version"}
     # Every bench emit is versioned now (ISSUE 9 satellite); the
     # unversioned r01-r05 artifacts still ingest into the ledger.
     assert rec["schema_version"] >= 2
@@ -116,9 +147,19 @@ def test_bench_json_contract_couple_mode(tmp_path):
     # resolved-layout record (ISSUE 6).
     _assert_costs_block(rec["costs"])
     _assert_layout_block(rec["layout"])
+    # Every leg carries the compiler-plane lowering verdict too
+    # (ISSUE 11) — and the CPU substrate exposes HLO, so the verdicts
+    # are real (native gather) here, not degraded Nones.
+    _assert_lowering_block(rec["lowering"], expect_native=True)
     for leg in ("fast_f32", "partitioned_f32", "fast_bf16"):
         _assert_costs_block(rec[leg]["costs"])
+        _assert_lowering_block(rec[leg]["lowering"], expect_native=True)
         assert rec[leg]["value"] > 0 and rec[leg]["vs_baseline"] > 0
+    # The bf16 leg's lowering must PROVE the reduced-precision stream
+    # reaches the hot gather (the fast_bf16 mechanical verification).
+    bf_whole = (rec["fast_bf16"]["lowering"] or {}).get("step") or {}
+    assert (bf_whole.get("gather") or {}).get(
+        "hot_gather", {}).get("stream_dtype") == "bf16", bf_whole
     _assert_layout_block(rec["fast_f32"]["layout"], form="step")
     # The partition-centric legs must have ACTUALLY run partitioned,
     # with the geometry recorded (span, window, autotuned chunk).
@@ -158,8 +199,8 @@ def test_bench_json_contract_single_mode(tmp_path):
     assert len(json_lines) == 1, r.stdout
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "build_s", "costs", "layout", "env",
-                        "scale", "iters", "edge_factor",
+                        "build_s", "costs", "layout", "lowering",
+                        "env", "scale", "iters", "edge_factor",
                         "schema_version"}
     assert rec["schema_version"] >= 2
     # The environment fingerprint makes future BENCH_r*.json cells
@@ -168,6 +209,7 @@ def test_bench_json_contract_single_mode(tmp_path):
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
     _assert_costs_block(rec["costs"])
     _assert_layout_block(rec["layout"])
+    _assert_lowering_block(rec["lowering"], expect_native=True)
 
 
 def test_bench_build_only_reports_stage_breakdown(tmp_path):
@@ -235,6 +277,10 @@ def test_multichip_json_contract(tmp_path):
         assert rec_l["value"] > 0 and rec_l["ms_per_iter"] > 0
         _assert_costs_block(rec_l["costs"])
         _assert_layout_block(rec_l["layout"])
+        # Multichip legs carry the lowering verdict too (ISSUE 11):
+        # the sharded step's collectives land in the collective
+        # multiset the fingerprint tracks.
+        _assert_lowering_block(rec_l["lowering"], expect_native=True)
         # Comms-vs-compute attribution per leg (ISSUE 10).
         _assert_attribution_block(rec_l["attribution"],
                                   multi_device=leg != "single_chip")

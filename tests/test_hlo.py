@@ -1,0 +1,676 @@
+"""Compiler-plane observability (ISSUE 11; pagerank_tpu/obs/hlo.py):
+the HLO text parser + gather-strategy classifier on synthetic and real
+modules, the harvest-is-lazy booby trap, PTH001-003 contract verdicts,
+the as_text degradation regression, and the CLI/schema round-trips."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph, obs
+from pagerank_tpu.analysis import contracts as contracts_mod
+from pagerank_tpu.obs import hlo as obs_hlo
+from pagerank_tpu.utils import jax_compat
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledgers():
+    obs.get_registry().reset()
+    obs_hlo.reset()
+    yield
+    obs_hlo.reset()
+
+
+def _graph(n=512, e=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+# -- synthetic HLO texts -----------------------------------------------------
+
+NATIVE_TEXT = """\
+HloModule synthetic_native, is_scheduled=true
+
+%fused_gather (param_0: f32[131072], param_1: s32[4096]) -> f32[4096] {
+  %param_0 = f32[131072]{0} parameter(0)
+  %param_1 = s32[4096]{0} parameter(1)
+  %bitcast.1 = s32[4096,1]{1,0} bitcast(s32[4096]{0} %param_1)
+  ROOT %gather.0 = f32[4096]{0} gather(f32[131072]{0} %param_0, s32[4096,1]{1,0} %bitcast.1), offset_dims={}, collapsed_slice_dims={0}, start_index_map={0}, index_vector_dim=1, slice_sizes={1}
+}
+
+ENTRY %main.1 (Arg_0.1: f32[131072], Arg_1.2: s32[4096]) -> f32[4096] {
+  %Arg_0.1 = f32[131072]{0} parameter(0)
+  %Arg_1.2 = s32[4096]{0} parameter(1)
+  %all-reduce.0 = f32[4096]{0} all-reduce(f32[4096]{0} %Arg_0.1), replica_groups={}, to_apply=%add.1
+  ROOT %fusion.0 = f32[4096]{0} fusion(f32[131072]{0} %Arg_0.1, s32[4096]{0} %Arg_1.2), kind=kLoop, calls=%fused_gather
+}
+"""
+
+# The bf16-streamed variant: the gather's table operand chain carries a
+# bf16 convert — the mechanical fast_bf16 verification.
+BF16_TEXT = NATIVE_TEXT.replace(
+    "  %bitcast.1 = s32[4096,1]{1,0} bitcast(s32[4096]{0} %param_1)\n"
+    "  ROOT %gather.0 = f32[4096]{0} gather(f32[131072]{0} %param_0,",
+    "  %bitcast.1 = s32[4096,1]{1,0} bitcast(s32[4096]{0} %param_1)\n"
+    "  %convert.2 = bf16[131072]{0} convert(f32[131072]{0} %param_0)\n"
+    "  %convert.1 = f32[131072]{0} convert(bf16[131072]{0} %convert.2)\n"
+    "  ROOT %gather.0 = f32[4096]{0} gather(f32[131072]{0} %convert.1,",
+)
+
+# The defeated lowering: no native gather — a while loop doing one
+# scalar table load + one scalar result update per index (trip bound
+# 4096 in the condition).
+EXPANDED_TEXT = """\
+HloModule synthetic_expanded, is_scheduled=true
+
+%body.1 (p.1: (s32[], f32[4096], s32[4096], f32[131072])) -> (s32[], f32[4096], s32[4096], f32[131072]) {
+  %p.1 = (s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) parameter(0)
+  %i.1 = s32[] get-tuple-element((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %p.1), index=0
+  %acc.1 = f32[4096]{0} get-tuple-element((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %p.1), index=1
+  %idx.1 = s32[4096]{0} get-tuple-element((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %p.1), index=2
+  %table.1 = f32[131072]{0} get-tuple-element((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %p.1), index=3
+  %ds.idx = s32[1]{0} dynamic-slice(s32[4096]{0} %idx.1, s32[] %i.1), dynamic_slice_sizes={1}
+  %bc.1 = s32[] bitcast(s32[1]{0} %ds.idx)
+  %ds.val = f32[1]{0} dynamic-slice(f32[131072]{0} %table.1, s32[] %bc.1), dynamic_slice_sizes={1}
+  %dus.1 = f32[4096]{0} dynamic-update-slice(f32[4096]{0} %acc.1, f32[1]{0} %ds.val, s32[] %i.1)
+  %one.1 = s32[] constant(1)
+  %next.1 = s32[] add(s32[] %i.1, s32[] %one.1)
+  ROOT %tuple.1 = (s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) tuple(s32[] %next.1, f32[4096]{0} %dus.1, s32[4096]{0} %idx.1, f32[131072]{0} %table.1)
+}
+
+%cond.1 (p.2: (s32[], f32[4096], s32[4096], f32[131072])) -> pred[] {
+  %p.2 = (s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) parameter(0)
+  %i.2 = s32[] get-tuple-element((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %p.2), index=0
+  %n.1 = s32[] constant(4096)
+  ROOT %lt.1 = pred[] compare(s32[] %i.2, s32[] %n.1), direction=LT
+}
+
+ENTRY %main.2 (Arg_0.1: f32[131072], Arg_1.2: s32[4096]) -> f32[4096] {
+  %Arg_0.1 = f32[131072]{0} parameter(0)
+  %Arg_1.2 = s32[4096]{0} parameter(1)
+  %zero.1 = s32[] constant(0)
+  %init.1 = f32[4096]{0} broadcast(s32[] %zero.1), dimensions={}
+  %tuple.0 = (s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) tuple(s32[] %zero.1, f32[4096]{0} %init.1, s32[4096]{0} %Arg_1.2, f32[131072]{0} %Arg_0.1)
+  %while.0 = (s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) while((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %tuple.0), condition=%cond.1, body=%body.1
+  ROOT %gte.1 = f32[4096]{0} get-tuple-element((s32[], f32[4096]{0}, s32[4096]{0}, f32[131072]{0}) %while.0), index=1
+}
+"""
+
+NO_GATHER_TEXT = """\
+HloModule synthetic_none, is_scheduled=true
+
+ENTRY %main.3 (Arg_0.1: f32[4096]) -> f32[4096] {
+  %Arg_0.1 = f32[4096]{0} parameter(0)
+  ROOT %add.0 = f32[4096]{0} add(f32[4096]{0} %Arg_0.1, f32[4096]{0} %Arg_0.1)
+}
+"""
+
+
+# -- classifier on synthetic texts ------------------------------------------
+
+
+def test_classifier_native_gather():
+    rep = obs_hlo.inspect_text("t", NATIVE_TEXT)
+    g = rep.gather
+    assert g["strategy"] == "native"
+    assert g["n_gathers"] == 1 and g["expansion_sites"] == []
+    hg = g["hot_gather"]
+    assert hg["output_elements"] == 4096
+    assert hg["table_dtype"] == "f32" and hg["stream_dtype"] == "f32"
+    assert hg["slice_sizes"] == [1]
+    assert hg["in_while"] is False
+    assert rep.fusion_count == 1 and rep.while_count == 0
+
+
+def test_classifier_while_expansion():
+    rep = obs_hlo.inspect_text("t", EXPANDED_TEXT)
+    g = rep.gather
+    assert g["strategy"] == "expanded"
+    assert g["hot_gather"] is None
+    assert g["expansion_sites"] == ["body.1"]
+    assert rep.while_count == 1
+
+
+def test_classifier_no_gather():
+    rep = obs_hlo.inspect_text("t", NO_GATHER_TEXT)
+    assert rep.gather["strategy"] == "none"
+    assert rep.gather["expansion_sites"] == []
+
+
+def test_classifier_bf16_stream_detected():
+    """The fast_bf16 verification: a bf16 convert in the gather's
+    table operand chain is reported as the streamed dtype even though
+    the gather itself reads/writes f32."""
+    rep = obs_hlo.inspect_text("t", BF16_TEXT)
+    hg = rep.gather["hot_gather"]
+    assert hg["table_dtype"] == "f32"
+    assert hg["stream_dtype"] == "bf16"
+
+
+def test_small_trip_chunk_loop_is_not_expansion():
+    """A short-trip while (the engine's chunk scan class) with scalar
+    bookkeeping slices must NOT classify as an expansion — the trip
+    bound gate."""
+    text = EXPANDED_TEXT.replace("constant(4096)", "constant(33)")
+    rep = obs_hlo.inspect_text("t", text)
+    assert rep.gather["expansion_sites"] == []
+    assert rep.gather["strategy"] == "none"
+
+
+SCATTER_RMW_TEXT = """\
+HloModule synthetic_scatter, is_scheduled=true
+
+%body.s (p.1: (s32[], f32[512], s32[4096], f32[4096])) -> (s32[], f32[512], s32[4096], f32[4096]) {
+  %p.1 = (s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) parameter(0)
+  %i.1 = s32[] get-tuple-element((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %p.1), index=0
+  %acc.1 = f32[512]{0} get-tuple-element((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %p.1), index=1
+  %idx.1 = s32[4096]{0} get-tuple-element((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %p.1), index=2
+  %upd.1 = f32[4096]{0} get-tuple-element((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %p.1), index=3
+  %ds.idx = s32[1]{0} dynamic-slice(s32[4096]{0} %idx.1, s32[] %i.1), dynamic_slice_sizes={1}
+  %bc.1 = s32[] bitcast(s32[1]{0} %ds.idx)
+  %ds.upd = f32[1]{0} dynamic-slice(f32[4096]{0} %upd.1, s32[] %i.1), dynamic_slice_sizes={1}
+  %ds.old = f32[1]{0} dynamic-slice(f32[512]{0} %acc.1, s32[] %bc.1), dynamic_slice_sizes={1}
+  %add.1 = f32[1]{0} add(f32[1]{0} %ds.old, f32[1]{0} %ds.upd)
+  %dus.1 = f32[512]{0} dynamic-update-slice(f32[512]{0} %acc.1, f32[1]{0} %add.1, s32[] %bc.1)
+  %one.1 = s32[] constant(1)
+  %next.1 = s32[] add(s32[] %i.1, s32[] %one.1)
+  ROOT %tuple.1 = (s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) tuple(s32[] %next.1, f32[512]{0} %dus.1, s32[4096]{0} %idx.1, f32[4096]{0} %upd.1)
+}
+
+%cond.s (p.2: (s32[], f32[512], s32[4096], f32[4096])) -> pred[] {
+  %p.2 = (s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) parameter(0)
+  %i.2 = s32[] get-tuple-element((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %p.2), index=0
+  %n.1 = s32[] constant(4096)
+  ROOT %lt.1 = pred[] compare(s32[] %i.2, s32[] %n.1), direction=LT
+}
+
+ENTRY %main.4 (Arg_0.1: f32[4096], Arg_1.2: s32[4096]) -> f32[512] {
+  %Arg_0.1 = f32[4096]{0} parameter(0)
+  %Arg_1.2 = s32[4096]{0} parameter(1)
+  %zero.1 = s32[] constant(0)
+  %init.1 = f32[512]{0} broadcast(s32[] %zero.1), dimensions={}
+  %tuple.0 = (s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) tuple(s32[] %zero.1, f32[512]{0} %init.1, s32[4096]{0} %Arg_1.2, f32[4096]{0} %Arg_0.1)
+  %while.0 = (s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) while((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %tuple.0), condition=%cond.s, body=%body.s
+  ROOT %gte.1 = f32[512]{0} get-tuple-element((s32[], f32[512]{0}, s32[4096]{0}, f32[4096]{0}) %while.0), index=1
+}
+"""
+
+
+def test_scalarized_scatter_is_not_gather_expansion():
+    """The scatter-vs-gather discriminator (the coo regression): a
+    scalarized SCATTER loop read-modify-writes its target — the dus
+    destination is also a scalar dynamic-slice source — while a
+    defeated gather's output is write-only. CPU XLA expands scatter-add
+    this way for coo's merge; it must not classify as the
+    fast-gather-defeated signature."""
+    rep = obs_hlo.inspect_text("t", SCATTER_RMW_TEXT)
+    assert rep.gather["expansion_sites"] == []
+    assert rep.gather["strategy"] == "none"
+
+
+def test_collective_multiset_with_operand_bytes():
+    rep = obs_hlo.inspect_text("t", NATIVE_TEXT)
+    assert rep.collectives == [
+        {"op": "all-reduce", "operand_bytes": 4096 * 4, "dtype": "f32"}
+    ]
+
+
+def test_fingerprint_moves_with_lowering_not_with_form_name():
+    a = obs_hlo.inspect_text("a", NATIVE_TEXT)
+    b = obs_hlo.inspect_text("b", NATIVE_TEXT)
+    c = obs_hlo.inspect_text("c", EXPANDED_TEXT)
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != c.fingerprint
+
+
+def test_report_is_strict_json():
+    rep = obs_hlo.inspect_text("t", NATIVE_TEXT, num_edges=4096)
+    doc = json.loads(json.dumps(rep.to_json(), allow_nan=False))
+    assert doc["fingerprint"] == rep.fingerprint
+    assert doc["hlo_bytes_per_edge"] > 0
+    assert "text" not in doc
+
+
+# -- real compiled programs --------------------------------------------------
+
+
+def test_inspect_compiled_real_gather():
+    compiled = jax.jit(lambda t, i: t[i]).lower(
+        jax.ShapeDtypeStruct((1024,), np.float32),
+        jax.ShapeDtypeStruct((256,), np.int32),
+    ).compile()
+    rep = obs_hlo.inspect_compiled("probe", compiled, num_edges=256,
+                                   record=False)
+    assert rep is not None
+    assert rep.gather["strategy"] == "native"
+    assert rep.hlo_bytes_per_edge > 0
+    # Same program -> same structural fingerprint.
+    rep2 = obs_hlo.inspect_compiled("probe", compiled, record=False)
+    assert rep2.fingerprint == rep.fingerprint
+
+
+def test_engine_lowering_reports_and_gauge():
+    eng = JaxTpuEngine(PageRankConfig(num_iters=2)).build(_graph())
+    snap = eng.lowering_reports()
+    assert "step" in snap
+    assert snap["step"]["gather"]["strategy"] == "native"
+    # Harvest disarms itself after the pass and publishes the
+    # reconciliation gauge.
+    assert not obs_hlo.armed()
+    gauges = obs.get_registry().snapshot()["gauges"]
+    assert gauges["cost.step.hlo_bytes_per_edge"] > 0
+    # Repeat calls are ledger hits (no recompile, same snapshot).
+    assert eng.lowering_reports() == snap
+
+
+def test_bf16_stream_verified_on_partitioned_bf16_engine():
+    eng = JaxTpuEngine(PageRankConfig(
+        num_iters=2, partition_span=256, stream_dtype="bfloat16",
+    )).build(_graph())
+    snap = eng.lowering_reports()
+    hg = snap["step"]["gather"]["hot_gather"]
+    assert hg["stream_dtype"] == "bf16"
+    # The plain partitioned form streams f32 — the two fingerprints
+    # must differ (the bf16 bet is visible in the lowering).
+    eng2 = JaxTpuEngine(PageRankConfig(
+        num_iters=2, partition_span=256,
+    )).build(_graph())
+    obs_hlo.reset()
+    snap2 = eng2.lowering_reports()
+    assert snap2["step"]["gather"]["hot_gather"]["stream_dtype"] == "f32"
+    assert snap2["step"]["fingerprint"] != snap["step"]["fingerprint"]
+
+
+def test_lowering_reports_not_stale_across_engines_or_rebuilds():
+    """The per-engine memo regression: the process-global hlo ledger is
+    shared, so a SECOND engine (or an in-place rebuild) must never be
+    handed the first program's verdict — each build re-classifies."""
+    g = _graph()
+    a = JaxTpuEngine(PageRankConfig(num_iters=2)).build(g)
+    fp_a = a.lowering_reports()["step"]["fingerprint"]
+    # No obs_hlo.reset() in between — the exact staleness scenario.
+    b = JaxTpuEngine(PageRankConfig(num_iters=2,
+                                    partition_span=256)).build(g)
+    fp_b = b.lowering_reports()["step"]["fingerprint"]
+    assert fp_b != fp_a
+    # And an in-place rebuild on a NEW graph drops the cache too.
+    b.build(_graph(n=1024, e=8192, seed=7))
+    fp_b2 = b.lowering_reports()["step"]["fingerprint"]
+    assert fp_b2 != fp_b
+
+
+# -- harvest-is-lazy booby trap ---------------------------------------------
+
+
+def test_disarmed_run_makes_zero_inspector_calls(monkeypatch):
+    """The acceptance criterion: with the inspector disarmed (the
+    default), a full build + solve + cost harvest makes ZERO inspector
+    calls — every entry point is booby-trapped (the tracer/sampler
+    discipline applied to the compiler plane)."""
+
+    def boom(*a, **k):
+        raise AssertionError("hlo inspector touched on a plain run")
+
+    monkeypatch.setattr(obs_hlo, "inspect_compiled", boom)
+    monkeypatch.setattr(obs_hlo, "inspect_text", boom)
+    monkeypatch.setattr(obs_hlo, "parse_hlo_text", boom)
+    g = _graph(seed=1)
+    eng = JaxTpuEngine(PageRankConfig(num_iters=3)).build(g)
+    eng.run_fast()          # stepwise dispatch path
+    eng.run_fused(1)        # the fused compile point (maybe_inspect)
+    eng.cost_reports()      # the cost harvest compile point
+    assert obs_hlo.ledger_snapshot() == {}
+
+
+def test_disarmed_device_build_makes_zero_inspector_calls(monkeypatch):
+    """stage_call (utils/compile_cache) is a harvest point too — a
+    disarmed device build must never reach the inspector."""
+    import jax.numpy as jnp
+
+    from pagerank_tpu.ops import device_build as db
+    from pagerank_tpu.utils import compile_cache
+
+    def boom(*a, **k):
+        raise AssertionError("hlo inspector touched during a build")
+
+    monkeypatch.setattr(obs_hlo, "inspect_compiled", boom)
+    monkeypatch.setattr(obs_hlo, "inspect_text", boom)
+    compile_cache.clear_stage_cache()
+    rng = np.random.default_rng(2)
+    src = jnp.asarray(rng.integers(0, 256, 2048), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, 256, 2048), jnp.int32)
+    dg = db.build_ell_device(src, dst, n=256, with_weights=False)
+    assert dg.num_edges > 0
+    assert obs_hlo.ledger_snapshot() == {}
+
+
+def test_armed_stage_call_harvests_build_forms():
+    import jax.numpy as jnp
+
+    from pagerank_tpu.ops import device_build as db
+    from pagerank_tpu.utils import compile_cache
+
+    compile_cache.clear_stage_cache()
+    obs_hlo.arm()
+    try:
+        rng = np.random.default_rng(3)
+        src = jnp.asarray(rng.integers(0, 256, 2048), jnp.int32)
+        dst = jnp.asarray(rng.integers(0, 256, 2048), jnp.int32)
+        db.build_ell_device(src, dst, n=256, with_weights=False)
+    finally:
+        obs_hlo.disarm()
+    snap = obs_hlo.ledger_snapshot()
+    assert any(form.startswith("build/") for form in snap)
+
+
+# -- degradation: backends without HLO text ---------------------------------
+
+
+def test_inspect_compiled_tolerates_raising_as_text():
+    """The ISSUE-11 satellite: a Compiled whose as_text raises (or
+    returns empty) degrades to a logged None — never an exception."""
+
+    class Broken:
+        def as_text(self):
+            raise NotImplementedError("bare PJRT plugin")
+
+        def hlo_modules(self):
+            raise NotImplementedError
+
+    assert jax_compat.compiled_hlo_text(Broken()) is None
+    assert obs_hlo.inspect_compiled("t", Broken()) is None
+
+    class Empty:
+        def as_text(self):
+            return ""
+
+        def hlo_modules(self):
+            return []
+
+    assert jax_compat.compiled_hlo_text(Empty()) is None
+    assert obs_hlo.inspect_compiled("t", Empty()) is None
+
+
+def test_pth_contracts_unknown_verdict_nonblocking(monkeypatch):
+    """PTH on a backend that hides its HLO: a surfaced-but-non-blocking
+    unknown — zero findings, mirroring the fit check's memory_analysis
+    degradation."""
+    monkeypatch.setattr(jax_compat, "compiled_hlo_text",
+                        lambda compiled: None)
+    form = next(f for f in contracts_mod.engine_forms(1)
+                if f.name == "ell")
+    eng = form.build()
+    findings = contracts_mod.check_hlo_form(eng, form)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_step_key_stability_tolerates_raising_as_text(monkeypatch):
+    """analysis/contracts.check_step_key_stability (the PTC004 text
+    diff) must also degrade to a non-blocking unknown when as_text
+    raises — the regression the ISSUE pins."""
+    lowered_cls = type(jax.jit(lambda x: x + 1).lower(1.0))
+
+    def boom(self, *a, **k):
+        raise NotImplementedError("no text on this backend")
+
+    monkeypatch.setattr(lowered_cls, "as_text", boom)
+    findings = contracts_mod.check_step_key_stability(1)
+    assert [f for f in findings if f.rule == "PTC004"] == [], \
+        [f.render() for f in findings]
+
+
+# -- PTH verdicts ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["ell", "partitioned_bf16", "coo"])
+def test_pth_clean_on_real_forms(name):
+    form = next(f for f in contracts_mod.engine_forms(1)
+                if f.name == name)
+    eng = form.build()
+    findings = contracts_mod.check_hlo_form(eng, form)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_pth_catches_expanded_lowering(monkeypatch):
+    """Seed the defect PTH001/003 exist for: a step whose optimized
+    HLO is the while-loop scalar expansion must fail the contract."""
+    monkeypatch.setattr(jax_compat, "compiled_hlo_text",
+                        lambda compiled: EXPANDED_TEXT)
+    form = next(f for f in contracts_mod.engine_forms(1)
+                if f.name == "ell")
+    eng = form.build()
+    findings = contracts_mod.check_hlo_form(eng, form)
+    rules = {f.rule for f in findings}
+    assert "PTH001" in rules, [f.render() for f in findings]
+
+
+def test_pth_fusion_budget(monkeypatch):
+    """PTH002: a fusion-count blow-up past the budget is a finding even
+    when the gather survives."""
+    blown = NATIVE_TEXT + "".join(
+        f"""
+%fused_pad.{i} (param_0: f32[4096]) -> f32[4096] {{
+  %param_0 = f32[4096]{{0}} parameter(0)
+  ROOT %fusion.{i + 10} = f32[4096]{{0}} fusion(f32[4096]{{0}} %param_0), kind=kLoop, calls=%fused_gather
+}}
+"""
+        for i in range(contracts_mod.PTH_FUSION_BUDGET + 1)
+    )
+    monkeypatch.setattr(jax_compat, "compiled_hlo_text",
+                        lambda compiled: blown)
+    form = next(f for f in contracts_mod.engine_forms(1)
+                if f.name == "ell")
+    eng = form.build()
+    findings = contracts_mod.check_hlo_form(eng, form)
+    assert "PTH002" in {f.rule for f in findings}, \
+        [f.render() for f in findings]
+
+
+def test_pth_partial_defeat_flagged(monkeypatch):
+    """PTH003: an expansion site NEXT TO a surviving native gather (a
+    partially-scalarized program) is still a finding."""
+    combined = EXPANDED_TEXT.replace(
+        "HloModule synthetic_expanded", "HloModule synthetic_partial"
+    ).replace(
+        "ENTRY %main.2", "%not_entry.2"
+    ) + "\n" + "\n".join(
+        line for line in NATIVE_TEXT.splitlines()
+        if not line.startswith("HloModule")
+    )
+    monkeypatch.setattr(jax_compat, "compiled_hlo_text",
+                        lambda compiled: combined)
+    form = next(f for f in contracts_mod.engine_forms(1)
+                if f.name == "ell")
+    eng = form.build()
+    findings = contracts_mod.check_hlo_form(eng, form)
+    assert "PTH003" in {f.rule for f in findings}, \
+        [f.render() for f in findings]
+
+
+def test_pth_rules_listed_in_catalogue(capsys):
+    from pagerank_tpu.analysis.__main__ import main as analysis_main
+
+    assert analysis_main(["--list-rules"]) == 0
+    text = capsys.readouterr().out
+    for rid in ("PTH001", "PTH002", "PTH003"):
+        assert rid in text
+
+
+# -- CLI + schema round-trips ------------------------------------------------
+
+
+def test_obs_hlo_cli_json_round_trip(capsys):
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    rc = obs_main(["hlo", "--form", "default,partitioned", "--scale",
+                   "10", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out, parse_constant=lambda c: pytest.fail(
+        f"non-strict JSON constant {c}"))
+    assert set(doc) == {"default", "partitioned"}
+    for form, snapshot in doc.items():
+        assert "step" in snapshot, (form, sorted(snapshot))
+        assert snapshot["step"]["gather"]["strategy"] == "native"
+        assert snapshot["step"]["fingerprint"]
+
+
+def test_obs_hlo_cli_human_and_dump(tmp_path, capsys):
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    dump = str(tmp_path / "hlo")
+    rc = obs_main(["hlo", "--form", "default", "--scale", "10",
+                   "--dump-hlo", dump])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gather NATIVE" in out
+    files = list((tmp_path / "hlo").iterdir())
+    assert files and files[0].suffix == ".hlo"
+    assert "HloModule" in files[0].read_text()
+
+
+def test_obs_hlo_cli_unknown_form():
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    assert obs_main(["hlo", "--form", "nope"]) == 2
+    # A typo'd form must fail fast even next to valid ones (validated
+    # BEFORE any graph build), and an empty list is a usage error.
+    assert obs_main(["hlo", "--form", "default,partioned"]) == 2
+    assert obs_main(["hlo", "--form", ","]) == 2
+
+
+def test_obs_hlo_cli_alias_forms_both_emitted(capsys):
+    """`--form ell,default` must emit BOTH requested keys (one shared
+    snapshot — aliases build the same program once), never silently
+    drop a name the user asked for."""
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    rc = obs_main(["hlo", "--form", "ell,default", "--scale", "10",
+                   "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"ell", "default"}
+    assert (doc["ell"]["step"]["fingerprint"]
+            == doc["default"]["step"]["fingerprint"])
+
+
+def test_run_report_carries_lowering_section():
+    eng = JaxTpuEngine(PageRankConfig(num_iters=2)).build(_graph())
+    eng.lowering_reports()
+    report = obs.build_run_report()
+    assert "lowering" in report
+    assert report["lowering"]["step"]["gather"]["strategy"] == "native"
+    json.dumps(report["lowering"], allow_nan=False)
+
+
+def test_report_diff_renders_lowering_deltas():
+    a = obs.build_run_report()
+    b = json.loads(json.dumps(a))
+    a["lowering"] = {"step": {
+        "gather": {"strategy": "native",
+                   "hot_gather": {"stream_dtype": "f32"}},
+        "fusion_count": 9, "fingerprint": "aaaa",
+    }}
+    b["lowering"] = {"step": {
+        "gather": {"strategy": "expanded", "hot_gather": None},
+        "fusion_count": 240, "fingerprint": "bbbb",
+    }}
+    out = obs.diff_reports(a, b)
+    assert "lowering deltas" in out
+    assert "gather native -> expanded" in out
+    assert "fusions 9 -> 240" in out
+    # Identical lowering says so explicitly.
+    out2 = obs.diff_reports(b, json.loads(json.dumps(b)))
+    assert "lowering: identical" in out2
+
+
+# -- history: the lowering fingerprint --------------------------------------
+
+
+def _bench_record(fp, strategy="native", value=3.0e8, bpe=160.0,
+                  jaxv="0.4.37"):
+    return {
+        "metric": "edges_per_sec_per_chip", "value": value,
+        "unit": "edges/s/chip", "vs_baseline": 1.0, "build_s": 2.0,
+        "costs": {"step": {"bytes_per_edge": bpe,
+                           "seconds_per_iter": 0.1}},
+        "lowering": {"step": {
+            "gather": {"strategy": strategy, "hot_gather": None},
+            "fusion_count": 9, "fingerprint": fp,
+            "hlo_bytes_per_edge": 170.0,
+        }},
+        "layout": {"form": "step"},
+        "scale": 20, "iters": 50, "edge_factor": 16,
+        "schema_version": 2,
+        "env": {"backend": "tpu", "device_kind": "TPU v5e",
+                "jax_version": jaxv, "git_rev": "abc1234"},
+    }
+
+
+def test_lowering_fingerprint_normalizes_into_leg():
+    from pagerank_tpu.obs import history as history_mod
+
+    rec = history_mod.normalize_result(_bench_record("deadbeef0123"),
+                                       source="BENCH_r11.json")
+    leg = rec["legs"]["fast_f32"]
+    assert leg["lowering_fingerprint"] == "deadbeef0123"
+    assert leg["gather_strategy"] == "native"
+    assert leg["hlo_bytes_per_edge"] == 170.0
+
+
+def test_pre_issue11_records_ingest_unchanged():
+    """Back-compat: artifacts without a lowering block normalize with
+    no lowering keys — the checked-in ledger needs no re-ingest."""
+    from pagerank_tpu.obs import history as history_mod
+
+    doc = _bench_record("x")
+    del doc["lowering"]
+    rec = history_mod.normalize_result(doc, source="BENCH_r05.json")
+    leg = rec["legs"]["fast_f32"]
+    assert "lowering_fingerprint" not in leg
+    assert "hlo_bytes_per_edge" not in leg
+
+
+def test_fingerprint_change_classified_program_change():
+    """A rate drop whose baseline cost model is flat but whose
+    lowering fingerprint moved (the jax/libtpu-upgrade scenario) must
+    gate as program-change, not drift/noise."""
+    from pagerank_tpu.obs import history as history_mod
+
+    records = [
+        history_mod.normalize_result(_bench_record("aaaa11112222"),
+                                     source=f"BENCH_r{i:02d}.json")
+        for i in range(1, 5)
+    ]
+    # Same env, same cost model, HALF the rate, new fingerprint.
+    slow = history_mod.normalize_result(
+        _bench_record("bbbb33334444", value=1.5e8),
+        source="BENCH_r05.json")
+    changes = history_mod.detect_changes(records + [slow])
+    flagged = [c for c in changes if c.flagged
+               and c.metric == "edges_per_sec_per_chip"]
+    assert flagged, changes
+    assert flagged[0].classification == "program-change"
+    assert "lowering fingerprint moved" in flagged[0].evidence
+    gate = history_mod.evaluate_gate(records + [slow])
+    assert not gate.ok
+
+
+def test_trend_renders_lowering_fingerprints(capsys):
+    from pagerank_tpu.obs import history as history_mod
+
+    records = [
+        history_mod.normalize_result(_bench_record("aaaa11112222"),
+                                     source="BENCH_r01.json"),
+        history_mod.normalize_result(_bench_record("bbbb33334444"),
+                                     source="BENCH_r02.json"),
+    ]
+    out = history_mod.render_trend(records)
+    assert "lowering fingerprints" in out
+    assert "aaaa1111" in out and "bbbb3333" in out
+    assert "LOWERING CHANGED" in out
+    # A stable series renders without the change flag.
+    out2 = history_mod.render_trend(records[:1])
+    assert "LOWERING CHANGED" not in out2

@@ -505,12 +505,16 @@ def test_bench_leg_costs_block():
     g = _graph()
     eng = make_engine("jax", PageRankConfig(
         num_iters=2, num_devices=2)).build(g)
-    block = bench._leg_costs(eng, 0.01, g.num_edges)
+    block, lowering = bench._leg_costs(eng, 0.01, g.num_edges)
     assert "step" in block
     assert block["step"]["seconds_per_iter"] == 0.01
     assert block["step"]["roofline_fraction"] is None  # CPU substrate
     b = block["step"]["bytes_per_edge"]
     assert b is None or b > 0
+    # The compiler-plane block rides the same harvest (ISSUE 11):
+    # same compiled handle, classified while the inspector was armed.
+    assert lowering is None or \
+        lowering["step"]["gather"]["strategy"] == "native"
 
 
 # -- probed fused path ------------------------------------------------------
