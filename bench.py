@@ -65,6 +65,8 @@ import time
 
 import numpy as np
 
+from pagerank_tpu.exitcodes import ExitCode
+
 NORTH_STAR_EDGES_PER_SEC_PER_CHIP = 1.47e9 * 50 / 60 / 8
 
 # Version of bench.py's OWN JSON schemas (couple, single, --build-only,
@@ -851,7 +853,7 @@ def main(argv=None):
                         "allocates: abstract-eval the build+step at "
                         "this run's geometry against per-chip HBM "
                         "(bytes_limit or the device-kind table) and "
-                        "exit 2 with the per-stage table when it "
+                        "exit 3 with the per-stage table when it "
                         "provably does not fit — a 75 s scale-24 "
                         "build should never be how we learn the "
                         "answer")
@@ -863,7 +865,10 @@ def main(argv=None):
     _enable_compile_cache()
 
     if args.preflight and not _preflight(args):
-        sys.exit(2)
+        # Same code as the CLI's --preflight refusal (the exit-code
+        # taxonomy, pagerank_tpu/exitcodes.py; bench exited 2 here
+        # before ISSUE 12 unified the two).
+        sys.exit(int(ExitCode.PREFLIGHT_UNFIT))
 
     if args.multichip:
         run_multichip(args)

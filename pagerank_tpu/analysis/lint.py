@@ -327,6 +327,31 @@ def rule_ptl007(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]
             )
 
 
+def rule_ptl008(tree: ast.AST, path: str, lines: List[str]) -> Iterable[Finding]:
+    """PTL008: process-global handler installation (``signal.signal``,
+    ``atexit.register``) outside the supervisor modules (scope excludes
+    ``jobs.py`` and ``cli.py``). Signal handlers and exit hooks are
+    PROCESS-wide state: a library module that installs one hijacks the
+    embedding application's preemption story (and the GracefulDrain
+    contract — jobs.py owns SIGTERM/SIGINT, docs/ROBUSTNESS.md
+    "Preemption & resumable jobs"). Library code takes an injectable
+    callback instead; only the entry-point supervisor wires it to real
+    signals."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in ("signal.signal", "atexit.register"):
+            yield Finding(
+                "PTL008", path, node.lineno,
+                f"{name}() in a library module installs process-global "
+                "handler state: only the job supervisor (jobs.py) and "
+                "the CLI entry point own signal/exit hooks — accept an "
+                "injectable callback instead",
+                _snippet(lines, node.lineno), node.col_offset,
+            )
+
+
 RuleFn = Callable[[ast.AST, str, List[str]], Iterable[Finding]]
 
 # rule id -> (fn, scope, one-line description). Scopes:
@@ -335,6 +360,9 @@ RuleFn = Callable[[ast.AST, str, List[str]], Iterable[Finding]]
 #   all     — every package file
 #   library — every package file EXCEPT CLI entry points (cli.py,
 #             */__main__.py), which legitimately print to the terminal
+#   handler_free — every package file EXCEPT jobs.py and cli.py, the
+#             two modules allowed to install process-global
+#             signal/exit handlers (ISSUE 12)
 RULES: Dict[str, Tuple[RuleFn, str, str]] = {
     "PTL001": (rule_ptl001, "ops",
                "magic lane-geometry constants outside LANES"),
@@ -349,6 +377,8 @@ RULES: Dict[str, Tuple[RuleFn, str, str]] = {
                "bare/broad exception swallows"),
     "PTL007": (rule_ptl007, "library",
                "bare print()/sys.std*.write outside CLI entry points"),
+    "PTL008": (rule_ptl008, "handler_free",
+               "signal.signal/atexit.register outside jobs.py/cli.py"),
 }
 
 _KERNEL_FILES = ("engines/jax_engine.py", "engines/ppr.py")
@@ -363,6 +393,11 @@ def _scope_match(scope: str, rel: str) -> bool:
         return rel.startswith("ops/") or rel in _KERNEL_FILES
     if scope == "library":
         return rel != "cli.py" and not rel.endswith("__main__.py")
+    if scope == "handler_free":
+        # Everything but the two modules that OWN process-global
+        # handlers: the job supervisor and the CLI entry point that
+        # installs its GracefulDrain (ISSUE 12).
+        return rel not in ("jobs.py", "cli.py")
     raise ValueError(f"unknown rule scope {scope!r}")
 
 

@@ -15,12 +15,15 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import sys
 import time
+import warnings
 
 import numpy as np
 
-from pagerank_tpu import PageRankConfig, build_graph, make_engine, obs
+from pagerank_tpu import PageRankConfig, build_graph, jobs, make_engine, obs
+from pagerank_tpu.exitcodes import ExitCode
 from pagerank_tpu.utils import fsio
 from pagerank_tpu.utils.metrics import MetricsLogger
 from pagerank_tpu.utils.snapshot import Snapshotter, TextDumper, resume_engine
@@ -200,6 +203,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="elastic-rescue budget under --stall-action rescue: mesh "
         "teardown + re-shard + warm-start recoveries allowed after "
         "device losses (default: the --max-rollbacks budget)",
+    )
+    ft.add_argument(
+        "--job-dir", default=None, metavar="PATH",
+        help="run as a RESUMABLE job (docs/ROBUSTNESS.md 'Preemption & "
+        "resumable jobs'): each pipeline stage (ingest -> build -> "
+        "solve -> output) persists a checksummed durable artifact "
+        "into PATH, snapshots default into PATH/snapshots, and a "
+        "restarted job with the same command validates the artifacts "
+        "(graph fingerprint + layout geometry + config hash) and "
+        "SKIPS completed stages — a preempted VM resumes instead of "
+        "recomputing. SIGTERM/SIGINT trigger a graceful drain (exit "
+        f"{int(ExitCode.INTERRUPTED)}); corrupt or mismatched "
+        "artifacts are recomputed, never trusted",
+    )
+    ft.add_argument(
+        "--drain-deadline", type=float,
+        default=jobs.DEFAULT_DRAIN_DEADLINE_S, metavar="SECONDS",
+        help="budget for the graceful SIGTERM/SIGINT drain: finish "
+        "the in-flight step, flush the async writer (a failing sink "
+        "still honors the SinkGuard dead-letter policy), write a "
+        "final snapshot + interrupted-marked run report. A flush "
+        "still hanging at the deadline is abandoned with a warning; "
+        "a SECOND signal hard-exits 128+signum immediately",
     )
     ft.add_argument(
         "--mass-tol", type=float, default=None,
@@ -540,7 +566,8 @@ def run_ppr(args, graph, ids) -> int:
     return 0
 
 
-def _device_build_graph(args, src, dst, n, dangling_mask=None):
+def _device_build_graph(args, src, dst, n, dangling_mask=None,
+                        names=None):
     """Pack raw (src, dst) edges on device with the SAME layout planner
     the bench uses (ops/device_build.plan_build), so product users get
     the build performance the bench measures (VERDICT r2 #3). ``src``/
@@ -554,6 +581,32 @@ def _device_build_graph(args, src, dst, n, dangling_mask=None):
         # converts it to a clean SystemExit for both paths.
         raise ValueError("empty graph: no vertices")
     from pagerank_tpu.ops import device_build as db
+
+    # Resumable-job hook (jobs.py; armed by _job_load_graph): persist
+    # the raw edges as the ingest artifact BEFORE the device build, so
+    # a job killed mid-build resumes without re-parsing. Synthetic
+    # inputs arrive as device arrays — nothing worth persisting, only
+    # the seed crossed the link.
+    job = getattr(args, "_job", None)
+    if job is not None:
+        if isinstance(src, np.ndarray):
+            arrays = {"src": np.asarray(src), "dst": np.asarray(dst)}
+            if dangling_mask is not None:
+                arrays["dangling_mask"] = np.asarray(dangling_mask)
+            job.save_stage_artifact(
+                "ingest", arrays,
+                {"key": args._job_key, "kind": "raw_edges", "n": int(n)},
+            )
+            if names is not None:
+                # Crawl/seqfile inputs: the id->name table commits WITH
+                # the raw edges, not after the 30-75s build — a job
+                # killed mid-sort must still write urls (not integer
+                # ids) from --out on every later resume.
+                job.save_names(names, args._job_key)
+            job.complete("ingest")
+        else:
+            job.complete("ingest", synthetic=True)
+        job.begin("build")
 
     # stream_dtype never changes the planned GEOMETRY (the stream is a
     # per-iteration cast) and requires a resolved span to validate, so
@@ -692,7 +745,8 @@ def load_graph(args):
                 native=native,
             )
             return _device_build_graph(args, src, dst, len(ids),
-                                       dangling_mask=~crawled), ids
+                                       dangling_mask=~crawled,
+                                       names=ids.names), ids
         from pagerank_tpu.ingest import load_crawl_seqfile
 
         graph, ids = load_crawl_seqfile(
@@ -707,7 +761,8 @@ def load_graph(args):
             src, dst, crawled, ids = load_crawl_file_arrays(
                 path, strict=args.strict_parse, native=native)
             return _device_build_graph(args, src, dst, len(ids),
-                                       dangling_mask=~crawled), ids
+                                       dangling_mask=~crawled,
+                                       names=ids.names), ids
         from pagerank_tpu.ingest import load_crawl_file
 
         graph, ids = load_crawl_file(path, strict=args.strict_parse,
@@ -850,7 +905,8 @@ def _append_history_record(args, cfg, graph, summary, robustness,
 
 
 def _export_observability(args, tracer, cfg, graph, metrics, summary,
-                          robustness, probes=None, error=None) -> None:
+                          robustness, probes=None, error=None,
+                          interrupted=None, job=None) -> None:
     """Write the --trace export and/or --run-report artifact
     (docs/OBSERVABILITY.md). Called on the success path AND — with
     ``error`` set, best-effort — from the failure path: the failing
@@ -876,10 +932,16 @@ def _export_observability(args, tracer, cfg, graph, metrics, summary,
         "engine": args.engine,
         "fused": bool(args.fused),
         "failed": error is not None,
+        # Preemption drain (ISSUE 12): an interrupted run is NOT a
+        # failed one — it drained cleanly and resumes from its job
+        # dir; the marker lets `obs report` say which it was.
+        "interrupted": interrupted is not None,
         "probes": probes.history if probes is not None else [],
     }
     if error is not None:
         extra["error"] = repr(error)
+    if interrupted is not None:
+        extra["interrupt_signal"] = getattr(interrupted, "signum", None)
     report = obs.build_run_report(
         config=cfg,
         tracer=tracer,
@@ -887,6 +949,7 @@ def _export_observability(args, tracer, cfg, graph, metrics, summary,
         history=metrics.history if metrics is not None else [],
         summary=summary,
         robustness=robustness,
+        job=job.report_section() if job is not None else None,
         extra=extra,
     )
     obs.write_run_report(args.run_report, report)
@@ -921,6 +984,7 @@ def _export_failure(ctx, err) -> None:
             ),
             probes=ctx.get("probes"),
             error=err,
+            job=ctx.get("job"),
         )
     except Exception as e2:
         print(f"pagerank_tpu: failure-path observability export "
@@ -975,7 +1039,164 @@ def _run_preflight(args, n: int, num_edges: int, scale,
     )
     print(obs_devices.render_fit(res), file=sys.stderr)
     if not res.fits:
-        raise SystemExit(3)
+        raise SystemExit(int(ExitCode.PREFLIGHT_UNFIT))
+
+
+def _input_stamp(path):
+    """Best-effort identity of a LOCAL input beyond its path string:
+    (size, mtime_ns) — a file regenerated IN PLACE between runs must
+    not let a resumed job serve the old graph's artifacts. Remote
+    paths (s3://...), comma-joined lists, and vanished files degrade
+    to None: the checksum+fingerprint validation still guards artifact
+    INTEGRITY, this stamp guards input FRESHNESS where the filesystem
+    can answer cheaply."""
+    if not path:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return [int(st.st_size), int(st.st_mtime_ns)]
+
+
+def _job_graph_key(args) -> str:
+    """Hash of everything that determines the ingest/build artifacts'
+    CONTENT (input spec + layout-shaping args) — artifacts from a
+    different input or layout must never satisfy this run's stages."""
+    return jobs.key_hash({
+        "input": args.input or args.synthetic,
+        "input_stamp": _input_stamp(args.input),
+        "format": args.format,
+        # Parse SEMANTICS change the edge set (strict=False drops
+        # malformed crawl entries); the native-vs-python path does NOT
+        # (differentially tested identical) and stays out of the key.
+        "strict_parse": bool(args.strict_parse),
+        "device_build": bool(args.device_build),
+        "host_mem_cap_gb": args.host_mem_cap_gb,
+        "dtype": args.dtype,
+        "accum_dtype": args.accum_dtype or args.dtype,
+        "lane_group": args.lane_group or 0,
+        "partition_span": args.partition_span,
+        "vertex_sharded": bool(args.vertex_sharded),
+        "vs_bounded": bool(args.vs_bounded),
+    })
+
+
+def _job_load_graph(args, job, drain):
+    """The ingest + build stages of a resumable job (jobs.py): restore
+    the graph from a validated durable artifact when one matches this
+    run's key, else run the normal loaders and persist the artifacts.
+    Corrupt or key-mismatched artifacts are recomputed, never trusted
+    (the PR-3 snapshot discipline)."""
+    key = _job_graph_key(args)
+
+    if not args.device_build:
+        # Host path: the BUILT Graph is the one artifact — restoring it
+        # skips the parse AND the host sort; the engine packs its own
+        # layout at build (the solve stage).
+        hit = job.load_stage_artifact("ingest", expect={"key": key})
+        if hit is not None:
+            arrays, meta = hit
+            try:
+                with obs.span("job/ingest_restore"):
+                    graph = jobs.graph_from_arrays(arrays, meta)
+            except jobs.ArtifactCorruptError as e:
+                warnings.warn(
+                    f"job ingest artifact rejected ({e}); recomputing",
+                    RuntimeWarning,
+                )
+            else:
+                job.skip("ingest", fingerprint=meta.get("fingerprint"))
+                job.skip("build",
+                         note="host layout packs at engine build")
+                names = jobs.decode_names(arrays)
+                return graph, (jobs.RestoredIds(names) if names else None)
+        with job.stage_span("ingest"):
+            with obs.span("ingest/load",
+                          input=args.input or args.synthetic):
+                graph, ids = load_graph(args)
+        arrays, meta = jobs.graph_to_arrays(graph)
+        meta["key"] = key
+        job.save_stage_artifact("ingest", arrays, meta)
+        job.complete("ingest", fingerprint=meta["fingerprint"])
+        job.begin("build")
+        job.complete("build", note="host layout packs at engine build")
+        # Drain AFTER the artifact commit: a SIGTERM that arrived
+        # mid-ingest must not throw away the stage it just finished —
+        # the resume's whole point is skipping this work.
+        drain.check("ingest")
+        return graph, ids
+
+    # Device build: the build artifact holds the post-sort packed
+    # planes — a restore skips ingest AND the composite-key sort (the
+    # single biggest unrecoverable cost before ISSUE 12).
+    from pagerank_tpu.ops import device_build as db
+
+    hit = job.load_stage_artifact("build", expect={"key": key})
+    if hit is not None:
+        arrays, meta = hit
+        try:
+            with obs.span("job/build_restore"):
+                graph = db.restore_device_graph(arrays, meta)
+        except (ValueError, jobs.ArtifactCorruptError) as e:
+            warnings.warn(
+                f"job build artifact rejected ({e}); recomputing",
+                RuntimeWarning,
+            )
+        else:
+            job.skip("ingest", note="covered by build artifact")
+            job.skip("build", fingerprint=meta.get("fingerprint"))
+            if meta.get("partition_span"):
+                args._resolved_partition_span = int(
+                    meta["partition_span"])
+            names = job.load_names(key)
+            return graph, (jobs.RestoredIds(names) if names else None)
+
+    graph, ids = None, None
+    if not args.synthetic:
+        # A prior run may have died DURING the build: the raw-edges
+        # ingest artifact still skips the host parse.
+        ing = job.load_stage_artifact("ingest", expect={"key": key})
+        if ing is not None:
+            arrs, imeta = ing
+            job.skip("ingest")
+            drain.check("ingest")
+            names = job.load_names(key)
+            ids = jobs.RestoredIds(names) if names else None
+            job.begin("build")
+            with obs.span("job/build"):
+                graph = _device_build_graph(
+                    args, arrs["src"], arrs["dst"], int(imeta["n"]),
+                    dangling_mask=arrs.get("dangling_mask"),
+                )
+    if graph is None:
+        # Fresh run: the normal loader path, with the supervisor hook
+        # armed so _device_build_graph persists the raw-edges ingest
+        # artifact (file inputs) and marks the stage transitions.
+        args._job = job
+        args._job_key = key
+        try:
+            with obs.span("ingest/load",
+                          input=args.input or args.synthetic):
+                graph, ids = load_graph(args)
+        finally:
+            args._job = None
+    arrays, meta = db.checkpoint_arrays(graph)
+    meta["key"] = key
+    part = getattr(args, "_resolved_partition_span", None)
+    if part:
+        meta["partition_span"] = int(part)
+    job.save_stage_artifact("build", arrays, meta)
+    job.complete("build", fingerprint=meta["fingerprint"])
+    # (names.npz already committed: the fresh crawl path saves it with
+    # the raw-edges artifact inside _device_build_graph's hook, and the
+    # restored-ingest branch just loaded it from disk — no rewrite of a
+    # potentially huge id->url table here.)
+    # Drain AFTER the artifact commit (not before): a SIGTERM during
+    # the 30-75s sort must still persist build.npz — that artifact is
+    # the single biggest thing a resume exists to skip.
+    drain.check("build")
+    return graph, ids
 
 
 def main(argv=None) -> int:
@@ -1001,6 +1222,69 @@ def main(argv=None) -> int:
 def _main(argv, ctx) -> int:
     args = build_parser().parse_args(argv)
     ctx["args"] = args
+    # Preemption drain (ISSUE 12; pagerank_tpu/jobs.py): the
+    # SIGTERM/SIGINT handlers live ONLY around this entry point —
+    # library modules stay handler-free (lint PTL008). A drain request
+    # surfaces as DrainInterrupt at the next safe point (completed
+    # step / stage boundary) and exits ExitCode.INTERRUPTED after the
+    # deadline-bounded flush; a second signal hard-exits 128+signum.
+    drain = jobs.GracefulDrain(deadline_s=args.drain_deadline)
+    ctx["drain"] = drain
+    with drain:
+        try:
+            return _run(args, ctx, drain)
+        except jobs.DrainInterrupt as e:
+            return _interrupted_exit(ctx, e, drain)
+
+
+def _interrupted_exit(ctx, e: "jobs.DrainInterrupt", drain) -> int:
+    """The graceful-preemption exit path: record the drain wall, mark
+    the job manifest interrupted (when a stage didn't already), export
+    the interrupted-marked run report + trace from whatever run state
+    exists, and return the documented distinct code. The in-solve half
+    of the drain (final snapshot, writer flush) already ran in
+    _run_solve's handler before this."""
+    args = ctx["args"]
+    spent = drain.finish()
+    job = ctx.get("job")
+    if job is not None and job.manifest.get("status") != "interrupted":
+        job.interrupt(e.where or "run", signal=e.signum)
+    metrics = ctx.get("metrics")
+    tracer = ctx.get("tracer")
+    guard = ctx.get("guard")
+    try:
+        if metrics is not None:
+            metrics.close()
+        if tracer is not None and (args.trace or args.run_report):
+            _export_observability(
+                args, tracer, ctx.get("cfg"), ctx.get("graph"), metrics,
+                summary=metrics.summary() if metrics is not None else {},
+                robustness=(
+                    _robustness_summary(args, ctx.get("engine"), guard)
+                    if guard is not None else {}
+                ),
+                probes=ctx.get("probes"),
+                interrupted=e,
+                job=job,
+            )
+    except Exception as e2:  # the drain must still exit 75
+        print(f"pagerank_tpu: interrupted-run observability export "
+              f"failed: {e2!r}", file=sys.stderr)
+    try:
+        sig = signal.Signals(e.signum).name if e.signum else "signal"
+    except ValueError:
+        sig = f"signal {e.signum}"
+    print(
+        f"pagerank_tpu: interrupted by {sig}; drained in {spent:.2f}s"
+        + (f" — rerun with --job-dir {args.job_dir} to resume"
+           if args.job_dir else "")
+        + f" (exit {int(ExitCode.INTERRUPTED)})",
+        file=sys.stderr,
+    )
+    return int(ExitCode.INTERRUPTED)
+
+
+def _run(args, ctx, drain) -> int:
     if args.engine == "jax" and not args.no_compile_cache:
         # Persist XLA executables across CLI runs: the engine-setup
         # chain is ~50 small jitted programs (and the device build ~50
@@ -1013,12 +1297,12 @@ def _main(argv, ctx) -> int:
     if args.device_build:
         if args.engine != "jax":
             print("--device-build requires --engine jax", file=sys.stderr)
-            return 2
+            return int(ExitCode.USAGE)
         if args.ppr_sources:
             print("--device-build does not support --ppr-sources "
                   "(the PPR engine builds from a host graph)",
                   file=sys.stderr)
-            return 2
+            return int(ExitCode.USAGE)
     if args.fused:
         # Pure-args validation BEFORE the (potentially minutes-long)
         # graph load and engine build. (--tol IS fused-compatible: the
@@ -1035,10 +1319,10 @@ def _main(argv, ctx) -> int:
                 f"{', '.join(bad)} need host control every iteration",
                 file=sys.stderr,
             )
-            return 2
+            return int(ExitCode.USAGE)
         if args.engine != "jax":
             print("--fused requires --engine jax", file=sys.stderr)
-            return 2
+            return int(ExitCode.USAGE)
     if args.stall_action == "rescue":
         # Pure-args validation before the graph load: rescue rebuilds
         # the engine over surviving devices, which needs the stepwise
@@ -1058,20 +1342,35 @@ def _main(argv, ctx) -> int:
                 f"incompatible with {', '.join(bad)}",
                 file=sys.stderr,
             )
-            return 2
+            return int(ExitCode.USAGE)
         if args.engine != "jax":
             print("--stall-action rescue requires --engine jax",
                   file=sys.stderr)
-            return 2
+            return int(ExitCode.USAGE)
     if args.ppr_sources:
         reject_ppr_incompatible_flags(args)
     if args.device_sample_every < 0:
         print("--device-sample-every must be >= 0", file=sys.stderr)
-        return 2
+        return int(ExitCode.USAGE)
+    if args.job_dir:
+        # Pure-args validation + defaults BEFORE any work: the
+        # resumable stage machine covers the global-PageRank pipeline;
+        # snapshots land in the job dir (resume always attempted).
+        if args.ppr_sources:
+            print("--job-dir does not support --ppr-sources (the "
+                  "stage machine covers the global-PageRank pipeline)",
+                  file=sys.stderr)
+            return int(ExitCode.USAGE)
+        if args.drain_deadline <= 0:
+            print("--drain-deadline must be positive", file=sys.stderr)
+            return int(ExitCode.USAGE)
+        if not args.snapshot_dir:
+            args.snapshot_dir = fsio.join(args.job_dir, "snapshots")
+        args.resume = True
     if args.preflight and args.engine != "jax":
         print("--preflight sizes against device HBM; it requires "
               "--engine jax", file=sys.stderr)
-        return 2
+        return int(ExitCode.USAGE)
     # Observability state is per-run, never inherited: a previous
     # in-process main() call (tests drive the CLI this way) must not
     # leak its tracer, counters, or cost ledger into this one.
@@ -1082,6 +1381,11 @@ def _main(argv, ctx) -> int:
     tracer = (obs.enable_tracing() if (args.trace or args.run_report)
               else obs.get_tracer())
     ctx["tracer"] = tracer
+    # Resumable-job supervisor (ISSUE 12; jobs.py): created AFTER the
+    # registry reset so its job.* telemetry survives into this run's
+    # report. Finding a prior manifest in the dir counts a resume.
+    job = jobs.JobSupervisor(args.job_dir) if args.job_dir else None
+    ctx["job"] = job
     if args.preflight and args.synthetic:
         # Synthetic geometry is knowable from the spec alone: the fit
         # check runs BEFORE any graph work — the whole point (a
@@ -1092,16 +1396,24 @@ def _main(argv, ctx) -> int:
             _run_preflight(args, n_syn, e_syn, scale_syn,
                            device_build=args.device_build)
     t0 = time.perf_counter()
-    with obs.span("ingest/load", input=args.input or args.synthetic):
-        try:
-            graph, ids = load_graph(args)
-        except ValueError as e:
-            # e.g. "empty graph: no vertices" (host build_graph and the
-            # device-build guard alike) — a clean CLI error, not a
-            # traceback.
-            raise SystemExit(str(e))
+    try:
+        if job is not None:
+            graph, ids = _job_load_graph(args, job, drain)
+        else:
+            with obs.span("ingest/load",
+                          input=args.input or args.synthetic):
+                graph, ids = load_graph(args)
+    except ValueError as e:
+        # e.g. "empty graph: no vertices" (host build_graph and the
+        # device-build guard alike) — a clean CLI error, not a
+        # traceback.
+        raise SystemExit(str(e))
     t_load = time.perf_counter() - t0
     ctx["graph"] = graph
+    # Stage-boundary drain point for EVERY run (job dirs have their own
+    # post-commit checks): a first Ctrl-C during a long ingest exits at
+    # its end instead of being silently deferred to the solve loop.
+    drain.check("ingest")
     if args.preflight and not args.synthetic:
         # File inputs: the geometry exists only after the host parse;
         # the check still precedes the ENGINE build — the device-
@@ -1191,375 +1503,490 @@ def _main(argv, ctx) -> int:
             )
     cfg.validate()
     ctx["cfg"] = cfg
-    engine = make_engine(args.engine, cfg)
-    ctx["engine"] = engine
-    if args.device_build:
-        engine.build_device(graph)
+    # Resumable-job solve stage (ISSUE 12; jobs.py): a validated
+    # final-ranks artifact from a completed prior solve satisfies the
+    # stage outright — the engine is never built, so a job SIGKILL'd
+    # AFTER the solve resumes straight to output.
+    solve_fp = solve_hash = None
+    solve_hit = None
+    if job is not None:
+        solve_fp = graph.fingerprint()
+        solve_hash = jobs.solve_config_hash(cfg)
+        # Scope the job's snapshots BY SOLVE CONFIG: the intra-stage
+        # resume grain must obey the same key discipline as the stage
+        # artifacts — a Snapshotter validates only graph fingerprint +
+        # semantics, so without this a rerun with changed solve flags
+        # (e.g. --damping) would warm-start the OLD config's
+        # trajectory and serve its ranks verbatim. A reconfigured
+        # rerun gets a fresh subdir and solves from r0; the prior
+        # config's snapshots stay valid for ITS resumes.
+        if args.snapshot_dir:
+            args.snapshot_dir = fsio.join(args.snapshot_dir, solve_hash)
+        solve_hit = job.load_stage_artifact(
+            "solve",
+            expect={"fingerprint": solve_fp, "solve_config": solve_hash},
+        )
+    if solve_hit is not None:
+        from pagerank_tpu.utils.snapshot import SinkGuard
+
+        ranks = solve_hit[0]["ranks"]
+        job.skip("solve", iterations=solve_hit[1].get("iterations"))
+        print(
+            "solve stage satisfied by durable artifact "
+            f"({solve_hit[1].get('iterations')} iteration(s) recorded)",
+            file=sys.stderr,
+        )
+        engine = None
+        ctx["engine"] = None
+        metrics = None
+        probes = None
+        summary = {}
+        guard = SinkGuard()
+        ctx["guard"] = guard
     else:
-        engine.build(graph)
+        if job is not None:
+            job.begin("solve")
+        engine = make_engine(args.engine, cfg)
+        ctx["engine"] = engine
+        if args.device_build:
+            engine.build_device(graph)
+        else:
+            engine.build(graph)
+        # A signal during the engine build/compile surfaces here, not
+        # after a whole first iteration.
+        drain.check("solve")
 
-    # Engine indirection for the elastic path: a rescue REPLACES the
-    # engine mid-run (teardown + rebuild over survivors), so every
-    # closure below reaches the engine through this holder instead of
-    # binding the original object.
-    engine_ref = {"engine": engine}
+        # Engine indirection for the elastic path: a rescue REPLACES the
+        # engine mid-run (teardown + rebuild over survivors), so every
+        # closure below reaches the engine through this holder instead of
+        # binding the original object.
+        engine_ref = {"engine": engine}
 
-    def _eng():
-        return engine_ref["engine"]
+        def _eng():
+            return engine_ref["engine"]
 
-    snap = None
-    if args.snapshot_dir:
-        # mesh_meta: topology + partition-geometry provenance in every
-        # snapshot (mesh-shape-agnostic resume; docs/ROBUSTNESS.md
-        # "Elastic solve").
-        snap = Snapshotter(args.snapshot_dir, graph.fingerprint(),
-                           cfg.semantics, mesh_meta=engine.snapshot_meta())
-        if args.resume:
-            it = resume_engine(engine, snap)
-            if it:
-                print(f"resumed from iteration {it}", file=sys.stderr)
+        snap = None
+        if args.snapshot_dir:
+            # mesh_meta: topology + partition-geometry provenance in every
+            # snapshot (mesh-shape-agnostic resume; docs/ROBUSTNESS.md
+            # "Elastic solve").
+            snap = Snapshotter(args.snapshot_dir, graph.fingerprint(),
+                               cfg.semantics, mesh_meta=engine.snapshot_meta())
+            if args.resume:
+                try:
+                    it = resume_engine(engine, snap)
+                except ValueError as e:
+                    # A job dir reused for a DIFFERENT graph: its old
+                    # snapshots fail the fingerprint check. Under the
+                    # supervisor that is the artifact-mismatch case —
+                    # recompute from r0, never trust (explicit --resume
+                    # without --job-dir still refuses loudly).
+                    if job is None:
+                        raise
+                    warnings.warn(
+                        f"job snapshots do not match this graph ({e}); "
+                        "solving from r0", RuntimeWarning,
+                    )
+                    it = 0
+                if it:
+                    print(f"resumed from iteration {it}", file=sys.stderr)
 
-    num_chips = 1
-    if args.engine == "jax":
-        num_chips = engine.mesh.devices.size
-    metrics = MetricsLogger(
-        graph.num_edges, num_chips, log_every=args.log_every, jsonl_path=args.jsonl
-    )
-    ctx["metrics"] = metrics
-    if args.history:
-        # Baseline-delta gauges for the live exporter (ISSUE 9): the
-        # running solve publishes history.* % -vs-ledger-baseline.
-        _arm_history_baseline(args.history, cfg, graph, num_chips)
-
-    dumper = None
-    if args.dump_text_dir:
-        dumper = TextDumper(
-            args.dump_text_dir, names=ids.names if ids is not None else None
-        )
-
-    # Async offload (C17 build target): the iteration loop submits a
-    # device-side rank copy and keeps dispatching; a worker thread does
-    # the device->host transfer + file writes. --sync-io restores the
-    # reference-like per-iteration barrier; the cpu engine's ranks are
-    # already host-side, so it stays synchronous.
-    def write_sinks(i, payload):
-        # THE single sink path — async and --sync-io runs must stay
-        # byte-identical (tests/test_snapshot.py asserts it).
-        want_snap, ranks = payload
-        if want_snap:
-            snap.save(i + 1, ranks)
-        if dumper is not None:
-            dumper.dump(i, ranks)
-
-    # One write-failure policy for BOTH I/O modes (SinkGuard): bounded
-    # retries, then fail or warn-and-drop with a dead-letter manifest
-    # of the dropped iterations (docs/ROBUSTNESS.md).
-    from pagerank_tpu.utils.snapshot import SinkGuard
-
-    dead_letter = None
-    if args.on_write_failure == "warn_and_drop":
-        base = args.snapshot_dir or args.dump_text_dir
-        if base:
-            dead_letter = fsio.join(base, "dead_letter.json")
-    guard = SinkGuard(
-        retry_policy=cfg.robustness.write_retry_policy(),
-        on_failure=args.on_write_failure,
-        dead_letter_path=dead_letter,
-    )
-    ctx["guard"] = guard
-
-    writer = None
-    can_write = dumper is not None or (snap and args.snapshot_every)
-    if can_write and args.engine == "jax" and not args.sync_io:
-        from pagerank_tpu.utils.snapshot import AsyncRankWriter
-
-        writer = AsyncRankWriter(
-            lambda p: (p[0], _eng().decode_ranks(p[1])), [write_sinks],
-            guard=guard,
-        )
-
-    # In-loop convergence probes (obs/probes.py; docs/OBSERVABILITY.md
-    # "Convergence probes"). --probe-every 0 leaves this None and the
-    # solve loop makes zero probe calls.
-    probes = None
-    if args.probe_every:
-        probes = obs.ConvergenceProbes(
-            args.probe_every, topk=args.probe_topk, stop_tol=args.stop_tol
-        )
-    ctx["probes"] = probes
-
-    # Constructed (and argument-validated) BEFORE the exporter below
-    # spawns its HTTP thread, so a bad --stall-timeout cannot leak a
-    # live server; armed right before the solve.
-    watchdog = None
-    if args.stall_timeout:
-        # Classification probes the SOLVE MESH's devices (tracking the
-        # rebuilt engine after a rescue), not every visible chip — a
-        # wedged device the solve never uses must not read as OUR loss.
-        device_source = None
+        num_chips = 1
         if args.engine == "jax":
-            def device_source():
-                return list(_eng().mesh.devices.reshape(-1))
-        watchdog = obs.StallWatchdog(
-            args.stall_timeout, action=args.stall_action,
-            device_source=device_source,
+            num_chips = engine.mesh.devices.size
+        metrics = MetricsLogger(
+            graph.num_edges, num_chips, log_every=args.log_every, jsonl_path=args.jsonl
         )
+        ctx["metrics"] = metrics
+        if args.history:
+            # Baseline-delta gauges for the live exporter (ISSUE 9): the
+            # running solve publishes history.* % -vs-ledger-baseline.
+            _arm_history_baseline(args.history, cfg, graph, num_chips)
 
-    # Device-plane sampler (obs/devices.py; ISSUE 10): armed ONLY on
-    # explicit opt-in — engine.run reads it once per run, and the
-    # disarmed hot loop makes zero sampler calls (the tracer
-    # discipline). Run reports still embed a one-shot boundary sample
-    # when disarmed (obs/report.build_run_report).
-    if args.device_sample_every:
-        # Sample the SOLVE MESH's devices (the watchdog's
-        # device_source discipline): on a shared host the watermark
-        # must not attribute a foreign job's HBM peak to this run.
-        # Resolved per sweep — None (pre-build boundary samples, the
-        # CPU engine) degrades to every visible device.
-        sample_source = None
-        if args.engine == "jax":
-            def sample_source():
-                return list(_eng().mesh.devices.reshape(-1))
-        obs.arm_sampler(obs.DeviceSampler(
-            every=args.device_sample_every, devices=sample_source))
-
-    # Live metrics exporter (obs/live.py): atomic Prometheus textfile
-    # per iteration and/or an HTTP /metrics endpoint.
-    exporter = None
-    if args.metrics_textfile or args.metrics_port is not None:
-        exporter = obs.MetricsExporter(
-            textfile=args.metrics_textfile, port=args.metrics_port
-        )
-        if exporter.port is not None:
-            print(
-                f"serving metrics on http://127.0.0.1:{exporter.port}"
-                f"/metrics",
-                file=sys.stderr,
+        dumper = None
+        if args.dump_text_dir:
+            dumper = TextDumper(
+                args.dump_text_dir, names=ids.names if ids is not None else None
             )
 
-    def on_iteration(i, info):
-        metrics(i, info)
-        if exporter is not None:
-            exporter.write_textfile()
-        want_snap = bool(
-            snap and args.snapshot_every and (i + 1) % args.snapshot_every == 0
+        # Async offload (C17 build target): the iteration loop submits a
+        # device-side rank copy and keeps dispatching; a worker thread does
+        # the device->host transfer + file writes. --sync-io restores the
+        # reference-like per-iteration barrier; the cpu engine's ranks are
+        # already host-side, so it stays synchronous.
+        def write_sinks(i, payload):
+            # THE single sink path — async and --sync-io runs must stay
+            # byte-identical (tests/test_snapshot.py asserts it).
+            want_snap, ranks = payload
+            if want_snap:
+                snap.save(i + 1, ranks)
+            if dumper is not None:
+                dumper.dump(i, ranks)
+
+        # One write-failure policy for BOTH I/O modes (SinkGuard): bounded
+        # retries, then fail or warn-and-drop with a dead-letter manifest
+        # of the dropped iterations (docs/ROBUSTNESS.md).
+        from pagerank_tpu.utils.snapshot import SinkGuard
+
+        dead_letter = None
+        if args.on_write_failure == "warn_and_drop":
+            base = args.snapshot_dir or args.dump_text_dir
+            if base:
+                dead_letter = fsio.join(base, "dead_letter.json")
+        guard = SinkGuard(
+            retry_policy=cfg.robustness.write_retry_policy(),
+            on_failure=args.on_write_failure,
+            dead_letter_path=dead_letter,
         )
-        if not (want_snap or dumper is not None):
-            return
-        if writer is not None:
-            writer.submit(i, (want_snap, _eng().device_ranks()))
-        else:
-            # one device->host fetch for both sinks
-            guard(i, lambda: write_sinks(i, (want_snap, _eng().ranks())))
+        ctx["guard"] = guard
 
-    # Stall watchdog (obs/live.py): armed around the solve only — the
-    # engine heartbeats it per completed step (chunk boundaries when
-    # fused); disarmed in the finally below on every exit path.
-    if watchdog is not None:
-        obs.arm_watchdog(watchdog)
+        writer = None
+        can_write = dumper is not None or (snap and args.snapshot_every)
+        if can_write and args.engine == "jax" and not args.sync_io:
+            from pagerank_tpu.utils.snapshot import AsyncRankWriter
 
-    try:
-        # Profiler lifecycle via obs.profiler_session: started here,
-        # stopped on EVERY exit path (the trace of a failing run is
-        # what the user wants to inspect), recorded as a 'profile'
-        # span when tracing is on — replaces the hand-rolled
-        # start/stop+finally this block used to carry.
-        with obs.profiler_session(args.profile_dir):
-            if args.fused:
-                import jax
-                import math
+            writer = AsyncRankWriter(
+                lambda p: (p[0], _eng().decode_ranks(p[1])), [write_sinks],
+                guard=guard,
+            )
 
-                first = engine.iteration
-                # Chunk cadence: fused dispatches between the host-
-                # visible points — snapshot boundaries, probe points,
-                # or both (their gcd aligns every needed boundary on a
-                # chunk edge; off-cadence boundaries are skipped per
-                # consumer below).
-                snap_every = (
-                    args.snapshot_every
-                    if (snap is not None and args.snapshot_every) else 0
+        # In-loop convergence probes (obs/probes.py; docs/OBSERVABILITY.md
+        # "Convergence probes"). --probe-every 0 leaves this None and the
+        # solve loop makes zero probe calls.
+        probes = None
+        if args.probe_every:
+            probes = obs.ConvergenceProbes(
+                args.probe_every, topk=args.probe_topk, stop_tol=args.stop_tol
+            )
+        ctx["probes"] = probes
+
+        # Constructed (and argument-validated) BEFORE the exporter below
+        # spawns its HTTP thread, so a bad --stall-timeout cannot leak a
+        # live server; armed right before the solve.
+        watchdog = None
+        if args.stall_timeout:
+            # Classification probes the SOLVE MESH's devices (tracking the
+            # rebuilt engine after a rescue), not every visible chip — a
+            # wedged device the solve never uses must not read as OUR loss.
+            device_source = None
+            if args.engine == "jax":
+                def device_source():
+                    return list(_eng().mesh.devices.reshape(-1))
+            watchdog = obs.StallWatchdog(
+                args.stall_timeout, action=args.stall_action,
+                device_source=device_source,
+            )
+
+        # Device-plane sampler (obs/devices.py; ISSUE 10): armed ONLY on
+        # explicit opt-in — engine.run reads it once per run, and the
+        # disarmed hot loop makes zero sampler calls (the tracer
+        # discipline). Run reports still embed a one-shot boundary sample
+        # when disarmed (obs/report.build_run_report).
+        if args.device_sample_every:
+            # Sample the SOLVE MESH's devices (the watchdog's
+            # device_source discipline): on a shared host the watermark
+            # must not attribute a foreign job's HBM peak to this run.
+            # Resolved per sweep — None (pre-build boundary samples, the
+            # CPU engine) degrades to every visible device.
+            sample_source = None
+            if args.engine == "jax":
+                def sample_source():
+                    return list(_eng().mesh.devices.reshape(-1))
+            obs.arm_sampler(obs.DeviceSampler(
+                every=args.device_sample_every, devices=sample_source))
+
+        # Live metrics exporter (obs/live.py): atomic Prometheus textfile
+        # per iteration and/or an HTTP /metrics endpoint.
+        exporter = None
+        if args.metrics_textfile or args.metrics_port is not None:
+            exporter = obs.MetricsExporter(
+                textfile=args.metrics_textfile, port=args.metrics_port
+            )
+            if exporter.port is not None:
+                print(
+                    f"serving metrics on http://127.0.0.1:{exporter.port}"
+                    f"/metrics",
+                    file=sys.stderr,
                 )
-                cadences = [c for c in (snap_every, args.probe_every) if c]
-                chunk_every = math.gcd(*cadences) if cadences else 0
-                if chunk_every and cadences and chunk_every < min(cadences):
-                    # Neither cadence divides the other: the gcd can be
-                    # far below both (coprime worst case: 1 — fully
-                    # unfused dispatch). Warn rather than silently
-                    # degrade the fused run.
-                    print(
-                        f"--snapshot-every {snap_every} and "
-                        f"--probe-every {args.probe_every} share no "
-                        f"cadence; fused chunks drop to gcd="
-                        f"{chunk_every} iterations — align one to a "
-                        f"multiple of the other to keep dispatches "
-                        f"fused",
-                        file=sys.stderr,
-                    )
-                chunked = bool(chunk_every)
-                # compile outside the timed region
-                engine.prepare_fused(
-                    tol=args.tol,
-                    every=chunk_every if chunked else None,
-                )
-                t_run = time.perf_counter()
-                if chunked:
-                    # Fused dispatches BETWEEN snapshot/probe points;
-                    # snapshots at chunk boundaries ride the same async
-                    # writer/sink path as the stepwise loop.
-                    def on_chunk(done_iters, ranks_thunk, traces):
-                        # --stop-tol fires at PROBE boundaries only —
-                        # returned truthy to stop the chunked run, so a
-                        # snapshot-only boundary (both cadences set,
-                        # gcd chunks) can never early-exit the solve
-                        # the way the every-iteration --tol may.
-                        stop = False
-                        if (probes is not None
-                                and done_iters % args.probe_every == 0):
-                            # The boundary's residual was already
-                            # computed on device (the chunk traces).
-                            rec = probes.probe_boundary(
-                                engine, done_iters - 1,
-                                l1_delta=float(
-                                    jax.device_get(traces[0][-1])
-                                ),
-                            )
-                            stop = probes.should_stop(rec)
-                        if exporter is not None:
-                            exporter.write_textfile()
-                        # Same absolute cadence as the stepwise loop: no
-                        # snapshot at an off-cadence final-remainder
-                        # boundary, so both modes write identical file
-                        # sets. (The device-side rank copy is only made
-                        # when the thunk is called — skipped boundaries
-                        # cost nothing.)
-                        if not snap_every or done_iters % snap_every != 0:
-                            return stop
-                        if writer is not None:
-                            writer.submit(done_iters - 1,
-                                          (True, ranks_thunk()))
-                        else:
-                            guard(
-                                done_iters - 1,
-                                lambda: write_sinks(
-                                    done_iters - 1,
-                                    (True,
-                                     engine.decode_ranks(ranks_thunk())),
-                                ),
-                            )
-                        return stop
 
-                    ranks = engine.run_fused_chunked(
-                        every=chunk_every, on_chunk=on_chunk,
-                        tol=args.tol,
-                    )
-                elif args.tol is not None:
-                    # On-device early stop: only the FINAL iteration's
-                    # delta/mass exist (dynamic trip count).
-                    ranks = engine.run_fused_tol(args.tol)
+        def on_iteration(i, info):
+            metrics(i, info)
+            if exporter is not None:
+                exporter.write_textfile()
+            want_snap = bool(
+                snap and args.snapshot_every and (i + 1) % args.snapshot_every == 0
+            )
+            if want_snap or dumper is not None:
+                if writer is not None:
+                    writer.submit(i, (want_snap, _eng().device_ranks()))
                 else:
-                    ranks = engine.run_fused()
-                total = time.perf_counter() - t_run
-                tr = engine.last_run_metrics
-                deltas = np.asarray(jax.device_get(tr["l1_delta"]))
-                masses = np.asarray(jax.device_get(tr["dangling_mass"]))
-                done = engine.iteration - first
-                if tracer.enabled:
-                    # One span for the fused dispatch window (per-step
-                    # host spans don't exist here by design — the loop
-                    # runs on device).
-                    tracer.add_span("solve/fused", t_run, total,
-                                    iters=done)
-                for i in range(len(deltas) if done else 0):
-                    # one record per executed iteration, except the
-                    # device-tol form which keeps only the final one.
-                    it = first + (i if len(deltas) == done else done - 1)
-                    metrics.record(
-                        it,
-                        {"l1_delta": deltas[i], "dangling_mass": masses[i]},
-                        total / max(1, done),
-                        timing="averaged",
+                    # one device->host fetch for both sinks
+                    guard(i, lambda: write_sinks(i, (want_snap, _eng().ranks())))
+            # Preemption points (ISSUE 12), AFTER this step's sinks were
+            # queued: the seeded chaos plan may deliver its signal here
+            # (job.tick), and a pending drain request surfaces here — the
+            # in-flight step always finishes before the drain starts.
+            if job is not None:
+                job.tick("solve", i)
+            drain.check("solve")
+
+        # Stall watchdog (obs/live.py): armed around the solve only — the
+        # engine heartbeats it per completed step (chunk boundaries when
+        # fused); disarmed in the finally below on every exit path.
+        if watchdog is not None:
+            obs.arm_watchdog(watchdog)
+
+        interrupted = None
+        try:
+            # Profiler lifecycle via obs.profiler_session: started here,
+            # stopped on EVERY exit path (the trace of a failing run is
+            # what the user wants to inspect), recorded as a 'profile'
+            # span when tracing is on — replaces the hand-rolled
+            # start/stop+finally this block used to carry.
+            with obs.profiler_session(args.profile_dir):
+                if args.fused:
+                    import jax
+                    import math
+
+                    first = engine.iteration
+                    # Chunk cadence: fused dispatches between the host-
+                    # visible points — snapshot boundaries, probe points,
+                    # or both (their gcd aligns every needed boundary on a
+                    # chunk edge; off-cadence boundaries are skipped per
+                    # consumer below).
+                    snap_every = (
+                        args.snapshot_every
+                        if (snap is not None and args.snapshot_every) else 0
                     )
-                fused_summary = dict(iters=done, total_seconds=total)
-            else:
-                # snap doubles as the rollback source for the
-                # self-healing loop (unhealthy steps restore the newest
-                # valid snapshot and recompute — engine.run;
-                # docs/ROBUSTNESS.md). With the async writer active,
-                # rollback scans must drain its queue first or they
-                # race the snapshots still in flight.
-                roll_snap = snap
-                if snap is not None and writer is not None:
-                    from pagerank_tpu.utils.snapshot import (
-                        WriterSyncedSnapshotter)
-
-                    roll_snap = WriterSyncedSnapshotter(snap, writer)
-                if args.stall_action == "rescue":
-                    # Elastic solve (docs/ROBUSTNESS.md "Elastic
-                    # solve"): device losses — injected, backend
-                    # runtime errors confirmed by liveness probes, or
-                    # watchdog fires classified as device-lost — tear
-                    # down the mesh, rebuild over survivors, re-shard
-                    # the graph, and warm-start from the newest valid
-                    # snapshot.
-                    from pagerank_tpu.engines.jax_engine import (
-                        JaxTpuEngine)
-                    from pagerank_tpu.parallel.elastic import (
-                        DeviceHealthMonitor, ElasticRunner)
-
-                    def _factory(devs):
-                        e = JaxTpuEngine(
-                            cfg.replace(num_devices=len(devs)),
-                            devices=devs,
+                    cadences = [c for c in (snap_every, args.probe_every) if c]
+                    chunk_every = math.gcd(*cadences) if cadences else 0
+                    if chunk_every and cadences and chunk_every < min(cadences):
+                        # Neither cadence divides the other: the gcd can be
+                        # far below both (coprime worst case: 1 — fully
+                        # unfused dispatch). Warn rather than silently
+                        # degrade the fused run.
+                        print(
+                            f"--snapshot-every {snap_every} and "
+                            f"--probe-every {args.probe_every} share no "
+                            f"cadence; fused chunks drop to gcd="
+                            f"{chunk_every} iterations — align one to a "
+                            f"multiple of the other to keep dispatches "
+                            f"fused",
+                            file=sys.stderr,
                         )
-                        return e.build(graph)
-
-                    def _rebound(e):
-                        engine_ref["engine"] = e
-                        ctx["engine"] = e
-                        if snap is not None:
-                            snap.mesh_meta = e.snapshot_meta()
-
-                    runner = ElasticRunner(
-                        engine, _factory, snapshotter=roll_snap,
-                        max_rescues=cfg.robustness.rescue_budget(),
-                        monitor=DeviceHealthMonitor(
-                            straggler_factor=(
-                                cfg.robustness.straggler_factor),
-                        ),
-                        on_rebuild=_rebound,
+                    chunked = bool(chunk_every)
+                    # compile outside the timed region
+                    engine.prepare_fused(
+                        tol=args.tol,
+                        every=chunk_every if chunked else None,
                     )
-                    ranks = runner.run(on_iteration=on_iteration,
-                                       probes=probes)
-                    engine = engine_ref["engine"]
+                    t_run = time.perf_counter()
+                    if chunked:
+                        # Fused dispatches BETWEEN snapshot/probe points;
+                        # snapshots at chunk boundaries ride the same async
+                        # writer/sink path as the stepwise loop.
+                        def on_chunk(done_iters, ranks_thunk, traces):
+                            # Fused runs drain at chunk boundaries — the
+                            # only host-visible points they have.
+                            drain.check("solve")
+                            # --stop-tol fires at PROBE boundaries only —
+                            # returned truthy to stop the chunked run, so a
+                            # snapshot-only boundary (both cadences set,
+                            # gcd chunks) can never early-exit the solve
+                            # the way the every-iteration --tol may.
+                            stop = False
+                            if (probes is not None
+                                    and done_iters % args.probe_every == 0):
+                                # The boundary's residual was already
+                                # computed on device (the chunk traces).
+                                rec = probes.probe_boundary(
+                                    engine, done_iters - 1,
+                                    l1_delta=float(
+                                        jax.device_get(traces[0][-1])
+                                    ),
+                                )
+                                stop = probes.should_stop(rec)
+                            if exporter is not None:
+                                exporter.write_textfile()
+                            # Same absolute cadence as the stepwise loop: no
+                            # snapshot at an off-cadence final-remainder
+                            # boundary, so both modes write identical file
+                            # sets. (The device-side rank copy is only made
+                            # when the thunk is called — skipped boundaries
+                            # cost nothing.)
+                            if not snap_every or done_iters % snap_every != 0:
+                                return stop
+                            if writer is not None:
+                                writer.submit(done_iters - 1,
+                                              (True, ranks_thunk()))
+                            else:
+                                guard(
+                                    done_iters - 1,
+                                    lambda: write_sinks(
+                                        done_iters - 1,
+                                        (True,
+                                         engine.decode_ranks(ranks_thunk())),
+                                    ),
+                                )
+                            return stop
+
+                        ranks = engine.run_fused_chunked(
+                            every=chunk_every, on_chunk=on_chunk,
+                            tol=args.tol,
+                        )
+                    elif args.tol is not None:
+                        # On-device early stop: only the FINAL iteration's
+                        # delta/mass exist (dynamic trip count).
+                        ranks = engine.run_fused_tol(args.tol)
+                    else:
+                        ranks = engine.run_fused()
+                    total = time.perf_counter() - t_run
+                    tr = engine.last_run_metrics
+                    deltas = np.asarray(jax.device_get(tr["l1_delta"]))
+                    masses = np.asarray(jax.device_get(tr["dangling_mass"]))
+                    done = engine.iteration - first
+                    if tracer.enabled:
+                        # One span for the fused dispatch window (per-step
+                        # host spans don't exist here by design — the loop
+                        # runs on device).
+                        tracer.add_span("solve/fused", t_run, total,
+                                        iters=done)
+                    for i in range(len(deltas) if done else 0):
+                        # one record per executed iteration, except the
+                        # device-tol form which keeps only the final one.
+                        it = first + (i if len(deltas) == done else done - 1)
+                        metrics.record(
+                            it,
+                            {"l1_delta": deltas[i], "dangling_mass": masses[i]},
+                            total / max(1, done),
+                            timing="averaged",
+                        )
+                    fused_summary = dict(iters=done, total_seconds=total)
                 else:
-                    ranks = engine.run(on_iteration=on_iteration,
-                                       snapshotter=roll_snap,
-                                       probes=probes)
-    finally:
-        # Capture BEFORE any nested try: inside an except handler,
-        # sys.exc_info() would report the just-caught close() error.
-        # (Failure-path observability export happens in main()'s
-        # wrapper — _export_failure — so ingest/build/resume/--out
-        # failures are covered too, not just this block's.)
-        propagating = sys.exc_info()[0] is not None
-        obs.disarm_watchdog()
-        if writer is not None:
-            try:
-                writer.close()  # flush pending writes; surface failures
-            except Exception:
-                if not propagating:
-                    raise
-                # an engine error is already propagating; don't mask it
-        if exporter is not None:
-            try:
-                exporter.close()  # final textfile flush + HTTP teardown
-            except Exception:
-                if not propagating:
-                    raise
-    # Fused runs know the true iteration count and wall-clock directly
-    # (the tol form records only the final iteration).
-    summary = metrics.summary(**fused_summary) if args.fused else metrics.summary()
-    metrics.close()
+                    # snap doubles as the rollback source for the
+                    # self-healing loop (unhealthy steps restore the newest
+                    # valid snapshot and recompute — engine.run;
+                    # docs/ROBUSTNESS.md). With the async writer active,
+                    # rollback scans must drain its queue first or they
+                    # race the snapshots still in flight.
+                    roll_snap = snap
+                    if snap is not None and writer is not None:
+                        from pagerank_tpu.utils.snapshot import (
+                            WriterSyncedSnapshotter)
+
+                        roll_snap = WriterSyncedSnapshotter(snap, writer)
+                    if args.stall_action == "rescue":
+                        # Elastic solve (docs/ROBUSTNESS.md "Elastic
+                        # solve"): device losses — injected, backend
+                        # runtime errors confirmed by liveness probes, or
+                        # watchdog fires classified as device-lost — tear
+                        # down the mesh, rebuild over survivors, re-shard
+                        # the graph, and warm-start from the newest valid
+                        # snapshot.
+                        from pagerank_tpu.engines.jax_engine import (
+                            JaxTpuEngine)
+                        from pagerank_tpu.parallel.elastic import (
+                            DeviceHealthMonitor, ElasticRunner)
+
+                        def _factory(devs):
+                            e = JaxTpuEngine(
+                                cfg.replace(num_devices=len(devs)),
+                                devices=devs,
+                            )
+                            return e.build(graph)
+
+                        def _rebound(e):
+                            engine_ref["engine"] = e
+                            ctx["engine"] = e
+                            if snap is not None:
+                                snap.mesh_meta = e.snapshot_meta()
+
+                        runner = ElasticRunner(
+                            engine, _factory, snapshotter=roll_snap,
+                            max_rescues=cfg.robustness.rescue_budget(),
+                            monitor=DeviceHealthMonitor(
+                                straggler_factor=(
+                                    cfg.robustness.straggler_factor),
+                            ),
+                            on_rebuild=_rebound,
+                        )
+                        ranks = runner.run(on_iteration=on_iteration,
+                                           probes=probes)
+                        engine = engine_ref["engine"]
+                    else:
+                        ranks = engine.run(on_iteration=on_iteration,
+                                           snapshotter=roll_snap,
+                                           probes=probes)
+        except jobs.DrainInterrupt as di:
+            # Graceful preemption (ISSUE 12): the in-flight step finished;
+            # the finally below flushes the writer under the drain
+            # deadline, then the epilogue writes a final snapshot and
+            # re-raises for main()'s interrupted-exit path.
+            interrupted = di
+        finally:
+            # Capture BEFORE any nested try: inside an except handler,
+            # sys.exc_info() would report the just-caught close() error.
+            # (Failure-path observability export happens in main()'s
+            # wrapper — _export_failure — so ingest/build/resume/--out
+            # failures are covered too, not just this block's.)
+            propagating = sys.exc_info()[0] is not None
+            obs.disarm_watchdog()
+            if writer is not None:
+                try:
+                    # A drain bounds the flush by its deadline — a hanging
+                    # sink is abandoned (warned + counted); a FAILING sink
+                    # still drains normally under the SinkGuard policy
+                    # (dead_letter.json, never a hang).
+                    writer.close(
+                        timeout=drain.remaining() if drain.requested
+                        else None
+                    )  # flush pending writes; surface failures
+                except Exception:
+                    if not propagating:
+                        raise
+                    # an engine error is already propagating; don't mask it
+            if exporter is not None:
+                try:
+                    exporter.close()  # final textfile flush + HTTP teardown
+                except Exception:
+                    if not propagating:
+                        raise
+        if interrupted is not None:
+            # Final snapshot of the drained state (the last completed
+            # step), manifest bookkeeping, then surface the interrupt —
+            # _interrupted_exit writes the interrupted-marked report.
+            if snap is not None:
+                try:
+                    # _eng() for the ITERATION too: after an elastic
+                    # rescue the local `engine` is the stale pre-rescue
+                    # object — labeling the rebuilt engine's ranks with
+                    # its old iteration would mislabel (and possibly
+                    # clobber) a genuine snapshot.
+                    guard(_eng().iteration,
+                          lambda: snap.save(_eng().iteration,
+                                            _eng().ranks()))
+                except Exception as e:
+                    print(f"pagerank_tpu: final drain snapshot failed: "
+                          f"{e!r}", file=sys.stderr)
+            if job is not None:
+                job.interrupt("solve", iteration=int(_eng().iteration),
+                              signal=interrupted.signum)
+            raise interrupted
+        # Fused runs know the true iteration count and wall-clock directly
+        # (the tol form records only the final iteration).
+        summary = metrics.summary(**fused_summary) if args.fused else metrics.summary()
+        metrics.close()
+        if job is not None:
+            # Durable solve artifact: the decoded final ranks, keyed by
+            # graph fingerprint + solve-config hash — a later restart
+            # skips straight to the output stage.
+            job.save_stage_artifact(
+                "solve", {"ranks": np.asarray(ranks)},
+                {"fingerprint": solve_fp, "solve_config": solve_hash,
+                 "iterations": int(engine.iteration)},
+            )
+            job.complete("solve", iterations=int(engine.iteration))
+            drain.check("solve")
     if summary:
         # The rate fields are null (not inf) on a degenerate zero
         # wall-clock (utils/metrics.py) — skip them rather than format
@@ -1601,7 +2028,7 @@ def _main(argv, ctx) -> int:
     # config, span summary, metrics snapshot, per-iteration history,
     # cost model, robustness counters. Diff two with
     # `python -m pagerank_tpu.obs report A.json B.json`.
-    if args.dump_hlo and args.engine == "jax":
+    if args.dump_hlo and args.engine == "jax" and engine is not None:
         # Compiler plane (ISSUE 11; obs/hlo.py): harvest the step
         # program(s)' optimized-HLO lowering reports (arming the
         # inspector around ONE cost_reports pass — same compiled
@@ -1626,24 +2053,21 @@ def _main(argv, ctx) -> int:
         except Exception as e:  # telemetry must not fail the solve
             print(f"pagerank_tpu: HLO dump failed ({e!r})",
                   file=sys.stderr)
-    if (args.run_report or args.history) and args.engine == "jax":
+    if ((args.run_report or args.history) and args.engine == "jax"
+            and engine is not None):
         # Fill the cost ledger with the step program's XLA cost model
         # (the fused executables harvested at their compile already);
         # best-effort by contract — cost_reports never raises. The
         # perf-history record needs it too: bytes/edge is the ledger's
         # program-change attribution axis.
         engine.cost_reports()
-    report = _export_observability(args, tracer, cfg, graph, metrics,
-                                   summary=summary,
-                                   robustness=rb_summary,
-                                   probes=probes)
-    if args.history:
-        # Durable half of --history: this run's canonical RunRecord
-        # appended to the perf ledger (content-hash deduped; reuses
-        # the --run-report build when both flags are set).
-        _append_history_record(args, cfg, graph, summary, rb_summary,
-                               tracer, report=report)
 
+    # Output stage BEFORE the observability export: the run report's
+    # ``job`` section must record the COMPLETED manifest (status,
+    # output wall, final skip set) — smoke R and `obs report` read
+    # job.resumes/status off the report, not the manifest file.
+    if job is not None:
+        job.begin("output")
     if args.out:
         names = ids.names if ids is not None else None
         if args.top > 0:
@@ -1660,7 +2084,32 @@ def _main(argv, ctx) -> int:
                 key = names[i] if names else i
                 f.write(f"{key}\t{float(ranks[i])!r}\n")
         print(f"wrote {len(order):,} ranks to {args.out}", file=sys.stderr)
-    return 0
+    if job is not None:
+        job.complete("output", out=args.out)
+        job.finish()
+
+    report = _export_observability(args, tracer, cfg, graph, metrics,
+                                   summary=summary,
+                                   robustness=rb_summary,
+                                   probes=probes, job=job)
+    if args.history:
+        # Durable half of --history: this run's canonical RunRecord
+        # appended to the perf ledger (content-hash deduped; reuses
+        # the --run-report build when both flags are set).
+        _append_history_record(args, cfg, graph, summary, rb_summary,
+                               tracer, report=report)
+
+    if job is not None:
+        skipped = [s for s, r in job.manifest["stages"].items()
+                   if r.get("skipped")]
+        print(
+            f"job complete in {args.job_dir} "
+            f"(resume #{job.manifest['resumes']}, "
+            f"{len(skipped)} stage(s) satisfied by durable artifacts"
+            + (f": {', '.join(skipped)}" if skipped else "") + ")",
+            file=sys.stderr,
+        )
+    return int(ExitCode.OK)
 
 
 if __name__ == "__main__":

@@ -57,6 +57,7 @@ import json
 import os
 import sys
 
+from pagerank_tpu.exitcodes import ExitCode
 from pagerank_tpu.obs import history as history_mod
 from pagerank_tpu.obs import report as report_mod
 
@@ -367,7 +368,7 @@ def _cmd_history(args) -> int:
         if not records and not os.path.exists(args.ledger):
             print(f"obs history: no such ledger: {args.ledger}",
                   file=sys.stderr)
-            return 2
+            return int(ExitCode.USAGE)
         budgets = (history_mod.load_budgets(args.budgets)
                    if args.budgets else None)
         if args.history_command == "trend":
@@ -398,10 +399,12 @@ def _cmd_history(args) -> int:
             print("gate: " + ("PASS" if res.ok else "FAIL")
                   + (f" ({len(res.drift_warnings)} drift warning(s))"
                      if res.drift_warnings else ""))
-        return 0 if res.ok else 1
+        # The exit-code taxonomy (pagerank_tpu/exitcodes.py): FAILURE
+        # is a judged-bad gate, USAGE a bad/missing invocation.
+        return int(ExitCode.OK if res.ok else ExitCode.FAILURE)
     except (OSError, json.JSONDecodeError, ValueError) as e:
         print(f"obs history: {e}", file=sys.stderr)
-        return 2
+        return int(ExitCode.USAGE)
 
 
 def main(argv=None) -> int:

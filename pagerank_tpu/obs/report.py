@@ -51,10 +51,13 @@ SCHEMA_VERSION = 1
 #: ``lowering`` (ISSUE 11) is the compiler-plane section: per-form
 #: optimized-HLO lowering reports (obs/hlo.py) — empty unless the
 #: inspector was armed (``--dump-hlo`` / ``engine.lowering_reports``).
+#: ``job`` (ISSUE 12) is the resumable-job section: stage statuses,
+#: resume count, skip/wall per stage (pagerank_tpu/jobs.py) — empty on
+#: runs without ``--job-dir``.
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
     "metrics", "iterations", "summary", "robustness", "costs",
-    "devices", "lowering",
+    "devices", "lowering", "job",
 )
 
 
@@ -149,6 +152,7 @@ def build_run_report(
     costs: Optional[dict] = None,
     devices: Optional[dict] = None,
     lowering: Optional[dict] = None,
+    job: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Assemble the report dict. Every section is optional — a bench
@@ -191,6 +195,7 @@ def build_run_report(
         "costs": _json_safe(costs or {}),
         "devices": _json_safe(devices or {}),
         "lowering": _json_safe(lowering or {}),
+        "job": _json_safe(job or {}),
     }
     if extra:
         report.update(_json_safe(extra))
@@ -296,6 +301,22 @@ def render_report(report: dict) -> str:
             "robustness: "
             + ", ".join(f"{k}={v}" for k, v in rb.items() if v)
         )
+    jb = report.get("job") or {}
+    if jb.get("stages"):
+        mark = ("INTERRUPTED" if report.get("interrupted")
+                else jb.get("status"))
+        lines.append(
+            f"job: {mark}, resume #{jb.get('resumes', 0)} "
+            f"({jb.get('dir')})"
+        )
+        for s, r in jb["stages"].items():
+            w = r.get("wall_s")
+            lines.append(
+                f"  {s:<8} {r.get('status')}"
+                + ("  [skipped: durable artifact]" if r.get("skipped")
+                   else (f"  {w:.3f}s" if isinstance(w, (int, float))
+                         else ""))
+            )
     dv = report.get("devices") or {}
     if dv.get("hbm_high_water_bytes") is not None:
         per_dev = dv.get("per_device_peak_bytes") or {}
@@ -496,6 +517,31 @@ def diff_reports(a: dict, b: dict) -> str:
         lines.append("device-plane deltas (comms attribution + HBM "
                      "watermark):")
         lines.extend(comms_lines)
+
+    # Resumable-job deltas (ISSUE 12): which stages a resumed run
+    # skipped via durable artifacts vs executed — "did the restart
+    # actually avoid the 75 s build" as a mechanical diff.
+    ja, jb = a.get("job") or {}, b.get("job") or {}
+    if ja.get("stages") or jb.get("stages"):
+        job_lines = []
+        if ja.get("resumes") != jb.get("resumes"):
+            job_lines.append(
+                f"  resumes: {ja.get('resumes', 0)} -> "
+                f"{jb.get('resumes', 0)}"
+            )
+        names = sorted(set(ja.get("stages") or {})
+                       | set(jb.get("stages") or {}))
+        for s in names:
+            ra = (ja.get("stages") or {}).get(s) or {}
+            rb_ = (jb.get("stages") or {}).get(s) or {}
+            da = ("skipped" if ra.get("skipped") else ra.get("status"))
+            db_ = ("skipped" if rb_.get("skipped") else rb_.get("status"))
+            if da != db_:
+                job_lines.append(f"  {s}: {da} -> {db_}")
+        if job_lines:
+            lines.append("job-stage deltas (resume skips vs executed "
+                         "work):")
+            lines.extend(job_lines)
 
     ca = (a.get("metrics") or {}).get("counters") or {}
     cb = (b.get("metrics") or {}).get("counters") or {}

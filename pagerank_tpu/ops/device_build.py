@@ -204,6 +204,102 @@ class DeviceEllGraph:
         return self._fp
 
 
+def checkpoint_arrays(dg: "DeviceEllGraph"
+                      ) -> Tuple[dict, dict]:
+    """Host-side (arrays, meta) snapshot of a built device graph — the
+    BUILD-STAGE durable artifact (ISSUE 12, pagerank_tpu/jobs.py): the
+    post-sort products (relabel permutation, packed slot planes, row
+    bookkeeping, degrees/masks) fetched to host once, so a preempted
+    job's warm restart skips the composite-key sort — the single
+    biggest unrecoverable cost before this existed. Striped/partitioned
+    layouts store their per-stripe lists as ``src_<i>`` planes; the
+    meta records the full layout geometry (group/stripe/presentinel)
+    plus the structural fingerprint for resume validation.
+
+    Call BEFORE the engine consumes the graph: ``build_device`` donates
+    the slot arrays away (``dg.src = None``)."""
+    if dg.src is None:
+        raise ValueError(
+            "device graph already consumed by an engine build; "
+            "checkpoint before engine.build_device"
+        )
+    srcs = dg.src if isinstance(dg.src, (list, tuple)) else [dg.src]
+    rbs = (dg.row_block if isinstance(dg.row_block, (list, tuple))
+           else [dg.row_block])
+    ws = dg.weight if isinstance(dg.weight, (list, tuple)) else [dg.weight]
+    arrays = {
+        "perm": dg.perm,
+        "dangling_mask": dg.dangling_mask,
+        "zero_in_mask": dg.zero_in_mask,
+        "out_degree": dg.out_degree,
+    }
+    for i, s in enumerate(srcs):
+        arrays[f"src_{i}"] = s
+    for i, r in enumerate(rbs):
+        arrays[f"row_block_{i}"] = r
+    weighted = any(w is not None for w in ws)
+    if weighted:
+        for i, w in enumerate(ws):
+            arrays[f"weight_{i}"] = w
+    # ONE host fetch for every plane (device_get batches the transfers).
+    host = jax.device_get(arrays)
+    arrays = {k: np.asarray(v) for k, v in host.items()}
+    meta = {
+        "kind": "device_ell_graph",
+        "n": dg.n,
+        "n_padded": dg.n_padded,
+        "num_blocks": dg.num_blocks,
+        "num_edges": dg.num_edges,
+        "group": dg.group,
+        "stripe_size": dg.stripe_size,
+        "presentinel": bool(dg.presentinel),
+        "n_stripes": len(srcs),
+        "listed": isinstance(dg.src, (list, tuple)),
+        "weighted": weighted,
+        "fingerprint": dg.fingerprint(),
+    }
+    return arrays, meta
+
+
+def restore_device_graph(arrays: dict, meta: dict) -> "DeviceEllGraph":
+    """Inverse of :func:`checkpoint_arrays`: device_put the persisted
+    planes back into a :class:`DeviceEllGraph`, skipping the entire
+    gen/relabel/sort/slots/scatter pipeline. The restored graph's
+    structural fingerprint is recomputed ON DEVICE and must equal the
+    recorded one — a validated artifact whose planes were damaged in a
+    way the sha256 somehow missed still cannot resume a solve against
+    the wrong adjacency."""
+    n_stripes = int(meta["n_stripes"])
+    listed = bool(meta.get("listed", n_stripes > 1))
+    srcs = [jnp.asarray(arrays[f"src_{i}"]) for i in range(n_stripes)]
+    rbs = [jnp.asarray(arrays[f"row_block_{i}"]) for i in range(n_stripes)]
+    if meta.get("weighted"):
+        ws = [jnp.asarray(arrays[f"weight_{i}"]) for i in range(n_stripes)]
+    else:
+        ws = [None] * n_stripes
+    dg = DeviceEllGraph(
+        n=int(meta["n"]), n_padded=int(meta["n_padded"]),
+        num_blocks=int(meta["num_blocks"]),
+        src=srcs if listed else srcs[0],
+        weight=ws if listed else ws[0],
+        row_block=rbs if listed else rbs[0],
+        perm=jnp.asarray(arrays["perm"]),
+        dangling_mask=jnp.asarray(arrays["dangling_mask"]),
+        zero_in_mask=jnp.asarray(arrays["zero_in_mask"]),
+        out_degree=jnp.asarray(arrays["out_degree"]),
+        num_edges=int(meta["num_edges"]), group=int(meta["group"]),
+        stripe_size=int(meta["stripe_size"]),
+        presentinel=bool(meta["presentinel"]),
+    )
+    fp = dg.fingerprint()
+    if fp != meta.get("fingerprint"):
+        raise ValueError(
+            f"restored device graph fingerprint {fp} != recorded "
+            f"{meta.get('fingerprint')}"
+        )
+    return dg
+
+
 def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
                host: bool = False, num_edges: Optional[int] = None,
                partition_span: Optional[int] = None

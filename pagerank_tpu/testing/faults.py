@@ -29,12 +29,16 @@ smokes in scripts/acceptance.py gate on it).
 from __future__ import annotations
 
 import io
+import os
 import random
+import signal
+import sys
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pagerank_tpu.obs import log as obs_log
 from pagerank_tpu.utils import fsio
 
 
@@ -286,6 +290,136 @@ class HttpFaultInjector:
             self.faults += 1
         self.log.append((n, method, path, action[0] if action else "-"))
         return action
+
+
+# -- process-plane faults (ISSUE 12; pagerank_tpu/jobs.py) -------------------
+
+
+class ProcessKillPlan:
+    """Seed-deterministic PROCESS-plane fault: kill THIS process with a
+    real signal at a staged point of a resumable job (jobs.py stage
+    boundaries; per-iteration inside the solve stage).
+
+    The plan travels to the target process via :data:`KILL_ENV`
+    (``stage=solve,iter=5,signal=TERM[,seed=N]``) so the chaos harness
+    (:func:`run_job_subprocess`) can kill a REAL subprocess job at an
+    exact, reproducible point — the self-delivery makes SIGKILL
+    placement deterministic in a way an external watcher never is.
+    ``signal=TERM`` exercises the graceful drain (handler installed
+    around cli.main); ``signal=KILL`` is the no-warning preemption —
+    the process dies mid-stage with nothing flushed beyond the durable
+    artifacts already committed.
+
+    Like every schedule here, the decision is a pure function of the
+    plan's (stage, iteration), one-shot, and logged — two same-plan
+    runs kill at the identical point bit-for-bit (the log is written to
+    ``PAGERANK_TPU_KILL_LOG`` when set, so even a SIGKILL'd process
+    leaves its reproducibility record: the log line is flushed BEFORE
+    the signal is raised). ``seed`` is schedule IDENTITY only — it
+    rides the env encoding and the log line so a kill record names
+    which seeded chaos campaign produced it, but never perturbs the
+    placement (there is nothing random to derive: the plan pins the
+    exact point)."""
+
+    ENV = "PAGERANK_TPU_KILL_PLAN"
+    LOG_ENV = "PAGERANK_TPU_KILL_LOG"
+
+    def __init__(self, stage: str, iteration: Optional[int] = None,
+                 signum: int = 15, seed: int = 0,
+                 log_path: Optional[str] = None):
+        self.stage = stage
+        self.iteration = iteration
+        self.signum = int(signum)
+        self.seed = int(seed)
+        self.fired = False
+        self.log: List[Tuple[str, str, int]] = []
+        self._log_path = log_path
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["ProcessKillPlan"]:
+        env = os.environ if env is None else env
+        spec = env.get(cls.ENV)
+        if not spec:
+            return None
+        fields = dict(
+            tok.split("=", 1) for tok in spec.split(",") if "=" in tok
+        )
+        sig_name = fields.get("signal", "TERM").upper()
+        signum = getattr(signal, f"SIG{sig_name}", None)
+        if signum is None:
+            raise ValueError(f"{cls.ENV}: unknown signal {sig_name!r}")
+        it = fields.get("iter")
+        return cls(
+            stage=fields.get("stage", "solve"),
+            iteration=int(it) if it is not None else None,
+            signum=int(signum), seed=int(fields.get("seed", 0)),
+            log_path=env.get(cls.LOG_ENV),
+        )
+
+    def to_env(self) -> Dict[str, str]:
+        """The env var encoding of this plan (for the subprocess
+        harness)."""
+        sig = signal.Signals(self.signum).name.replace("SIG", "", 1)
+        spec = f"stage={self.stage},signal={sig},seed={self.seed}"
+        if self.iteration is not None:
+            spec += f",iter={self.iteration}"
+        return {self.ENV: spec}
+
+    def check(self, stage: str, iteration: Optional[int] = None) -> None:
+        """Deliver the signal when (stage, iteration) matches; one-shot.
+        The reproducibility log line (and stdio) is flushed FIRST —
+        a SIGKILL leaves no second chance."""
+        if self.fired or stage != self.stage:
+            return
+        if self.iteration is not None and iteration != self.iteration:
+            return
+        self.fired = True
+        entry = (stage, signal.Signals(self.signum).name,
+                 -1 if iteration is None else int(iteration))
+        self.log.append(entry)
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(f"{entry[0]},{entry[1]},{entry[2]}\n")
+                f.flush()
+                os.fsync(f.fileno())
+        obs_log.warn(
+            f"chaos: delivering {entry[1]} at {stage}"
+            + (f" iteration {iteration}" if iteration is not None else "")
+            + f" (seed {self.seed})"
+        )
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), self.signum)
+
+
+def run_job_subprocess(argv: Sequence[str],
+                       kill: Optional[ProcessKillPlan] = None,
+                       env: Optional[Dict[str, str]] = None,
+                       kill_log: Optional[str] = None,
+                       timeout: float = 600.0):
+    """Chaos harness: run ``python -m pagerank_tpu.cli <argv>`` as a
+    REAL subprocess, optionally carrying a seeded :class:`ProcessKillPlan`
+    that makes the child kill itself (SIGTERM -> graceful drain path,
+    SIGKILL -> nothing survives but the durable artifacts). Returns the
+    CompletedProcess; a SIGKILL'd child's returncode is ``-9`` and a
+    hard-exited SIGTERM child's is per the exit-code taxonomy
+    (pagerank_tpu/exitcodes.py)."""
+    import subprocess
+
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    if env:
+        child_env.update(env)
+    if kill is not None:
+        child_env.update(kill.to_env())
+        if kill_log:
+            child_env[ProcessKillPlan.LOG_ENV] = kill_log
+    else:
+        child_env.pop(ProcessKillPlan.ENV, None)
+    return subprocess.run(
+        [sys.executable, "-m", "pagerank_tpu.cli", *argv],
+        env=child_env, capture_output=True, text=True, timeout=timeout,
+    )
 
 
 # -- device-plane faults (ISSUE 7; parallel/elastic.py) ----------------------

@@ -21,6 +21,7 @@ import json
 import queue
 import re
 import threading
+import time
 import warnings
 import zipfile
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
@@ -481,6 +482,7 @@ class AsyncRankWriter:
         self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
         self._err: Optional[BaseException] = None
         self._closed = False
+        self._abandoned = False
         self._thread = threading.Thread(
             target=self._run, name="rank-writer", daemon=True
         )
@@ -545,17 +547,69 @@ class AsyncRankWriter:
         self._q.join()
         self._check()
 
-    def close(self) -> None:
+    def close(self, timeout: Optional[float] = None) -> None:
         """Flush all pending writes and stop the worker; raises if ANY
         write failed — including one raised by the background thread
         after the final ``submit``, which is only observable here.
         Idempotent: every call (first or repeated, e.g. an explicit
         close inside a ``with`` block) re-raises a recorded failure, so
-        no caller path can exit cleanly over a lost write."""
+        no caller path can exit cleanly over a lost write.
+
+        ``timeout`` (seconds) bounds the flush — the preemption drain's
+        deadline (pagerank_tpu/jobs.py): a sink wedged PAST the
+        SinkGuard's own bounded retries must not hold the drain beyond
+        its deadline. On expiry the worker (a daemon thread) is
+        abandoned with a RuntimeWarning and a ``sink.drain_timeouts``
+        count; any failure it already recorded still re-raises. The
+        guard's dead-letter semantics are untouched: a FAILING (not
+        hanging) sink drains normally inside the deadline, dropping to
+        ``dead_letter.json`` per policy."""
         if not self._closed:
             self._closed = True
-            self._q.put(None)
-            self._thread.join()
+            if timeout is None:
+                self._q.put(None)
+                self._thread.join()
+            else:
+                # Bounded close must not block on the sentinel put:
+                # with the worker wedged inside a sink and the queue
+                # full, an unbounded put(None) would hang before ever
+                # reaching the bounded join. But a HEALTHY backlogged
+                # worker frees a slot within its next write, so retry
+                # the put under the same deadline — dropping the
+                # sentinel outright would leave a fully-drained worker
+                # parked on q.get() and burn the whole deadline in
+                # join() for a false abandonment.
+                deadline = time.monotonic() + timeout
+                while True:
+                    left = deadline - time.monotonic()
+                    try:
+                        self._q.put(None, timeout=max(0.01, min(0.1, left)))
+                        break
+                    except queue.Full:
+                        if left <= 0:
+                            break
+                self._thread.join(max(0.0, deadline - time.monotonic()))
+        elif timeout is not None and self._thread.is_alive():
+            self._thread.join(timeout)  # repeat close: one more grace
+        if self._thread.is_alive() and not self._abandoned:
+            # Warn + count ONCE per abandonment: a repeat close (e.g.
+            # the __exit__ after an explicit drain close, which passes
+            # no timeout) must stay a cheap no-op, not a second count
+            # — and a bounded join only ever leaves the thread alive
+            # when a numeric timeout expired, so the message can name
+            # it.
+            self._abandoned = True
+            obs_metrics.counter(
+                "sink.drain_timeouts",
+                "async-writer flushes abandoned at the drain deadline",
+            ).inc()
+            warnings.warn(
+                f"async rank writer still flushing after the "
+                f"{timeout:g}s drain deadline; abandoning the worker "
+                f"(pending writes may be lost — the durable job "
+                f"artifacts and snapshots already committed are safe)",
+                RuntimeWarning,
+            )
         self._check()
 
     def __enter__(self):

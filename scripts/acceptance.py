@@ -63,8 +63,10 @@ Usage:
 import argparse
 import json
 import os
+import signal
 import sys
 import time
+import warnings
 
 import numpy as np
 
@@ -211,9 +213,20 @@ CONFIGS = {
     "Q": dict(kind="hlo", scale=14, forms="default,partitioned",
               label="compiler-plane smoke (optimized-HLO gather "
                     "verdict, default + partitioned)"),
+    # Preemption smoke (ISSUE 12; pagerank_tpu/jobs.py): a resumable
+    # job is SIGTERM'd mid-solve by a seeded ProcessKillPlan — the
+    # graceful drain must exit INTERRUPTED (75) with the manifest
+    # marked interrupted, and a second invocation against the same
+    # --job-dir must RESUME (skip the graph stages, warm-start the
+    # solve) and complete with oracle-parity ranks, `job.resumes == 1`
+    # in the run report, under R_SMOKE_BUDGET_S — the preemptible-VM
+    # lifecycle the TPU measurement campaign will actually run on.
+    "R": dict(kind="jobs", scale=10, iters=12, kill_iter=6,
+              label="preemption smoke (SIGTERM drain + job-dir "
+                    "resume)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "F", "A",
-                "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "F",
+                "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1257,6 +1270,120 @@ def run_hlo_smoke(key: str):
     return rec
 
 
+# Budget for the preemption smoke (seconds, measured around the
+# SIGTERM'd run + the resumed run — NOT the f64 oracle pass): two
+# 1024-vertex cpu-engine solves, a drain, and artifact save/restore
+# are well under a second; 3s absorbs a loaded host while catching a
+# drain that blocks on its deadline or a resume that recomputes the
+# world.
+R_SMOKE_BUDGET_S = 3.0
+
+
+def run_jobs_smoke(key: str):
+    """ISSUE-12 gate: the preemption lifecycle end to end, in-process
+    (the SIGTERM is self-delivered by the seeded ProcessKillPlan at an
+    exact solve iteration, so the whole drain->resume cycle is
+    deterministic and fits the budget). Gates: the killed run returns
+    ExitCode.INTERRUPTED with an interrupted-marked manifest, the
+    resumed run returns 0 having SKIPPED the graph stages (durable
+    artifacts) and warm-started the solve, the final ranks match the
+    f64 CPU oracle at the standing f32 gate, the resumed run report
+    carries job.resumes == 1, and both runs land under
+    R_SMOKE_BUDGET_S."""
+    import shutil
+    import tempfile
+
+    from pagerank_tpu import (PageRankConfig, ReferenceCpuEngine,
+                              build_graph)
+    from pagerank_tpu.cli import main as cli_main
+    from pagerank_tpu.exitcodes import ExitCode
+    from pagerank_tpu.testing.faults import ProcessKillPlan
+    from pagerank_tpu.utils import synth
+
+    spec = CONFIGS[key]
+    scale, iters, kill_iter = spec["scale"], spec["iters"], spec["kill_iter"]
+    work = tempfile.mkdtemp(prefix="pagerank_jobs_")
+    job_dir = os.path.join(work, "job")
+    out_path = os.path.join(work, "ranks.tsv")
+    report_path = os.path.join(work, "run_report.json")
+    argv = ["--synthetic", f"rmat:{scale}", "--engine", "cpu",
+            "--iters", str(iters), "--job-dir", job_dir,
+            "--out", out_path, "--log-every", "0"]
+    plan_env = ProcessKillPlan(
+        "solve", iteration=kill_iter, signum=signal.SIGTERM).to_env()
+    t0 = time.perf_counter()
+    try:
+        os.environ.update(plan_env)
+        try:
+            rc_kill = cli_main(argv)
+        finally:
+            for k in plan_env:
+                os.environ.pop(k, None)
+        with open(os.path.join(job_dir, "job.json")) as f:
+            man_killed = json.load(f)
+        with warnings.catch_warnings():
+            # The resumed run's solve-artifact miss warns by design.
+            warnings.simplefilter("ignore", RuntimeWarning)
+            rc_resume = cli_main(argv + ["--run-report", report_path])
+        t_run = time.perf_counter() - t0
+        with open(report_path) as f:
+            report = json.load(f)
+        n = 1 << scale
+        got = np.zeros(n)
+        with open(out_path) as f:
+            for line in f:
+                k, v = line.split("\t")
+                got[int(k)] = float(v)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    src, dst = synth.rmat_edges(scale)
+    g = build_graph(src, dst, n=n)
+    oracle = ReferenceCpuEngine(
+        PageRankConfig(num_iters=iters, dtype="float64",
+                       accum_dtype="float64")).build(g).run()
+    l1 = float(np.abs(got - oracle).sum() / np.abs(oracle).sum())
+
+    jb = report.get("job") or {}
+    stages = jb.get("stages") or {}
+    drained = (rc_kill == int(ExitCode.INTERRUPTED)
+               and man_killed.get("status") == "interrupted")
+    resumed_ok = (rc_resume == 0 and jb.get("resumes") == 1
+                  and jb.get("status") == "complete"
+                  and (stages.get("build") or {}).get("skipped") is True
+                  and (stages.get("solve") or {}).get("skipped") is False)
+    passed = bool(drained and resumed_ok and l1 <= ELASTIC_F32_GATE
+                  and t_run <= R_SMOKE_BUDGET_S)
+    rec = {
+        "config": key,
+        "kind": "jobs",
+        "label": spec["label"],
+        "scale": scale,
+        "iters": iters,
+        "kill_iter": kill_iter,
+        "kill_exit_code": rc_kill,
+        "resume_exit_code": rc_resume,
+        "drained": drained,
+        "job_resumes": jb.get("resumes"),
+        "stages_skipped": sorted(s for s, r in stages.items()
+                                 if r.get("skipped")),
+        "accuracy_l1": l1,
+        "seconds": t_run,
+        "budget_s": R_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] SIGTERM at solve iter {kill_iter}: exit {rc_kill} "
+        f"({'drained' if drained else 'NOT DRAINED'}); resume exit "
+        f"{rc_resume}, resumes={jb.get('resumes')}, skipped "
+        f"{','.join(rec['stages_skipped']) or 'none'}; oracle L1 "
+        f"{l1:.2e} vs {ELASTIC_F32_GATE:g}; {t_run:.2f}s vs budget "
+        f"{R_SMOKE_BUDGET_S:g}s -> {'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def run_partitioned_smoke(key: str):
     """ISSUE-6 gate: a short solve on the partition-centric layout —
     the jax engine through the CLI with an explicit --partition-span
@@ -1847,7 +1974,8 @@ def main(argv=None) -> int:
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
                "elastic": run_elastic_smoke, "halo": run_halo_smoke,
                "history": run_history_smoke,
-               "devices": run_devices_smoke, "hlo": run_hlo_smoke}
+               "devices": run_devices_smoke, "hlo": run_hlo_smoke,
+               "jobs": run_jobs_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

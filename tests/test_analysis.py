@@ -74,6 +74,14 @@ FIXTURES = {
             print(msg)
             sys.stderr.write(msg)
     """,
+    "PTL008": """
+        import atexit
+        import signal
+
+        def f(handler):
+            signal.signal(signal.SIGTERM, handler)
+            atexit.register(handler)
+    """,
 }
 
 
@@ -195,6 +203,47 @@ def test_ptl007_scope_exempts_cli_entry_points(tmp_path):
             if f.rule == "PTL007"] == ["PTL007"]
 
 
+def test_ptl008_scope_exempts_supervisor_modules(tmp_path):
+    """PTL008 (ISSUE 12): process-global handler installation flags in
+    LIBRARY modules but is exempt in the two modules that OWN handlers
+    — jobs.py (GracefulDrain) and cli.py (the entry point that installs
+    it). An injectable-callback spelling (the GracefulDrain idiom:
+    ``install=signal.signal`` as a default ARGUMENT, called through the
+    parameter) never flags — only direct installation calls do."""
+    p = _write(tmp_path, "handlers.py", FIXTURES["PTL008"])
+
+    def ptl008(rel):
+        return [f for f in lint_mod.lint_file(p, rel)
+                if f.rule == "PTL008"]
+
+    assert len(ptl008(None)) == 2            # fixture mode: all rules
+    assert len(ptl008("utils/foo.py")) == 2  # library module: flags
+    assert len(ptl008("parallel/elastic.py")) == 2
+    assert ptl008("jobs.py") == []           # supervisor: exempt
+    assert ptl008("cli.py") == []            # entry point: exempt
+
+    injectable = _write(tmp_path, "drain.py", """
+        import signal
+
+        class Drain:
+            def __init__(self, install=signal.signal):
+                self._install = install
+
+            def arm(self, signum, handler):
+                self._install(signum, handler)
+    """)
+    assert [f for f in lint_mod.lint_file(injectable, "utils/m.py")
+            if f.rule == "PTL008"] == []
+
+
+def test_repo_tree_is_handler_free():
+    """The PTL008 satellite's whole point, pinned: no library module in
+    the shipped package installs signal/exit handlers (no waivers
+    either — the allowlist carries no PTL008 entries)."""
+    findings = [f for f in lint_mod.lint_tree() if f.rule == "PTL008"]
+    assert findings == []
+
+
 def test_lanes_assignment_is_the_one_allowed_spelling(tmp_path):
     p = _write(tmp_path, "geom.py", "LANES = 128\nHALF = 128 // 2\n")
     findings = lint_mod.lint_file(p)
@@ -283,7 +332,7 @@ def test_list_rules(capsys):
     text = capsys.readouterr().out
     assert rc == 0
     for rid in ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005",
-                "PTL006", "PTL007",
+                "PTL006", "PTL007", "PTL008",
                 "PTC001", "PTC002", "PTC003", "PTC004", "PTC005",
                 "PTC006", "PTC007"):
         assert rid in text

@@ -480,10 +480,13 @@ def test_cli_preflight_blocks_doomed_run(tmp_path):
 
 def test_bench_preflight_blocks(tmp_path):
     import bench
+    from pagerank_tpu.exitcodes import ExitCode
 
     with pytest.raises(SystemExit) as ei:
         bench.main(["--scale", "26", "--preflight"])
-    assert ei.value.code == 2
+    # Unified with the CLI's refusal code by the ISSUE-12 exit-code
+    # taxonomy (bench exited 2 for this before).
+    assert ei.value.code == int(ExitCode.PREFLIGHT_UNFIT)
 
 
 def test_bench_multichip_preflight_models_clamped_mesh(monkeypatch):
