@@ -308,12 +308,27 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
 
             src, dst = rmat_edges(args.scale, args.edge_factor, seed=0)
             graph = build_graph(src, dst, n=1 << args.scale)
-            return JaxTpuEngine(cfg).build(graph), graph.num_edges
+            return JaxTpuEngine(cfg).build(graph), graph.num_edges, graph
         dg = _device_graph(cfg, args.scale, args.edge_factor, stripe)
-        return JaxTpuEngine(cfg).build_device(dg), dg.num_edges
+        return JaxTpuEngine(cfg).build_device(dg), dg.num_edges, None
 
+    # Data plane (ISSUE 13): rate legs arm the graph profiler so every
+    # emitted leg carries its `graph` block (device builds compute the
+    # profile in one fused reduction inside the build — a small,
+    # now-standing addition to build_s; host legs profile in numpy
+    # below). --build-only stays DISARMED: its build_s is the
+    # stage-breakdown budget gate and must measure the bare pipeline.
+    from pagerank_tpu.obs import graph_profile
+
+    if not build_only:
+        graph_profile.reset()
+        graph_profile.arm()
     t0 = time.perf_counter()
-    engine, num_edges = do_build()
+    try:
+        engine, num_edges, host_graph = do_build()
+    finally:
+        if not build_only:
+            graph_profile.disarm()
     t_build = time.perf_counter() - t0
     label = f"{dtype}" + (f"+{accum_dtype}-accum" if accum_dtype != dtype else "")
     if wide_accum == "pair":
@@ -354,6 +369,7 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
     costs, lowering = _leg_costs(engine, dt / args.iters, num_edges,
                                  dump_hlo=args.dump_hlo, label=label)
     layout = engine.layout_info()
+    graph_block = _leg_graph_block(engine, host_graph, layout)
     del engine  # free HBM before the next config builds
     return {
         "value": eps_chip,
@@ -375,7 +391,36 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         # perf-history ledger tracks. None when the backend reports
         # no optimized HLO.
         "lowering": lowering,
+        # Data-plane block (ISSUE 13; obs/graph_profile.py): the
+        # structural profile + skew-driven prediction this leg's
+        # graph/layout implies — the DATA axis the perf-history
+        # classifier attributes against. None on non-reporting paths.
+        "graph": graph_block,
     }
+
+
+def _leg_graph_block(engine, host_graph, layout):
+    """One rate leg's ``graph`` data-plane block (ISSUE 13): the
+    profile the device build published (or a numpy profile of the host
+    graph at the leg's RESOLVED layout geometry) plus the load
+    prediction for the leg's mesh. None when neither source exists
+    (e.g. a restored device graph without its artifact)."""
+    from pagerank_tpu.obs import graph_profile
+    from pagerank_tpu.parallel import comms
+
+    prof = graph_profile.get_profile()
+    if prof is None and host_graph is not None:
+        group, span = graph_profile.layout_profile_geometry(layout)
+        prof = graph_profile.profile_graph(
+            host_graph, group=group, partition_span=span,
+        )
+        graph_profile.publish(prof)
+    if prof is None:
+        return None
+    pred = comms.predict_from_profile(prof, engine.mesh.devices.size)
+    comms.publish_prediction(pred)
+    prof.prediction = pred
+    return {"profile": prof.summary(), "prediction": pred}
 
 
 def _leg_costs(engine, seconds_per_iter, num_edges, dump_hlo=None,
@@ -578,6 +623,13 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None):
     print(line, file=sys.stderr)
     costs, lowering = _leg_costs(engine, dt / iters, graph.num_edges,
                                  dump_hlo=dump_hlo, label=label)
+    # Fresh per-leg data-plane block (ISSUE 13): each leg profiles at
+    # ITS layout geometry and predicts for ITS mesh size — the
+    # predicted-vs-measured skew pairing lives within one leg.
+    from pagerank_tpu.obs import graph_profile
+
+    graph_profile.reset()
+    graph_block = _leg_graph_block(engine, graph, engine.layout_info())
     leg = {
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
@@ -586,6 +638,7 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None):
         "build_s": t_build,
         "costs": costs,
         "lowering": lowering,
+        "graph": graph_block,
         "layout": engine.layout_info(),
         "comms": engine.comms_model(),
         "bytes_exchanged": bytes_exchanged,
@@ -929,6 +982,7 @@ def main(argv=None):
             "build_s": rate["build_s"],
             "costs": rate["costs"],
             "lowering": rate["lowering"],
+            "graph": rate["graph"],
             "layout": rate["layout"],
             "scale": args.scale,
             "iters": args.iters,
@@ -973,6 +1027,7 @@ def main(argv=None):
         "build_s": pair_rate["build_s"],
         "costs": pair_rate["costs"],  # headline (pair) leg's cost model
         "lowering": pair_rate["lowering"],  # headline lowering verdict
+        "graph": pair_rate["graph"],  # headline data-plane block
         "layout": pair_rate["layout"],
         "fast_f32": f32_rate,  # carries its own "costs" block
         "partitioned_f32": part_rate,

@@ -715,49 +715,61 @@ def check_probe_form(form_engine, form: Form) -> List[Finding]:
     if form_engine._ms_stripe is None:
         args = form_engine._device_args()
         plain = jax.make_jaxpr(form_engine._step_core)(*args)
-        probed_fn = form_engine._get_probed_step(k)
-        probed = jax.make_jaxpr(probed_fn)(*args, prev)
-        if _collective_tally(probed) != _collective_tally(plain):
-            findings.append(_finding(
-                "PTC007",
-                f"probe-enabled step changed the collective structure: "
-                f"plain {_collective_tally(plain)} vs probed "
-                f"{_collective_tally(probed)}",
-                form.name,
-            ))
-        cbs = callback_prims(probed)
-        if cbs:
-            findings.append(_finding(
-                "PTC007",
-                f"probe-enabled step emits host callback(s) "
-                f"{sorted(set(cbs))}",
-                form.name,
-            ))
-        if form.f32:
-            hits = f64_avals(probed)
-            if hits:
+        # Both probed programs are checked: the plain probed step AND
+        # the LEDGER-enabled one a probed run actually dispatches
+        # (ISSUE 13; step_probed prefers the ledger core when the
+        # build stashed one — its three extra reductions must be as
+        # communication-transparent as the probe tail).
+        variants = [("probed", form_engine._get_probed_step(k))]
+        if form_engine._step_core_ledger is not None:
+            variants.append(
+                ("probed+ledger",
+                 form_engine._get_probed_step(k, ledger=True)))
+        for tag, probed_fn in variants:
+            probed = jax.make_jaxpr(probed_fn)(*args, prev)
+            if _collective_tally(probed) != _collective_tally(plain):
                 findings.append(_finding(
                     "PTC007",
-                    "probe tail promotes to f64 in f32 config: "
-                    + "; ".join(sorted(set(hits))[:4]),
+                    f"{tag} step changed the collective structure: "
+                    f"plain {_collective_tally(plain)} vs {tag} "
+                    f"{_collective_tally(probed)}",
                     form.name,
                 ))
-        # The probed step donates the rank buffer exactly like the
-        # plain step — its output set must still carry a matching aval.
-        out_avals = jax.tree_util.tree_leaves(
-            jax.eval_shape(probed_fn, *args, prev)
-        )
-        r_aval = (tuple(args[0].shape), np.dtype(args[0].dtype))
-        if not any(
-            (tuple(o.shape), np.dtype(o.dtype)) == r_aval
-            for o in out_avals
-        ):
-            findings.append(_finding(
-                "PTC007",
-                "probed step has no output aval matching the donated "
-                "rank buffer: donation can never be consumed",
-                form.name,
-            ))
+            cbs = callback_prims(probed)
+            if cbs:
+                findings.append(_finding(
+                    "PTC007",
+                    f"{tag} step emits host callback(s) "
+                    f"{sorted(set(cbs))}",
+                    form.name,
+                ))
+            if form.f32:
+                hits = f64_avals(probed)
+                if hits:
+                    findings.append(_finding(
+                        "PTC007",
+                        f"{tag} tail promotes to f64 in f32 config: "
+                        + "; ".join(sorted(set(hits))[:4]),
+                        form.name,
+                    ))
+            # The probed step donates the rank buffer exactly like the
+            # plain step — its output set must still carry a matching
+            # aval.
+            out_avals = jax.tree_util.tree_leaves(
+                jax.eval_shape(probed_fn, *args, prev)
+            )
+            r_aval = (tuple(args[0].shape), np.dtype(args[0].dtype))
+            if not any(
+                (tuple(o.shape), np.dtype(o.dtype)) == r_aval
+                for o in out_avals
+            ):
+                findings.append(_finding(
+                    "PTC007",
+                    f"{tag} step has no output aval matching the "
+                    "donated rank buffer: donation can never be "
+                    "consumed",
+                    form.name,
+                ))
     else:
         probe_jx = jax.make_jaxpr(form_engine._get_probe_fn(k))(
             form_engine._r, form_engine._valid, prev

@@ -338,6 +338,21 @@ def build_parser() -> argparse.ArgumentParser:
         "booby-trap discipline); jax engine only",
     )
     ob.add_argument(
+        "--graph-profile", action="store_true",
+        help="arm the data-plane profiler (ISSUE 13; "
+        "obs/graph_profile.py): device builds compute the structural "
+        "profile — log2 degree histograms, dedup/self-loop counts, "
+        "top hubs, partition skew, power-law tail — in one fused "
+        "reduction pass during the build; host builds profile in "
+        "numpy after the engine packs. Publishes graph.* gauges, the "
+        "run report's `graph` section (diffed FIRST as data drift by "
+        "`obs report`), the skew-driven load prediction for this "
+        "run's mesh, and — under --job-dir — a checksummed profile "
+        "artifact keyed by graph fingerprint. Off by default: a "
+        "disarmed run makes zero profile computations (the "
+        "tracer/sampler booby-trap discipline)",
+    )
+    ob.add_argument(
         "--stall-timeout", type=float, default=None, metavar="SECONDS",
         help="arm the stall watchdog: if no solve step completes "
         "within SECONDS, log a loud diagnostic (last-completed "
@@ -810,6 +825,49 @@ def _s3_retry_total(paths) -> int:
     return total
 
 
+def _publish_graph_profile(args, cfg, graph, engine, job) -> None:
+    """--graph-profile (ISSUE 13; obs/graph_profile.py): make sure a
+    profile exists and is published — device builds computed it inside
+    the build, resumed jobs restore the checksummed artifact keyed by
+    graph fingerprint, host builds profile in numpy at the layout the
+    engine actually packed — then attach the skew-driven load
+    prediction for this run's mesh (parallel/comms) and persist the
+    job artifact. Best-effort telemetry: never fails the run."""
+    from pagerank_tpu.obs import graph_profile
+    from pagerank_tpu.parallel import comms
+
+    try:
+        prof = graph_profile.get_profile()
+        restored = False
+        if prof is None and job is not None:
+            prof = job.load_profile(graph.fingerprint())
+            if prof is not None:
+                graph_profile.publish(prof)
+                restored = True
+        if prof is None and hasattr(graph, "in_degree"):
+            lay = (engine.layout_info()
+                   if engine is not None
+                   and hasattr(engine, "layout_info") else {})
+            group, span = graph_profile.layout_profile_geometry(lay)
+            prof = graph_profile.profile_graph(
+                graph, group=group, partition_span=span,
+            )
+            graph_profile.publish(prof)
+        if prof is None:
+            return  # device graph restored without its artifact
+        ndev = 1
+        if engine is not None and getattr(engine, "mesh", None) is not None:
+            ndev = engine.mesh.devices.size
+        pred = comms.predict_from_profile(prof, ndev)
+        comms.publish_prediction(pred)
+        prof.prediction = pred
+        if job is not None and not restored:
+            job.save_profile(prof)
+    except Exception as e:  # telemetry must not fail the solve
+        print(f"pagerank_tpu: graph profile publish failed ({e!r})",
+              file=sys.stderr)
+
+
 def _robustness_summary(args, engine, guard) -> dict:
     """The run's robustness counters (docs/ROBUSTNESS.md) as one dict —
     feeds both the stderr summary line and the flight recorder."""
@@ -888,7 +946,8 @@ def _append_history_record(args, cfg, graph, summary, robustness,
             robustness=robustness,
             extra={
                 "graph": {"n": int(graph.n),
-                          "num_edges": int(graph.num_edges)},
+                          "num_edges": int(graph.num_edges),
+                          **obs.graph_profile.report_section()},
                 "engine": args.engine,
             },
         )
@@ -925,8 +984,12 @@ def _export_observability(args, tracer, cfg, graph, metrics, summary,
     if not args.run_report:
         return None
     extra = {
+        # Data plane (ISSUE 13): the graph's identity plus — when
+        # --graph-profile armed the profiler — the structural profile
+        # and load prediction, diffed FIRST by `obs report A B`.
         "graph": (
-            {"n": int(graph.n), "num_edges": int(graph.num_edges)}
+            {"n": int(graph.n), "num_edges": int(graph.num_edges),
+             **obs.graph_profile.report_section()}
             if graph is not None else None
         ),
         "engine": args.engine,
@@ -1217,6 +1280,7 @@ def main(argv=None) -> int:
         obs.disarm_watchdog()
         obs.disarm_sampler()
         obs.disarm_history_baseline()
+        obs.graph_profile.disarm()
 
 
 def _main(argv, ctx) -> int:
@@ -1378,6 +1442,12 @@ def _run(args, ctx, drain) -> int:
     obs.get_registry().reset()
     obs.costs.reset()
     obs.hlo.reset()
+    obs.graph_profile.reset()
+    if args.graph_profile:
+        # Data-plane profiler (ISSUE 13): armed BEFORE the graph load
+        # so a --device-build computes the profile inside the build's
+        # own fused reduction pass; disarmed in main()'s finally.
+        obs.graph_profile.arm()
     tracer = (obs.enable_tracing() if (args.trace or args.run_report)
               else obs.get_tracer())
     ctx["tracer"] = tracer
@@ -1543,6 +1613,8 @@ def _run(args, ctx, drain) -> int:
         summary = {}
         guard = SinkGuard()
         ctx["guard"] = guard
+        if args.graph_profile:
+            _publish_graph_profile(args, cfg, graph, None, job)
     else:
         if job is not None:
             job.begin("solve")
@@ -1555,6 +1627,11 @@ def _run(args, ctx, drain) -> int:
         # A signal during the engine build/compile surfaces here, not
         # after a whole first iteration.
         drain.check("solve")
+        if args.graph_profile:
+            # Published BEFORE the solve so the live exporter carries
+            # graph.* next to the solve gauges; prediction targets the
+            # mesh this run actually built.
+            _publish_graph_profile(args, cfg, graph, engine, job)
 
         # Engine indirection for the elastic path: a rescue REPLACES the
         # engine mid-run (teardown + rebuild over survivors), so every

@@ -94,13 +94,15 @@ class PageRankEngine(abc.ABC):
 
     def probe_values(self, k: int, prev_ids):
         """(rank_mass, entered_count, topk_ids_engine_space,
-        topk_ids_original_space) of the CURRENT state — the standalone
-        probe used at fused-chunk boundaries. ``prev_ids`` is the
-        previous probe's engine-space top-k (None on the first probe);
-        ``entered_count`` is how many current top-k ids are NOT in it.
-        Base impl: host numpy over ranks() (the CPU oracle's own probe
-        — what the device path is parity-tested against). Ties break
-        by lowest id, matching ``lax.top_k``."""
+        topk_ids_original_space, topk_mass) of the CURRENT state — the
+        standalone probe used at fused-chunk boundaries. ``prev_ids``
+        is the previous probe's engine-space top-k (None on the first
+        probe); ``entered_count`` is how many current top-k ids are
+        NOT in it; ``topk_mass`` is the rank mass the top-k hold (the
+        concentration signal, ISSUE 13). Base impl: host numpy over
+        ranks() (the CPU oracle's own probe — what the device path is
+        parity-tested against). Ties break by lowest id, matching
+        ``lax.top_k``."""
         r = np.asarray(self.ranks(), dtype=np.float64)
         k = min(int(k), r.shape[0])
         ids = np.argsort(-r, kind="stable")[:k].astype(np.int64)
@@ -108,23 +110,62 @@ class PageRankEngine(abc.ABC):
             k if prev_ids is None
             else int(k - np.isin(ids, np.asarray(prev_ids)).sum())
         )
-        return float(r.sum()), entered, ids, ids
+        return float(r.sum()), entered, ids, ids, float(r[ids].sum())
+
+    def ledger_values(self):
+        """Raw rank-mass-ledger sums of the step just taken —
+        ``(mass_prev, contrib_total, retained_total)`` measured INSIDE
+        the step, or None when this engine cannot measure them (the
+        ledger fields then stay absent; obs/graph_profile.py
+        ``mass_ledger_entry`` documents the decomposition). The CPU
+        oracle and the JAX engine both override."""
+        return None
+
+    def _ledger_eps(self) -> float:
+        """Machine epsilon of the accumulation dtype the ledger sums
+        were computed in (the dtype-tolerance axis of the ledger)."""
+        return float(np.finfo(np.float64).eps)
+
+    def _ledger_entry(self, info: Dict[str, float]):
+        """Assemble one mass-ledger entry from a probed step's info
+        (requires the ``ledger_*`` sums; obs/graph_profile.py owns the
+        decomposition + leak naming)."""
+        from pagerank_tpu.obs import graph_profile
+
+        return graph_profile.mass_ledger_entry(
+            damping=self.config.damping,
+            semantics=self.config.semantics,
+            n=int(self.graph.n),
+            eps=self._ledger_eps(),
+            mass_prev=info["ledger_mass_prev"],
+            mass=info["rank_mass"],
+            dangling_mass=info["dangling_mass"],
+            contrib_total=info["ledger_contrib_total"],
+            retained_total=info["ledger_retained_total"],
+        )
 
     def step_probed(self, probes):
         """One iteration WITH the convergence probe: returns
         ``(info, (ids_engine, ids_original))`` where ``info`` carries
-        ``rank_mass`` and ``topk_churn`` next to the step scalars.
-        Base impl: plain step() + the host probe; JaxTpuEngine
-        overrides with one fused device dispatch (zero extra host
-        syncs — contract PTC007). Never called when probing is off
-        (the zero-probe-call contract, tests/test_telemetry.py)."""
+        ``rank_mass``, ``topk_churn``, ``topk_mass``, and — when the
+        engine measures the ledger sums — the ``mass_ledger``
+        decomposition (ISSUE 13) next to the step scalars. Base impl:
+        plain step() + the host probe; JaxTpuEngine overrides with one
+        fused device dispatch (zero extra host syncs — contract
+        PTC007). Never called when probing is off (the zero-probe-call
+        contract, tests/test_telemetry.py)."""
         info = self.step()
         prev = probes.prev_ids
-        mass, entered, ids_engine, ids_original = self.probe_values(
-            probes.topk, prev
-        )
+        mass, entered, ids_engine, ids_original, topk_mass = \
+            self.probe_values(probes.topk, prev)
         info["rank_mass"] = mass
         info["topk_churn"] = 0 if prev is None else entered
+        info["topk_mass"] = topk_mass
+        led = self.ledger_values()
+        if led is not None:
+            (info["ledger_mass_prev"], info["ledger_contrib_total"],
+             info["ledger_retained_total"]) = led
+            info["mass_ledger"] = self._ledger_entry(info)
         return info, (ids_engine, ids_original)
 
     def run(
@@ -223,6 +264,20 @@ class PageRankEngine(abc.ABC):
                             f"rank mass drifted {last_mass!r} -> {mass!r} "
                             f"(> mass_tol={rb.mass_tol:g} per step)"
                         )
+                        # Rank-mass ledger (ISSUE 13): on probed steps
+                        # the drift scalar upgrades to a named leak —
+                        # WHICH term of the mass decomposition broke
+                        # (link / teleport / dangling), the diagnostic
+                        # the CLI robustness summary surfaces.
+                        led = info.get("mass_ledger")
+                        if led and led.get("leak"):
+                            self.health["mass_leak"] = led["leak"]
+                            reason += (
+                                f"; mass ledger names the "
+                                f"{led['leak']} term (residual "
+                                f"{led['residual']:.3e}, unaccounted "
+                                f"{led['unaccounted']!r})"
+                            )
                     else:
                         last_mass = mass
             if reason is not None:

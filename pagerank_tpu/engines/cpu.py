@@ -53,12 +53,24 @@ class ReferenceCpuEngine(PageRankEngine):
         r = self._r
         contrib = self._at @ r
         m = float(self._dangling @ r)
+        # Rank-mass-ledger sums (ISSUE 13; obs/graph_profile.py),
+        # MEASURED off the step's own intermediates — three O(n)
+        # reductions the oracle can afford unconditionally; the probed
+        # step reads them via ledger_values().
+        self._last_ledger = (
+            float(r.sum()),
+            float(contrib.sum()),
+            float((self._zero_in * r).sum()),
+        )
         r_new = pr_model.apply_update(
             contrib, r, self._zero_in, m, self.graph.n, cfg.damping, cfg.semantics, np
         )
         delta = float(np.abs(r_new - r).sum())
         self._r = r_new
         return {"dangling_mass": m, "l1_delta": delta}
+
+    def ledger_values(self):
+        return getattr(self, "_last_ledger", None)
 
     def ranks(self) -> np.ndarray:
         return np.asarray(self._r)

@@ -100,6 +100,35 @@ def _pad_rows(a, multiple: int, fill, xp=np):
     return xp.concatenate([a, xp.full(pad_shape, fill, dtype=a.dtype)])
 
 
+def _ledger_sums(contrib, r, zero_in, accum):
+    """Rank-mass-ledger raw sums (ISSUE 13; obs/graph_profile.py) over
+    FULL (replicated) vectors: (contrib_total, retained_total,
+    mass_prev) as accum-dtype scalars. Local reductions only — the
+    ledger-enabled probed step keeps the plain step's exact collective
+    multiset (the PTC007 discipline)."""
+    return (
+        jnp.sum(contrib.astype(accum)),
+        jnp.sum(jnp.where(zero_in, r, jnp.zeros((), r.dtype))
+                .astype(accum)),
+        jnp.sum(r.astype(accum)),
+    )
+
+
+def _ledger_partials(contrib_l, r_l, zin_l, accum):
+    """The sharded twin of :func:`_ledger_sums`: per-shard PARTIAL sums
+    shaped [1] so a ``P(axis)`` out-spec concatenates them to [ndev]
+    and the HOST finishes the reduction — no psum joins the step (the
+    probed program's collective multiset stays exactly the plain
+    step's)."""
+    return (
+        jnp.reshape(jnp.sum(contrib_l.astype(accum)), (1,)),
+        jnp.reshape(
+            jnp.sum(jnp.where(zin_l, r_l, jnp.zeros((), r_l.dtype))
+                    .astype(accum)), (1,)),
+        jnp.reshape(jnp.sum(r_l.astype(accum)), (1,)),
+    )
+
+
 @register_engine("jax")
 class JaxTpuEngine(PageRankEngine):
     """Sharded power iteration over a 1-D device mesh."""
@@ -132,6 +161,8 @@ class JaxTpuEngine(PageRankEngine):
         self._exchange_core = None
         self._exchange_fn = None
         self._lowering_cache = None
+        self._step_core_ledger = None
+        self._ms_final_ledger = None
 
     # -- build ------------------------------------------------------------
 
@@ -151,6 +182,11 @@ class JaxTpuEngine(PageRankEngine):
         self._exchange_core = None
         self._exchange_fn = None
         self._lowering_cache = None
+        # Rank-mass-ledger step variants (ISSUE 13): every setup path
+        # that supports the ledger reassigns these; a rebuild into a
+        # form that doesn't must not inherit the previous layout's.
+        self._step_core_ledger = None
+        self._ms_final_ledger = None
         self._mesh = mesh_lib.make_mesh(
             cfg.num_devices, cfg.mesh_axis, devices=self._devices
         )
@@ -1633,10 +1669,9 @@ class JaxTpuEngine(PageRankEngine):
 
         update_tail = self._update_tail  # set by _finalize, shared
 
-        def final_body(r, *rest):
+        def _merge_parts(rest):
             parts = rest[:n_stripes]
             ids_l = rest[n_stripes : 2 * n_stripes]
-            dangling, zero_in, valid_m = rest[2 * n_stripes :]
             total = jnp.zeros((num_blocks, 128), accum)
             for s in range(n_stripes):
                 # .sum(0) collapses the per-device partials (GSPMD turns
@@ -1645,10 +1680,26 @@ class JaxTpuEngine(PageRankEngine):
                 total = spmv.scatter_block_sums(
                     total, parts[s].sum(0), ids_l[s], prefix_flags[s]
                 )
-            contrib = total.reshape(-1)[: r.shape[0]]
+            return total
+
+        def final_body(r, *rest):
+            dangling, zero_in, valid_m = rest[2 * n_stripes :]
+            contrib = _merge_parts(rest).reshape(-1)[: r.shape[0]]
             return update_tail(contrib, r, dangling, zero_in, valid_m)
 
+        def final_body_ledger(r, *rest):
+            # The ledger finalize (ISSUE 13): same merge + three local
+            # reductions; a separate lazily-compiled executable so the
+            # plain dispatch sequence never carries them.
+            dangling, zero_in, valid_m = rest[2 * n_stripes :]
+            contrib = _merge_parts(rest).reshape(-1)[: r.shape[0]]
+            led = _ledger_sums(contrib, r, zero_in, accum)
+            return (*update_tail(contrib, r, dangling, zero_in,
+                                 valid_m), *led)
+
         self._ms_final = jax.jit(final_body, donate_argnums=(0,))
+        self._ms_final_ledger = jax.jit(final_body_ledger,
+                                        donate_argnums=(0,))
         self._ms_ids = list(ids)
         self._ms_n_stripes = n_stripes
         self._layout = dict(self._layout, form="multi_dispatch")
@@ -1934,12 +1985,30 @@ class JaxTpuEngine(PageRankEngine):
             contrib_l = merge_scatter(total)
             return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
+        def vs_body_ledger(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+            zs = gather_z(r_l, inv_l)
+            total = accumulate_stripes(zs, rest)
+            contrib_l = merge_scatter(total)
+            out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+            # Ledger sums ride as [1] per-shard partials (out P(axis)
+            # -> [ndev] on host) — no collective joins the step
+            # (_ledger_partials docstring).
+            return (*out, *_ledger_partials(contrib_l, r_l, zin_l,
+                                            accum))
+
+        vs_in_specs = (P(axis),) * 5 \
+            + (P(axis, None), P(axis), P()) * n_stripes
         step_core = shard_map(
             vs_body,
             mesh=mesh,
-            in_specs=(P(axis),) * 5
-            + (P(axis, None), P(axis), P()) * n_stripes,
+            in_specs=vs_in_specs,
             out_specs=(P(axis), P(), P()),
+        )
+        self._step_core_ledger = shard_map(
+            vs_body_ledger,
+            mesh=mesh,
+            in_specs=vs_in_specs,
+            out_specs=(P(axis), P(), P()) + (P(axis),) * 3,
         )
 
         self._contrib_args = tuple(
@@ -2131,12 +2200,31 @@ class JaxTpuEngine(PageRankEngine):
             contrib_l = merge_sparse(total, halo)
             return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
+        def vs_body_ledger(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+            halo, stripes = rest[:n_halo], rest[n_halo:]
+            zs = gather_z_sparse(r_l, inv_l, halo)
+            total = accumulate_stripes(zs, stripes)
+            contrib_l = merge_sparse(total, halo)
+            out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+            # Each position's contribution lands at its owner exactly
+            # once (the window/trash-band construction), so per-shard
+            # sums of contrib_l add to the full contribution total.
+            return (*out, *_ledger_partials(contrib_l, r_l, zin_l,
+                                            accum))
+
+        halo_in_specs = (P(axis),) * 5 + tuple(halo_specs) \
+            + (P(axis, None), P(axis), P()) * n_stripes
         step_core = shard_map(
             vs_body,
             mesh=mesh,
-            in_specs=(P(axis),) * 5 + tuple(halo_specs)
-            + (P(axis, None), P(axis), P()) * n_stripes,
+            in_specs=halo_in_specs,
             out_specs=(P(axis), P(), P()),
+        )
+        self._step_core_ledger = shard_map(
+            vs_body_ledger,
+            mesh=mesh,
+            in_specs=halo_in_specs,
+            out_specs=(P(axis), P(), P()) + (P(axis),) * 3,
         )
 
         self._contrib_args = tuple(halo_args) + tuple(
@@ -2204,11 +2292,12 @@ class JaxTpuEngine(PageRankEngine):
         self._ms_stripe = self._ms_stripe_fns[0]
         vs_tail = self._vs_tail
 
-        def final_body(r_l, *rest):
+        accum_dt = self._accum_dtype
+
+        def _merge_vs_parts(rest):
             parts = rest[:n_stripes]
             ids_l = rest[n_stripes : 2 * n_stripes]
-            dang_l, zin_l, valid_l = rest[2 * n_stripes :]
-            total = jnp.zeros((num_blocks, 128), accum)
+            total = jnp.zeros((num_blocks, 128), accum_dt)
             for s in range(n_stripes):
                 # parts[s] is this device's OWN compact partial
                 # ([1, Ps, 128] under the P(axis, None, None) spec);
@@ -2217,18 +2306,39 @@ class JaxTpuEngine(PageRankEngine):
                 total = spmv.scatter_block_sums(
                     total, parts[s][0], ids_l[s], prefix_flags[s]
                 )
-            contrib_l = merge_scatter(total)
+            return total
+
+        def final_body(r_l, *rest):
+            dang_l, zin_l, valid_l = rest[2 * n_stripes :]
+            contrib_l = merge_scatter(_merge_vs_parts(rest))
             return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
+        def final_body_ledger(r_l, *rest):
+            dang_l, zin_l, valid_l = rest[2 * n_stripes :]
+            contrib_l = merge_scatter(_merge_vs_parts(rest))
+            out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+            return (*out, *_ledger_partials(contrib_l, r_l, zin_l,
+                                            accum_dt))
+
+        ms_in_specs = (P(axis),) \
+            + (P(axis, None, None),) * n_stripes \
+            + (P(),) * n_stripes \
+            + (P(axis),) * 3
         self._ms_final = jax.jit(
             shard_map(
                 final_body,
                 mesh=mesh,
-                in_specs=(P(axis),)
-                + (P(axis, None, None),) * n_stripes
-                + (P(),) * n_stripes
-                + (P(axis),) * 3,
+                in_specs=ms_in_specs,
                 out_specs=(P(axis), P(), P()),
+            ),
+            donate_argnums=(0,),
+        )
+        self._ms_final_ledger = jax.jit(
+            shard_map(
+                final_body_ledger,
+                mesh=mesh,
+                in_specs=ms_in_specs,
+                out_specs=(P(axis), P(), P()) + (P(axis),) * 3,
             ),
             donate_argnums=(0,),
         )
@@ -2481,7 +2591,7 @@ class JaxTpuEngine(PageRankEngine):
         )
 
         if not multi_dispatch:
-            def vs_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+            def _vsb_contrib(r_l, inv_l, rest):
                 z_l = r_l.astype(zd) * inv_l
                 total = jnp.zeros((nbd + trash, 128), accum)
                 for s in range(S):
@@ -2494,17 +2604,33 @@ class JaxTpuEngine(PageRankEngine):
                         part, indices_are_sorted=True,
                         unique_indices=True,
                     )
-                contrib_l = total[:nbd].reshape(-1)
+                return total[:nbd].reshape(-1)
+
+            def vs_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
+                contrib_l = _vsb_contrib(r_l, inv_l, rest)
                 return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
+            def vs_body_ledger(r_l, inv_l, dang_l, zin_l, valid_l,
+                               *rest):
+                contrib_l = _vsb_contrib(r_l, inv_l, rest)
+                out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+                return (*out, *_ledger_partials(contrib_l, r_l, zin_l,
+                                                accum))
+
+            vsb_in_specs = (P(axis),) * 5 \
+                + (P(axis, None), P(axis), P(axis, None)) * S
             step_core = shard_map(
                 vs_body, mesh=mesh,
-                in_specs=(P(axis),) * 5
-                + (P(axis, None), P(axis), P(axis, None)) * S,
+                in_specs=vsb_in_specs,
                 out_specs=(P(axis), P(), P()),
             )
             self._step_core = step_core
             self._step_fn = self._jit_step(step_core)
+            self._step_core_ledger = shard_map(
+                vs_body_ledger, mesh=mesh,
+                in_specs=vsb_in_specs,
+                out_specs=(P(axis), P(), P()) + (P(axis),) * 3,
+            )
             return
 
         # -- multi-dispatch form (past SCAN_STRIPE_UNITS) ------------------
@@ -2550,10 +2676,9 @@ class JaxTpuEngine(PageRankEngine):
         ]
         self._ms_stripe = self._ms_stripe_fns[0]
 
-        def final_body(r_l, *rest):
+        def _vsb_merge(rest):
             parts = rest[:S]
             ids_l = rest[S : 2 * S]
-            dang_l, zin_l, valid_l = rest[2 * S :]
             total = jnp.zeros((nbd + trash, 128), accum)
             for s in range(S):
                 # Stage (b): each device's partials land ONLY in its
@@ -2562,17 +2687,37 @@ class JaxTpuEngine(PageRankEngine):
                     parts[s][0], indices_are_sorted=True,
                     unique_indices=True,
                 )
-            contrib_l = total[:nbd].reshape(-1)
+            return total[:nbd].reshape(-1)
+
+        def final_body(r_l, *rest):
+            dang_l, zin_l, valid_l = rest[2 * S :]
+            contrib_l = _vsb_merge(rest)
             return vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
 
+        def final_body_ledger(r_l, *rest):
+            dang_l, zin_l, valid_l = rest[2 * S :]
+            contrib_l = _vsb_merge(rest)
+            out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+            return (*out, *_ledger_partials(contrib_l, r_l, zin_l,
+                                            accum))
+
+        vsb_ms_in_specs = (P(axis),) \
+            + (P(axis, None, None),) * S \
+            + (P(axis, None),) * S \
+            + (P(axis),) * 3
         self._ms_final = jax.jit(
             shard_map(
                 final_body, mesh=mesh,
-                in_specs=(P(axis),)
-                + (P(axis, None, None),) * S
-                + (P(axis, None),) * S
-                + (P(axis),) * 3,
+                in_specs=vsb_ms_in_specs,
                 out_specs=(P(axis), P(), P()),
+            ),
+            donate_argnums=(0,),
+        )
+        self._ms_final_ledger = jax.jit(
+            shard_map(
+                final_body_ledger, mesh=mesh,
+                in_specs=vsb_ms_in_specs,
+                out_specs=(P(axis), P(), P()) + (P(axis),) * 3,
             ),
             donate_argnums=(0,),
         )
@@ -2638,6 +2783,13 @@ class JaxTpuEngine(PageRankEngine):
             def step_core(r, dangling, zero_in, valid_m, *c_args):
                 contrib = contrib_fn(r, *c_args)[: r.shape[0]]
                 return update_tail(contrib, r, dangling, zero_in, valid_m)
+
+            def step_core_ledger(r, dangling, zero_in, valid_m,
+                                 *c_args):
+                contrib = contrib_fn(r, *c_args)[: r.shape[0]]
+                led = _ledger_sums(contrib, r, zero_in, accum)
+                return (*update_tail(contrib, r, dangling, zero_in,
+                                     valid_m), *led)
         else:
             def step_core(r, inv, dangling, zero_in, valid_m, *c_args):
                 z = prescale(r, inv)
@@ -2645,6 +2797,20 @@ class JaxTpuEngine(PageRankEngine):
                 contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
                 return update_tail(contrib, r, dangling, zero_in, valid_m)
 
+            def step_core_ledger(r, inv, dangling, zero_in, valid_m,
+                                 *c_args):
+                z = prescale(r, inv)
+                zs = z if isinstance(z, tuple) else (z,)
+                contrib = contrib_fn(*zs, *c_args)[: r.shape[0]]
+                led = _ledger_sums(contrib, r, zero_in, accum)
+                return (*update_tail(contrib, r, dangling, zero_in,
+                                     valid_m), *led)
+
+        # Rank-mass-ledger step variant (ISSUE 13): the SAME body plus
+        # three local reductions over intermediates the plain step
+        # already computes — compiled lazily only when a probed run
+        # wants the ledger (step_probed), so plain runs never pay.
+        self._step_core_ledger = step_core_ledger
         self._contrib_args = contrib_args
         self._step_core = step_core
         self._step_fn = jax.jit(step_core, donate_argnums=(0,))
@@ -2854,13 +3020,19 @@ class JaxTpuEngine(PageRankEngine):
         def tail(r, valid_m, prev_ids):
             mass = jnp.sum(r.astype(accum))
             rv = jnp.where(valid_m, r, -jnp.inf)
-            _vals, ids = jax.lax.top_k(rv, k)
+            vals, ids = jax.lax.top_k(rv, k)
             ids = ids.astype(jnp.int32)
             entered = jnp.sum(
                 (ids[:, None] != prev_ids[None, :]).all(axis=1),
                 dtype=jnp.int32,
             )
-            return mass, ids, entered
+            # Top-k rank concentration (ISSUE 13): the mass the top-k
+            # hold — -inf fillers (k > valid lanes) masked out.
+            topk_mass = jnp.sum(
+                jnp.where(jnp.isfinite(vals), vals,
+                          jnp.zeros((), vals.dtype)).astype(accum)
+            )
+            return mass, ids, entered, topk_mass
 
         return tail
 
@@ -2876,17 +3048,21 @@ class JaxTpuEngine(PageRankEngine):
             self._fused_cache[key] = fn
         return fn
 
-    def _get_probed_step(self, k: int):
+    def _get_probed_step(self, k: int, ledger: bool = False):
         """The probe-enabled step: ONE jitted program running the
         step body plus the probe tail on its output — probing adds no
         extra dispatch, no host callback, and no collective beyond the
         form's own budget (the tail is elementwise + top_k on the
         already-merged rank vector; contract PTC007 proves it). The
-        rank buffer stays donated exactly like the plain step."""
-        key = ("probe_step", k)
+        rank buffer stays donated exactly like the plain step.
+        ``ledger=True`` runs the rank-mass-ledger core instead (same
+        body + three local reductions — ISSUE 13; the collective
+        multiset still matches the plain step's), appending the raw
+        ledger sums to the outputs."""
+        key = ("probe_step_ledger" if ledger else "probe_step", k)
         fn = self._fused_cache.get(key)
         if fn is None:
-            core = self._step_core
+            core = self._step_core_ledger if ledger else self._step_core
             tail = self._probe_tail(k)
             # valid's position in the device-args tail (see
             # _device_args: prescaled forms carry inv at index 1).
@@ -2895,9 +3071,11 @@ class JaxTpuEngine(PageRankEngine):
             def probed(*args):
                 prev_ids = args[-1]
                 core_args = args[:-1]
-                r2, delta, m = core(*core_args)
-                mass, ids, entered = tail(r2, core_args[vi], prev_ids)
-                return r2, delta, m, mass, ids, entered
+                r2, delta, m, *led = core(*core_args)
+                mass, ids, entered, topk_mass = tail(
+                    r2, core_args[vi], prev_ids)
+                return (r2, delta, m, mass, ids, entered, topk_mass,
+                        *led)
 
             from pagerank_tpu.utils.compile_cache import usable_donations
 
@@ -2921,43 +3099,93 @@ class JaxTpuEngine(PageRankEngine):
         k = self._resolve_probe_k(k)
         prev_dev = (jnp.full((k,), jnp.int32(-1)) if prev_ids is None
                     else prev_ids)
-        mass, ids, entered = self._get_probe_fn(k)(
+        mass, ids, entered, topk_mass = self._get_probe_fn(k)(
             self._r, self._valid, prev_dev
         )
-        mass_h, ent_h, ids_np = jax.device_get((mass, entered, ids))
+        mass_h, ent_h, ids_np, tm_h = jax.device_get(
+            (mass, entered, ids, topk_mass))
         ids_np = np.asarray(ids_np)
         ids_orig = self._perm[ids_np] if self._perm is not None else ids_np
-        return float(mass_h), int(ent_h), ids, np.asarray(ids_orig)
+        return (float(mass_h), int(ent_h), ids, np.asarray(ids_orig),
+                float(tm_h))
+
+    def _ledger_eps(self) -> float:
+        return float(jnp.finfo(self._accum_dtype).eps)
+
+    def _device_step_ledger(self):
+        """The multi-dispatch sequence with the LEDGER finalize
+        (ISSUE 13): same prescale + per-stripe dispatches, the
+        ``_ms_final_ledger`` executable in place of the plain finalize.
+        Returns (delta, mass, (contrib_p, retained_p, prev_p)) — the
+        ledger values as device arrays (per-shard partials on the
+        sharded forms), fetched by step_probed's one device_get."""
+        zs = self._ms_prescale(self._r, self._inv_out)
+        parts = [
+            self._ms_stripe_fns[s](
+                *zs, self._src[s], self._row_block[s]
+            )
+            for s in range(self._ms_n_stripes)
+        ]
+        self._r, delta, m, lk, rt, pv = self._ms_final_ledger(
+            self._r, *parts, *self._ms_ids,
+            self._dangling, self._zero_in, self._valid,
+        )
+        self._note_comms(1)
+        return delta, m, (lk, rt, pv)
 
     def step_probed(self, probes):
         """One iteration + probe in a single device dispatch (the
         multi-dispatch layouts append one standalone probe dispatch to
         their pipelined sequence instead — still zero extra host
         syncs: everything is fetched in the ONE device_get the
-        stepwise loop already pays per iteration)."""
+        stepwise loop already pays per iteration). When the build
+        stashed a ledger core (every form except a pallas downgrade's
+        edge cases), the probed step ALSO measures the rank-mass
+        ledger sums and the info carries the named decomposition
+        (ISSUE 13; obs/graph_profile.mass_ledger_entry)."""
         k = self._resolve_probe_k(probes.topk)
         prev = probes.prev_ids
         prev_dev = jnp.full((k,), jnp.int32(-1)) if prev is None else prev
+        led = None
         if self._ms_stripe is not None:
-            delta, m = self._device_step()
-            mass, ids, entered = self._get_probe_fn(k)(
+            if self._ms_final_ledger is not None:
+                delta, m, led = self._device_step_ledger()
+            else:
+                delta, m = self._device_step()
+            mass, ids, entered, topk_mass = self._get_probe_fn(k)(
                 self._r, self._valid, prev_dev
             )
+        elif self._step_core_ledger is not None:
+            fn = self._get_probed_step(k, ledger=True)
+            (self._r, delta, m, mass, ids, entered, topk_mass,
+             *led) = fn(*self._device_args(), prev_dev)
+            self._note_comms(1)
         else:
             fn = self._get_probed_step(k)
-            self._r, delta, m, mass, ids, entered = fn(
+            self._r, delta, m, mass, ids, entered, topk_mass = fn(
                 *self._device_args(), prev_dev
             )
             self._note_comms(1)
-        d_h, m_h, mass_h, ent_h, ids_np = jax.device_get(
-            (delta, m, mass, entered, ids)
-        )
+        fetch = [delta, m, mass, entered, ids, topk_mass]
+        if led:
+            fetch.extend(led)
+        host = jax.device_get(tuple(fetch))
+        d_h, m_h, mass_h, ent_h, ids_np, tm_h = host[:6]
         info = {
             "l1_delta": float(d_h),
             "dangling_mass": float(m_h),
             "rank_mass": float(mass_h),
             "topk_churn": 0 if prev is None else int(ent_h),
+            "topk_mass": float(tm_h),
         }
+        if led:
+            # Sharded forms return per-shard partials ([ndev]); the
+            # host finishes the reduction (no step collective).
+            lk_h, rt_h, pv_h = host[6:9]
+            info["ledger_contrib_total"] = float(np.asarray(lk_h).sum())
+            info["ledger_retained_total"] = float(np.asarray(rt_h).sum())
+            info["ledger_mass_prev"] = float(np.asarray(pv_h).sum())
+            info["mass_ledger"] = self._ledger_entry(info)
         ids_np = np.asarray(ids_np)
         ids_orig = self._perm[ids_np] if self._perm is not None else ids_np
         return info, (ids, np.asarray(ids_orig))

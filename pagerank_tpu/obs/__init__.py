@@ -53,6 +53,19 @@ perf-history ledger. Surfaced via ``engine.lowering_reports()``,
 bench/CLI ``--dump-hlo``, contracts PTH001-003, and
 ``python -m pagerank_tpu.obs hlo``.
 
+ISSUE 13 adds the **data plane** (obs/graph_profile.py): the graph
+itself as telemetry — on-device structural profiling during the
+build (log2 degree histograms, dedup/self-loop counts, hub ids,
+partition-skew geometry, a power-law tail estimate), the rank-mass
+conservation LEDGER riding the convergence probes (link / teleport /
+dangling decomposition with a named leak location), and skew-driven
+load prediction (parallel/comms.predict_from_profile: per-device
+imbalance + halo head-K predicted BEFORE any build). Surfaced via
+``python -m pagerank_tpu.obs graph``, CLI ``--graph-profile``, the
+run report's ``graph`` section (diffed FIRST as data drift), bench
+legs' ``graph`` blocks, and per-leg profile scalars in the perf
+ledger (a data change gates distinctly from a program or env change).
+
 Plus :func:`profiler_session` (obs/profiler.py), the jax.profiler
 lifecycle as a tracer-composed context manager, and :mod:`obs.log`,
 the sanctioned stderr channel for library diagnostics (lint PTL007).
@@ -61,7 +74,7 @@ Import cost: stdlib only (jax is imported lazily inside the functions
 that need it), so any utils module can depend on obs without cycles.
 """
 
-from pagerank_tpu.obs import costs, devices, history, hlo
+from pagerank_tpu.obs import costs, devices, graph_profile, history, hlo
 from pagerank_tpu.obs.devices import (
     DeviceSampler,
     arm_sampler,
@@ -111,6 +124,7 @@ from pagerank_tpu.obs.trace import (
 __all__ = [
     "costs",
     "devices",
+    "graph_profile",
     "history",
     "hlo",
     "DeviceSampler",

@@ -31,6 +31,20 @@ artifacts, the perf-history ledger, and the OOM-preflight fit check.
                                   nonzero with the per-stage table
                                   when it provably does not fit
 
+  graph --scale N [--ndev D]      data-plane inspection (ISSUE 13;
+                                  obs/graph_profile.py): build a
+                                  synthetic graph with the profiler
+                                  armed, print the structural profile
+                                  (degree histograms, dedup/self-loop
+                                  counts, hubs, partition skew,
+                                  power-law tail), the skew-driven
+                                  load prediction for --ndev devices
+                                  (+ the measured per-device edge
+                                  counts when the mesh exists), and
+                                  run a short PROBED solve whose
+                                  rank-mass ledger must reconcile —
+                                  exit 1 on any ledger violation
+
   hlo --form F [--scale N]        compiler-plane inspection (ISSUE 11;
                                   obs/hlo.py): build the named
                                   dispatch form(s) at the target
@@ -46,8 +60,8 @@ artifacts, the perf-history ledger, and the OOM-preflight fit check.
                                   signature); --dump-hlo DIR writes
                                   the raw modules for offline diffing
 
-Exit codes: 0 ok, 1 gate violation / does not fit / defeated gather,
-2 usage/unreadable input.
+Exit codes: 0 ok, 1 gate violation / does not fit / defeated gather /
+mass-ledger violation, 2 usage/unreadable input.
 """
 
 from __future__ import annotations
@@ -169,6 +183,40 @@ def build_parser() -> argparse.ArgumentParser:
                     "reserve (default 0.9)")
     fp.add_argument("--json", action="store_true",
                     help="emit the FitResult as JSON")
+    gp = sub.add_parser(
+        "graph",
+        help="data-plane inspection (ISSUE 13; obs/graph_profile.py): "
+        "structural profile + skew-driven load prediction + the "
+        "rank-mass conservation ledger over a short probed solve — "
+        "exit 1 on a ledger violation",
+    )
+    gp.add_argument("--scale", type=int, default=14,
+                    help="R-MAT scale of the probe graph (default 14)")
+    gp.add_argument("--edge-factor", type=int, default=16)
+    gp.add_argument("--synthetic", default=None, metavar="SPEC",
+                    help="synthetic spec (the CLI grammar: rmat:N | "
+                    "uniform:N[:E]) instead of --scale")
+    gp.add_argument("--ndev", type=int, default=1,
+                    help="target device count for the load prediction; "
+                    "> 1 also runs the vertex-sharded solve and "
+                    "reports MEASURED per-device edge counts when the "
+                    "mesh exists")
+    gp.add_argument("--iters", type=int, default=4,
+                    help="probed solve iterations for the ledger check")
+    gp.add_argument("--device-build", action="store_true",
+                    help="profile via the on-device build's fused "
+                    "reduction pass (default: host build + numpy "
+                    "profile)")
+    gp.add_argument("--semantics", choices=["reference", "textbook"],
+                    default="textbook",
+                    help="solve semantics for the ledger check "
+                    "(textbook sums to 1 — the default gate)")
+    gp.add_argument("--topk", type=int, default=100,
+                    help="hub count / rank-concentration k "
+                    "(default 100)")
+    gp.add_argument("--json", action="store_true",
+                    help="emit {profile, prediction, measured, ledger} "
+                    "as strict JSON")
     hp2 = sub.add_parser(
         "hlo",
         help="compiler-plane lowering inspection (ISSUE 11; "
@@ -195,6 +243,187 @@ def build_parser() -> argparse.ArgumentParser:
                      help="also write every inspected program's raw "
                      "optimized HLO to DIR as <form>.<program>.hlo")
     return p
+
+
+def _cmd_graph(args) -> int:
+    """Data-plane inspection (ISSUE 13): profile -> prediction ->
+    measured -> ledger, nonzero exit on a ledger violation."""
+    from pagerank_tpu import PageRankConfig, build_graph
+    from pagerank_tpu.engine import make_engine
+    from pagerank_tpu.obs import graph_profile
+    from pagerank_tpu.obs.probes import ConvergenceProbes
+    from pagerank_tpu.parallel import comms
+
+    if args.iters < 1 or args.ndev < 1:
+        print("obs graph: --iters and --ndev must be >= 1",
+              file=sys.stderr)
+        return 2
+    # Synthetic geometry through THE shared spec grammar (cli.py) so
+    # `obs graph` and the CLI can never disagree about what a spec
+    # means; --scale is shorthand for rmat:N.
+    kind, scale, n, e = "rmat", args.scale, 1 << args.scale, None
+    if args.synthetic:
+        from pagerank_tpu.cli import _parse_synthetic_geometry
+
+        geo = _parse_synthetic_geometry(args.synthetic)
+        if geo is None:
+            print(f"obs graph: unknown synthetic spec "
+                  f"{args.synthetic!r}", file=sys.stderr)
+            return 2
+        kind, n, e, scale = geo
+
+    import jax
+
+    avail = len(jax.devices())
+    run_ndev = min(args.ndev, avail)
+    if run_ndev < args.ndev:
+        print(f"obs graph: {args.ndev} devices requested, {avail} "
+              f"available — prediction targets {args.ndev}, the "
+              f"measured solve runs on {run_ndev}", file=sys.stderr)
+
+    graph_profile.reset()
+    graph_profile.arm()
+    try:
+        cfg = PageRankConfig(
+            num_iters=args.iters, semantics=args.semantics,
+            probe_every=1, probe_topk=args.topk,
+            vertex_sharded=run_ndev > 1,
+            num_devices=run_ndev if run_ndev > 1 else None,
+        ).validate()
+        if args.device_build:
+            from pagerank_tpu.ops import device_build as db
+
+            if kind == "rmat":
+                src, dst = db.rmat_edges_device(
+                    scale, edge_factor=args.edge_factor, seed=0)
+            else:
+                src, dst = db.uniform_edges_device(n, e, seed=0)
+            grp, stripe, _part = db.plan_build(cfg, n,
+                                               num_edges=len(src))
+            dg = db.build_ell_device(src, dst, n=n, group=grp,
+                                     stripe_size=stripe)
+            profile = graph_profile.get_profile()
+            engine = make_engine("jax", cfg).build_device(dg)
+        else:
+            from pagerank_tpu.utils import synth
+
+            if kind == "rmat":
+                src, dst = synth.rmat_edges(scale, args.edge_factor,
+                                            seed=0)
+                g = build_graph(src, dst, n=n)
+            else:
+                src, dst = synth.uniform_edges(n, e)
+                g = build_graph(src, dst, n=n)
+            engine = make_engine("jax", cfg).build(g)
+            # Profile at the layout the engine ACTUALLY packed (the
+            # lane group shapes the row geometry the load prediction
+            # walks; shared derivation — CLI/bench use the same one).
+            group, span = graph_profile.layout_profile_geometry(
+                engine.layout_info())
+            profile = graph_profile.profile_graph(
+                g, group=group, partition_span=span, topk=args.topk,
+            )
+            graph_profile.publish(profile)
+
+        prediction = comms.predict_from_profile(profile, args.ndev)
+        comms.publish_prediction(prediction)
+        if profile is not None:
+            profile.prediction = prediction
+
+        probes = ConvergenceProbes(1, topk=args.topk)
+        engine.run(probes=probes)
+
+        measured = None
+        if run_ndev > 1:
+            counts = comms.measured_device_edges(engine)
+            if counts is not None and counts.sum() > 0:
+                mean = float(counts.sum()) / len(counts)
+                measured = {
+                    "ndev": int(len(counts)),
+                    "device_edges": [int(v) for v in counts],
+                    "straggler_skew": float(counts.max() / mean),
+                }
+    finally:
+        graph_profile.disarm()
+
+    residuals = [abs((r.get("mass_ledger") or {}).get("residual", 0.0))
+                 for r in probes.history if r.get("mass_ledger")]
+    entries = sum(1 for r in probes.history if r.get("mass_ledger"))
+    ledger = {
+        "probes": len(probes.history),
+        "entries": entries,
+        "max_abs_residual": max(residuals) if residuals else None,
+        "violations": [
+            {k: v for k, v in rec.items()}
+            for rec in probes.ledger_violations
+        ],
+        # NOT vacuous: a run whose probed steps never measured the
+        # ledger (a form without a ledger core) must FAIL the gate —
+        # "no evidence" is not "reconciled".
+        "ok": (entries == len(probes.history) and entries > 0
+               and not probes.ledger_violations),
+    }
+    doc = {
+        "profile": profile.summary() if profile is not None else None,
+        "prediction": prediction,
+        "measured": measured,
+        "ledger": ledger,
+    }
+    if args.json:
+        print(json.dumps(report_mod._json_safe(doc), indent=2,
+                         allow_nan=False))
+    else:
+        prof = doc["profile"] or {}
+        print(f"graph profile ({prof.get('source')}): n={prof.get('n'):,}, "
+              f"{prof.get('num_edges'):,} unique edges"
+              + (f" ({prof.get('duplicate_edges'):,} dups)"
+                 if prof.get("duplicate_edges") is not None else "")
+              + (f", {prof.get('self_loops'):,} self-loops"
+                 if prof.get("self_loops") is not None else ""))
+        print(f"  dangling {prof.get('dangling_fraction', 0):.3%} "
+              f"({prof.get('dangling_count'):,}), zero-in "
+              f"{prof.get('zero_in_count'):,}")
+        print(f"  in-degree hist (log2 bins): "
+              f"{_fmt_hist(prof.get('in_hist') or [])}")
+        print(f"  out-degree hist (log2 bins): "
+              f"{_fmt_hist(prof.get('out_hist') or [])}")
+        hubs = list(zip(prof.get("top_hub_ids") or [],
+                        prof.get("top_hub_in_degrees") or []))[:8]
+        print("  top hubs (id:in-degree): "
+              + ", ".join(f"{i}:{d}" for i, d in hubs))
+        if prof.get("partition_skew") is not None:
+            print(f"  partition skew (max/mean over "
+                  f"{len(prof.get('partition_edges') or [])} "
+                  f"partition(s)): {prof['partition_skew']:.3f}")
+        if prof.get("powerlaw_alpha") is not None:
+            print(f"  power-law tail alpha ~ "
+                  f"{prof['powerlaw_alpha']:.2f}")
+        if prediction:
+            print(f"predicted @ ndev {prediction['ndev']}: straggler "
+                  f"skew {prediction.get('predicted_straggler_skew')}, "
+                  f"halo head-K "
+                  f"{prediction.get('predicted_halo_head_k')}")
+        if measured:
+            print(f"measured  @ ndev {measured['ndev']}: straggler "
+                  f"skew {measured['straggler_skew']:.4f} "
+                  f"(per-device edges {measured['device_edges']})")
+        print(f"mass ledger: {ledger['entries']}/{ledger['probes']} "
+              f"probed iteration(s) reconciled"
+              + (f", max |residual| {ledger['max_abs_residual']:.3e}"
+                 if ledger["max_abs_residual"] is not None else "")
+              + (" -> OK" if ledger["ok"] else
+                 f" -> {len(ledger['violations'])} VIOLATION(S)"))
+        for v in ledger["violations"]:
+            print(f"  iteration {v.get('iteration')}: {v.get('leak')} "
+                  f"term leaked (residual {v.get('residual'):.3e})")
+    return 0 if ledger["ok"] else 1
+
+
+def _fmt_hist(hist) -> str:
+    top = max(len(hist) - 1, 0)
+    while top > 0 and not hist[top]:
+        top -= 1
+    return "[" + " ".join(str(int(v)) for v in hist[:top + 1]) + "]"
 
 
 def _cmd_hlo(args) -> int:
@@ -415,6 +644,8 @@ def main(argv=None) -> int:
         return _cmd_fit(args)
     if args.command == "hlo":
         return _cmd_hlo(args)
+    if args.command == "graph":
+        return _cmd_graph(args)
     return _cmd_history(args)
 
 

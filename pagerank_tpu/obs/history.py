@@ -81,7 +81,23 @@ LEG_METRICS = (
     # ``lowering_fingerprint`` / ``gather_strategy`` the trend and
     # classifier read).
     "hlo_bytes_per_edge",
+    # ISSUE 13: the data plane's profile scalars — a DATA change (new
+    # crawl segment, different synthetic seed/skew) gates distinctly
+    # from a program or env change: classify_change attributes a flag
+    # whose cost model is flat but whose profile scalars moved as
+    # **data-change** (warns, never fails). Pre-ISSUE-13 ledger rows
+    # simply lack the keys (no re-ingest, no fork).
+    "graph_dangling_fraction",
+    "graph_partition_skew",
+    "graph_topk_concentration",
 )
+
+#: Profile scalars whose motion marks the DATA axis (classify_change
+#: rule 1c) — and the relative motion treated as "the data changed"
+#: (the profile is exact arithmetic over the graph, so anything beyond
+#: float formatting noise is a real data delta).
+GRAPH_DATA_KEYS = ("graph_dangling_fraction", "graph_partition_skew")
+DATA_MOVED_REL = 0.01
 
 #: Which direction is BAD, per metric (direction-aware thresholds:
 #: a throughput DROP is a regression, a build-time RISE is).
@@ -97,6 +113,13 @@ METRIC_BAD_DIRECTION = {
     "exchange_fraction": "up",
     "comms_achieved_bytes_per_sec": "down",
     "hlo_bytes_per_edge": "up",
+    # Data-plane directions are nominal (a moved profile is DRIFT to
+    # attribute, not a regression to gate): more dangling mass, more
+    # partition skew, and more top-k concentration all make the solve
+    # harder, so "up" renders as the worse direction.
+    "graph_dangling_fraction": "up",
+    "graph_partition_skew": "up",
+    "graph_topk_concentration": "up",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -186,10 +209,33 @@ def _rate_leg(d: dict) -> dict:
     # ISSUE-11 artifacts simply lack the key (back-compat: no
     # re-ingest, the series starts when the instrument did).
     _leg_lowering(d.get("lowering"), leg)
+    # Data-plane block (ISSUE 13; bench legs since r13): the graph
+    # profile's headline scalars join the series so classify_change
+    # can attribute a move to the DATA axis. Pre-ISSUE-13 artifacts
+    # lack the key (back-compat, same discipline as lowering).
+    _leg_graph(d.get("graph"), leg)
     nd = d.get("n_devices")
     if isinstance(nd, int):
         leg["n_devices"] = nd
     return leg
+
+
+def _leg_graph(graph_block, leg: dict) -> None:
+    """Fold one ``graph`` data-plane block (obs/graph_profile
+    report_section shape: {"profile": summary, "prediction": ...})
+    into canonical leg metrics."""
+    if not isinstance(graph_block, dict):
+        return
+    prof = graph_block.get("profile")
+    if not isinstance(prof, dict):
+        return
+    for src_key, dst_key in (
+        ("dangling_fraction", "graph_dangling_fraction"),
+        ("partition_skew", "graph_partition_skew"),
+    ):
+        v = _num(prof.get(src_key))
+        if v is not None:
+            leg[dst_key] = v
 
 
 def _leg_lowering(lowering, leg: dict) -> None:
@@ -360,6 +406,14 @@ def _normalize_run_report(doc: dict, rec: dict) -> None:
         if v is not None:
             leg[metric] = v
     _leg_lowering(doc.get("lowering"), leg)
+    _leg_graph(doc.get("graph"), leg)
+    # Top-k rank concentration (ISSUE 13): the last probe record's
+    # convergence-quality signal joins the leg when the run probed.
+    conc = [_num((p or {}).get("topk_concentration"))
+            for p in (doc.get("probes") or [])]
+    conc = [c for c in conc if c is not None]
+    if conc:
+        leg["graph_topk_concentration"] = conc[-1]
     if leg:
         rec["legs"][leg_name_for_config(cfg)] = leg
     iters = cfg.get("num_iters") if isinstance(cfg, dict) else None
@@ -588,7 +642,8 @@ class Change:
     rel_delta: float                    # (value - median) / median
     flagged: bool
     direction: str = "flat"             # regression | improvement | flat
-    classification: str = "noise"       # program-change | env-drift | noise
+    # program-change | env-drift | data-change | noise
+    classification: str = "noise"
     evidence: str = ""
 
     def to_dict(self) -> dict:
@@ -627,6 +682,12 @@ def classify_change(target: dict, baseline: Sequence[dict],
          upgrade that changes the lowering is a program change even
          when the analytic cost model is flat, e.g. a defeated
          gather);
+      1c. cost flat but the leg's GRAPH-PROFILE scalars (ISSUE 13;
+         obs/graph_profile) moved vs their baseline medians ⇒
+         **data-change** — the INPUT changed shape (new crawl
+         segment, different skew), which explains a perf move without
+         indicting the program or the backend; the gate warns, never
+         fails;
       2. cost flat (or unmeasurable) and the env fingerprint drifted
          within the class ⇒ **env-drift**;
       3. cost flat and the baseline never recorded a fingerprint ⇒
@@ -661,6 +722,23 @@ def classify_change(target: dict, baseline: Sequence[dict],
                 f"lowering fingerprint moved: {fp_base} -> {fp_now} — "
                 f"the compiler emitted a different program shape"
                 + (f" (gather now {strat})" if strat else ""))
+    # Rule 1c (ISSUE 13): cost model flat but the DATA moved — the
+    # graph profile scalars are exact arithmetic over the input, so a
+    # move beyond formatting noise means the graph itself changed.
+    for data_metric in GRAPH_DATA_KEYS:
+        d_now = metric_value(target, leg, data_metric)
+        d_base = [metric_value(r, leg, data_metric) for r in baseline]
+        d_base = [v for v in d_base if v is not None]
+        if d_now is None or not d_base:
+            continue
+        med, _ = median_mad(d_base)
+        moved = (abs(d_now - med) / abs(med) > DATA_MOVED_REL
+                 if med else d_now != 0)
+        if moved:
+            return ("data-change",
+                    f"cost model flat; graph profile moved "
+                    f"({data_metric}: {med:.4g} -> {d_now:.4g}) — the "
+                    f"input data changed shape")
     t_env = target.get("env") or {}
     drifted = []
     baseline_known = False
@@ -844,6 +922,10 @@ def evaluate_gate(records: Sequence[dict],
             res.improvements.append(line)
         elif ch.classification == "env-drift":
             res.drift_warnings.append("DRIFT " + line)
+        elif ch.classification == "data-change":
+            # ISSUE 13: the INPUT changed shape — not a code
+            # regression; warn like drift, with the distinct tag.
+            res.drift_warnings.append("DATA " + line)
         else:
             res.violations.append("REGRESSION " + line)
     if not evaluated:
@@ -868,6 +950,9 @@ _METRIC_SHORT = {
     "exchange_fraction": "exch frac",
     "comms_achieved_bytes_per_sec": "achieved B/s",
     "hlo_bytes_per_edge": "hlo B/edge",
+    "graph_dangling_fraction": "dangling frac",
+    "graph_partition_skew": "part skew",
+    "graph_topk_concentration": "topk conc",
 }
 
 

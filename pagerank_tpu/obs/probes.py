@@ -67,6 +67,11 @@ class ConvergenceProbes:
         #: consumers/tests compare across engines.
         self.last_topk_ids = None
         self.history: List[Dict[str, float]] = []
+        #: Rank-mass-ledger violations observed this run (ISSUE 13;
+        #: obs/graph_profile.mass_ledger_entry): one record per probe
+        #: whose decomposition failed to reconcile, carrying the named
+        #: leaking term.
+        self.ledger_violations: List[Dict[str, object]] = []
 
     @property
     def enabled(self) -> bool:
@@ -94,6 +99,15 @@ class ConvergenceProbes:
             "rank_mass": float(info["rank_mass"]),
             "topk_churn": int(info["topk_churn"]),
         }
+        # Top-k rank concentration (ISSUE 13): what fraction of the
+        # total mass the top-k hold — a convergence-quality signal
+        # (ordering stabilizes long before the residual bottoms out).
+        tm = info.get("topk_mass")
+        if tm is not None and rec["rank_mass"]:
+            rec["topk_concentration"] = float(tm) / rec["rank_mass"]
+        ledger = info.get("mass_ledger")
+        if ledger is not None:
+            rec["mass_ledger"] = dict(ledger)
         self.history.append(rec)
         obs_metrics.counter(
             "probe.points", "convergence probes taken this run"
@@ -110,9 +124,22 @@ class ConvergenceProbes:
             "probe.topk_churn",
             "top-k entries new since the previous probe point",
         ).set(rec["topk_churn"])
+        if rec.get("topk_concentration") is not None:
+            obs_metrics.gauge(
+                "probe.topk_concentration",
+                "fraction of rank mass held by the probe top-k",
+            ).set(rec["topk_concentration"])
+        if ledger is not None:
+            from pagerank_tpu.obs import graph_profile
+
+            graph_profile.record_ledger(ledger)
+            if not ledger.get("ok", True):
+                self.ledger_violations.append(
+                    {"iteration": iteration, **ledger})
         tracer = obs_trace.get_tracer()
         if tracer.enabled:
-            tracer.add_event("probe/convergence", **rec)
+            tracer.add_event("probe/convergence", **{
+                k: v for k, v in rec.items() if k != "mass_ledger"})
         return rec
 
     def should_stop(self, rec: Dict[str, float]) -> bool:
@@ -132,13 +159,15 @@ class ConvergenceProbes:
         program over the current state. ``l1_delta`` is the boundary's
         last on-device trace value (the residual was already
         computed)."""
-        mass, churn, ids_engine, ids_original = engine.probe_values(
-            self.topk, self.prev_ids
-        )
+        mass, churn, ids_engine, ids_original, topk_mass = \
+            engine.probe_values(self.topk, self.prev_ids)
         info = {
             "rank_mass": mass,
             "topk_churn": 0 if self.prev_ids is None else churn,
+            "topk_mass": topk_mass,
         }
         if l1_delta is not None:
             info["l1_delta"] = float(l1_delta)
+        # No mass ledger at a fused boundary: the decomposition's link
+        # sum lives inside the step dispatch, which already retired.
         return self.commit(iteration, info, ids_engine, ids_original)

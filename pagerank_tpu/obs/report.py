@@ -54,10 +54,15 @@ SCHEMA_VERSION = 1
 #: ``job`` (ISSUE 12) is the resumable-job section: stage statuses,
 #: resume count, skip/wall per stage (pagerank_tpu/jobs.py) — empty on
 #: runs without ``--job-dir``.
+#: ``graph`` (ISSUE 13) is the data-plane section: the graph's n/edge
+#: counts plus — when ``--graph-profile`` armed the profiler — the
+#: structural profile and the skew-driven load prediction
+#: (obs/graph_profile.py; diffed FIRST by ``obs report A B`` as data
+#: drift, like env drift).
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
     "metrics", "iterations", "summary", "robustness", "costs",
-    "devices", "lowering", "job",
+    "devices", "lowering", "job", "graph",
 )
 
 
@@ -196,6 +201,11 @@ def build_run_report(
         "devices": _json_safe(devices or {}),
         "lowering": _json_safe(lowering or {}),
         "job": _json_safe(job or {}),
+        # Data plane (ISSUE 13): producers that profiled the graph
+        # override via ``extra["graph"]`` (the CLI merges n/num_edges
+        # with obs/graph_profile.report_section); the key is always
+        # present so consumers never key-error.
+        "graph": {},
     }
     if extra:
         report.update(_json_safe(extra))
@@ -317,6 +327,26 @@ def render_report(report: dict) -> str:
                    else (f"  {w:.3f}s" if isinstance(w, (int, float))
                          else ""))
             )
+    gr = report.get("graph") or {}
+    prof = gr.get("profile") or {}
+    if prof:
+        lines.append(
+            f"graph profile: {prof.get('num_edges'):,} unique edges"
+            + (f" ({prof.get('duplicate_edges'):,} dups collapsed)"
+               if prof.get("duplicate_edges") is not None else "")
+            + f", dangling {prof.get('dangling_fraction', 0):.3%}"
+            + (f", partition skew {prof['partition_skew']:.2f}"
+               if prof.get("partition_skew") is not None else "")
+            + (f", alpha {prof['powerlaw_alpha']:.2f}"
+               if prof.get("powerlaw_alpha") is not None else "")
+        )
+        pred = gr.get("prediction") or {}
+        if pred:
+            lines.append(
+                f"  predicted (ndev {pred.get('ndev')}): straggler "
+                f"skew {pred.get('predicted_straggler_skew')}, halo "
+                f"head-K {pred.get('predicted_halo_head_k')}"
+            )
     dv = report.get("devices") or {}
     if dv.get("hbm_high_water_bytes") is not None:
         per_dev = dv.get("per_device_peak_bytes") or {}
@@ -348,6 +378,53 @@ def _rel(a, b) -> Optional[float]:
     return (b - a) / a
 
 
+#: Profile scalars the data-drift diff compares (a subset of
+#: obs/graph_profile.GraphProfile.summary() chosen to move whenever
+#: the DATA moved: size, dedup shape, mass structure, skew, tail).
+GRAPH_DRIFT_KEYS = (
+    "n", "num_edges", "raw_edges", "duplicate_edges", "self_loops",
+    "dangling_count", "dangling_fraction", "zero_in_count",
+    "partition_skew", "powerlaw_alpha", "fingerprint",
+)
+
+
+def _diff_graph_block(ga: dict, gb: dict) -> List[str]:
+    """The ``graph`` section's data-drift lines (empty when nothing
+    moved / neither run profiled)."""
+    lines: List[str] = []
+    diffs = []
+    for k in ("n", "num_edges"):
+        va, vb = ga.get(k), gb.get(k)
+        if va != vb and (va is not None or vb is not None):
+            diffs.append(f"  {k}: {va!r} -> {vb!r}")
+    pa = ga.get("profile") or {}
+    pb = gb.get("profile") or {}
+    for k in GRAPH_DRIFT_KEYS:
+        va, vb = pa.get(k), pb.get(k)
+        if va is None and vb is None:
+            continue
+        if isinstance(va, float) and isinstance(vb, float):
+            if va == vb or (va and abs(vb - va) / abs(va) < 1e-9):
+                continue
+        elif va == vb:
+            continue
+        diffs.append(f"  profile.{k}: {va!r} -> {vb!r}")
+    qa = ga.get("prediction") or {}
+    qb = gb.get("prediction") or {}
+    for k in ("predicted_straggler_skew", "predicted_halo_head_k"):
+        va, vb = qa.get(k), qb.get(k)
+        if va != vb and (va is not None or vb is not None):
+            diffs.append(f"  prediction.{k}: {va!r} -> {vb!r}")
+    if diffs:
+        lines.append("data DIFFERS (the GRAPH changed — deltas below "
+                     "may be data-shaped, not code or backend):")
+        lines.extend(diffs)
+    elif pa or pb:
+        lines.append("data: graph profile identical (deltas below are "
+                     "not data drift)")
+    return lines
+
+
 def diff_reports(a: dict, b: dict) -> str:
     """Phase-by-phase diff of two reports: environment differences
     first (the backend-drift axis — if these differ, wall deltas below
@@ -372,6 +449,12 @@ def diff_reports(a: dict, b: dict) -> str:
     else:
         lines.append("environment: identical (deltas below are code or "
                      "load, not backend drift)")
+
+    # Data-plane drift (ISSUE 13; obs/graph_profile.py) — called out
+    # BEFORE any perf delta, like env drift: if the GRAPH changed,
+    # wall/rate/skew deltas below may be data-shaped, not code.
+    lines.extend(_diff_graph_block(a.get("graph") or {},
+                                   b.get("graph") or {}))
 
     sa, sb = a.get("spans") or {}, b.get("spans") or {}
     names = sorted(set(sa) | set(sb),

@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pagerank_tpu import graph as graph_lib
+from pagerank_tpu.obs import graph_profile
 from pagerank_tpu.obs import log as obs_log
 from pagerank_tpu.obs import trace as obs_trace
 from pagerank_tpu.ops import LANES
@@ -773,6 +774,19 @@ def build_ell_device(
     del src, dst, inv_perm
     _stage_fence(timings, "sort_s", t0, sb_dst)
 
+    # Data-plane profile (ISSUE 13; obs/graph_profile.py): one fused
+    # reduction pass over the sorted composite key, BEFORE the sort
+    # products are donated into the slot stage. Read-only and
+    # armed-only — a disarmed build makes ZERO profile computations
+    # and is bit-identical (the booby-trap contract,
+    # tests/test_graph_profile.py).
+    prof_stats = None
+    if graph_profile.armed():
+        prof_stats = graph_profile.device_stats(
+            sb_dst, new_src, perm, n=n, n_padded=n_padded,
+            stripe_size=stripe_arg, num_blocks=num_blocks,
+        )
+
     # Stage 3 (slots): slot coordinates + dedup flags + dedup-corrected
     # unique out-degrees, all from key adjacency in one program.
     t0 = time.perf_counter()
@@ -868,7 +882,7 @@ def build_ell_device(
         timings, "scatter_s", t0,
         rb_out[-1] if isinstance(rb_out, list) else rb_out,
     )
-    return DeviceEllGraph(
+    dg = DeviceEllGraph(
         n=n, n_padded=n_padded, num_blocks=num_blocks,
         src=src_out, weight=w_out, row_block=rb_out,
         perm=perm, dangling_mask=mass_mask, zero_in_mask=zero_in,
@@ -876,3 +890,19 @@ def build_ell_device(
         group=group, stripe_size=stripe_size,
         presentinel=not with_weights,
     )
+    if prof_stats is not None:
+        # Finish + publish the data-plane profile (ONE batched
+        # device_get): the build's own exact sb_rows vector is the
+        # load-prediction substrate, and an explicit dangling-mask
+        # override (crawl semantics) replaces the out_degree==0 count.
+        profile = graph_profile.finish_device_profile(
+            prof_stats, stripe_size=stripe_size, group=group, n=n,
+            n_padded=n_padded, block_rows=sb_rows,
+            dangling_count_override=(
+                jnp.sum(mass_mask.astype(jnp.int32), dtype=jnp.int32)
+                if dangling_mask is not None else None
+            ),
+            fingerprint=dg.fingerprint(),
+        )
+        graph_profile.publish(profile)
+    return dg

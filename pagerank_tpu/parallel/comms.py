@@ -30,7 +30,9 @@ ppermute exactly its payload.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
+
+import numpy as np
 
 from pagerank_tpu.obs import metrics as obs_metrics
 
@@ -107,3 +109,204 @@ def register(model: dict) -> Optional[obs_metrics.Counter]:
         "modeled wire bytes sent by this chip, accumulated per "
         "iteration",
     )
+
+
+# -- skew-driven load prediction (ISSUE 13; obs/graph_profile.py) -----------
+#
+# The device plane measures straggler skew (elastic.straggler_skew) and
+# the comms model prices the halo AFTER a build exists; this section
+# PREDICTS both from the data-plane GraphProfile alone — per-device
+# load imbalance from the per-(stripe, dst-block) edge/row geometry,
+# and the halo head-K from the in-degree distribution — so a TPU
+# session's balance risk is readable BEFORE burning chip time, and
+# predicted-vs-measured is one `obs report` diff (graph.* gauges next
+# to the measured elastic.*/comms.* values).
+
+
+def predict_device_load(profile, ndev: int) -> Optional[dict]:
+    """Per-device unique-edge counts for the row-sharded
+    vertex-sharded solve, predicted from the profile's per-(stripe,
+    128-dst-block) edge and row counts: slot rows concatenate in
+    (stripe, block) order and shard evenly over ``ndev`` devices (the
+    engine's ``P(axis, None)`` row sharding, rows padded to an ndev
+    multiple), with each block's edges spread uniformly over its own
+    rows — exact up to within-block row-density variation. None when
+    the profile lacks the block geometry or the graph is edge-free."""
+    be = getattr(profile, "block_edges", None)
+    br = getattr(profile, "block_rows", None)
+    if be is None or br is None or not ndev or ndev < 1:
+        return None
+    be = np.asarray(be, np.float64)
+    br = np.asarray(br, np.int64)
+    num_blocks = profile.n_padded // 128 if profile.n_padded else 0
+    if num_blocks == 0 or be.shape != br.shape:
+        return None
+    n_stripes = max(1, be.shape[0] // num_blocks)
+    edges_dev = np.zeros(ndev, np.float64)
+    for s in range(n_stripes):
+        e = be[s * num_blocks:(s + 1) * num_blocks]
+        r = br[s * num_blocks:(s + 1) * num_blocks]
+        rows = int(r.sum())
+        if rows == 0:
+            continue
+        rows_pad = -(-rows // ndev) * ndev
+        per_row = np.repeat(e / np.maximum(r, 1), r)
+        if rows_pad > rows:
+            per_row = np.concatenate(
+                [per_row, np.zeros(rows_pad - rows)])
+        edges_dev += per_row.reshape(ndev, rows_pad // ndev).sum(axis=1)
+    total = float(edges_dev.sum())
+    if total <= 0:
+        return None
+    mean = total / ndev
+    return {
+        "ndev": int(ndev),
+        "device_edges": [float(x) for x in edges_dev],
+        "straggler_skew": float(edges_dev.max() / mean),
+    }
+
+
+def _expected_remote_readers(d: np.ndarray, ndev: int) -> np.ndarray:
+    """Expected distinct NON-OWNER devices whose rows gather a vertex
+    of unique in-degree ``d``, under uniform edge-to-row placement:
+    distinct devices among d draws = ndev*(1-(1-1/ndev)^d), of which
+    (ndev-1)/ndev are remote on average."""
+    hit = 1.0 - np.power(1.0 - 1.0 / ndev, np.asarray(d, np.float64))
+    return (ndev - 1) * hit
+
+
+def predict_halo_head_k(profile, ndev: int) -> int:
+    """Predicted head-replication K for the sparse boundary exchange,
+    from the profile's log2 in-degree histogram alone — the same cost
+    argmin as parallel/partition.auto_head_k (replicating the first K
+    relabeled vertices costs ``2*(ndev-1)*K/ndev`` all-reduce elements
+    against the tail pair entries it removes), with the exact pair
+    sets replaced by the expected remote-reader count per degree bin
+    (bin k's vertices carry the bin's geometric-midpoint degree; the
+    relabel is in-degree descending, so a prefix IS the high-degree
+    head). A prediction, not the plan: `obs report` diffs it against
+    the measured ``comms.head_k``."""
+    if ndev <= 1:
+        return 0
+    # Descending (degree, count) sequence from the histogram.
+    seq: List[tuple] = []
+    hist = list(getattr(profile, "in_hist", []) or [])
+    for k in range(len(hist) - 1, 0, -1):
+        c = int(hist[k])
+        if not c:
+            continue
+        d = 1.0 if k == 1 else 1.5 * (1 << (k - 1))
+        seq.append((d, c))
+    if not seq:
+        return 0
+    n_vs = -(-profile.n_padded // (128 * ndev)) * (128 * ndev)
+    cap = min((n_vs // 256) * 128, 1 << 20)
+    cands = [0]
+    k = 128
+    while k <= cap:
+        cands.append(k)
+        k *= 2
+    degs = np.asarray([d for d, _ in seq])
+    cnts = np.asarray([c for _, c in seq], np.int64)
+    readers = _expected_remote_readers(degs, ndev)
+    # Per-CHIP tail cost of one tail vertex with r expected remote
+    # readers: the real plan pays one padded round per ring offset
+    # (sum over offsets of the MAX pair width). A fully-shared vertex
+    # (r = ndev-1) sits in every pair, so it costs each chip one slot
+    # in every round: ndev-1. A scattered vertex (r small) hits r of
+    # the ndev pairs per offset on average: ~r/ndev ~ r^2/(ndev-1)
+    # per chip. r^2/(ndev-1) interpolates both ends exactly.
+    per_vertex = readers * readers / (ndev - 1)
+    cum = np.concatenate([[0], np.cumsum(cnts)])
+    total_tail = float((per_vertex * cnts).sum())
+    best_k, best_cost = 0, None
+    for K in cands:
+        # Tail cost beyond rank K: whole bins past K plus the partial
+        # bin K lands in.
+        i = int(np.searchsorted(cum, K, side="right")) - 1
+        if i >= len(cnts):
+            tail = 0.0
+        else:
+            head = float((per_vertex[:i] * cnts[:i]).sum())
+            head += per_vertex[i] * (K - cum[i])
+            tail = total_tail - head
+        # The head all-reduce costs 2*(ndev-1)*K/ndev sends per chip
+        # (the HaloPlan ring convention).
+        cost = 2.0 * (ndev - 1) * K / ndev + tail
+        if best_cost is None or cost < best_cost:
+            best_k, best_cost = K, cost
+    return int(best_k)
+
+
+def predict_from_profile(profile, ndev: int) -> Optional[dict]:
+    """The data-plane prediction block: per-device load + straggler
+    skew + halo head-K for a target mesh size, from the profile alone
+    (no build, no devices). Embedded in run reports/bench legs next to
+    the measured values; published as ``graph.*`` gauges by
+    :func:`publish_prediction`."""
+    if profile is None or not ndev:
+        return None
+    load = predict_device_load(profile, ndev)
+    pred = {
+        "ndev": int(ndev),
+        "predicted_straggler_skew": (load["straggler_skew"]
+                                     if load else None),
+        "predicted_device_edges": (load["device_edges"]
+                                   if load else None),
+        "predicted_halo_head_k": predict_halo_head_k(profile, ndev),
+    }
+    return pred
+
+
+def publish_prediction(pred: Optional[dict]) -> None:
+    """Mirror a prediction block into ``graph.*`` gauges so predicted
+    sits next to measured (elastic.straggler_skew / comms.head_k) in
+    the exporter and the run-report diff."""
+    if not pred:
+        return
+    if pred.get("predicted_straggler_skew") is not None:
+        obs_metrics.gauge(
+            "graph.predicted_straggler_skew",
+            "max/mean per-device edge load predicted from the graph "
+            "profile (compare: elastic.straggler_skew)",
+        ).set(pred["predicted_straggler_skew"])
+    if pred.get("predicted_halo_head_k") is not None:
+        obs_metrics.gauge(
+            "graph.predicted_halo_head_k",
+            "halo head-K predicted from the in-degree histogram "
+            "(compare: comms.head_k)",
+        ).set(pred["predicted_halo_head_k"])
+
+
+def measured_device_edges(engine, ndev: Optional[int] = None
+                          ) -> Optional[np.ndarray]:
+    """ACTUAL per-device real-slot counts of a built engine's
+    row-sharded tables (the measurement the predicted skew is gated
+    against, scripts/acceptance smoke S): rows split evenly over the
+    mesh, sentinel/duplicate slots excluded. None on layouts whose
+    slot words aren't plain packed int words (the 3-byte partitioned
+    planes) or whose rows don't divide the mesh."""
+    import jax
+
+    layout = engine.layout_info()
+    group = int(layout.get("group") or 1)
+    sz = int(layout.get("stripe_span") or getattr(engine, "_n_state", 0))
+    if not sz:
+        return None
+    ndev = int(ndev or engine.mesh.devices.size)
+    log2g = group.bit_length() - 1
+    counts = np.zeros(ndev, np.int64)
+    for s in getattr(engine, "_src", []) or []:
+        a = np.asarray(jax.device_get(s))
+        # Plain packed slot words are int32 [rows, 128]; anything else
+        # (the partitioned layout's 3-byte planar int8 planes) is not
+        # decodable here — None, never garbage counts.
+        if a.ndim != 2 or a.dtype != np.int32:
+            return None
+        rows = a.shape[0]
+        if rows % ndev:
+            return None
+        real = (a.astype(np.int64) >> log2g) < sz
+        counts += real.reshape(ndev, (rows // ndev) * a.shape[1]
+                               ).sum(axis=1)
+    return counts

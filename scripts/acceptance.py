@@ -53,7 +53,10 @@ comms-vs-compute attribution block, per-device sampler gauges, and
 the OOM-preflight fit check passing at scale 14 while refusing an
 absurd scale — ISSUE 10), Q (compiler plane: `obs hlo` over the
 default + partitioned forms — a gather-strategy classification per
-form, strict JSON, no EXPANDED verdict — ISSUE 11), F (fault
+form, strict JSON, no EXPANDED verdict — ISSUE 11), S (data plane:
+`obs graph` at scale 14 — strict JSON, the rank-mass ledger
+reconciling at the f32 gate, predicted per-device skew within 10% of
+the measured 8-fake-device edge counts — ISSUE 13), F (fault
 injection).
 
 Usage:
@@ -224,9 +227,19 @@ CONFIGS = {
     "R": dict(kind="jobs", scale=10, iters=12, kill_iter=6,
               label="preemption smoke (SIGTERM drain + job-dir "
                     "resume)"),
+    # Data-plane smoke (ISSUE 13; obs/graph_profile.py): a profiled
+    # scale-14 run through `obs graph` — strict-JSON parse, the
+    # rank-mass ledger reconciling at the f32 gate over every probed
+    # iteration, and the predicted per-device straggler skew agreeing
+    # with the MEASURED per-device edge counts on the 8-fake-device
+    # mesh within 10% — the predict-before-you-burn-a-TPU-session
+    # instrument, gated end to end.
+    "S": dict(kind="graph", scale=14, ndev=8, iters=3,
+              label="data-plane smoke (graph profile + mass ledger + "
+                    "skew prediction)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "F",
-                "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "S",
+                "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1270,6 +1283,127 @@ def run_hlo_smoke(key: str):
     return rec
 
 
+# Budget for the data-plane smoke (seconds): a scale-14 host build +
+# numpy profile + an 8-fake-device vertex-sharded probed solve (3
+# iterations) lands well under 2s warm on the CPU substrate; the
+# in-process form times exactly the `obs graph` work (the subprocess
+# fallback for non-CPU backends pays jax import on top — its budget
+# adds the documented interpreter grace).
+GRAPH_SMOKE_BUDGET_S = 2.0
+GRAPH_SMOKE_SUBPROC_GRACE_S = 20.0
+# Predicted-vs-measured per-device skew agreement gate (relative).
+GRAPH_SKEW_GATE = 0.10
+
+
+def run_graph_smoke(key: str):
+    """ISSUE-13 gate: the data plane end to end — `python -m
+    pagerank_tpu.obs graph --scale 14 --ndev 8` must emit strict JSON
+    whose rank-mass LEDGER reconciles at the f32 gate over every
+    probed iteration, whose predicted per-device straggler skew agrees
+    with the MEASURED per-device edge counts of the 8-fake-device mesh
+    within GRAPH_SKEW_GATE, and land under GRAPH_SMOKE_BUDGET_S. Runs
+    in-process on a multi-device CPU backend; otherwise re-invokes in
+    a subprocess with the fake-device flags (the L/M discipline)."""
+    import jax
+
+    spec = CONFIGS[key]
+    scale, ndev, iters = spec["scale"], spec["ndev"], spec["iters"]
+    argv = ["graph", "--scale", str(scale), "--ndev", str(ndev),
+            "--iters", str(iters), "--json"]
+    in_process = (jax.default_backend() == "cpu"
+                  and len(jax.devices()) >= ndev)
+    budget = GRAPH_SMOKE_BUDGET_S
+    if in_process:
+        import contextlib
+        import io
+
+        from pagerank_tpu import obs
+        from pagerank_tpu.obs.__main__ import main as obs_main
+
+        obs.get_registry().reset()
+        obs.graph_profile.reset()
+        buf = io.StringIO()
+        t0 = time.perf_counter()
+        with contextlib.redirect_stdout(buf):
+            rc = obs_main(argv)
+        t_run = time.perf_counter() - t0
+        out_text = buf.getvalue()
+        obs.graph_profile.reset()
+    else:
+        import subprocess
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={ndev}"
+        ).strip()
+        env["PYTHONPATH"] = REPO
+        budget += GRAPH_SMOKE_SUBPROC_GRACE_S
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "pagerank_tpu.obs", *argv],
+            capture_output=True, text=True, env=env, timeout=600,
+        )
+        t_run = time.perf_counter() - t0
+        rc, out_text = r.returncode, r.stdout
+
+    doc, json_ok = {}, False
+    try:
+        doc = json.loads(out_text, parse_constant=lambda c: (
+            (_ for _ in ()).throw(ValueError(f"non-strict constant {c}"))
+        ))
+        json_ok = {"profile", "prediction", "measured",
+                   "ledger"} <= set(doc)
+    except ValueError:
+        pass
+    ledger = (doc.get("ledger") or {})
+    ledger_ok = bool(ledger.get("ok")) and \
+        ledger.get("entries", 0) >= iters
+    pred = (doc.get("prediction") or {}).get("predicted_straggler_skew")
+    meas = (doc.get("measured") or {}).get("straggler_skew")
+    skew_rel_err = (abs(pred - meas) / meas
+                    if isinstance(pred, (int, float))
+                    and isinstance(meas, (int, float)) and meas else None)
+    skew_ok = skew_rel_err is not None and skew_rel_err <= GRAPH_SKEW_GATE
+
+    passed = bool(rc == 0 and json_ok and ledger_ok and skew_ok
+                  and t_run <= budget)
+    rec = {
+        "config": key,
+        "kind": "graph",
+        "label": spec["label"],
+        "scale": scale,
+        "ndev": ndev,
+        "exit_code": rc,
+        "strict_json": json_ok,
+        "ledger_ok": ledger_ok,
+        "ledger_max_abs_residual": ledger.get("max_abs_residual"),
+        "predicted_skew": pred,
+        "measured_skew": meas,
+        "skew_rel_err": skew_rel_err,
+        "skew_gate": GRAPH_SKEW_GATE,
+        "in_process": in_process,
+        "seconds": t_run,
+        "budget_s": budget,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] obs graph scale {scale} x{ndev}dev: rc {rc}, strict "
+        f"JSON {'OK' if json_ok else 'BAD'}, ledger "
+        f"{'OK' if ledger_ok else 'VIOLATED'}"
+        + (f" (max |resid| {ledger['max_abs_residual']:.2e})"
+           if isinstance(ledger.get("max_abs_residual"), float) else "")
+        + f", skew pred {pred} vs measured {meas}"
+        + (f" ({skew_rel_err:.1%} vs {GRAPH_SKEW_GATE:.0%} gate)"
+           if skew_rel_err is not None else " (UNMEASURED)")
+        + f"; {t_run:.2f}s vs budget {budget:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 # Budget for the preemption smoke (seconds, measured around the
 # SIGTERM'd run + the resumed run — NOT the f64 oracle pass): two
 # 1024-vertex cpu-engine solves, a drain, and artifact save/restore
@@ -1975,7 +2109,7 @@ def main(argv=None) -> int:
                "elastic": run_elastic_smoke, "halo": run_halo_smoke,
                "history": run_history_smoke,
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,
-               "jobs": run_jobs_smoke}
+               "jobs": run_jobs_smoke, "graph": run_graph_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

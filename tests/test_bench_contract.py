@@ -61,6 +61,41 @@ def _assert_lowering_block(lowering, expect_native=False):
         assert whole["gather"]["strategy"] == "native", whole["gather"]
 
 
+def _assert_graph_block(graph, expect_profile=False, ndev=None):
+    """The per-leg data-plane block (ISSUE 13; obs/graph_profile.py):
+    structural profile + skew-driven load prediction. None-tolerant as
+    a WHOLE (a restored device graph without its artifact reports
+    None, never a fabricated block); per-field None-tolerant inside.
+    ``expect_profile`` pins the paths that must report (every bench
+    rate leg — the builds are fresh, both profile sources exist)."""
+    if graph is None:
+        assert not expect_profile
+        return
+    assert isinstance(graph, dict)
+    prof = graph.get("profile")
+    if expect_profile:
+        assert isinstance(prof, dict) and prof, graph
+    if prof is not None:
+        for key in ("n", "num_edges", "dangling_fraction",
+                    "in_hist", "out_hist", "top_hub_ids",
+                    "partition_edges", "partition_skew",
+                    "powerlaw_alpha", "fingerprint", "source"):
+            assert key in prof, key
+        assert prof["num_edges"] >= 0
+        assert 0.0 <= prof["dangling_fraction"] <= 1.0
+        assert len(prof["in_hist"]) == len(prof["out_hist"])
+        assert sum(prof["in_hist"]) == prof["n"]
+    pred = graph.get("prediction")
+    if pred is not None:
+        for key in ("ndev", "predicted_straggler_skew",
+                    "predicted_halo_head_k"):
+            assert key in pred, key
+        if ndev is not None:
+            assert pred["ndev"] == ndev
+        if pred["predicted_straggler_skew"] is not None:
+            assert pred["predicted_straggler_skew"] >= 1.0
+
+
 def _env():
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
@@ -124,9 +159,9 @@ def test_bench_json_contract_couple_mode(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "build_s", "costs", "layout", "lowering",
-                        "fast_f32", "partitioned_f32", "fast_bf16",
-                        "accuracy", "env", "scale", "iters",
-                        "edge_factor", "schema_version"}
+                        "graph", "fast_f32", "partitioned_f32",
+                        "fast_bf16", "accuracy", "env", "scale",
+                        "iters", "edge_factor", "schema_version"}
     # Every bench emit is versioned now (ISSUE 9 satellite); the
     # unversioned r01-r05 artifacts still ingest into the ledger.
     assert rec["schema_version"] >= 2
@@ -151,10 +186,23 @@ def test_bench_json_contract_couple_mode(tmp_path):
     # (ISSUE 11) — and the CPU substrate exposes HLO, so the verdicts
     # are real (native gather) here, not degraded Nones.
     _assert_lowering_block(rec["lowering"], expect_native=True)
+    # Every leg carries the data-plane graph block (ISSUE 13) — and a
+    # fresh host build must actually report a profile, not None.
+    _assert_graph_block(rec["graph"], expect_profile=True, ndev=1)
     for leg in ("fast_f32", "partitioned_f32", "fast_bf16"):
         _assert_costs_block(rec[leg]["costs"])
         _assert_lowering_block(rec[leg]["lowering"], expect_native=True)
+        _assert_graph_block(rec[leg]["graph"], expect_profile=True,
+                            ndev=1)
         assert rec[leg]["value"] > 0 and rec[leg]["vs_baseline"] > 0
+    # The partitioned legs' profiles record the partition geometry the
+    # layout actually ran (per-partition edge counts + skew).
+    for leg in ("partitioned_f32", "fast_bf16"):
+        prof = rec[leg]["graph"]["profile"]
+        assert prof["stripe_span"] == \
+            rec[leg]["layout"]["partition_span"]
+        assert len(prof["partition_edges"]) >= 2
+        assert prof["partition_skew"] >= 1.0
     # The bf16 leg's lowering must PROVE the reduced-precision stream
     # reaches the hot gather (the fast_bf16 mechanical verification).
     bf_whole = (rec["fast_bf16"]["lowering"] or {}).get("step") or {}
@@ -200,8 +248,8 @@ def test_bench_json_contract_single_mode(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "build_s", "costs", "layout", "lowering",
-                        "env", "scale", "iters", "edge_factor",
-                        "schema_version"}
+                        "graph", "env", "scale", "iters",
+                        "edge_factor", "schema_version"}
     assert rec["schema_version"] >= 2
     # The environment fingerprint makes future BENCH_r*.json cells
     # comparable across backend drift (ISSUE 4; obs/report.py).
@@ -210,6 +258,7 @@ def test_bench_json_contract_single_mode(tmp_path):
     _assert_costs_block(rec["costs"])
     _assert_layout_block(rec["layout"])
     _assert_lowering_block(rec["lowering"], expect_native=True)
+    _assert_graph_block(rec["graph"], expect_profile=True, ndev=1)
 
 
 def test_bench_build_only_reports_stage_breakdown(tmp_path):
@@ -281,6 +330,10 @@ def test_multichip_json_contract(tmp_path):
         # the sharded step's collectives land in the collective
         # multiset the fingerprint tracks.
         _assert_lowering_block(rec_l["lowering"], expect_native=True)
+        # ... and the data-plane block (ISSUE 13), whose prediction
+        # targets the LEG's mesh size.
+        _assert_graph_block(rec_l["graph"], expect_profile=True,
+                            ndev=rec_l["n_devices"])
         # Comms-vs-compute attribution per leg (ISSUE 10).
         _assert_attribution_block(rec_l["attribution"],
                                   multi_device=leg != "single_chip")

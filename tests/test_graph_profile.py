@@ -1,0 +1,668 @@
+"""Data-plane observability (ISSUE 13; pagerank_tpu/obs/graph_profile.py).
+
+Four gated axes:
+  - every GraphProfile stat matches an INDEPENDENT numpy oracle on
+    random + R-MAT inputs (device-build fused pass AND host numpy);
+  - the rank-mass ledger sums to 1 (textbook) / reconciles (reference)
+    within dtype tolerance across the dispatch forms — incl. vs_halo
+    and partitioned — and names the leaking term when mass breaks;
+  - a DISARMED run makes zero profile computations and is bit-identical
+    (the tracer/sampler booby-trap discipline);
+  - predicted per-device load agrees with the measured per-device edge
+    counts on the 8-fake-device mesh within 10%, and the job artifact
+    round-trips with tamper rejection.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from pagerank_tpu import PageRankConfig, build_graph
+from pagerank_tpu.engine import SolverHealthError, make_engine
+from pagerank_tpu.obs import graph_profile
+from pagerank_tpu.obs.probes import ConvergenceProbes
+from pagerank_tpu.ops import device_build as db
+from pagerank_tpu.parallel import comms
+from pagerank_tpu.utils.synth import rmat_edges, uniform_edges
+
+NDEV = len(jax.devices())
+needs_mesh = pytest.mark.skipif(NDEV < 8, reason="needs 8 fake devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean_profile_state():
+    graph_profile.reset()
+    graph_profile.disarm()
+    yield
+    graph_profile.reset()
+    graph_profile.disarm()
+
+
+# -- independent numpy oracle ------------------------------------------------
+
+
+def _oracle_hist(deg):
+    bins = np.zeros(graph_profile.HIST_BINS, np.int64)
+    for d in np.asarray(deg, np.int64):
+        bins[int(d).bit_length()] += 1
+    return bins
+
+
+def _oracle_profile(raw_src, raw_dst, n, sz, group):
+    """Every profile stat recomputed from FIRST PRINCIPLES (np.unique
+    dedup, bit_length histogram, explicit run-length row packing) —
+    deliberately not sharing code with the module under test."""
+    raw_src = np.asarray(raw_src, np.int64)
+    raw_dst = np.asarray(raw_dst, np.int64)
+    key = raw_dst * n + raw_src
+    uk = np.unique(key)
+    src = (uk % n).astype(np.int64)
+    dst = (uk // n).astype(np.int64)
+    in_deg = np.bincount(dst, minlength=n)
+    out_deg = np.bincount(src, minlength=n)
+    raw_in = np.bincount(raw_dst, minlength=n)
+    # The build relabels by RAW in-degree, stable descending.
+    perm = np.argsort(-raw_in, kind="stable")
+    inv = np.empty(n, np.int64)
+    inv[perm] = np.arange(n)
+    n_padded = -(-n // 128) * 128
+    span = sz or n_padded
+    n_stripes = -(-n_padded // span)
+    new_src, new_dst = inv[src], inv[dst]
+    out = {
+        "num_edges": uk.size,
+        "raw_edges": raw_src.size,
+        "self_loops": int((src == dst).sum()),
+        "dangling_count": int((out_deg == 0).sum()),
+        "zero_in_count": int((in_deg == 0).sum()),
+        "in_hist": _oracle_hist(in_deg),
+        "out_hist": _oracle_hist(out_deg),
+        "partition_edges": np.bincount(new_src // span,
+                                       minlength=n_stripes),
+        "block_edges": np.bincount(
+            (new_src // span) * (n_padded // 128) + new_dst // 128,
+            minlength=n_stripes * (n_padded // 128)),
+        "in_deg_rel": in_deg[perm],
+    }
+    # Rows per (stripe, block): run lengths over the RAW relabeled
+    # sorted order (duplicate edges occupy slots too), max over lane
+    # groups of ceil(run/group) — first-principles walk.
+    rs, rd = inv[raw_src], inv[raw_dst]
+    order = np.lexsort((rs, rd, rs // span))
+    rs, rd = rs[order], rd[order]
+    log2g = group.bit_length() - 1
+    grp = ((rs // span) * n_padded + rd) >> log2g
+    rows = {}
+    from collections import Counter
+
+    for g_id, cnt in Counter(grp.tolist()).items():
+        sb = ((g_id << log2g) // n_padded) * (n_padded // 128) + (
+            (g_id << log2g) % n_padded) // 128
+        rows[sb] = max(rows.get(sb, 0), -(-cnt // group))
+    block_rows = np.zeros(n_stripes * (n_padded // 128), np.int64)
+    for sb, r in rows.items():
+        block_rows[sb] = r
+    out["block_rows"] = block_rows
+    return out
+
+
+def _check_profile(prof, oracle):
+    assert prof.num_edges == oracle["num_edges"]
+    if prof.raw_edges is not None:
+        assert prof.raw_edges == oracle["raw_edges"]
+    assert prof.self_loops == oracle["self_loops"]
+    assert prof.dangling_count == oracle["dangling_count"]
+    assert prof.zero_in_count == oracle["zero_in_count"]
+    assert np.array_equal(prof.in_hist, oracle["in_hist"])
+    assert np.array_equal(prof.out_hist, oracle["out_hist"])
+    assert np.array_equal(prof.partition_edges,
+                          oracle["partition_edges"])
+    assert np.array_equal(prof.block_edges, oracle["block_edges"])
+    if prof.block_rows is not None:
+        assert np.array_equal(prof.block_rows, oracle["block_rows"])
+    # Top hubs: the DEGREES must be the k largest unique in-degrees,
+    # and each returned id must carry its claimed degree (id-level
+    # equality is tie-dependent, degree-level is not).
+    want = np.sort(oracle["in_deg_rel"])[::-1][:len(prof.top_hub_ids)]
+    assert np.array_equal(prof.top_hub_in_degrees, want)
+
+
+@pytest.mark.parametrize("gen,seed", [("rmat", 0), ("uniform", 7)])
+def test_device_profile_matches_numpy_oracle(gen, seed):
+    scale, n = 10, 1 << 10
+    if gen == "rmat":
+        sd, dd = db.rmat_edges_device(scale, seed=seed)
+    else:
+        sd, dd = db.uniform_edges_device(n, 16 * n, seed=seed)
+    raw_src = np.asarray(jax.device_get(sd))
+    raw_dst = np.asarray(jax.device_get(dd))
+    group, sz = 4, 256
+    graph_profile.arm()
+    dg = db.build_ell_device(raw_src.copy(), raw_dst.copy(), n=n,
+                             group=group, stripe_size=sz)
+    prof = graph_profile.get_profile()
+    assert prof is not None and prof.source == "device_build"
+    assert prof.fingerprint == dg.fingerprint()
+    _check_profile(prof, _oracle_profile(raw_src, raw_dst, n, sz, group))
+    # Hub ids claim their degrees in ORIGINAL id space.
+    key = raw_dst.astype(np.int64) * n + raw_src
+    dst_u = np.unique(key) // n
+    in_deg = np.bincount(dst_u, minlength=n)
+    for vid, d in zip(prof.top_hub_ids, prof.top_hub_in_degrees):
+        assert in_deg[vid] == d
+
+
+def test_host_profile_matches_numpy_oracle():
+    n = 1 << 10
+    src, dst = rmat_edges(10, 16, seed=3)
+    g = build_graph(src, dst, n=n)
+    prof = graph_profile.profile_graph(g, partition_span=256, group=4)
+    oracle = _oracle_profile(np.asarray(g.src), np.asarray(g.dst), n,
+                             256, 4)
+    _check_profile(prof, oracle)
+    assert prof.raw_edges is None and prof.duplicate_edges is None
+    assert prof.fingerprint == g.fingerprint()
+    # Host and device paths agree on the shared stats when fed the
+    # SAME deduplicated edges.
+    graph_profile.arm()
+    db.build_ell_device(np.asarray(g.src).copy(),
+                        np.asarray(g.dst).copy(), n=n, group=4,
+                        stripe_size=256)
+    dev = graph_profile.get_profile()
+    assert dev.num_edges == prof.num_edges
+    assert dev.in_hist == prof.in_hist
+    assert dev.out_hist == prof.out_hist
+    assert dev.partition_edges == prof.partition_edges
+    assert np.array_equal(dev.block_edges, prof.block_edges)
+    assert np.array_equal(dev.block_rows, prof.block_rows)
+    assert dev.top_hub_in_degrees == prof.top_hub_in_degrees
+
+
+def test_log2_hist_is_bit_length_exact():
+    deg = np.array([0, 1, 2, 3, 4, 7, 8, 1023, 1024, (1 << 24) + 1,
+                    (1 << 30) + 5])
+    assert np.array_equal(graph_profile.log2_hist(deg),
+                          _oracle_hist(deg))
+
+
+def test_powerlaw_alpha_recovers_synthetic_exponent():
+    # Exact power-law histogram: count in bin k = C * 2^(k(1-alpha)).
+    alpha = 2.2
+    hist = [0, 0] + [int(round(1e6 * 2 ** (k * (1 - alpha))))
+                     for k in range(2, 12)]
+    hist += [0] * (graph_profile.HIST_BINS - len(hist))
+    prof = graph_profile.GraphProfile(
+        n=10, n_padded=128, num_edges=10, raw_edges=None,
+        self_loops=None, dangling_count=0, zero_in_count=0,
+        in_hist=hist, out_hist=hist, top_hub_ids=[], top_hub_in_degrees=[],
+        partition_edges=[10], stripe_span=0)
+    assert prof.powerlaw_alpha() == pytest.approx(alpha, abs=0.05)
+
+
+# -- the rank-mass ledger ----------------------------------------------------
+
+
+def _run_probed(engine_name, graph, semantics="textbook", iters=4,
+                **cfg_kw):
+    cfg = PageRankConfig(num_iters=iters, semantics=semantics,
+                         probe_every=1, **cfg_kw)
+    eng = make_engine(engine_name, cfg).build(graph)
+    probes = ConvergenceProbes(1, topk=32)
+    eng.run(probes=probes)
+    return eng, probes
+
+
+LEDGER_FORMS = [
+    ("cpu", {}),
+    ("jax", {}),                                  # default ell
+    ("jax", dict(kernel="coo")),
+    ("jax", dict(partition_span=512)),            # partitioned
+    pytest.param("jax", dict(vertex_sharded=True, num_devices=8),
+                 marks=needs_mesh, id="jax-vs_dense"),
+    pytest.param("jax", dict(vertex_sharded=True, halo_exchange=True,
+                             num_devices=8),
+                 marks=needs_mesh, id="jax-vs_halo"),
+    pytest.param("jax", dict(vertex_sharded=True, vs_bounded=True,
+                             num_devices=8),
+                 marks=needs_mesh, id="jax-vs_bounded"),
+]
+
+
+@pytest.mark.parametrize("engine_name,kw", LEDGER_FORMS)
+def test_ledger_sums_to_one_across_forms(engine_name, kw):
+    g = build_graph(*rmat_edges(11, 16, seed=2), n=1 << 11)
+    eng, probes = _run_probed(engine_name, g, **kw)
+    assert len(probes.history) == 4
+    tol = graph_profile.ledger_tolerance(eng._ledger_eps(), g.n)
+    for rec in probes.history:
+        ml = rec["mass_ledger"]
+        assert ml is not None and ml["ok"], ml
+        assert ml["leak"] is None
+        # Textbook mass is conserved at 1 — the decomposition's terms
+        # sum to the measured mass AND the mass is the unit.
+        assert abs(ml["normalized_mass"] - 1.0) <= 4 * tol + 1e-6
+        assert abs(ml["residual"]) <= tol
+        assert abs(ml["teleport_mass"] + ml["link_mass"]
+                   + ml["retained_mass"] + ml["dangling_mass"]
+                   - ml["normalized_mass"]) <= tol
+    assert probes.ledger_violations == []
+
+
+def test_ledger_multi_dispatch_form():
+    """Striped device graph past SCAN_STRIPE_UNITS: the ledger rides
+    the dedicated _ms_final_ledger executable."""
+    src, dst = rmat_edges(11, 16, seed=4)
+    g = build_graph(src, dst, n=1 << 11)
+    dg = db.build_ell_device(np.asarray(g.src).copy(),
+                             np.asarray(g.dst).copy(), n=g.n,
+                             stripe_size=128)
+    cfg = PageRankConfig(num_iters=3, semantics="textbook",
+                         probe_every=1)
+    eng = make_engine("jax", cfg).build_device(dg)
+    assert eng._ms_stripe is not None  # the multi-dispatch form engaged
+    probes = ConvergenceProbes(1, topk=16)
+    eng.run(probes=probes)
+    tol = graph_profile.ledger_tolerance(eng._ledger_eps(), g.n)
+    for rec in probes.history:
+        ml = rec["mass_ledger"]
+        assert ml["ok"] and abs(ml["residual"]) <= tol
+
+
+def test_ledger_reference_semantics_identity():
+    """Reference semantics deliberately does not conserve mass (the
+    zero-in retention); the ledger still reconciles its IDENTITY —
+    measured mass equals the term sum — with the retained term live."""
+    g = build_graph(*rmat_edges(10, 16, seed=5), n=1 << 10)
+    for engine_name in ("cpu", "jax"):
+        _eng, probes = _run_probed(engine_name, g,
+                                   semantics="reference", iters=3)
+        for rec in probes.history:
+            ml = rec["mass_ledger"]
+            assert ml["ok"], ml
+            assert ml["unaccounted"] is None  # no flow check here
+            assert ml["retained_mass"] > 0
+
+
+def test_probe_topk_concentration_recorded():
+    g = build_graph(*rmat_edges(10, 16, seed=6), n=1 << 10)
+    for engine_name in ("cpu", "jax"):
+        _eng, probes = _run_probed(engine_name, g, iters=2)
+        for rec in probes.history:
+            assert 0.0 < rec["topk_concentration"] <= 1.0
+    # cpu and jax agree on the concentration (parity like rank_mass).
+    g2 = build_graph(*rmat_edges(10, 16, seed=6), n=1 << 10)
+    _e1, p1 = _run_probed("cpu", g2, iters=2)
+    _e2, p2 = _run_probed("jax", g2, iters=2)
+    for a, b in zip(p1.history, p2.history):
+        assert a["topk_concentration"] == pytest.approx(
+            b["topk_concentration"], rel=1e-5)
+
+
+def test_mass_ledger_entry_names_each_leak():
+    """Unit coverage of the leak taxonomy (obs/graph_profile
+    docstring): link (edges created mass), dangling (mass fell out of
+    the flow), teleport (the epilogue's derived term broke)."""
+    base = dict(damping=0.85, semantics="textbook", n=1000,
+                eps=np.finfo(np.float32).eps, mass_prev=1.0)
+    # Healthy: contrib == mass_prev - m.
+    ok = graph_profile.mass_ledger_entry(
+        mass=1.0, dangling_mass=0.2, contrib_total=0.8, **base)
+    assert ok["ok"] and ok["leak"] is None
+    # Edges CREATED mass (bad weights): unaccounted < 0 -> link.
+    e = graph_profile.mass_ledger_entry(
+        mass=1.0 + 0.85 * 0.1, dangling_mass=0.2, contrib_total=0.9,
+        **base)
+    assert e["leak"] == "link" and not e["ok"]
+    # Mass fell out of the flow (a sink the mask misses) -> dangling.
+    e = graph_profile.mass_ledger_entry(
+        mass=1.0 - 0.85 * 0.1, dangling_mass=0.1, contrib_total=0.8,
+        **base)
+    assert e["leak"] == "dangling"
+    # The identity itself broke (epilogue/mask) -> teleport.
+    e = graph_profile.mass_ledger_entry(
+        mass=0.9, dangling_mass=0.2, contrib_total=0.8, **base)
+    assert e["leak"] == "teleport"
+
+
+def test_health_error_names_leaking_term():
+    """The ISSUE-13 satellite: engine.rank_mass()'s drift check routed
+    through the ledger — SolverHealthError names WHICH term leaked. A
+    scaled CSR (weights * 1.15) makes the oracle's edges CREATE mass:
+    a link leak by construction."""
+    g = build_graph(*rmat_edges(9, 16, seed=7), n=1 << 9)
+    cfg = PageRankConfig(
+        num_iters=6, semantics="textbook", probe_every=1,
+    )
+    cfg.robustness.mass_tol = 1e-4
+    eng = make_engine("cpu", cfg).build(g)
+    eng._at = eng._at * 1.15  # corrupt the link weights
+    probes = ConvergenceProbes(1, topk=16)
+    with pytest.raises(SolverHealthError) as ei:
+        eng.run(probes=probes)
+    assert "mass ledger names the link term" in str(ei.value)
+    assert eng.health.get("mass_leak") == "link"
+
+
+def test_ledger_detects_dangling_mask_leak():
+    """A vertex with no out-edges MISSING from the dangling mask drops
+    its mass on the floor every step — the ledger names 'dangling'."""
+    g = build_graph(*rmat_edges(9, 16, seed=8), n=1 << 9)
+    cfg = PageRankConfig(num_iters=3, semantics="textbook",
+                         probe_every=1)
+    eng = make_engine("cpu", cfg).build(g)
+    # Knock half the dangling vertices out of the mass mask.
+    dang = np.flatnonzero(eng._dangling)
+    assert dang.size >= 2
+    eng._dangling[dang[::2]] = 0.0
+    probes = ConvergenceProbes(1, topk=16)
+    eng.run(probes=probes)
+    assert probes.ledger_violations
+    assert all(v["leak"] == "dangling"
+               for v in probes.ledger_violations)
+
+
+def test_probed_ledger_run_matches_plain_run_bitwise():
+    """Probe transparency survives the ledger: a probed (ledger-on)
+    f32 run's ranks are bit-identical to the unprobed run's."""
+    g = build_graph(*rmat_edges(10, 16, seed=9), n=1 << 10)
+    cfg = PageRankConfig(num_iters=5, semantics="textbook")
+    r_plain = make_engine("jax", cfg).build(g).run()
+    eng, _probes = _run_probed("jax", g, iters=5)
+    assert np.array_equal(r_plain, eng.ranks())
+
+
+# -- booby trap (disarmed = zero profile computations) ----------------------
+
+
+def test_disarmed_build_makes_zero_profile_calls(monkeypatch):
+    def boom(*a, **k):
+        raise AssertionError("profile computation on a DISARMED build")
+
+    monkeypatch.setattr(graph_profile, "device_stats", boom)
+    monkeypatch.setattr(graph_profile, "profile_graph", boom)
+    src, dst = rmat_edges(9, 16, seed=1)
+    dg = db.build_ell_device(np.asarray(src).copy(),
+                             np.asarray(dst).copy(), n=1 << 9)
+    assert dg.num_edges > 0
+    assert graph_profile.get_profile() is None
+
+
+def test_armed_build_is_bit_identical_to_disarmed():
+    src, dst = rmat_edges(9, 16, seed=2)
+    a = db.build_ell_device(np.asarray(src).copy(),
+                            np.asarray(dst).copy(), n=1 << 9)
+    graph_profile.arm()
+    b = db.build_ell_device(np.asarray(src).copy(),
+                            np.asarray(dst).copy(), n=1 << 9)
+    graph_profile.disarm()
+    assert a.fingerprint() == b.fingerprint()
+    assert np.array_equal(np.asarray(a.src), np.asarray(b.src))
+    assert np.array_equal(np.asarray(a.row_block),
+                          np.asarray(b.row_block))
+    assert np.array_equal(np.asarray(a.out_degree),
+                          np.asarray(b.out_degree))
+    # ... and the solves from each are bit-identical too.
+    cfg = PageRankConfig(num_iters=3, semantics="textbook")
+    ra = make_engine("jax", cfg).build_device(a).run()
+    rb = make_engine("jax", cfg).build_device(b).run()
+    assert np.array_equal(ra, rb)
+
+
+# -- skew-driven prediction --------------------------------------------------
+
+
+@needs_mesh
+def test_predicted_skew_within_10pct_of_measured():
+    """The ISSUE-13 acceptance bound: predicted per-device straggler
+    skew vs the measured per-device edge counts on the 8-fake-device
+    mesh, at the smoke geometry (scale 14)."""
+    g = build_graph(*rmat_edges(14, 16, seed=1), n=1 << 14)
+    cfg = PageRankConfig(num_iters=1, semantics="textbook",
+                         vertex_sharded=True, num_devices=8)
+    eng = make_engine("jax", cfg).build(g)
+    lay = eng.layout_info()
+    prof = graph_profile.profile_graph(
+        g, group=int(lay.get("group") or 1))
+    pred = comms.predict_from_profile(prof, 8)
+    meas = comms.measured_device_edges(eng)
+    assert meas is not None and int(meas.sum()) == g.num_edges
+    mskew = float(meas.max() / meas.mean())
+    assert pred["predicted_straggler_skew"] == pytest.approx(
+        mskew, rel=0.10)
+    # The per-device predicted counts track the measured ones too.
+    assert np.allclose(pred["predicted_device_edges"], meas,
+                       rtol=0.25, atol=g.num_edges * 0.02)
+
+
+def test_predict_halo_head_k_shape():
+    g = build_graph(*rmat_edges(10, 16, seed=3), n=1 << 10)
+    prof = graph_profile.profile_graph(g)
+    assert comms.predict_halo_head_k(prof, 1) == 0
+    k8 = comms.predict_halo_head_k(prof, 8)
+    assert k8 % 128 == 0 and 0 <= k8 <= prof.n_padded
+    # A hub-heavy profile (every vertex read by every shard) must
+    # choose to replicate a head.
+    hub = graph_profile.GraphProfile(
+        n=1 << 16, n_padded=1 << 16, num_edges=1 << 22, raw_edges=None,
+        self_loops=None, dangling_count=0, zero_in_count=0,
+        in_hist=[0] * 10 + [1 << 16] + [0] * (graph_profile.HIST_BINS
+                                              - 11),
+        out_hist=[0] * graph_profile.HIST_BINS,
+        top_hub_ids=[], top_hub_in_degrees=[], partition_edges=[1],
+        stripe_span=0)
+    assert comms.predict_halo_head_k(hub, 8) > 0
+
+
+def test_prediction_none_without_block_geometry():
+    prof = graph_profile.GraphProfile(
+        n=128, n_padded=128, num_edges=10, raw_edges=None,
+        self_loops=None, dangling_count=0, zero_in_count=0,
+        in_hist=[0] * graph_profile.HIST_BINS,
+        out_hist=[0] * graph_profile.HIST_BINS,
+        top_hub_ids=[], top_hub_in_degrees=[], partition_edges=[10],
+        stripe_span=0)
+    assert comms.predict_device_load(prof, 8) is None
+    pred = comms.predict_from_profile(prof, 8)
+    assert pred["predicted_straggler_skew"] is None
+
+
+# -- job artifact ------------------------------------------------------------
+
+
+def test_profile_artifact_round_trip_and_tamper(tmp_path):
+    from pagerank_tpu import jobs
+
+    src, dst = rmat_edges(10, 16, seed=0)
+    graph_profile.arm()
+    db.build_ell_device(np.asarray(src).copy(), np.asarray(dst).copy(),
+                        n=1 << 10, stripe_size=256)
+    prof = graph_profile.get_profile()
+    graph_profile.disarm()
+
+    path = str(tmp_path / "profile.npz")
+    arrays, meta = prof.to_arrays()
+    jobs.save_artifact(path, arrays, meta)
+    arrays2, meta2 = jobs.load_artifact(path)
+    back = graph_profile.GraphProfile.from_arrays(arrays2, meta2)
+    assert back.summary() == prof.summary()
+    assert np.array_equal(back.block_edges, prof.block_edges)
+    assert np.array_equal(back.block_rows, prof.block_rows)
+
+    # Tamper 1: modify one payload array, keeping the STORED meta +
+    # checksum entries verbatim — the recomputed digest must reject.
+    with np.load(path) as z:
+        entries = {k: z[k].copy() for k in z.files}
+    entries["in_hist"][0] += 1
+    with open(path, "wb") as f:
+        np.savez(f, **entries)
+    with pytest.raises(jobs.ArtifactCorruptError):
+        jobs.load_artifact(path)
+    # Tamper 2: a truncated file is unreadable, same exception class.
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:-7])
+    with pytest.raises(jobs.ArtifactCorruptError):
+        jobs.load_artifact(path)
+
+
+def test_job_supervisor_profile_fingerprint_gate(tmp_path):
+    from pagerank_tpu import jobs
+
+    src, dst = rmat_edges(9, 16, seed=0)
+    graph_profile.arm()
+    db.build_ell_device(np.asarray(src).copy(), np.asarray(dst).copy(),
+                        n=1 << 9)
+    prof = graph_profile.get_profile()
+    graph_profile.disarm()
+    job = jobs.JobSupervisor(str(tmp_path / "job"))
+    job.save_profile(prof)
+    back = job.load_profile(prof.fingerprint)
+    assert back is not None and back.summary() == prof.summary()
+    # A different graph's fingerprint never restores this profile.
+    with pytest.warns(RuntimeWarning):
+        assert job.load_profile("dev-ffffffffffff") is None
+
+
+# -- surfaces: CLI / report / history ---------------------------------------
+
+
+def test_obs_graph_cli_strict_json(capsys):
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    rc = obs_main(["graph", "--scale", "9", "--iters", "2", "--json"])
+    out = capsys.readouterr().out
+    doc = json.loads(out, parse_constant=lambda c: (
+        (_ for _ in ()).throw(ValueError(f"non-strict constant {c}"))
+    ))
+    assert rc == 0
+    assert {"profile", "prediction", "measured", "ledger"} <= set(doc)
+    assert doc["ledger"]["ok"] is True
+    assert doc["ledger"]["entries"] == 2
+    assert doc["profile"]["num_edges"] > 0
+
+
+def test_obs_graph_cli_device_build(capsys):
+    from pagerank_tpu.obs.__main__ import main as obs_main
+
+    rc = obs_main(["graph", "--scale", "9", "--iters", "2",
+                   "--device-build", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["profile"]["source"] == "device_build"
+    assert doc["profile"]["duplicate_edges"] is not None
+
+
+def test_report_diff_calls_out_data_drift_before_perf():
+    from pagerank_tpu.obs import report as report_mod
+
+    g = build_graph(*rmat_edges(9, 16, seed=0), n=1 << 9)
+    prof_a = graph_profile.profile_graph(g)
+    g2 = build_graph(*rmat_edges(9, 16, seed=12), n=1 << 9)
+    prof_b = graph_profile.profile_graph(g2)
+
+    def rep(prof):
+        r = report_mod.build_run_report(summary={})
+        r["graph"] = {"n": prof.n, "num_edges": prof.num_edges,
+                      "profile": prof.summary()}
+        r["spans"] = {"solve/step": {"total_s": 1.0, "count": 1,
+                                     "mean_s": 1.0}}
+        return r
+
+    text = report_mod.diff_reports(rep(prof_a), rep(prof_b))
+    assert "data DIFFERS" in text
+    assert text.index("data DIFFERS") < text.index("phase wall deltas")
+    # Identical data says so instead.
+    text2 = report_mod.diff_reports(rep(prof_a), rep(prof_a))
+    assert "data: graph profile identical" in text2
+
+
+def test_report_keys_include_graph():
+    from pagerank_tpu.obs import report as report_mod
+
+    rep = report_mod.build_run_report()
+    assert set(report_mod.REPORT_KEYS) <= set(rep)
+    assert "graph" in rep
+
+
+def _ledger_rec(i, eps, dangling, skew=None, cost=100.0, env=None):
+    legs = {"fast_f32": {
+        "edges_per_sec_per_chip": eps,
+        "cost_bytes_per_edge": cost,
+        "graph_dangling_fraction": dangling,
+    }}
+    if skew is not None:
+        legs["fast_f32"]["graph_partition_skew"] = skew
+    return {
+        "schema_version": 1, "kind": "bench_single",
+        "source": f"r{i}.json", "env": env or {"backend": "cpu",
+                                               "device_kind": "cpu",
+                                               "jax_version": "0.4.1"},
+        "workload": {}, "legs": legs, "extras": {}, "legacy": False,
+    }
+
+
+def test_history_data_change_attribution_and_gate():
+    from pagerank_tpu.obs import history as history_mod
+
+    base = [_ledger_rec(i, 100.0 + i * 0.01, 0.25) for i in range(6)]
+    # Throughput halves, cost model flat, dangling fraction doubled:
+    # a DATA change, not a program regression.
+    target = _ledger_rec(9, 50.0, 0.5)
+    changes = history_mod.detect_changes(base + [target])
+    flagged = [c for c in changes if c.flagged
+               and c.metric == "edges_per_sec_per_chip"]
+    assert flagged and flagged[0].classification == "data-change"
+    assert "data changed shape" in flagged[0].evidence
+    gate = history_mod.evaluate_gate(base + [target])
+    assert gate.ok  # data drift warns, never fails
+    assert any(w.startswith("DATA ") for w in gate.drift_warnings)
+    # Same move WITHOUT profile motion still gates as program-change.
+    target2 = _ledger_rec(9, 50.0, 0.25)
+    gate2 = history_mod.evaluate_gate(base + [target2])
+    assert not gate2.ok
+
+
+def test_history_run_report_carries_graph_leg_metrics():
+    from pagerank_tpu.obs import history as history_mod
+    from pagerank_tpu.obs import report as report_mod
+
+    g = build_graph(*rmat_edges(9, 16, seed=0), n=1 << 9)
+    prof = graph_profile.profile_graph(g, partition_span=128)
+    rep = report_mod.build_run_report(
+        config={"dtype": "float32"},
+        summary={"edges_per_sec_per_chip": 1e6,
+                 "mean_iter_seconds": 0.01},
+    )
+    rep["graph"] = {"n": g.n, "num_edges": g.num_edges,
+                    "profile": prof.summary()}
+    rep["probes"] = [{"iteration": 0, "topk_concentration": 0.31}]
+    rec = history_mod.normalize_result(rep, source="run_report.json")
+    leg = rec["legs"]["fast_f32"]
+    assert leg["graph_dangling_fraction"] == pytest.approx(
+        prof.dangling_fraction)
+    assert leg["graph_partition_skew"] == pytest.approx(
+        prof.partition_skew())
+    assert leg["graph_topk_concentration"] == pytest.approx(0.31)
+
+
+def test_history_pre_issue13_records_ingest_unchanged():
+    """Normalization regression: pre-ISSUE-13 artifacts produce the
+    exact records already in the checked-in ledger (same content
+    hash), with no graph_* keys invented."""
+    from pagerank_tpu.obs import history as history_mod
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ledger = history_mod.read_ledger(
+        os.path.join(repo, "PERF_HISTORY.jsonl"))
+    assert ledger
+    by_source = {r.get("source"): r for r in ledger}
+    name = "BENCH_r05.json"
+    with open(os.path.join(repo, name)) as f:
+        doc = json.load(f)
+    rec = history_mod.normalize_result(doc, source=name)
+    assert rec["content_hash"] == by_source[name]["content_hash"]
+    for leg in rec["legs"].values():
+        assert not any(k.startswith("graph_") for k in leg)
