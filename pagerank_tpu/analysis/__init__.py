@@ -1,10 +1,17 @@
-"""Static analysis for the TPU hot path — AST lint + jaxpr contracts.
+"""Static analysis for the TPU hot path — AST lint, concurrency rules,
+jaxpr contracts.
 
-Two passes, one CLI (``python -m pagerank_tpu.analysis``):
+Three passes, one CLI (``python -m pagerank_tpu.analysis``):
 
 - :mod:`pagerank_tpu.analysis.lint` — repo-specific AST rules over the
   package source (magic lane geometry, implicit dtypes, host syncs
   inside jit, mutable defaults, stray float64).
+- :mod:`pagerank_tpu.analysis.concurrency` — the whole-program
+  thread/signal-context race detector (PTR rules): execution-context
+  inference over every ``threading.Thread``/signal-handler root,
+  per-context shared-state and lock-scope tracking, lock-order cycles,
+  signal-handler purity, blocking-under-lock, thread lifecycle, and
+  the injectable-clock idiom.
 - :mod:`pagerank_tpu.analysis.contracts` — abstract-evals every engine
   dispatch form and the registered kernels, then asserts the
   performance invariants nothing else checks mechanically: the
@@ -12,9 +19,10 @@ Two passes, one CLI (``python -m pagerank_tpu.analysis``):
   donation actually consumed, stable step compilation keys, and no
   host callbacks inside the step.
 
-Findings carry a stable rule id (``PTLnnn`` lint / ``PTCnnn``
-contracts); deliberate exceptions are waived in ``allowlist.txt`` with
-a reason. Rule catalogue and workflow: ``docs/ANALYSIS.md``.
+Findings carry a stable rule id (``PTLnnn`` lint / ``PTRnnn``
+concurrency / ``PTCnnn``+``PTHnnn`` contracts); deliberate exceptions
+are waived in ``allowlist.txt`` with a reason. Rule catalogue and
+workflow: ``docs/ANALYSIS.md``.
 """
 
 from pagerank_tpu.analysis.findings import (  # noqa: F401

@@ -75,8 +75,12 @@ def main(argv=None) -> int:
     from pagerank_tpu.analysis import lint as lint_mod
 
     if args.list_rules:
+        from pagerank_tpu.analysis import concurrency as conc_mod
+
         for rid, (_fn, scope, desc) in sorted(lint_mod.RULES.items()):
             print(f"{rid}  [{scope:6}] {desc}")
+        for rid, (_fn, desc) in sorted(conc_mod.RULES.items()):
+            print(f"{rid}  [thread] {desc}")
         for rid, desc in (
             ("PTC001", "per-iteration collective budget / kernel shapes"),
             ("PTC002", "no f64 promotion under f32 configs"),
@@ -113,23 +117,53 @@ def main(argv=None) -> int:
 
     findings = []
     if not args.contracts_only:
+        from pagerank_tpu.analysis import concurrency as conc_mod
+
         if args.paths:
             pkg = lint_mod.package_root()
+            in_pkg_rels = []
+            in_pkg_prefixes = []
             for path in args.paths:
+                ap = os.path.abspath(path)
+                inside = ap == pkg or ap.startswith(pkg + os.sep)
                 if os.path.isdir(path):
                     findings.extend(lint_mod.lint_tree(path))
+                    if inside:
+                        # PTR is whole-program: an in-package subtree's
+                        # threads/callers live elsewhere in the
+                        # package, so analyze the FULL package and
+                        # filter (the file form's rationale).
+                        rel = os.path.relpath(ap, pkg).replace(os.sep, "/")
+                        in_pkg_prefixes.append(
+                            "" if rel == "." else rel + "/")
+                    else:
+                        # An OUTSIDE directory is its own whole
+                        # program (fixture space).
+                        findings.extend(conc_mod.analyze_package(path))
                     continue
                 # An explicit IN-PACKAGE file keeps package-relative
                 # scoping and reporting (so allowlist globs match and
                 # only in-scope rules run); outside files are fixture
                 # space.
-                ap = os.path.abspath(path)
                 rel = None
-                if ap.startswith(pkg + os.sep):
+                if inside:
                     rel = os.path.relpath(ap, pkg).replace(os.sep, "/")
+                    in_pkg_rels.append(rel)
+                else:
+                    # Standalone fixture file: the file IS the program
+                    # (thread/signal roots discovered within it).
+                    findings.extend(conc_mod.analyze_file(path))
                 findings.extend(lint_mod.lint_file(path, rel))
+            if in_pkg_rels or in_pkg_prefixes:
+                wanted = set(in_pkg_rels)
+                findings.extend(
+                    f for f in conc_mod.analyze_package()
+                    if f.path in wanted
+                    or any(f.path.startswith(p) for p in in_pkg_prefixes)
+                )
         else:
             findings.extend(lint_mod.lint_tree())
+            findings.extend(conc_mod.analyze_package())
 
     if not args.lint_only:
         _prepare_jax_env()

@@ -18,6 +18,7 @@ import ast
 import os
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from pagerank_tpu.analysis import roots as roots_mod
 from pagerank_tpu.analysis.findings import Finding
 
 # The lane-geometry constants whose literal spelling is banned in ops/:
@@ -394,10 +395,13 @@ def _scope_match(scope: str, rel: str) -> bool:
     if scope == "library":
         return rel != "cli.py" and not rel.endswith("__main__.py")
     if scope == "handler_free":
-        # Everything but the two modules that OWN process-global
-        # handlers: the job supervisor and the CLI entry point that
-        # installs its GracefulDrain (ISSUE 12).
-        return rel not in ("jobs.py", "cli.py")
+        # Everything but the modules that OWN process-global handlers
+        # (the job supervisor and the CLI entry point that installs
+        # its GracefulDrain, ISSUE 12) — read from the SHARED source
+        # of truth PTR003's signal-root discovery also uses
+        # (analysis/roots.py, ISSUE 14), so moving GracefulDrain can
+        # never silently split the two rules' views.
+        return rel not in roots_mod.HANDLER_OWNER_MODULES
     raise ValueError(f"unknown rule scope {scope!r}")
 
 

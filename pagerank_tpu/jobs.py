@@ -321,6 +321,10 @@ class GracefulDrain:
         self.requested = False
         self.signum: Optional[int] = None
         self._t_request: Optional[float] = None
+        # Pre-allocated handler flag: the handler may only SET simple
+        # scalars (PTR003); the counter/log emission it used to do
+        # in-handler is deferred to the next safe point.
+        self._pending_note = False
 
     # -- handler lifecycle --------------------------------------------------
 
@@ -350,6 +354,16 @@ class GracefulDrain:
         self._installed = False
 
     def _handler(self, signum, frame) -> None:
+        """Signal-handler context (PTR003, docs/ANALYSIS.md): this body
+        may only set pre-allocated flags/simple scalars. CPython runs
+        handlers ON THE MAIN THREAD between bytecodes — a handler that
+        takes a lock (the pre-fix ``obs_log.warn`` reached the
+        tracer's ``add_event`` lock, and the registry get-or-create
+        takes the registry lock) self-deadlocks the moment the signal
+        lands while the main thread holds that lock. Telemetry is
+        deferred to :meth:`_note_request` at the next safe point;
+        ``hard_exit`` (``os._exit``) is the sanctioned exception — the
+        operator asked twice."""
         if self.requested:
             # Second signal: the operator means NOW.
             self._hard_exit(hard_exit_code(signum))
@@ -357,13 +371,23 @@ class GracefulDrain:
         self.requested = True
         self.signum = int(signum)
         self._t_request = self._clock()
+        self._pending_note = True
+
+    def _note_request(self) -> None:
+        """Emit the drain request's counter + log line OUTSIDE handler
+        context — called from every drain-side entry point (check /
+        remaining / finish), so the first safe point after the signal
+        reports it exactly once."""
+        if not self._pending_note:
+            return
+        self._pending_note = False
         obs_metrics.counter(
             "job.drain_requests",
             "graceful-drain requests received (first SIGTERM/SIGINT)",
         ).inc()
         obs_log.warn(
-            f"signal {signum}: draining (deadline {self.deadline_s:g}s;"
-            " a second signal hard-exits)"
+            f"signal {self.signum}: draining (deadline "
+            f"{self.deadline_s:g}s; a second signal hard-exits)"
         )
 
     # -- drain-side API -----------------------------------------------------
@@ -373,6 +397,7 @@ class GracefulDrain:
         call at safe points only (stage boundaries, completed
         iterations): the in-flight step always finishes."""
         if self.requested:
+            self._note_request()
             raise DrainInterrupt(self.signum or 0, where)
 
     def remaining(self) -> Optional[float]:
@@ -381,12 +406,14 @@ class GracefulDrain:
         get one attempt)."""
         if self._t_request is None:
             return None
+        self._note_request()
         left = self.deadline_s - (self._clock() - self._t_request)
         return max(0.5, left)
 
     def finish(self) -> float:
         """Record the drain's wall (request -> flushes done) in the
         ``job.drain_seconds`` gauge; returns it."""
+        self._note_request()
         spent = (
             self._clock() - self._t_request
             if self._t_request is not None else 0.0

@@ -85,33 +85,39 @@ def render_prometheus(registry: Optional[obs_metrics.MetricsRegistry]
     plus ``_sum`` / ``_count`` (quantile estimates stay in the run
     report — the exposition format reserves ``quantile`` labels for
     summaries). Deterministic ordering (registry name order) so the
-    output is golden-testable."""
+    output is golden-testable.
+
+    Renders from ``registry.export_view()`` — a consistent snapshot
+    copied under the registry/histogram locks — never from live
+    internals: this function runs on the exporter's HTTP thread while
+    the solve loop registers and records (PTR001; the pre-fix direct
+    ``_metrics``/bucket iteration could race a concurrent insert)."""
     registry = registry if registry is not None else obs_metrics.get_registry()
     lines: List[str] = []
-    for name in registry.names():
-        m = registry._metrics[name]
+    for name, kind, help_text, snap in registry.export_view():
         pname = _prom_name(name)
-        lines.append(f"# HELP {pname} {_prom_help(m.help or name)}")
-        if m.kind in ("counter", "gauge"):
-            lines.append(f"# TYPE {pname} {m.kind}")
-            v = m.snapshot()
-            if m.kind == "gauge" and v is None:
+        lines.append(f"# HELP {pname} {_prom_help(help_text or name)}")
+        if kind in ("counter", "gauge"):
+            lines.append(f"# TYPE {pname} {kind}")
+            if kind == "gauge" and snap is None:
                 continue  # unset gauge: publish nothing, not NaN
-            lines.append(f"{pname} {_prom_value(v)}")
+            lines.append(f"{pname} {_prom_value(snap)}")
         else:  # histogram -> cumulative le-buckets
             lines.append(f"# TYPE {pname} histogram")
+            buckets = snap["buckets"]
+
             def bound(key: str) -> float:
                 return float("inf") if key == "+inf" else float(int(key))
             cum = 0
-            finite = (k for k in m.buckets if k != "+inf")
+            finite = (k for k in buckets if k != "+inf")
             for key in sorted(finite, key=bound):
-                cum += m.buckets[key]
+                cum += buckets[key]
                 lines.append(f'{pname}_bucket{{le="{key}"}} {cum}')
             # The +Inf bucket is total count by definition (covers the
             # registry's own "+inf" overflow bucket too).
-            lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
-            lines.append(f"{pname}_sum {_prom_value(m.sum)}")
-            lines.append(f"{pname}_count {m.count}")
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {snap["count"]}')
+            lines.append(f"{pname}_sum {_prom_value(snap['sum'])}")
+            lines.append(f"{pname}_count {snap['count']}")
     return "\n".join(lines) + "\n"
 
 

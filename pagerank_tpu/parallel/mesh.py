@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -98,7 +99,9 @@ def deadline_device_get(value, timeout_s: float):
 
 
 def probe_liveness(devices: Optional[Sequence] = None,
-                   timeout_s: float = 2.0) -> Dict[int, bool]:
+                   timeout_s: float = 2.0,
+                   clock: Callable[[], float] = time.monotonic,
+                   ) -> Dict[int, bool]:
     """Per-device liveness: {device id: alive}. Each device gets one
     tiny round-trip (device_put + device_get of a scalar) under a
     SHARED deadline — a device that cannot answer a 4-byte echo within
@@ -108,9 +111,11 @@ def probe_liveness(devices: Optional[Sequence] = None,
     (one daemon thread each), so a mesh with several dead devices
     still classifies in ~``timeout_s`` total, not ndev * timeout_s.
     Any error — timeout or a backend exception from the dead device —
-    counts as not-alive; the probe itself never raises."""
-    import time as _time
+    counts as not-alive; the probe itself never raises.
 
+    ``clock`` is injectable (the utils/retry.py discipline, PTR006):
+    this runs in the stall watchdog's context, and virtual-time tests
+    must be able to drive the shared deadline."""
     devs = list(devices) if devices is not None else list(jax.devices())
     results: Dict[int, bool] = {}
 
@@ -127,9 +132,9 @@ def probe_liveness(devices: Optional[Sequence] = None,
                              name="pagerank-liveness-probe", daemon=True)
         t.start()
         threads.append(t)
-    deadline = _time.monotonic() + timeout_s
+    deadline = clock() + timeout_s
     for t in threads:
-        t.join(max(0.0, deadline - _time.monotonic()))
+        t.join(max(0.0, deadline - clock()))
     # A device whose echo thread missed the shared deadline is dead.
     return {d.id: results.get(d.id, False) for d in devs}
 

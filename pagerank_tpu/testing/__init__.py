@@ -1,11 +1,19 @@
 """Test-support subpackage: deterministic fault injection
-(:mod:`pagerank_tpu.testing.faults`). Shipped inside the package — not
-under tests/ — so downstream users can chaos-test their own deployments
-against the same schedules (docs/ROBUSTNESS.md)."""
+(:mod:`pagerank_tpu.testing.faults`) and seed-deterministic
+interleaving replay (:mod:`pagerank_tpu.testing.schedules`). Shipped
+inside the package — not under tests/ — so downstream users can
+chaos-test their own deployments against the same schedules
+(docs/ROBUSTNESS.md, docs/ANALYSIS.md "Concurrency rules")."""
 
 from pagerank_tpu.testing.faults import (  # noqa: F401
     FaultInjectedError,
     FaultInjectingFileSystem,
     FaultSchedule,
     HttpFaultInjector,
+)
+from pagerank_tpu.testing.schedules import (  # noqa: F401
+    DeadlockDetected,
+    InterleavingScheduler,
+    TrackedLock,
+    VirtualClock,
 )

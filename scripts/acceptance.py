@@ -56,8 +56,10 @@ default + partitioned forms — a gather-strategy classification per
 form, strict JSON, no EXPANDED verdict — ISSUE 11), S (data plane:
 `obs graph` at scale 14 — strict JSON, the rank-mass ledger
 reconciling at the f32 gate, predicted per-device skew within 10% of
-the measured 8-fake-device edge counts — ISSUE 13), F (fault
-injection).
+the measured 8-fake-device edge counts — ISSUE 13), U (concurrency
+plane: the PTR thread/signal-context race pass over the whole package
+— zero unwaived findings, every thread root + the GracefulDrain
+signal root discovered, <2 s — ISSUE 14), F (fault injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -237,9 +239,19 @@ CONFIGS = {
     "S": dict(kind="graph", scale=14, ndev=8, iters=3,
               label="data-plane smoke (graph profile + mass ledger + "
                     "skew prediction)"),
+    # Concurrency-plane smoke (ISSUE 14; analysis/concurrency.py): the
+    # PTR thread/signal-context race pass over the whole package —
+    # zero unwaived findings, every known thread root discovered with
+    # its label (rank-writer, watchdog, metrics HTTP, deadline
+    # dispatch, liveness probes) plus the GracefulDrain signal root,
+    # in under CONCURRENCY_SMOKE_BUDGET_S. Pure AST, no device work —
+    # the same pass the --no-analysis pre-gate runs via --lint-only.
+    "U": dict(kind="concurrency",
+              label="concurrency-plane smoke (PTR race pass, zero "
+                    "unwaived findings)"),
 }
 DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "S",
-                "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+                "U", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1404,6 +1416,73 @@ def run_graph_smoke(key: str):
     return rec
 
 
+# Budget for the concurrency-plane smoke (seconds): the whole-package
+# PTR pass (parse + call graph + contexts + six rules) measures ~1.5s
+# nominal on the CPU test substrate — the <2s pre-gate latency target
+# (ISSUE 14) — and 3s absorbs a loaded host (the R/L/M convention)
+# while still catching an order-of-magnitude pass regression.
+CONCURRENCY_SMOKE_BUDGET_S = 3.0
+
+
+def run_concurrency_smoke(key: str):
+    """ISSUE-14 gate: the PTR thread/signal-context race pass
+    (analysis/concurrency.py) over the shipped package. Gates: ZERO
+    unwaived PTR findings against the checked-in allowlist, every
+    known thread root discovered WITH its label (a silently vanished
+    root would gut PTR001's context inference), the GracefulDrain
+    signal-handler root discovered through the shared
+    analysis/roots.py source of truth, and the whole pass under
+    CONCURRENCY_SMOKE_BUDGET_S."""
+    from pagerank_tpu.analysis import concurrency as conc_mod
+    from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+    from pagerank_tpu.analysis.lint import package_root
+
+    spec = CONFIGS[key]
+    t0 = time.perf_counter()
+    prog = conc_mod.build_package_program()
+    findings = conc_mod.analyze_program(prog)
+    allow = os.path.join(package_root(), "analysis", "allowlist.txt")
+    active, waived = split_allowlisted(findings, load_allowlist(allow))
+    t_run = time.perf_counter() - t0
+
+    labels = {ts.label for ts in prog.thread_sites}
+    expected_roots = {
+        "rank-writer", "pagerank-stall-watchdog", "pagerank-metrics-http",
+        "pagerank-deadline-dispatch", "pagerank-liveness-probe",
+    }
+    missing_roots = sorted(expected_roots - labels)
+    signal_ok = any(r == "jobs.py::GracefulDrain._handler"
+                    for _label, r in prog.signal_roots)
+    ptr_waived = sum(1 for f, _w in waived if f.rule.startswith("PTR"))
+    passed = bool(
+        not active and not missing_roots and signal_ok
+        and t_run <= CONCURRENCY_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "concurrency",
+        "label": spec["label"],
+        "active_findings": [f.render() for f in active],
+        "ptr_waived": ptr_waived,
+        "thread_roots": sorted(labels),
+        "missing_roots": missing_roots,
+        "signal_root_ok": signal_ok,
+        "seconds": t_run,
+        "budget_s": CONCURRENCY_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] PTR race pass in {t_run:.2f}s vs budget "
+        f"{CONCURRENCY_SMOKE_BUDGET_S:g}s; {len(active)} unwaived / "
+        f"{ptr_waived} waived PTR finding(s); roots "
+        f"{'complete' if not missing_roots else 'MISSING ' + repr(missing_roots)}; "
+        f"signal root {'OK' if signal_ok else 'MISSING'} -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 # Budget for the preemption smoke (seconds, measured around the
 # SIGTERM'd run + the resumed run — NOT the f64 oracle pass): two
 # 1024-vertex cpu-engine solves, a drain, and artifact save/restore
@@ -2086,11 +2165,12 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if not args.no_analysis:
-        # Cheap pre-gate: the AST lint (docs/ANALYSIS.md) — a dirty
-        # tree fails fast before minutes of acceptance runs. The jaxpr
-        # contract suite is skipped here: it forces a CPU fake mesh,
-        # which would fight this process's TPU backend; it runs in
-        # tier-1 pytest instead.
+        # Cheap pre-gate: the AST lint PLUS the PTR concurrency pass
+        # (docs/ANALYSIS.md — --lint-only runs both; ISSUE 14) — a
+        # dirty tree fails fast before minutes of acceptance runs.
+        # The jaxpr contract suite is skipped here: it forces a CPU
+        # fake mesh, which would fight this process's TPU backend; it
+        # runs in tier-1 pytest instead.
         from pagerank_tpu.analysis.__main__ import main as analysis_main
 
         if analysis_main(["--lint-only"]) != 0:
@@ -2109,7 +2189,8 @@ def main(argv=None) -> int:
                "elastic": run_elastic_smoke, "halo": run_halo_smoke,
                "history": run_history_smoke,
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,
-               "jobs": run_jobs_smoke, "graph": run_graph_smoke}
+               "jobs": run_jobs_smoke, "graph": run_graph_smoke,
+               "concurrency": run_concurrency_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

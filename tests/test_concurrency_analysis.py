@@ -1,0 +1,626 @@
+"""The concurrency plane (ISSUE 14): the PTR static pass
+(analysis/concurrency.py) — seeded-defect fixtures per rule, context
+inference, the shared handler-root source of truth, the clean-tree
+gate — and the deterministic interleaving replays
+(testing/schedules.py) that reproduce the fixed GracefulDrain handler
+race and demonstrate a waived watchdog race benign under every sampled
+schedule."""
+
+import ast
+import json
+import os
+import random
+import signal
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pagerank_tpu import jobs
+from pagerank_tpu.analysis import concurrency as conc_mod
+from pagerank_tpu.analysis import lint as lint_mod
+from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+from pagerank_tpu.analysis import roots as roots_mod
+from pagerank_tpu.analysis.__main__ import main as analysis_main
+from pagerank_tpu.obs import live as obs_live
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.obs import trace as obs_trace
+from pagerank_tpu.testing import schedules
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- seeded-defect fixtures: each rule fires on its synthetic defect --------
+
+PTR_FIXTURES = {
+    "PTR001": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.total = 0
+                self._thread = threading.Thread(
+                    target=self._run, name="acc", daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                self.total += 1   # written on the 'acc' thread
+
+            def read(self):
+                return self.total  # read on the main thread, no lock
+
+            def stop(self):
+                self._thread.join()
+    """,
+    "PTR002": """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        return 2
+    """,
+    "PTR003": """
+        import signal
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.flag = False
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _on_term(self, signum, frame):
+                print("terminating")   # I/O in handler context
+                with self._lock:       # lock acquire in handler context
+                    self.flag = True
+    """,
+    "PTR004": """
+        import threading
+        import time
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.data = {}
+
+            def refresh(self):
+                with self._lock:
+                    time.sleep(0.1)    # blocking while holding the lock
+                    self.data["k"] = 1
+    """,
+    "PTR005": """
+        import json
+        import threading
+
+        def _work():
+            with open("/tmp/x.json", "w") as f:
+                json.dump({}, f)       # durable write on a daemon thread
+
+        def spawn():
+            t = threading.Thread(target=_work, name="bg", daemon=True)
+            t.start()                  # never joined anywhere
+
+        def spawn_forever(handler):
+            u = threading.Thread(target=handler, name="fg")
+            u.start()                  # non-daemon, never joined
+    """,
+    "PTR006": """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._last = 0.0
+                self._thread = threading.Thread(
+                    target=self._run, name="poller", daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                self._last = time.monotonic()  # raw clock in thread code
+
+            def stop(self):
+                self._thread.join()
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(PTR_FIXTURES))
+def test_seeded_defect_fires_expected_rule(tmp_path, rule):
+    path = _write(tmp_path, f"bad_{rule.lower()}.py", PTR_FIXTURES[rule])
+    findings = conc_mod.analyze_file(path)
+    assert rule in _rules_of(findings), [f.render() for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(PTR_FIXTURES))
+def test_cli_exits_nonzero_per_rule(tmp_path, capsys, rule):
+    path = _write(tmp_path, f"bad_{rule.lower()}.py", PTR_FIXTURES[rule])
+    rc = analysis_main([path, "--lint-only", "--allowlist", "none",
+                        "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert rule in {f["rule"] for f in out["findings"]}
+
+
+def test_json_schema_is_stable_for_ptr_findings(tmp_path, capsys):
+    """PTR findings ride the existing --json schema (version 1) —
+    pinned alongside the PTL/PTC checks in tests/test_analysis.py."""
+    path = _write(tmp_path, "bad.py", PTR_FIXTURES["PTR001"])
+    rc = analysis_main([path, "--lint-only", "--allowlist", "none",
+                        "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["version"] == 1
+    assert set(out) == {"version", "ok", "counts", "findings", "waived"}
+    f = next(f for f in out["findings"] if f["rule"].startswith("PTR"))
+    assert set(f) == {"rule", "path", "line", "col", "message", "snippet"}
+
+
+def test_fixed_variants_stay_quiet(tmp_path):
+    """The discriminating half of each fixture: the same structure with
+    the discipline applied (a common lock; the injectable clock) must
+    produce ZERO PTR findings."""
+    locked = _write(tmp_path, "locked.py", """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.total = 0
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(
+                    target=self._run, name="acc", daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                with self._lock:
+                    self.total += 1
+
+            def read(self):
+                with self._lock:
+                    return self.total
+
+            def stop(self):
+                self._thread.join()
+    """)
+    assert conc_mod.analyze_file(locked) == []
+
+    injectable = _write(tmp_path, "injectable.py", """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self, clock=time.monotonic):
+                self._clock = clock
+                self._last = 0.0
+                self._thread = threading.Thread(
+                    target=self._run, name="poller", daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                self._last = self._clock()
+
+            def stop(self):
+                self._thread.join()
+    """)
+    assert [f.rule for f in conc_mod.analyze_file(injectable)
+            if f.rule == "PTR006"] == []
+
+
+def test_module_level_thread_fixture_fires(tmp_path):
+    """Thread creation at module TOP LEVEL (the natural standalone-
+    fixture and script shape) must be discovered: the module body is
+    scanned as a synthetic function, so its Thread sites root contexts
+    and its joins count — while import-time writes stay construction-
+    exempt (module constants never read as cross-context writes)."""
+    p = _write(tmp_path, "toplevel.py", """
+        import threading
+
+        COUNTS = {}
+
+        def _work():
+            COUNTS["n"] = COUNTS.get("n", 0) + 1
+
+        def read():
+            return COUNTS.get("n")
+
+        t = threading.Thread(target=_work, name="top-worker")
+        t.start()
+    """)
+    rules = _rules_of(conc_mod.analyze_file(p))
+    assert "PTR001" in rules  # cross-context COUNTS, no lock
+    assert "PTR005" in rules  # non-daemon thread, never joined
+
+
+def test_module_level_signal_install_discovered(tmp_path):
+    p = _write(tmp_path, "toplevel_sig.py", """
+        import signal
+
+        def _handler(signum, frame):
+            print("bye")
+
+        signal.signal(signal.SIGTERM, _handler)
+    """)
+    findings = conc_mod.analyze_file(p)
+    assert "PTR003" in _rules_of(findings), [f.render() for f in findings]
+
+
+def test_in_package_directory_keeps_whole_program_view(capsys):
+    """An in-package DIRECTORY argument analyzes the full package and
+    filters (the in-package file rationale): contexts rooted outside
+    the subtree still reach its state. The Counter.value waiver's
+    finding must name the rank-writer context (rooted in
+    utils/snapshot.py, OUTSIDE obs/) — an isolated-subtree analysis
+    could never see it."""
+    target = os.path.join(lint_mod.package_root(), "obs")
+    rc = analysis_main(["--lint-only", target, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["findings"]
+    ptr = [w["finding"] for w in out["waived"]
+           if w["finding"]["rule"].startswith("PTR")]
+    assert ptr and all(f["path"].startswith("obs/") for f in ptr)
+    counter = next(f for f in ptr if f["snippet"] == "Counter.value")
+    assert "rank-writer" in counter["message"]
+
+
+def test_prefix_drain_handler_fixture_fires_ptr003(tmp_path):
+    """Provenance, like the PTL001 ell-deal fixture: the pre-ISSUE-14
+    GracefulDrain._handler performed telemetry IN HANDLER CONTEXT
+    (stderr write via obs_log, registry get-or-create). The replica
+    must trip PTR003 through the injectable-install idiom; the shipped
+    jobs.py (flags only, telemetry deferred to the next safe point) is
+    covered by the clean-tree gate below."""
+    bad = _write(tmp_path, "drain_old.py", """
+        import signal
+        import sys
+
+        class Drain:
+            def __init__(self, install=signal.signal):
+                self._install = install
+                self.requested = False
+                self.signum = None
+
+            def __enter__(self):
+                self._prev = self._install(signal.SIGTERM, self._handler)
+                return self
+
+            def _handler(self, signum, frame):
+                self.requested = True
+                self.signum = int(signum)
+                sys.stderr.write("draining\\n")  # pre-fix telemetry
+    """)
+    findings = conc_mod.analyze_file(bad)
+    assert "PTR003" in _rules_of(findings), [f.render() for f in findings]
+
+
+# -- whole-package analysis: contexts, roots, the clean gate ----------------
+
+
+@pytest.fixture(scope="module")
+def package_program():
+    return conc_mod.build_package_program()
+
+
+def test_thread_roots_discovered_with_labels(package_program):
+    labels = {ts.label for ts in package_program.thread_sites}
+    assert {"rank-writer", "pagerank-stall-watchdog",
+            "pagerank-metrics-http", "pagerank-deadline-dispatch",
+            "pagerank-liveness-probe"} <= labels
+
+
+def test_signal_root_is_graceful_drain_handler(package_program):
+    assert ("signal:GracefulDrain._handler",
+            "jobs.py::GracefulDrain._handler") in \
+        package_program.signal_roots
+
+
+def test_context_inference_reaches_shared_infrastructure(package_program):
+    ctx = package_program.contexts
+    # The watchdog's fire path registers counters: the registry's
+    # get-or-create runs in watchdog context (the PTR001 class the
+    # registry lock now guards).
+    assert "pagerank-stall-watchdog" in \
+        ctx["obs/metrics.py::MetricsRegistry._get"]
+    # The HTTP handler renders through the exporter closure alias.
+    assert "pagerank-metrics-http" in ctx["obs/live.py::Handler.do_GET"]
+    # The rank-writer worker reaches the SinkGuard policy.
+    assert "rank-writer" in ctx["utils/snapshot.py::SinkGuard.__call__"]
+    # The signal context is confined to the handler after the fix —
+    # obs_log's stderr funnel is NOT handler-reachable anymore.
+    assert not any(c.startswith("signal:")
+                   for c in ctx["obs/log.py::_emit"])
+
+
+def test_handler_roots_shared_source_of_truth():
+    """The ISSUE-14 satellite: PTL008's scope and PTR003's root
+    discovery read ONE source of truth (analysis/roots.py), so moving
+    GracefulDrain cannot silently split the two rules' views."""
+    assert roots_mod.HANDLER_OWNER_MODULES == ("jobs.py", "cli.py")
+    for rel in roots_mod.HANDLER_OWNER_MODULES:
+        assert lint_mod._scope_match("handler_free", rel) is False
+    assert lint_mod._scope_match("handler_free", "utils/snapshot.py")
+    assert lint_mod._scope_match("handler_free", "parallel/elastic.py")
+    # The real jobs.py installation (the injectable-install idiom) is
+    # discovered by the shared walker.
+    path = os.path.join(lint_mod.package_root(), "jobs.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    installs = list(roots_mod.iter_handler_installs(tree))
+    assert any(cls == "GracefulDrain" for _call, _h, cls in installs)
+
+
+def test_package_tree_has_zero_unwaived_ptr_findings():
+    """The acceptance gate's AST half: the shipped tree is PTR-clean
+    modulo the reasoned allowlist entries — and the waivers that ARE
+    there all match live findings (no stale debt)."""
+    findings = conc_mod.analyze_package()
+    allow = os.path.join(lint_mod.package_root(), "analysis",
+                         "allowlist.txt")
+    active, waived = split_allowlisted(findings, load_allowlist(allow))
+    assert [f.render() for f in active] == []
+    assert any(f.rule.startswith("PTR") for f, _w in waived)
+
+
+def test_fixed_defects_stay_fixed(package_program):
+    """The three audit fixes, pinned structurally: (1) the registry map
+    and histogram internals are lock-guarded; (2) the drain handler's
+    closure performs no telemetry; (3) probe_liveness takes an
+    injectable clock."""
+    findings = conc_mod.analyze_package()
+    assert not any(f.rule == "PTR003" for f in findings), \
+        [f.render() for f in findings if f.rule == "PTR003"]
+    assert not any(f.rule == "PTR006" for f in findings), \
+        [f.render() for f in findings if f.rule == "PTR006"]
+    assert not any(f.snippet == "MetricsRegistry._metrics"
+                   for f in findings)
+
+
+# -- interleaving replays (testing/schedules.py) ----------------------------
+
+
+def test_same_seed_same_schedule_bit_for_bit():
+    def build(sched):
+        def a():
+            for i in range(5):
+                yield f"a{i}"
+
+        def b():
+            for i in range(3):
+                yield f"b{i}"
+
+        sched.spawn("a", a())
+        sched.spawn("b", b())
+
+    logs = [schedules.replay(seed=11, build=build).log for _ in range(2)]
+    assert logs[0] == logs[1]
+    other = schedules.replay(seed=12, build=build).log
+    assert other != logs[0]  # a different seed permutes the schedule
+
+
+_PREFIX_MSG = "signal %d: draining"
+
+
+def _prefix_handler(drain, signum):
+    """The pre-ISSUE-14 GracefulDrain._handler body, verbatim in
+    behavior: flag sets PLUS in-handler telemetry (registry
+    get-or-create + obs_log.warn -> tracer.add_event -> tracer lock)."""
+    if drain.requested:
+        return
+    drain.requested = True
+    drain.signum = int(signum)
+    drain._t_request = drain._clock()
+    obs_metrics.counter(
+        "job.drain_requests",
+        "graceful-drain requests received (first SIGTERM/SIGINT)",
+    ).inc()
+    from pagerank_tpu.obs import log as obs_log
+
+    obs_log.warn(_PREFIX_MSG % signum)
+
+
+def _drain_replay(seed, deliver_factory):
+    """One seeded schedule interleaving a traced main loop with a
+    signal delivery. Returns (scheduler, tracer_lock, results,
+    deadlock: bool)."""
+    clock = schedules.VirtualClock()
+    results = {"interrupted": False, "held_at_delivery": None}
+    sched = schedules.InterleavingScheduler(seed=seed, clock=clock)
+    tracer = obs_trace.Tracer()
+    lock = schedules.TrackedLock("tracer._lock", sched)
+    tracer._lock = lock
+    obs_metrics.get_registry().reset()
+    obs_trace.enable_tracing(tracer)
+    drain = jobs.GracefulDrain(
+        deadline_s=5.0, install=lambda s, h: None,
+        hard_exit=lambda code: None, clock=clock,
+    )
+    deliver = deliver_factory(drain)
+
+    def main_task():
+        # The tracer's add_event/_pop critical section: exactly where
+        # the main thread holds tracer._lock — a signal can land on
+        # any bytecode inside it.
+        with lock:
+            yield "tracer-lock-held"
+        yield "lock-released"
+        try:
+            drain.check("solve")
+        except jobs.DrainInterrupt:
+            results["interrupted"] = True
+        yield "checked"
+
+    def signal_task():
+        yield "pre-delivery"
+        results["held_at_delivery"] = lock.holder is not None
+        deliver()
+        yield "delivered"
+
+    sched.spawn("main", main_task())
+    sched.spawn("signal", signal_task())
+    deadlock = False
+    try:
+        sched.run()
+    except schedules.DeadlockDetected:
+        deadlock = True
+    finally:
+        obs_trace.disable_tracing()
+    return sched, lock, results, deadlock
+
+
+SEEDS = range(40)
+
+
+def test_replay_reproduces_the_prefix_handler_deadlock():
+    """The race the pass found and the fix removed, REPRODUCED: under
+    schedules where the signal lands while the main thread holds the
+    tracer lock, the pre-fix handler re-acquires it on the same OS
+    thread — DeadlockDetected, deterministically, same seeds every
+    run."""
+    def deliver_factory(drain):
+        return lambda: _prefix_handler(drain, signal.SIGTERM)
+
+    outcomes = {}
+    for seed in SEEDS:
+        _s, _l, results, deadlock = _drain_replay(seed, deliver_factory)
+        outcomes[seed] = (deadlock, results["held_at_delivery"])
+        if deadlock:
+            assert results["held_at_delivery"], (
+                "a deadlock requires delivery inside the held region")
+    deadlocked = {s for s, (d, _h) in outcomes.items() if d}
+    assert deadlocked, "no sampled schedule hit the held-lock window"
+    # Bit-for-bit: the same seeds deadlock on a second pass.
+    again = {s for s in SEEDS
+             if _drain_replay(s, deliver_factory)[3]}
+    assert again == deadlocked
+
+
+def test_fixed_handler_survives_all_schedules():
+    """The fix, pinned: the shipped GracefulDrain._handler sets flags
+    only — under the SAME schedules (including ones delivering inside
+    the held-lock window) no lock is ever touched from the signal
+    actor, the drain is honored at the next safe point, and the
+    deferred telemetry is emitted exactly once."""
+    def deliver_factory(drain):
+        return lambda: drain._handler(signal.SIGTERM, None)
+
+    hit_held_window = False
+    for seed in SEEDS:
+        sched, lock, results, deadlock = _drain_replay(
+            seed, deliver_factory)
+        assert not deadlock, f"seed {seed} deadlocked with the FIXED handler"
+        assert "signal" not in lock.acquirers(), (
+            f"seed {seed}: the handler touched the tracer lock")
+        hit_held_window |= bool(results["held_at_delivery"])
+        # Delivery before the check -> honored at that safe point with
+        # the telemetry emitted there; delivery after -> honored at
+        # the NEXT safe point (checked here post-run).
+        if results["interrupted"]:
+            snap = obs_metrics.get_registry().snapshot()
+            assert snap["counters"]["job.drain_requests"] == 1
+    assert hit_held_window, (
+        "no sampled schedule exercised the dangerous window")
+
+
+def test_waived_rescue_handshake_benign_under_all_schedules():
+    """The allowlist's PTR001 waiver for StallWatchdog.rescue_requested
+    names this test: under every sampled schedule of watchdog fires vs
+    main-thread heartbeats/consumes, the one-shot handshake never
+    double-consumes a fire and never leaves a request dangling."""
+    for seed in range(25):
+        clock = schedules.VirtualClock()
+        wd = obs_live.StallWatchdog(
+            timeout_s=5.0, action="rescue", clock=clock,
+            interrupt=lambda: None, device_source=lambda: [],
+        )
+        consumed = {"n": 0}
+
+        def watchdog_task():
+            for _ in range(6):
+                clock.advance(3.0)
+                wd.check()
+                yield "poll"
+
+        def solve_task():
+            rng = random.Random(seed * 7 + 1)
+            for i in range(6):
+                if rng.random() < 0.5:
+                    wd.heartbeat(i)
+                    yield "heartbeat"
+                if wd.consume_rescue():
+                    consumed["n"] += 1
+                yield "consume"
+
+        sched = schedules.InterleavingScheduler(seed=seed, clock=clock)
+        sched.spawn("watchdog", watchdog_task())
+        sched.spawn("solve", solve_task())
+        sched.run()
+        if wd.consume_rescue():  # final drain of a dangling request
+            consumed["n"] += 1
+        assert consumed["n"] <= wd.stalls, (
+            f"seed {seed}: consumed more rescues than fires")
+        assert not wd.rescue_requested
+
+
+# -- the registry/exporter fix under real threads ---------------------------
+
+
+def test_exporter_render_concurrent_with_recording():
+    """Regression for the PTR001 finding the audit surfaced on
+    MetricsRegistry._metrics: the exporter thread renders while other
+    contexts register and record. With the registry map and histogram
+    buckets lock-guarded this ALWAYS passes; pre-fix the scrape could
+    die iterating a dict mid-insert."""
+    reg = obs_metrics.MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                reg.histogram(f"h.{i % 211}", "hammer").record(i % 4096)
+                reg.counter(f"c.{i % 97}", "hammer").inc()
+                reg.gauge(f"g.{i % 53}", "hammer").set(i)
+                i += 1
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                obs_live.render_prometheus(reg)
+                reg.snapshot()
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert errors == []
+    # The render still strict-parses as exposition format afterwards.
+    text = obs_live.render_prometheus(reg)
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line
