@@ -370,8 +370,22 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
                                  dump_hlo=args.dump_hlo, label=label)
     layout = engine.layout_info()
     graph_block = _leg_graph_block(engine, host_graph, layout)
+    # SDC detection overhead (ISSUE 15; pagerank_tpu/sdc.py): when
+    # --sdc-check-every arms the plane, time the CHECKED step against
+    # the plain loop just measured — the per-checked-iteration cost a
+    # production config pays amortized over its cadence. None when
+    # disarmed (the schema is None-tolerant by contract,
+    # tests/test_bench_contract.py).
+    sdc_overhead = None
+    if getattr(args, "sdc_check_every", 0):
+        sdc_overhead = _sdc_overhead_pct(engine, args.iters,
+                                         dt / args.iters)
+        print(f"sdc[{label}]: checked-step overhead "
+              f"{sdc_overhead:.1f}% per checked iteration",
+              file=sys.stderr)
     del engine  # free HBM before the next config builds
     return {
+        "sdc_check_overhead_pct": sdc_overhead,
         "value": eps_chip,
         "vs_baseline": eps_chip / NORTH_STAR_EDGES_PER_SEC_PER_CHIP,
         "build_s": t_build,  # graph build wall-clock (VERDICT r3 weak #1)
@@ -397,6 +411,44 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         # classifier attributes against. None on non-reporting paths.
         "graph": graph_block,
     }
+
+
+def _sdc_overhead_pct(engine, iters: int, plain_s_per_iter: float):
+    """Per-checked-iteration SDC detection overhead: ``iters`` full
+    checked boundaries — the standalone boundary-state dispatch, the
+    ABFT-checked step with its host fetch, AND the host-side invariant
+    reconciliation, i.e. exactly what ``SdcGuard.checked_step`` pays
+    per boundary — against the plain loop's measured wall, as percent
+    extra. The probe retains/restores the engine state, so the
+    measured solve trajectory is untouched; the checked programs
+    compile OUTSIDE the timed region (the prepare_fused
+    discipline)."""
+    from pagerank_tpu import sdc as sdc_mod
+
+    cfg = engine.config
+    ne = int(engine.graph.num_edges) if engine.graph is not None else None
+
+    def boundary():
+        pre = engine.sdc_state_values()
+        _info, chk = engine.step_sdc()
+        sdc_mod.evaluate_check(
+            pre, chk, damping=cfg.damping, semantics=cfg.semantics,
+            n=int(engine.graph.n), num_edges=ne,
+            eps=engine._ledger_eps(),
+        )
+
+    token = engine.retain_state()
+    try:
+        boundary()  # compile + warm outside the timing
+        engine.restore_state(token)
+        t0 = time.perf_counter()
+        for _ in range(max(1, iters)):
+            boundary()
+        checked = (time.perf_counter() - t0) / max(1, iters)
+    finally:
+        engine.restore_state(token)
+    return max(0.0, (checked - plain_s_per_iter)
+               / max(plain_s_per_iter, 1e-12) * 100.0)
 
 
 def _leg_graph_block(engine, host_graph, layout):
@@ -900,6 +952,17 @@ def main(argv=None):
                         "offline diffing (ISSUE 11; obs/hlo.py) — the "
                         "classified verdict rides the JSON's per-leg "
                         "'lowering' block either way")
+    p.add_argument("--sdc-check-every", type=int, default=0,
+                   metavar="K",
+                   help="ALSO measure the SDC-checked step's overhead "
+                        "per rate leg (ISSUE 15; pagerank_tpu/sdc.py): "
+                        "each leg's JSON carries "
+                        "'sdc_check_overhead_pct' — percent extra wall "
+                        "per CHECKED iteration vs the plain step "
+                        "(amortize over the cadence K for the "
+                        "production cost). 0 (default) disarms: the "
+                        "field rides as null and zero check "
+                        "computations run")
     p.add_argument("--preflight", action="store_true",
                    help="OOM-preflight fit check (ISSUE 10; "
                         "obs/devices.fit_check) BEFORE anything "
@@ -984,6 +1047,7 @@ def main(argv=None):
             "lowering": rate["lowering"],
             "graph": rate["graph"],
             "layout": rate["layout"],
+            "sdc_check_overhead_pct": rate["sdc_check_overhead_pct"],
             "scale": args.scale,
             "iters": args.iters,
             "edge_factor": args.edge_factor,
@@ -1029,6 +1093,9 @@ def main(argv=None):
         "lowering": pair_rate["lowering"],  # headline lowering verdict
         "graph": pair_rate["graph"],  # headline data-plane block
         "layout": pair_rate["layout"],
+        # Headline leg's SDC detection overhead (ISSUE 15): null
+        # unless --sdc-check-every armed the measurement.
+        "sdc_check_overhead_pct": pair_rate["sdc_check_overhead_pct"],
         "fast_f32": f32_rate,  # carries its own "costs" block
         "partitioned_f32": part_rate,
         "fast_bf16": bf16_rate,

@@ -45,7 +45,9 @@ flag), PTC007 probe transparency (the probe-enabled step —
 multiset of the plain step, add no host callback, no f64 under f32
 configs, and keep the rank donation consumable; on multi-dispatch
 layouts the standalone probe program must be collective- and
-callback-free).
+callback-free), and PTC008 SDC-check transparency (the same
+discipline for the ABFT-checked step and the standalone
+boundary-state program — ISSUE 15, pagerank_tpu/sdc.py).
 
 The PTH family (ISSUE 11; obs/hlo.py) checks the OPTIMIZED HLO the
 backend actually compiled, not the jaxpr: PTH001 gather strategy —
@@ -564,6 +566,9 @@ def check_engine_form(form: Form) -> List[Finding]:
     # PTC007 — probe transparency (ISSUE 5).
     findings.extend(check_probe_form(engine, form))
 
+    # PTC008 — SDC-check transparency (ISSUE 15).
+    findings.extend(check_sdc_form(engine, form))
+
     # PTH001-003 — optimized-HLO lowering contracts (ISSUE 11).
     findings.extend(check_hlo_form(engine, form))
     return findings
@@ -800,6 +805,108 @@ def check_probe_form(form_engine, form: Form) -> List[Finding]:
                     + "; ".join(sorted(set(hits))[:4]),
                     form.name,
                 ))
+    return findings
+
+
+def check_sdc_form(form_engine, form: Form) -> List[Finding]:
+    """PTC008: the SDC-checked step (ISSUE 15; pagerank_tpu/sdc.py)
+    must be COMMUNICATION-TRANSPARENT exactly like the probe. On
+    single-program forms the checked step (``_get_sdc_step``: the
+    ledger core + the per-device ABFT check tail in ONE program) must
+    trace to the exact collective multiset of the plain step, add no
+    host callback, introduce no f64 under an all-f32 config, and keep
+    the donated rank buffer consumable. On every form the standalone
+    boundary-state program (``_get_sdc_state_fn`` — the
+    dual-fingerprint dispatch, and the multi-dispatch layouts' whole
+    check) must be collective- and callback-free: its per-device
+    values are local reductions concatenated by out-spec, never
+    merged. Abstract evaluation only; nothing runs."""
+    import jax
+    import numpy as np
+
+    findings: List[Finding] = []
+    if not form_engine.sdc_supported():
+        return findings
+    w = form_engine._sdc_w()
+    inv = ((form_engine._inv_out,)
+           if form_engine._sdc_has_inv() else ())
+    state_jx = jax.make_jaxpr(form_engine._get_sdc_state_fn())(
+        w, form_engine._r, *inv
+    )
+    colls = [p for p, _s in collectives(state_jx)]
+    if colls:
+        findings.append(_finding(
+            "PTC008",
+            f"standalone SDC state program emits collective(s) "
+            f"{sorted(set(colls))} (check partials are local "
+            f"reductions by contract)",
+            form.name,
+        ))
+    cbs = callback_prims(state_jx)
+    if cbs:
+        findings.append(_finding(
+            "PTC008",
+            f"standalone SDC state program emits host callback(s) "
+            f"{sorted(set(cbs))}",
+            form.name,
+        ))
+    if form.f32:
+        hits = f64_avals(state_jx)
+        if hits:
+            findings.append(_finding(
+                "PTC008",
+                "SDC state program promotes to f64 in f32 config: "
+                + "; ".join(sorted(set(hits))[:4]),
+                form.name,
+            ))
+    if form_engine._ms_stripe is not None:
+        # Multi-dispatch layouts run the ledger sequence bracketed by
+        # the (already checked) standalone state program — nothing
+        # else to prove here.
+        return findings
+    args = form_engine._device_args()
+    plain = jax.make_jaxpr(form_engine._step_core)(*args)
+    sdc_fn = form_engine._get_sdc_step()
+    sdc_jx = jax.make_jaxpr(sdc_fn)(w, *args)
+    if _collective_tally(sdc_jx) != _collective_tally(plain):
+        findings.append(_finding(
+            "PTC008",
+            f"SDC-checked step changed the collective structure: "
+            f"plain {_collective_tally(plain)} vs checked "
+            f"{_collective_tally(sdc_jx)}",
+            form.name,
+        ))
+    cbs = callback_prims(sdc_jx)
+    if cbs:
+        findings.append(_finding(
+            "PTC008",
+            f"SDC-checked step emits host callback(s) "
+            f"{sorted(set(cbs))}",
+            form.name,
+        ))
+    if form.f32:
+        hits = f64_avals(sdc_jx)
+        if hits:
+            findings.append(_finding(
+                "PTC008",
+                "SDC check tail promotes to f64 in f32 config: "
+                + "; ".join(sorted(set(hits))[:4]),
+                form.name,
+            ))
+    out_avals = jax.tree_util.tree_leaves(
+        jax.eval_shape(sdc_fn, w, *args)
+    )
+    r_aval = (tuple(args[0].shape), np.dtype(args[0].dtype))
+    if not any(
+        (tuple(o.shape), np.dtype(o.dtype)) == r_aval
+        for o in out_avals
+    ):
+        findings.append(_finding(
+            "PTC008",
+            "SDC-checked step has no output aval matching the donated "
+            "rank buffer: donation can never be consumed",
+            form.name,
+        ))
     return findings
 
 

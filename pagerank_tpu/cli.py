@@ -23,6 +23,7 @@ import warnings
 import numpy as np
 
 from pagerank_tpu import PageRankConfig, build_graph, jobs, make_engine, obs
+from pagerank_tpu import sdc as sdc_mod
 from pagerank_tpu.exitcodes import ExitCode
 from pagerank_tpu.utils import fsio
 from pagerank_tpu.utils.metrics import MetricsLogger
@@ -235,6 +236,36 @@ def build_parser() -> argparse.ArgumentParser:
     ft.add_argument(
         "--no-health-checks", action="store_true",
         help="disable the per-step solver health check entirely",
+    )
+    ft.add_argument(
+        "--sdc-check-every", type=int, default=0, metavar="K",
+        help="silent-data-corruption defense (docs/ROBUSTNESS.md "
+        "'Silent data corruption'; pagerank_tpu/sdc.py): every K-th "
+        "step runs the ABFT-checked variant — per-device "
+        "random-projection fingerprints, dual w.r computation, "
+        "link-mass conservation, and the mass-ledger identity, all "
+        "inside the step's own dispatch (contract PTC008: the exact "
+        "collective multiset of the plain step). A breach triggers a "
+        "deadline-bounded re-execution from the retained state: a "
+        "clean redo is TRANSIENT (counted, continue); a repeat breach "
+        "on the same device is STICKY and quarantines that chip "
+        "through the elastic rescue path (--stall-action rescue), "
+        "persisting the id in job.json so a resumed job never "
+        "re-adopts it. 0 (default) disables: the solve is "
+        "bit-identical with ZERO check computations; jax engine, "
+        "stepwise loop only",
+    )
+    ft.add_argument(
+        "--sdc-seed", type=int, default=0,
+        help="seed of the SDC random-projection fingerprint vector "
+        "(reproducible per (seed, state length))",
+    )
+    ft.add_argument(
+        "--sdc-redo-deadline", type=float, default=30.0,
+        metavar="SECONDS",
+        help="wall-clock budget for one SDC breach's bounded "
+        "re-execution window before the episode escalates "
+        "(quarantine when attributed, a diagnostic error otherwise)",
     )
     p.add_argument("--log-every", type=int, default=1, help="0 silences per-iter logs")
     p.add_argument("--jsonl", default=None, help="append per-iter metrics to this JSONL file")
@@ -881,6 +912,13 @@ def _robustness_summary(args, engine, guard) -> dict:
         "s3_request_retries": _s3_retry_total(
             (args.snapshot_dir, args.dump_text_dir, args.out, args.jsonl)
         ),
+        # SDC plane (ISSUE 15; pagerank_tpu/sdc.py): detection /
+        # classification / quarantine counts — zero on a disarmed run.
+        "sdc_flips_detected": int(counters.get("sdc.flips_detected", 0)),
+        "sdc_transient_flips": int(
+            counters.get("sdc.transient_flips", 0)),
+        "sdc_quarantined_devices": int(
+            counters.get("sdc.quarantined_devices", 0)),
     }
 
 
@@ -1000,6 +1038,9 @@ def _export_observability(args, tracer, cfg, graph, metrics, summary,
         # dir; the marker lets `obs report` say which it was.
         "interrupted": interrupted is not None,
         "probes": probes.history if probes is not None else [],
+        # SDC plane (ISSUE 15): the detection/classification summary
+        # — empty on a disarmed run, diffed by `obs report A B`.
+        "sdc": sdc_mod.report_section(),
     }
     if error is not None:
         extra["error"] = repr(error)
@@ -1416,6 +1457,25 @@ def _run(args, ctx, drain) -> int:
     if args.device_sample_every < 0:
         print("--device-sample-every must be >= 0", file=sys.stderr)
         return int(ExitCode.USAGE)
+    if args.sdc_check_every:
+        # Pure-args validation before the graph load: the SDC guard
+        # drives the STEPWISE loop (retain/redo needs host control
+        # between steps) and measures per-device invariants only the
+        # jax engine's mesh has.
+        if args.sdc_check_every < 0:
+            print("--sdc-check-every must be >= 0", file=sys.stderr)
+            return int(ExitCode.USAGE)
+        if args.fused:
+            print("--sdc-check-every drives the stepwise loop "
+                  "(bounded re-execution needs host control between "
+                  "steps); incompatible with --fused",
+                  file=sys.stderr)
+            return int(ExitCode.USAGE)
+        if args.engine != "jax":
+            print("--sdc-check-every requires --engine jax (the ABFT "
+                  "invariants are per-device measurements)",
+                  file=sys.stderr)
+            return int(ExitCode.USAGE)
     if args.job_dir:
         # Pure-args validation + defaults BEFORE any work: the
         # resumable stage machine covers the global-PageRank pipeline;
@@ -1443,6 +1503,7 @@ def _run(args, ctx, drain) -> int:
     obs.costs.reset()
     obs.hlo.reset()
     obs.graph_profile.reset()
+    sdc_mod.reset()
     if args.graph_profile:
         # Data-plane profiler (ISSUE 13): armed BEFORE the graph load
         # so a --device-build computes the profile inside the build's
@@ -1456,6 +1517,11 @@ def _run(args, ctx, drain) -> int:
     # report. Finding a prior manifest in the dir counts a resume.
     job = jobs.JobSupervisor(args.job_dir) if args.job_dir else None
     ctx["job"] = job
+    if job is not None and args.sdc_check_every:
+        # Convictions persist AT conviction time (ISSUE 15): a sticky
+        # chip lands in job.json even when no elastic rescue is wired
+        # to survive it — the resumed job excludes it either way.
+        sdc_mod.set_quarantine_hook(job.quarantine_devices)
     if args.preflight and args.synthetic:
         # Synthetic geometry is knowable from the spec alone: the fit
         # check runs BEFORE any graph work — the whole point (a
@@ -1520,6 +1586,8 @@ def _run(args, ctx, drain) -> int:
         snapshot_dir=args.snapshot_dir,
         snapshot_every=args.snapshot_every,
         log_every=args.log_every,
+        sdc_check_every=args.sdc_check_every,
+        sdc_seed=args.sdc_seed,
         robustness=RobustnessConfig(
             health_checks=not args.no_health_checks,
             mass_tol=args.mass_tol,
@@ -1527,6 +1595,7 @@ def _run(args, ctx, drain) -> int:
             max_rescues=args.max_rescues,
             write_attempts=args.write_retries,
             on_write_failure=args.on_write_failure,
+            sdc_redo_deadline_s=args.sdc_redo_deadline,
         ),
     )
     if args.lane_group is not None:
@@ -1618,7 +1687,35 @@ def _run(args, ctx, drain) -> int:
     else:
         if job is not None:
             job.begin("solve")
-        engine = make_engine(args.engine, cfg)
+        # Persisted SDC quarantine (ISSUE 15): a resumed job must
+        # never re-adopt a chip a prior run convicted of sticky
+        # corruption — the initial mesh already excludes the ids
+        # recorded in job.json.
+        quarantined = set(job.quarantined_devices()) if job is not None \
+            else set()
+        if quarantined and args.engine == "jax":
+            from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+            from pagerank_tpu.parallel import mesh as mesh_lib
+
+            try:
+                # THE one spelling of "the mesh minus the casualty
+                # list" — shared with ElasticRunner's rescue path.
+                devs = mesh_lib.surviving_devices(sorted(quarantined))
+            except RuntimeError as e:
+                raise SystemExit(str(e))
+            if cfg.num_devices:
+                devs = devs[:cfg.num_devices]
+            print(
+                f"excluding quarantined device(s) "
+                f"{sorted(quarantined)} (job manifest); building on "
+                f"{len(devs)} device(s)",
+                file=sys.stderr,
+            )
+            cfg = cfg.replace(num_devices=len(devs)).validate()
+            ctx["cfg"] = cfg
+            engine = JaxTpuEngine(cfg, devices=devs)
+        else:
+            engine = make_engine(args.engine, cfg)
         ctx["engine"] = engine
         if args.device_build:
             engine.build_device(graph)
@@ -1972,12 +2069,25 @@ def _run(args, ctx, drain) -> int:
                             )
                             return e.build(graph)
 
+                        runner_ref = {}
+
                         def _rebound(e):
                             engine_ref["engine"] = e
                             ctx["engine"] = e
                             if snap is not None:
-                                snap.mesh_meta = e.snapshot_meta()
+                                meta = e.snapshot_meta()
+                                q = runner_ref.get("runner")
+                                if q is not None and \
+                                        q.quarantined_device_ids:
+                                    meta["quarantined_devices"] = \
+                                        sorted(q.quarantined_device_ids)
+                                snap.mesh_meta = meta
 
+                        # Conviction persistence rides the sdc
+                        # quarantine hook (set at job creation above)
+                        # — it fires AT conviction time, before the
+                        # rescue even starts, so no on_quarantine
+                        # callback is needed here.
                         runner = ElasticRunner(
                             engine, _factory, snapshotter=roll_snap,
                             max_rescues=cfg.robustness.rescue_budget(),
@@ -1986,7 +2096,9 @@ def _run(args, ctx, drain) -> int:
                                     cfg.robustness.straggler_factor),
                             ),
                             on_rebuild=_rebound,
+                            exclude_device_ids=sorted(quarantined),
                         )
+                        runner_ref["runner"] = runner
                         ranks = runner.run(on_iteration=on_iteration,
                                            probes=probes)
                         engine = engine_ref["engine"]
@@ -2082,12 +2194,21 @@ def _run(args, ctx, drain) -> int:
     rollbacks = rb_summary["rollbacks"]
     rescues = rb_summary["rescues"]
     io_retries = rb_summary["s3_request_retries"]
-    if rollbacks or rescues or guard.retries or guard.dropped or io_retries:
+    sdc_detected = rb_summary["sdc_flips_detected"]
+    if (rollbacks or rescues or guard.retries or guard.dropped
+            or io_retries or sdc_detected):
         parts = [f"{rollbacks} rollback(s)", f"{guard.retries} write retr(y/ies)"]
         if rescues:
             parts.append(
                 f"{rescues} elastic rescue(s) "
                 f"({rb_summary['devices_lost']} device(s) lost)"
+            )
+        if sdc_detected:
+            parts.append(
+                f"{sdc_detected} SDC breach(es) "
+                f"({rb_summary['sdc_transient_flips']} transient, "
+                f"{rb_summary['sdc_quarantined_devices']} "
+                f"quarantined chip(s))"
             )
         if io_retries:
             parts.append(f"{io_retries} s3 request retr(y/ies)")

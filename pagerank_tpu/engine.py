@@ -90,6 +90,23 @@ class PageRankEngine(abc.ABC):
         The jax engine overrides with the real mesh/layout view."""
         return {"num_devices": 1, "engine": self.name}
 
+    def sdc_supported(self) -> bool:
+        """Whether this engine can run the SDC-checked step (ISSUE 15;
+        pagerank_tpu/sdc.py). The base engine cannot — the invariants
+        need per-device check partials only a device mesh has."""
+        return False
+
+    def retain_state(self, iteration=None):
+        """Opaque rewind token (iteration, rank copy) — the SDC redo's
+        double buffer. Base impl holds a host copy; the jax engine
+        keeps it on device."""
+        it = self.iteration if iteration is None else int(iteration)
+        return (it, np.array(self.ranks(), copy=True))
+
+    def restore_state(self, token) -> None:
+        it, ranks = token
+        self.set_ranks(np.array(ranks, copy=True), iteration=int(it))
+
     # -- convergence probes (obs/probes.py; ISSUE 5) -----------------------
 
     def probe_values(self, k: int, prev_ids):
@@ -230,14 +247,33 @@ class PageRankEngine(abc.ABC):
         sampler = obs_devices.get_sampler()
         probing = probes is not None and probes.enabled
         probe_ids = None
+        # SDC guard (ISSUE 15; pagerank_tpu/sdc.py): built ONCE per
+        # run, None when --sdc-check-every is 0 — the loop body then
+        # adds one `is not None` check per iteration and the solve is
+        # bit-identical to the unchecked path (zero check
+        # computations; tests/test_sdc.py booby-traps it).
+        sdc_guard = None
+        if getattr(self.config, "sdc_check_every", 0):
+            from pagerank_tpu import sdc as sdc_mod
+
+            sdc_guard = sdc_mod.attach_guard(self)
         while self.iteration < total:
             probe_now = probing and probes.wants(self.iteration)
+            sdc_now = (sdc_guard is not None
+                       and sdc_guard.wants(self.iteration))
             if trace_steps:
                 with tracer.span("solve/step", iteration=self.iteration):
-                    if probe_now:
+                    if sdc_now:
+                        # Checked step: detect -> bounded redo ->
+                        # transient/sticky; a sticky conviction raises
+                        # DeviceQuarantinedError for the rescue path.
+                        info = sdc_guard.checked_step()
+                    elif probe_now:
                         info, probe_ids = self.step_probed(probes)
                     else:
                         info = self.step()
+            elif sdc_now:
+                info = sdc_guard.checked_step()
             elif probe_now:
                 info, probe_ids = self.step_probed(probes)
             else:
@@ -318,6 +354,12 @@ class PageRankEngine(abc.ABC):
                     )
                 it0, ranks, _meta = rolled
                 self.set_ranks(ranks, iteration=it0)
+                if sdc_guard is not None:
+                    # The SDC double buffer must follow the rollback:
+                    # a retained token AHEAD of the restored iteration
+                    # would let a later redo jump the solve forward
+                    # onto the rejected state.
+                    sdc_guard.note_rollback()
                 self.health["rollbacks"] += 1
                 obs_metrics.counter(
                     "engine.rollbacks",
@@ -333,7 +375,16 @@ class PageRankEngine(abc.ABC):
                 # Committed only AFTER the health check accepted the
                 # step (rolled-back iterates `continue` above) and
                 # after on_iteration saw the probe-augmented info.
-                rec = probes.commit(i, info, *probe_ids)
+                if sdc_now:
+                    # The SDC-checked step took this iteration, so the
+                    # fused probe tail never ran: probe the boundary
+                    # standalone (the fused-chunk idiom) — same
+                    # record shape, one extra small dispatch at
+                    # overlapping cadences only.
+                    rec = probes.probe_boundary(
+                        self, i, l1_delta=info.get("l1_delta"))
+                else:
+                    rec = probes.commit(i, info, *probe_ids)
                 if probes.should_stop(rec):
                     break
             if tol is not None:

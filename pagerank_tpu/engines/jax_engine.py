@@ -3190,6 +3190,220 @@ class JaxTpuEngine(PageRankEngine):
         ids_orig = self._perm[ids_np] if self._perm is not None else ids_np
         return info, (ids, np.asarray(ids_orig))
 
+    # -- silent-data-corruption checks (ISSUE 15; pagerank_tpu/sdc.py) -----
+
+    def sdc_supported(self) -> bool:
+        """Whether this build can run the SDC-checked step: it rides
+        the rank-mass-ledger cores (ISSUE 13), so every form that
+        stashed one qualifies — the fused single-program forms via
+        ``_step_core_ledger``, the multi-dispatch forms via the ledger
+        finalize."""
+        return (self._step_core_ledger is not None
+                or self._ms_final_ledger is not None)
+
+    def retain_state(self, iteration: Optional[int] = None):
+        """Device-side double buffer for the SDC redo (and any caller
+        that must rewind without a snapshot round-trip): an opaque
+        ``(iteration, rank copy)`` token. The copy stays on device —
+        no host transfer, no decode."""
+        it = self.iteration if iteration is None else int(iteration)
+        return (it, jnp.copy(self._r))
+
+    def restore_state(self, token) -> None:
+        """Rewind to a :meth:`retain_state` token (the token itself
+        stays reusable — a second redo restores the same bits)."""
+        it, r = token
+        self._r = jnp.copy(r)
+        self.iteration = int(it)
+
+    def _sdc_w(self):
+        """The seeded Rademacher projection vector, placed at the
+        state sharding in the accumulation dtype (+-1 is exact in any
+        float dtype). Built lazily on the first checked step — a
+        disarmed run never touches it (the booby-trap contract)."""
+        w = self._fused_cache.get("sdc_w")
+        if w is None:
+            from pagerank_tpu import sdc as sdc_mod
+
+            host = sdc_mod.fingerprint_vector(
+                self.config.sdc_seed, self._n_state
+            ).astype(self._accum_dtype)
+            w = jax.device_put(jnp.asarray(host), self._state_sharding)
+            self._fused_cache["sdc_w"] = w
+        return w
+
+    def _sdc_specs(self):
+        """(state in-spec, per-device out-spec) of the check programs:
+        replicated forms run each check over every device's OWN copy
+        of the state (the copy-consistency invariant needs exactly
+        that), sharded forms over each device's shard — either way the
+        [1]-shaped local reductions concatenate to [ndev] under a
+        ``P(axis)`` out-spec with NO collective joining the program
+        (the ``_ledger_partials`` discipline)."""
+        axis = self.config.mesh_axis
+        state = P(axis) if self.config.vertex_sharded else P()
+        return state, P(axis)
+
+    def _sdc_has_inv(self) -> bool:
+        return getattr(self, "_inv_out", None) is not None
+
+    def _get_sdc_state_fn(self):
+        """The standalone boundary-state check program: per-device
+        (w.r fingerprint, rank-mass, source-mass) local reductions
+        over the CURRENT state — the dual-fingerprint counterpart of
+        the in-step tail, and the multi-dispatch layouts' whole check
+        (dispatched around the pipelined step like the standalone
+        probe). Collective- and callback-free by contract (PTC008)."""
+        fn = self._fused_cache.get("sdc_state_fn")
+        if fn is None:
+            accum = self._accum_dtype
+            state_spec, out_spec = self._sdc_specs()
+            has_inv = self._sdc_has_inv()
+
+            def body(w, r, *inv):
+                ra = r.astype(accum)
+                fp = jnp.reshape(jnp.sum(ra * w), (1,))
+                mass = jnp.reshape(jnp.sum(ra), (1,))
+                if inv:
+                    src = jnp.reshape(jnp.sum(
+                        jnp.where(inv[0] != 0, ra,
+                                  jnp.zeros((), accum))), (1,))
+                else:
+                    src = jnp.zeros(1, accum)
+                return fp, mass, src
+
+            sm = shard_map(
+                body, mesh=self._mesh,
+                in_specs=(state_spec,) * (3 if has_inv else 2),
+                out_specs=(out_spec,) * 3,
+                # Replicated-input forms compute a per-copy value the
+                # static varying-mesh-axes checker cannot type.
+                check_vma=False,
+            )
+            fn = jax.jit(sm)
+            self._fused_cache["sdc_state_fn"] = fn
+        return fn
+
+    def _get_sdc_step(self):
+        """The SDC-checked fused step: the LEDGER core (same body,
+        same collective multiset — PTC008 proves it) plus the ABFT
+        check tail as one more shard_map of local reductions in the
+        SAME program: per-device fingerprints/masses over the input
+        and output rank vectors and the directly-measured source
+        mass. The rank donation stays consumable exactly like the
+        plain step's."""
+        fn = self._fused_cache.get("sdc_step")
+        if fn is None:
+            core = self._step_core_ledger
+            accum = self._accum_dtype
+            state_spec, out_spec = self._sdc_specs()
+            has_inv = self._inv_in_args
+
+            def check_body(w, r_in, r_out, *inv):
+                ra, rb = r_in.astype(accum), r_out.astype(accum)
+                fp_in = jnp.reshape(jnp.sum(ra * w), (1,))
+                mass_in = jnp.reshape(jnp.sum(ra), (1,))
+                if inv:
+                    src_in = jnp.reshape(jnp.sum(
+                        jnp.where(inv[0] != 0, ra,
+                                  jnp.zeros((), accum))), (1,))
+                else:
+                    src_in = jnp.zeros(1, accum)
+                fp_out = jnp.reshape(jnp.sum(rb * w), (1,))
+                mass_out = jnp.reshape(jnp.sum(rb), (1,))
+                return fp_in, mass_in, src_in, fp_out, mass_out
+
+            check = shard_map(
+                check_body, mesh=self._mesh,
+                in_specs=(state_spec,) * (4 if has_inv else 3),
+                out_specs=(out_spec,) * 5,
+                check_vma=False,
+            )
+
+            def sdc_core(w, *args):
+                r = args[0]
+                r2, delta, m, ck, rt, pv = core(*args)
+                extra = (args[1],) if has_inv else ()
+                checks = check(w, r, r2, *extra)
+                return (r2, delta, m, ck, rt, pv, *checks)
+
+            from pagerank_tpu.utils.compile_cache import usable_donations
+
+            donate = usable_donations(
+                sdc_core, (self._sdc_w(), *self._device_args()), (1,)
+            )
+            with obs_trace.span("engine/compile", form="sdc_step"):
+                fn = jax.jit(sdc_core, donate_argnums=donate)
+            self._fused_cache["sdc_step"] = fn
+        return fn
+
+    def sdc_state_values(self):
+        """One standalone boundary-state check dispatch over the
+        current state; per-device numpy arrays on host (full-copy
+        values on replicated forms, per-shard partials otherwise)."""
+        w = self._sdc_w()
+        inv = (self._inv_out,) if self._sdc_has_inv() else ()
+        fp, mass, src = self._get_sdc_state_fn()(w, self._r, *inv)
+        fp_h, mass_h, src_h = jax.device_get((fp, mass, src))
+        # Plain host arrays in the device dtype — the evaluator
+        # (sdc.evaluate_check) upcasts once, where the reconciliation
+        # arithmetic actually happens.
+        return {
+            "fp": np.asarray(fp_h),
+            "mass": np.asarray(mass_h),
+            "src": (np.asarray(src_h)
+                    if self._sdc_has_inv() else None),
+        }
+
+    def step_sdc(self):
+        """One SDC-checked iteration: ``(info, check record)``. On
+        single-program layouts the ledger core and the check tail run
+        in ONE dispatch; on multi-dispatch layouts the pipelined
+        ledger sequence is bracketed by two standalone state-check
+        dispatches (input and output side) — still zero collectives
+        beyond the form's own budget. Never called when SDC checking
+        is off (the zero-computation contract, tests/test_sdc.py)."""
+        sharded = bool(self.config.vertex_sharded)
+        has_inv = self._sdc_has_inv()
+        if self._ms_stripe is not None:
+            w = self._sdc_w()
+            inv = (self._inv_out,) if has_inv else ()
+            state_fn = self._get_sdc_state_fn()
+            fin, min_, sin = state_fn(w, self._r, *inv)
+            delta, m, (lk, rt, pv) = self._device_step_ledger()
+            fout, mout, _ = state_fn(w, self._r, *inv)
+            host = jax.device_get(
+                (delta, m, lk, rt, pv, fin, min_, sin, fout, mout))
+        else:
+            fn = self._get_sdc_step()
+            (self._r, delta, m, lk, rt, pv, fin, min_, sin, fout,
+             mout) = fn(self._sdc_w(), *self._device_args())
+            self._note_comms(1)
+            host = jax.device_get(
+                (delta, m, lk, rt, pv, fin, min_, sin, fout, mout))
+        (d_h, m_h, lk_h, rt_h, pv_h, fin_h, min_h, sin_h, fout_h,
+         mout_h) = host
+        mout_np = np.asarray(mout_h)
+        chk = {
+            "sharded": sharded,
+            "fp_in": np.asarray(fin_h),
+            "mass_in": np.asarray(min_h),
+            "src_in": np.asarray(sin_h) if has_inv else None,
+            "fp_out": np.asarray(fout_h),
+            "mass_out": mout_np,
+            "contrib": np.asarray(lk_h),
+            "retained": np.asarray(rt_h),
+            "mass_prev": np.asarray(pv_h),
+            "dangling_mass": float(m_h),
+        }
+        info = {
+            "l1_delta": float(d_h),
+            "dangling_mass": float(m_h),
+            "rank_mass": float(mout_np.astype(float).sum() if sharded
+                               else np.median(mout_np)),
+        }
+        return info, chk
+
     # -- cost accounting (obs/costs.py; ISSUE 5) ---------------------------
 
     def cost_reports(self, refresh: bool = False) -> Dict[str, dict]:

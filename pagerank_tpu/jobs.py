@@ -684,6 +684,26 @@ class JobSupervisor:
             )
             return None
 
+    # -- SDC quarantine persistence (ISSUE 15; pagerank_tpu/sdc.py) ---------
+
+    def quarantined_devices(self) -> List[int]:
+        """Device ids convicted of sticky silent data corruption in
+        ANY run of this job — a resumed job must never re-adopt a
+        known-bad chip, so the exclusion list rides the manifest
+        (atomic rewrite, like every stage transition)."""
+        return [int(d) for d in
+                self.manifest.get("quarantined_devices", [])]
+
+    def quarantine_devices(self, device_ids) -> None:
+        """Merge freshly convicted device ids into the persisted
+        exclusion list (idempotent; survives resumes)."""
+        have = set(self.quarantined_devices())
+        new = sorted(have | {int(d) for d in device_ids})
+        if new == sorted(have):
+            return
+        self.manifest["quarantined_devices"] = new
+        self._write_manifest()
+
     def save_names(self, names, key: str) -> None:
         """Persist an ingest id->name table (crawl inputs) next to the
         stage artifacts so a resumed job's --out/--dump-text-dir still
@@ -726,4 +746,7 @@ class JobSupervisor:
         }
         if "interrupted_after" in self.manifest:
             out["interrupted_after"] = self.manifest["interrupted_after"]
+        quarantined = self.quarantined_devices()
+        if quarantined:
+            out["quarantined_devices"] = quarantined
         return out

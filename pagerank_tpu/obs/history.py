@@ -90,6 +90,11 @@ LEG_METRICS = (
     "graph_dangling_fraction",
     "graph_partition_skew",
     "graph_topk_concentration",
+    # ISSUE 15: per-checked-iteration SDC detection overhead (percent
+    # extra wall vs the plain step) — present only on legs measured
+    # with ``bench.py --sdc-check-every`` armed; None-tolerant like
+    # every leg metric (disarmed legs simply lack the key).
+    "sdc_check_overhead_pct",
 )
 
 #: Profile scalars whose motion marks the DATA axis (classify_change
@@ -120,6 +125,7 @@ METRIC_BAD_DIRECTION = {
     "graph_dangling_fraction": "up",
     "graph_partition_skew": "up",
     "graph_topk_concentration": "up",
+    "sdc_check_overhead_pct": "up",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -214,6 +220,13 @@ def _rate_leg(d: dict) -> dict:
     # can attribute a move to the DATA axis. Pre-ISSUE-13 artifacts
     # lack the key (back-compat, same discipline as lowering).
     _leg_graph(d.get("graph"), leg)
+    # SDC-plane overhead (ISSUE 15; bench legs since r15): present
+    # only when the leg was measured with the checked step armed —
+    # disarmed legs lack the key (None-tolerant by schema contract,
+    # tests/test_bench_contract.py).
+    so = _num(d.get("sdc_check_overhead_pct"))
+    if so is not None:
+        leg["sdc_check_overhead_pct"] = so
     nd = d.get("n_devices")
     if isinstance(nd, int):
         leg["n_devices"] = nd

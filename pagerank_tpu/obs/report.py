@@ -59,10 +59,14 @@ SCHEMA_VERSION = 1
 #: structural profile and the skew-driven load prediction
 #: (obs/graph_profile.py; diffed FIRST by ``obs report A B`` as data
 #: drift, like env drift).
+#: ``sdc`` (ISSUE 15) is the silent-data-corruption section:
+#: check/breach/transient/sticky counts, the quarantined device ids,
+#: and the last breach's invariant detail (pagerank_tpu/sdc.py) —
+#: empty unless ``--sdc-check-every`` armed the plane.
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
     "metrics", "iterations", "summary", "robustness", "costs",
-    "devices", "lowering", "job", "graph",
+    "devices", "lowering", "job", "graph", "sdc",
 )
 
 
@@ -206,6 +210,10 @@ def build_run_report(
         # with obs/graph_profile.report_section); the key is always
         # present so consumers never key-error.
         "graph": {},
+        # SDC plane (ISSUE 15): producers override via
+        # ``extra["sdc"]`` (pagerank_tpu/sdc.report_section); always
+        # present, empty on a disarmed run.
+        "sdc": {},
     }
     if extra:
         report.update(_json_safe(extra))
@@ -311,6 +319,26 @@ def render_report(report: dict) -> str:
             "robustness: "
             + ", ".join(f"{k}={v}" for k, v in rb.items() if v)
         )
+    sdc = report.get("sdc") or {}
+    if sdc:
+        lines.append(
+            f"sdc: {sdc.get('checks', 0)} checked step(s), "
+            f"{sdc.get('flips_detected', 0)} breach(es) "
+            f"({sdc.get('transient', 0)} transient, "
+            f"{sdc.get('sticky', 0)} sticky), quarantined "
+            f"{sdc.get('quarantined_devices') or []}"
+        )
+        lb = sdc.get("last_breach") or {}
+        if lb:
+            kinds = ", ".join(
+                r.get("kind", "?") for r in (lb.get("reasons") or []))
+            lines.append(
+                f"  last breach @ iter {lb.get('iteration')}: {kinds}"
+                + (f" -> {lb.get('classified')}"
+                   if lb.get("classified") else "")
+                + (f" (device {lb.get('device')})"
+                   if lb.get("device") is not None else "")
+            )
     jb = report.get("job") or {}
     if jb.get("stages"):
         mark = ("INTERRUPTED" if report.get("interrupted")
@@ -625,6 +653,25 @@ def diff_reports(a: dict, b: dict) -> str:
             lines.append("job-stage deltas (resume skips vs executed "
                          "work):")
             lines.extend(job_lines)
+
+    # SDC-plane deltas (ISSUE 15): detection/classification/quarantine
+    # movement between two runs — "did the integrity plane fire" as a
+    # mechanical diff, next to the robustness counters it extends.
+    xa, xb = a.get("sdc") or {}, b.get("sdc") or {}
+    if xa or xb:
+        sdc_lines = []
+        for k in ("checks", "flips_detected", "transient", "sticky"):
+            va, vb = xa.get(k, 0), xb.get(k, 0)
+            if va != vb:
+                sdc_lines.append(f"  {k}: {va} -> {vb}")
+        qa_, qb_ = (xa.get("quarantined_devices") or [],
+                    xb.get("quarantined_devices") or [])
+        if qa_ != qb_:
+            sdc_lines.append(
+                f"  quarantined_devices: {qa_!r} -> {qb_!r}")
+        if sdc_lines:
+            lines.append("sdc deltas (silent-data-corruption plane):")
+            lines.extend(sdc_lines)
 
     ca = (a.get("metrics") or {}).get("counters") or {}
     cb = (b.get("metrics") or {}).get("counters") or {}

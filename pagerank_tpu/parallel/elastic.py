@@ -64,6 +64,20 @@ class DeviceLostError(RuntimeError):
         self.device_ids = tuple(device_ids)
 
 
+class DeviceQuarantinedError(DeviceLostError):
+    """A device is ALIVE but LYING: the SDC plane (pagerank_tpu/sdc.py,
+    ISSUE 15) convicted it of sticky silent data corruption — repeat
+    ABFT-invariant breaches across a clean-state re-execution, both
+    attributing to the same chip. The rescue path treats the carried
+    ids as lost (teardown -> re-shard over the remaining devices) even
+    though every liveness probe answers, and records them in
+    ``ElasticRunner.quarantined_device_ids`` (the ``on_quarantine``
+    hook fires for runner-side consumers). Durable persistence
+    (job.json) happens AT conviction time via the sdc quarantine hook
+    — before this error even raises — so a resumed job never
+    re-adopts a known-bad chip."""
+
+
 class ElasticExhaustedError(RuntimeError):
     """The rescue budget is spent (or no devices survive). Carries the
     full casualty list and the rescue count — the 3am-page diagnostic,
@@ -235,6 +249,8 @@ class ElasticRunner:
         resume_timeout_s: float = 60.0,
         monitor: Optional[DeviceHealthMonitor] = None,
         on_rebuild: Optional[Callable[[object], None]] = None,
+        exclude_device_ids: Sequence[int] = (),
+        on_quarantine: Optional[Callable[[Sequence[int]], None]] = None,
     ):
         self.engine = engine
         self._factory = engine_factory
@@ -245,9 +261,22 @@ class ElasticRunner:
         self._resume_timeout_s = float(resume_timeout_s)
         self.monitor = monitor
         self._on_rebuild = on_rebuild
+        self._on_quarantine = on_quarantine
         self.rescues = 0
         self.restarts = 0  # rescues that found no snapshot (iteration 0)
         self.lost_device_ids: List[int] = []
+        # Devices a rescue must NEVER rebuild over: the persisted
+        # quarantine list (ISSUE 15) — known-bad chips from prior
+        # runs. Kept SEPARATE from lost_device_ids (the casualty
+        # record the 3am-page diagnostics report): a healthy-but-
+        # excluded chip is not a loss of THIS run — the two lists
+        # merge only where the next mesh is chosen.
+        self.excluded_device_ids: List[int] = [
+            int(d) for d in exclude_device_ids
+        ]
+        #: Devices convicted of sticky SDC THIS run (a subset of
+        #: lost_device_ids once their rescue fires).
+        self.quarantined_device_ids: List[int] = []
         obs_metrics.gauge(
             "elastic.mesh_devices", "devices in the current solve mesh"
         ).set(self._ndev())
@@ -291,7 +320,8 @@ class ElasticRunner:
                             dead_devices=",".join(map(str, dead))) as sp:
             try:
                 survivors = mesh_lib.surviving_devices(
-                    self.lost_device_ids, self._devices()
+                    self.lost_device_ids + self.excluded_device_ids,
+                    self._devices(),
                 )
             except RuntimeError as e:
                 raise ElasticExhaustedError(
@@ -435,6 +465,23 @@ class ElasticRunner:
             except Exception as e:
                 if not looks_like_device_loss(e):
                     raise
-                if self._classify_and_rescue(e, f"step failure: "
-                                             f"{type(e).__name__}") is None:
+                cause = f"step failure: {type(e).__name__}"
+                if isinstance(e, DeviceQuarantinedError):
+                    # An SDC conviction (ISSUE 15): the chip ANSWERS
+                    # liveness probes but cannot be trusted — record
+                    # it, persist it via the hook, and rescue on the
+                    # carried ids (classify unions them with any probe
+                    # casualties).
+                    self._note_quarantine(e.device_ids)
+                    cause = "sdc quarantine"
+                if self._classify_and_rescue(e, cause) is None:
                     raise
+
+    def _note_quarantine(self, device_ids: Sequence[int]) -> None:
+        new = [int(d) for d in device_ids
+               if int(d) not in self.quarantined_device_ids]
+        if not new:
+            return
+        self.quarantined_device_ids.extend(new)
+        if self._on_quarantine is not None:
+            self._on_quarantine(list(self.quarantined_device_ids))

@@ -11,10 +11,13 @@ seams instead:
   (tests/s3stub.S3Stub.fault_hook) to answer 5xx/SlowDown, drop
   connections mid-body, or lose a multipart-complete response;
 - :class:`DeviceFaultSchedule` + :func:`install_device_faults`
-  (ISSUE 7) inject DEVICE-plane faults — kill device k at iteration i,
-  delay a step to simulate a straggler, poison the merged collective
-  output — through a mesh-aware shim over the engine's step, so the
-  elastic rescue path (parallel/elastic.py) is fully testable on CPU
+  (ISSUE 7, ISSUE 15) inject DEVICE-plane faults — kill device k at
+  iteration i, delay a step to simulate a straggler, poison the merged
+  collective output, or silently FLIP one bit of one device's rank
+  buffer (mantissa/exponent/sign; one-shot or sticky — the SDC
+  plane's chaos substrate, pagerank_tpu/sdc.py) — through a mesh-aware
+  shim over the engine's step, so the elastic rescue and SDC
+  quarantine paths (parallel/elastic.py) are fully testable on CPU
   with ``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
 
 Everything is driven by a schedule whose decisions are a pure function
@@ -425,6 +428,102 @@ def run_job_subprocess(argv: Sequence[str],
 # -- device-plane faults (ISSUE 7; parallel/elastic.py) ----------------------
 
 
+#: Bit-flip fault kinds (ISSUE 15; pagerank_tpu/sdc.py): which bit of
+#: the targeted float element flips. "mantissa" flips the highest
+#: mantissa bit (up to ~2x relative change — well above the derived
+#: SDC tolerance, well below NaN territory); "exponent" flips the
+#: LOWEST exponent bit (x2 / x0.5 magnitude — never produces Inf/NaN
+#: at realistic rank magnitudes, so the SDC plane sees it, not the
+#: NaN health check); "sign" negates the element.
+FLIP_KINDS = ("mantissa", "exponent", "sign")
+
+
+def _flip_bit_index(dtype, kind: str) -> int:
+    dtype = np.dtype(dtype)
+    bits = dtype.itemsize * 8
+    try:
+        mant = int(np.finfo(dtype).nmant)  # f16 10, bf16 7, f32 23...
+    except (ValueError, TypeError):
+        mant = {16: 10, 32: 23, 64: 52}[bits]
+    if kind == "sign":
+        return bits - 1
+    if kind == "exponent":
+        return mant  # lowest exponent bit
+    if kind == "mantissa":
+        return mant - 1  # highest mantissa bit
+    raise ValueError(f"unknown flip kind {kind!r}; have {FLIP_KINDS}")
+
+
+def mutate_rank_shard(engine, device_id: int, mutator):
+    """Rewrite ONE device's buffer of the engine's rank vector through
+    ``mutator(host_copy) -> host_copy`` — the silent-data-corruption
+    injection primitive (ISSUE 15). The logical array is reassembled
+    from per-device buffers with ONLY the targeted device's bytes
+    changed, which is exactly what hardware SDC looks like: on
+    replicated layouts the copies now disagree (the copy-consistency
+    invariant's whole premise), on sharded layouts the owned block is
+    silently wrong. Fake CPU devices make every shard addressable, so
+    the whole machine tests without hardware. Returns the targeted
+    shard's global start offset (localization ground truth for
+    tests)."""
+    import jax
+
+    r = engine._r
+    shards = list(r.addressable_shards)
+    target = None
+    for s in shards:
+        if int(s.device.id) == int(device_id):
+            target = s
+            break
+    if target is None:
+        raise ValueError(
+            f"device {device_id} holds no addressable shard of the "
+            f"rank vector (mesh devices: "
+            f"{sorted(int(s.device.id) for s in shards)})"
+        )
+    idx = target.index[0] if target.index else slice(0, None)
+    lo = int(idx.start or 0)
+    mutated = mutator(np.array(target.data, copy=True), lo)
+    bufs = []
+    for s in shards:
+        arr = mutated if s is target else np.asarray(s.data)
+        bufs.append(jax.device_put(arr, s.device))
+    engine._r = jax.make_array_from_single_device_arrays(
+        r.shape, r.sharding, bufs
+    )
+    return lo
+
+
+def flip_rank_bit(engine, device_id: int, kind: str, frac: float):
+    """Flip one bit (``kind``: mantissa/exponent/sign) of one element
+    of ``device_id``'s rank buffer. ``frac`` in [0, 1) picks the
+    element deterministically among the device's VALID lanes (the
+    relabeled real-vertex prefix / the shard's non-padding lanes), so
+    a seeded schedule reproduces the exact corrupted bit. Returns
+    ``(global_element, bit)`` for the reproducibility log."""
+    out = {}
+
+    def mutator(data, lo):
+        # Valid lanes of THIS buffer: the relabeled real-vertex prefix
+        # intersected with the shard's global range (replicated
+        # buffers hold the whole vector, lo == 0).
+        n_valid = int(min(max(1, engine.graph.n - lo), data.size))
+        element = min(max(0, int(float(frac) * n_valid)),
+                      max(0, data.size - 1))
+        bit = _flip_bit_index(data.dtype, kind)
+        # Same-width unsigned view, whatever the float width (f64/f32
+        # and the 16-bit dtypes alike) — a mismatched view would XOR a
+        # bit of a DIFFERENT element than the record claims.
+        u = data.view(np.dtype(f"uint{data.dtype.itemsize * 8}"))
+        u[element] ^= np.asarray(1 << bit, u.dtype)
+        out["element"] = element
+        out["bit"] = bit
+        return data
+
+    lo = mutate_rank_shard(engine, device_id, mutator)
+    return lo + out["element"], out["bit"]
+
+
 class DeviceFaultSchedule:
     """Seed-deterministic DEVICE-plane fault plan, keyed by ITERATION.
 
@@ -439,10 +538,23 @@ class DeviceFaultSchedule:
     - ``poison``: iterable of iterations whose merged collective
       output is corrupted (NaN state + NaN step info — the numeric
       self-healing plane's rollback handles it, exactly the
-      separation the decision table documents).
+      separation the decision table documents);
+    - ``flip``:   {iteration: (device_id, kind)} — SILENT bit-flip
+      corruption (ISSUE 15): one bit of one element of that device's
+      rank buffer flips (``kind`` in :data:`FLIP_KINDS` —
+      mantissa/exponent/sign; the element rides the seeded per-
+      iteration draw, so the corrupted bit is reproducible). Injected
+      BEFORE the step runs — a lying chip corrupts inputs, not
+      verdicts — and aimed at the SDC plane (pagerank_tpu/sdc.py):
+      no NaN, no error, nothing the ISSUE-3/7 planes can see.
+      One-shot like every fault UNLESS the iteration is listed in
+      ``sticky_flips``: a sticky entry re-fires every time its
+      iteration is consulted, modeling a chip that corrupts every
+      pass — the SDC redo then convicts it (transient-vs-sticky is
+      EXACTLY "does the flip reproduce on re-execution").
 
-    ``kill_rate``/``delay_rate`` add seeded probabilistic chaos on
-    top. Every consulted iteration draws a FIXED number of uniforms
+    ``kill_rate``/``delay_rate``/``flip_rate`` add seeded
+    probabilistic chaos on top. Every consulted iteration draws a FIXED number of uniforms
     from an RNG derived purely from ``(seed, iteration)``, so the
     schedule is a pure function of the seed and the iteration — NOT
     of how many times an iteration is consulted: a post-rescue
@@ -459,8 +571,11 @@ class DeviceFaultSchedule:
         kill: Optional[Dict[int, object]] = None,
         delay: Optional[Dict[int, Tuple[int, float]]] = None,
         poison: Iterable[int] = (),
+        flip: Optional[Dict[int, Tuple[int, str]]] = None,
+        sticky_flips: Iterable[int] = (),
         kill_rate: float = 0.0,
         delay_rate: float = 0.0,
+        flip_rate: float = 0.0,
         delay_s: float = 0.1,
         max_faults: Optional[int] = None,
     ):
@@ -472,8 +587,17 @@ class DeviceFaultSchedule:
         self._delay = {int(i): (int(d), float(s))
                        for i, (d, s) in (delay or {}).items()}
         self._poison = frozenset(int(i) for i in poison)
+        self._flip = {int(i): (int(d), str(k))
+                      for i, (d, k) in (flip or {}).items()}
+        for _i, (_d, k) in self._flip.items():
+            if k not in FLIP_KINDS:
+                raise ValueError(
+                    f"unknown flip kind {k!r}; have {FLIP_KINDS}"
+                )
+        self._sticky_flips = frozenset(int(i) for i in sticky_flips)
         self._kill_rate = kill_rate
         self._delay_rate = delay_rate
+        self._flip_rate = flip_rate
         self._delay_s = delay_s
         self._max_faults = max_faults
         self.faults = 0
@@ -526,6 +650,28 @@ class DeviceFaultSchedule:
         if (self._budget_ok() and iteration in self._poison
                 and ("poison", iteration) not in self._fired):
             fire("poison", ("poison",), "collective output")
+        # Bit flips (ISSUE 15): one-shot unless the iteration is
+        # sticky — a sticky chip re-corrupts on every consult
+        # (including the SDC redo's re-execution, which is what
+        # convicts it). The element fraction rides ``v`` so the exact
+        # corrupted bit is a pure function of (seed, iteration).
+        flip_ok = (("flip", iteration) not in self._fired
+                   or iteration in self._sticky_flips)
+        if self._budget_ok() and flip_ok:
+            ent = self._flip.get(iteration)
+            if (ent is None
+                    and u < (self._kill_rate + self._delay_rate
+                             + self._flip_rate)
+                    and u >= self._kill_rate + self._delay_rate
+                    and alive):
+                ent = (alive[int(v * len(alive))],
+                       FLIP_KINDS[int(u * 997) % len(FLIP_KINDS)])
+            if ent is not None and ent[0] in alive:
+                fire("flip", ("flip", ent[0], ent[1], v),
+                     f"device {ent[0]} {ent[1]} bit, element frac "
+                     f"{v:.6f}"
+                     + (" (sticky)" if iteration in self._sticky_flips
+                        else ""))
         if not actions:
             self.log.append((iteration, "-", ""))
         return actions
@@ -560,7 +706,11 @@ def install_device_faults(engine, schedule: DeviceFaultSchedule,
       (straggler telemetry, never an error);
     - poison: the real step runs, then the merged output is corrupted
       (NaN state + NaN info) — the NUMERIC plane's health check +
-      rollback owns this, not the rescue path.
+      rollback owns this, not the rescue path;
+    - flip:   the device's rank buffer is silently bit-corrupted
+      BEFORE the step dispatches (a lying chip corrupts inputs) — the
+      SDC plane (pagerank_tpu/sdc.py) owns detection; nothing else
+      can see it.
     """
     from pagerank_tpu.parallel.elastic import DeviceLostError
 
@@ -596,8 +746,27 @@ def install_device_faults(engine, schedule: DeviceFaultSchedule,
 
     def split(actions):
         kills = [a for a in actions if a[0] == "kill"]
-        rest = [a for a in actions if a[0] != "kill"]
-        return kills, rest
+        flips = [a for a in actions if a[0] == "flip"]
+        rest = [a for a in actions if a[0] not in ("kill", "flip")]
+        return kills, flips, rest
+
+    def pre_apply(iteration):
+        """Consult the schedule and inject everything that happens
+        BEFORE the step: kills raise, flips corrupt the input state
+        (skipped on engines without a device rank buffer — the CPU
+        oracle). Returns the post-step actions."""
+        kills, flips, rest = split(
+            schedule.decide(iteration, device_ids()))
+        if kills:
+            raise DeviceLostError(
+                f"injected device loss at iteration {iteration} "
+                f"(seed {schedule.seed})",
+                device_ids=[a[1] for a in kills],
+            )
+        for a in flips:
+            if hasattr(engine, "_r"):
+                flip_rank_bit(engine, a[1], a[2], a[3])
+        return rest
 
     # Re-installs rewrap from the ORIGINALS (stashed on first install),
     # never the previous shim — stacking would double-consult the
@@ -609,26 +778,28 @@ def install_device_faults(engine, schedule: DeviceFaultSchedule,
     engine._prefault_step_probed = orig_probed
 
     def step():
-        kills, rest = split(schedule.decide(engine.iteration, device_ids()))
-        if kills:
-            raise DeviceLostError(
-                f"injected device loss at iteration {engine.iteration} "
-                f"(seed {schedule.seed})",
-                device_ids=[a[1] for a in kills],
-            )
+        rest = pre_apply(engine.iteration)
         return apply(rest, orig_step())
 
     def step_probed(probes):
-        kills, rest = split(schedule.decide(engine.iteration, device_ids()))
-        if kills:
-            raise DeviceLostError(
-                f"injected device loss at iteration {engine.iteration} "
-                f"(seed {schedule.seed})",
-                device_ids=[a[1] for a in kills],
-            )
+        rest = pre_apply(engine.iteration)
         info, ids = orig_probed(probes)
         return apply(rest, info), ids
 
     engine.step = step
     engine.step_probed = step_probed
+    # The SDC-checked step (ISSUE 15) is a third dispatch surface of
+    # the same iteration — shimmed identically so a checked boundary
+    # sees the same one-consult-per-iteration schedule.
+    orig_sdc = getattr(engine, "_prefault_step_sdc",
+                       getattr(engine, "step_sdc", None))
+    if orig_sdc is not None:
+        engine._prefault_step_sdc = orig_sdc
+
+        def step_sdc():
+            rest = pre_apply(engine.iteration)
+            info, chk = orig_sdc()
+            return apply(rest, info), chk
+
+        engine.step_sdc = step_sdc
     return engine

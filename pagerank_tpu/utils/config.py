@@ -63,6 +63,21 @@ class RobustnessConfig:
     #: counter, straggler_skew gauge). Never triggers a rescue.
     straggler_factor: float = 4.0
 
+    #: Wall-clock budget for one SDC breach's bounded re-execution
+    #: window (ISSUE 15; pagerank_tpu/sdc.py): the redo replays the
+    #: iterations since the last clean check boundary from the
+    #: retained device-side state; past the deadline the episode
+    #: escalates (quarantine when an attribution stands, a diagnostic
+    #: SdcExhaustedError otherwise).
+    sdc_redo_deadline_s: float = 30.0
+
+    #: Re-executions one SDC breach episode may spend before
+    #: escalating: the first clean redo classifies TRANSIENT, a repeat
+    #: breach attributing to the same device classifies STICKY
+    #: (quarantine) — 2 leaves one extra attempt for a moved
+    #: attribution.
+    sdc_max_redos: int = 2
+
     def validate(self) -> "RobustnessConfig":
         if self.max_rollbacks < 0:
             raise ValueError(
@@ -89,6 +104,15 @@ class RobustnessConfig:
         if self.mass_tol is not None and not (0.0 < self.mass_tol):
             raise ValueError(
                 f"mass_tol must be positive, got {self.mass_tol}"
+            )
+        if self.sdc_redo_deadline_s <= 0:
+            raise ValueError(
+                f"sdc_redo_deadline_s must be positive, got "
+                f"{self.sdc_redo_deadline_s}"
+            )
+        if self.sdc_max_redos < 1:
+            raise ValueError(
+                f"sdc_max_redos must be >= 1, got {self.sdc_max_redos}"
             )
         return self
 
@@ -278,6 +302,24 @@ class PageRankConfig:
     probe_topk: int = 64
     stop_tol: Optional[float] = None
 
+    # Silent-data-corruption defense (ISSUE 15; pagerank_tpu/sdc.py;
+    # docs/ROBUSTNESS.md "Silent data corruption"): every K-th step
+    # runs the SDC-checked variant — per-device ABFT invariants
+    # (replicated-copy fingerprints, dual w.r projection, link-mass
+    # conservation, the mass-ledger identity) computed inside the
+    # step's own dispatch (contract PTC008: the exact collective
+    # multiset of the plain step), with a breach triggering the
+    # bounded redo -> transient/sticky -> quarantine machine. 0
+    # (default) disables: the solve takes the exact unchecked code
+    # path — zero check computations, bit-identical ranks (the
+    # booby-trapped contract, tests/test_sdc.py).
+    sdc_check_every: int = 0
+
+    # Seed of the Rademacher random-projection vector the SDC
+    # fingerprints contract against (sdc.fingerprint_vector) —
+    # schedule identity, reproducible per (seed, n_state).
+    sdc_seed: int = 0
+
     # Fault tolerance (docs/ROBUSTNESS.md): solver health checks +
     # rollback budget + sink-write failure policy.
     robustness: RobustnessConfig = field(default_factory=RobustnessConfig)
@@ -307,6 +349,11 @@ class PageRankConfig:
         if self.probe_topk < 1:
             raise ValueError(
                 f"probe_topk must be >= 1, got {self.probe_topk}"
+            )
+        if self.sdc_check_every < 0:
+            raise ValueError(
+                f"sdc_check_every must be >= 0 (0 disables), got "
+                f"{self.sdc_check_every}"
             )
         if self.stop_tol is not None:
             if not (0.0 < self.stop_tol < float("inf")):

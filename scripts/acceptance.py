@@ -59,7 +59,11 @@ reconciling at the f32 gate, predicted per-device skew within 10% of
 the measured 8-fake-device edge counts — ISSUE 13), U (concurrency
 plane: the PTR thread/signal-context race pass over the whole package
 — zero unwaived findings, every thread root + the GracefulDrain
-signal root discovered, <2 s — ISSUE 14), F (fault injection).
+signal root discovered, <2 s — ISSUE 14), V (SDC plane: a seeded
+sticky bit flip on 8 fake devices must be detected by the ABFT
+invariants, localized to the injected device, quarantined through the
+elastic rescue, and the solve must finish on 7 devices at the f32
+oracle gate — ISSUE 15), F (fault injection).
 
 Usage:
   PYTHONPATH=. python scripts/acceptance.py [--only <KEY>] [--no-append]
@@ -249,9 +253,24 @@ CONFIGS = {
     "U": dict(kind="concurrency",
               label="concurrency-plane smoke (PTR race pass, zero "
                     "unwaived findings)"),
+    # SDC smoke (ISSUE 15; pagerank_tpu/sdc.py): an 8-fake-device
+    # solve with a seeded STICKY bit flip — the ABFT invariants must
+    # detect the breach within the check cadence, localize it to the
+    # injected device, convict it sticky across the bounded redo,
+    # quarantine it through the elastic rescue path, and FINISH on 7
+    # devices at the f32 oracle gate, with sdc.flips_detected /
+    # sdc.quarantined_devices in the run report — under
+    # SDC_SMOKE_BUDGET_S. Re-invokes itself in a subprocess with the
+    # fake-device flags when this backend can't host the mesh (the
+    # smoke-L protocol).
+    "V": dict(kind="sdc", iters=12, flip_iter=5, flip_device=2,
+              seed=11,
+              label="sdc smoke (sticky bit-flip -> detect/localize/"
+                    "quarantine on 8 fake devices)"),
 }
 DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "S",
-                "U", "F", "A", "B", "T", "P", "E", "BV", "BB", "TV"]
+                "U", "V", "F", "A", "B", "T", "P", "E", "BV", "BB",
+                "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -834,6 +853,145 @@ def run_elastic_smoke(key: str):
         f"{'OK' if rescue_span else 'MISSING'}; counters "
         f"{sorted(elastic_counters)}; {t_run:.2f}s vs budget "
         f"{ELASTIC_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+# Budget for the SDC smoke (seconds, ISSUE 15): times the CHAOS RUN
+# itself — checked solve + breach + bounded redo + sticky conviction +
+# teardown + 7-device rebuild + finish — not the initial 8-device
+# compile or the f64 oracle pass (the smoke-L protocol, same 3 s
+# class: one extra checked-step compile + one rebuild inside it).
+SDC_SMOKE_BUDGET_S = 3.0
+
+
+def run_sdc_smoke(key: str):
+    """ISSUE-15 gate: a seeded STICKY bit flip on the 8-fake-device
+    CPU mesh -> ABFT detect (within the check cadence) -> localize to
+    the injected device -> bounded redo convicts sticky -> quarantine
+    through the elastic rescue -> FINISH on 7 devices; rank parity vs
+    the f64 oracle at the f32 gate; sdc.flips_detected /
+    sdc.quarantined_devices in the run report; under
+    SDC_SMOKE_BUDGET_S. Subprocess fallback per the smoke-L
+    protocol."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        return _fake_mesh_subprocess(key, "sdc",
+                                     "PAGERANK_SDC_SMOKE_CHILD")
+
+    import warnings
+
+    from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
+                              ReferenceCpuEngine, build_graph, obs)
+    from pagerank_tpu import sdc as sdc_mod
+    from pagerank_tpu.parallel.elastic import (DeviceHealthMonitor,
+                                               ElasticRunner)
+    from pagerank_tpu.testing.faults import (DeviceFaultSchedule,
+                                             install_device_faults)
+
+    iters, seed = spec["iters"], spec["seed"]
+    flip_iter, flip_device = spec["flip_iter"], spec["flip_device"]
+    ndev = min(8, len(jax.devices()))
+    rng = np.random.default_rng(9)
+    n, e = 1024, 8192
+    src, dst = rng.integers(0, n, e), rng.integers(0, n, e)
+    g = build_graph(src, dst, n=n)
+    cfg = PageRankConfig(num_iters=iters, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev,
+                         sdc_check_every=1)
+
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    sdc_mod.reset()
+    tracer = obs.enable_tracing()
+    sched = DeviceFaultSchedule(
+        seed=seed, flip={flip_iter: (flip_device, "mantissa")},
+        sticky_flips=[flip_iter],
+    )
+    eng = JaxTpuEngine(cfg).build(g)
+    install_device_faults(eng, sched)
+    # Warm the checked-step executables outside the timed region (the
+    # smoke-L protocol excludes the initial compiles): one untimed
+    # checked step on a retained copy, restored before the run.
+    tok = eng.retain_state()
+    eng.sdc_state_values()
+    eng._prefault_step_sdc()
+    eng.restore_state(tok)
+    t0 = time.perf_counter()
+
+    def factory(devs):
+        return JaxTpuEngine(
+            cfg.replace(num_devices=len(devs)), devices=devs
+        ).build(g)
+
+    runner = ElasticRunner(
+        eng, factory, snapshotter=None, max_rescues=2,
+        liveness=sched.liveness_probe,
+        monitor=DeviceHealthMonitor(),
+        on_rebuild=lambda e2: install_device_faults(e2, sched),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        ranks = runner.run()
+    t_run = time.perf_counter() - t0
+    report = obs.build_run_report(
+        config=cfg, tracer=tracer, registry=obs.get_registry(),
+        extra={"sdc": sdc_mod.report_section()},
+    )
+    obs.disable_tracing()
+
+    oracle = ReferenceCpuEngine(
+        PageRankConfig(num_iters=iters, dtype="float64",
+                       accum_dtype="float64")
+    ).build(build_graph(src, dst, n=n)).run()
+    l1 = float(np.abs(ranks - oracle).sum()) / float(np.abs(oracle).sum())
+
+    counters = (report.get("metrics") or {}).get("counters") or {}
+    sdc_counters = {k: v for k, v in counters.items()
+                    if k.startswith("sdc.")}
+    sdc_section = report.get("sdc") or {}
+    localized = (sdc_section.get("last_breach") or {}).get("device")
+    passed = bool(
+        sdc_counters.get("sdc.flips_detected", 0) >= 1
+        and sdc_counters.get("sdc.quarantined_devices") == 1
+        and localized == flip_device
+        and runner.quarantined_device_ids == [flip_device]
+        and runner.rescues == 1
+        and runner.engine.mesh.devices.size == ndev - 1
+        and l1 <= ELASTIC_F32_GATE
+        and t_run <= SDC_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "sdc",
+        "label": spec["label"],
+        "iters": iters,
+        "devices": ndev,
+        "flip": {"iteration": flip_iter, "device": flip_device,
+                 "kind": "mantissa", "sticky": True},
+        "localized_device": localized,
+        "quarantined": list(runner.quarantined_device_ids),
+        "rescues": runner.rescues,
+        "surviving_devices": int(runner.engine.mesh.devices.size),
+        "normalized_l1": l1,
+        "gate": ELASTIC_F32_GATE,
+        "sdc_counters": sdc_counters,
+        "seconds": t_run,
+        "budget_s": SDC_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] sticky {rec['flip']['kind']} flip on dev "
+        f"{flip_device} @ iter {flip_iter}: detected "
+        f"{sdc_counters.get('sdc.flips_detected', 0)}, localized to "
+        f"dev {localized}, quarantined {rec['quarantined']}, finished "
+        f"on {rec['surviving_devices']} device(s); oracle L1 "
+        f"{l1:.3e} vs gate {ELASTIC_F32_GATE:g}; {t_run:.2f}s vs "
+        f"budget {SDC_SMOKE_BUDGET_S:g}s -> "
         f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
@@ -2190,7 +2348,8 @@ def main(argv=None) -> int:
                "history": run_history_smoke,
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,
                "jobs": run_jobs_smoke, "graph": run_graph_smoke,
-               "concurrency": run_concurrency_smoke}
+               "concurrency": run_concurrency_smoke,
+               "sdc": run_sdc_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

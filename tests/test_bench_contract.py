@@ -159,9 +159,14 @@ def test_bench_json_contract_couple_mode(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "build_s", "costs", "layout", "lowering",
-                        "graph", "fast_f32", "partitioned_f32",
-                        "fast_bf16", "accuracy", "env", "scale",
-                        "iters", "edge_factor", "schema_version"}
+                        "graph", "sdc_check_overhead_pct", "fast_f32",
+                        "partitioned_f32", "fast_bf16", "accuracy",
+                        "env", "scale", "iters", "edge_factor",
+                        "schema_version"}
+    # SDC overhead (ISSUE 15): None-tolerant when disarmed — the key
+    # rides every leg, null without --sdc-check-every.
+    assert rec["sdc_check_overhead_pct"] is None
+    assert rec["fast_f32"]["sdc_check_overhead_pct"] is None
     # Every bench emit is versioned now (ISSUE 9 satellite); the
     # unversioned r01-r05 artifacts still ingest into the ledger.
     assert rec["schema_version"] >= 2
@@ -248,9 +253,11 @@ def test_bench_json_contract_single_mode(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "build_s", "costs", "layout", "lowering",
-                        "graph", "env", "scale", "iters",
-                        "edge_factor", "schema_version"}
+                        "graph", "sdc_check_overhead_pct", "env",
+                        "scale", "iters", "edge_factor",
+                        "schema_version"}
     assert rec["schema_version"] >= 2
+    assert rec["sdc_check_overhead_pct"] is None  # disarmed -> null
     # The environment fingerprint makes future BENCH_r*.json cells
     # comparable across backend drift (ISSUE 4; obs/report.py).
     assert rec["env"]["jax_version"] and rec["env"]["backend"]
@@ -259,6 +266,30 @@ def test_bench_json_contract_single_mode(tmp_path):
     _assert_layout_block(rec["layout"])
     _assert_lowering_block(rec["lowering"], expect_native=True)
     _assert_graph_block(rec["graph"], expect_profile=True, ndev=1)
+
+
+def test_bench_sdc_overhead_leg(tmp_path):
+    """--sdc-check-every arms the per-leg SDC detection-overhead
+    measurement (ISSUE 15): the single-config record carries a real
+    float in ``sdc_check_overhead_pct`` and the --history RunRecord's
+    leg folds it into the canonical metric vocabulary."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--scale", "10",
+         "--dtype", "float32", "--iters", "2", "--warmup", "1",
+         "--host-build", "--no-accuracy", "--sdc-check-every", "1",
+         "--history", ledger],
+        capture_output=True, text=True, env=_env(), timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    rec = json.loads(json_lines[0])
+    ov = rec["sdc_check_overhead_pct"]
+    assert isinstance(ov, float) and ov >= 0.0, rec
+    with open(ledger) as f:
+        lines = [json.loads(l) for l in f.read().splitlines() if l]
+    leg = lines[0]["legs"]["fast_f32"]
+    assert leg["sdc_check_overhead_pct"] == ov
 
 
 def test_bench_build_only_reports_stage_breakdown(tmp_path):

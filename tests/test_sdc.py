@@ -1,0 +1,511 @@
+"""Silent-data-corruption defense tests (ISSUE 15; pagerank_tpu/sdc.py;
+docs/ROBUSTNESS.md "Silent data corruption"): ABFT check-value parity
+vs a numpy oracle per dispatch form, every injected flip class detected
+AND localized to the injected device, transient-vs-sticky
+classification across the bounded redo, quarantine -> oracle-parity
+finish on the degraded mesh, the persisted exclusion list, the
+``--sdc-check-every 0`` bit-identity + zero-computation booby trap,
+and same-seed bit-for-bit chaos reproducibility."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph, jobs
+from pagerank_tpu import sdc as sdc_mod
+from pagerank_tpu.engines.cpu import ReferenceCpuEngine
+from pagerank_tpu.obs import metrics as obs_metrics
+from pagerank_tpu.parallel.elastic import (
+    DeviceHealthMonitor,
+    DeviceQuarantinedError,
+    ElasticRunner,
+)
+from pagerank_tpu.testing.faults import (
+    DeviceFaultSchedule,
+    flip_rank_bit,
+    install_device_faults,
+    mutate_rank_shard,
+)
+
+NDEV = len(jax.devices())
+EPS32 = float(np.finfo(np.float32).eps)
+F32_GATE = 1e-4
+
+
+def _graph(seed=7, n=1024, e=8192):
+    rng = np.random.default_rng(seed)
+    return build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+
+
+def _edges(seed=7, n=1024, e=8192):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, n, e), rng.integers(0, n, e)
+
+
+def _cfg(**kw):
+    kw.setdefault("num_iters", 12)
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("accum_dtype", "float32")
+    kw.setdefault("num_devices", NDEV)
+    return PageRankConfig(**kw)
+
+
+def _oracle(src, dst, n, iters, semantics="reference"):
+    cfg = PageRankConfig(num_iters=iters, dtype="float64",
+                         accum_dtype="float64", semantics=semantics)
+    return ReferenceCpuEngine(cfg).build(
+        build_graph(src, dst, n=n)).run()
+
+
+def _l1(ranks, oracle):
+    return float(np.abs(ranks - oracle).sum()) / float(
+        np.abs(oracle).sum())
+
+
+def _evaluate(eng, pre, chk):
+    return sdc_mod.evaluate_check(
+        pre, chk, damping=eng.config.damping,
+        semantics=eng.config.semantics, n=int(eng.graph.n),
+        num_edges=int(eng.graph.num_edges), eps=EPS32)
+
+
+# -- invariant parity vs the numpy oracle, per dispatch form ----------------
+
+
+FORM_CONFIGS = {
+    "step": dict(),
+    "coo": dict(kernel="coo"),
+    "partitioned": dict(partition_span=256),
+    "vertex_sharded": dict(vertex_sharded=True),
+    "vs_halo": dict(vertex_sharded=True, halo_exchange=True),
+    "vs_bounded": dict(vertex_sharded=True, vs_bounded=True),
+}
+
+
+@pytest.mark.parametrize("form", sorted(FORM_CONFIGS))
+def test_check_values_match_numpy_oracle(form):
+    """The in-step ABFT values must equal a direct numpy computation
+    over the engine's own (padded, relabeled) state — per dispatch
+    form — and a clean step must reconcile every invariant."""
+    g = _graph()
+    cfg = _cfg(semantics="textbook", sdc_check_every=1,
+               **FORM_CONFIGS[form])
+    eng = JaxTpuEngine(cfg).build(g)
+    assert eng.sdc_supported()
+    for _ in range(2):
+        eng.step()
+        eng.iteration += 1
+    r_pad = np.asarray(jax.device_get(eng._r), np.float64)
+    w = sdc_mod.fingerprint_vector(0, eng._n_state)
+    pre = eng.sdc_state_values()
+    info, chk = eng.step_sdc()
+    sharded = chk["sharded"]
+
+    def total(v):
+        return float(np.sum(v)) if sharded else float(np.median(v))
+
+    assert total(chk["fp_in"]) == pytest.approx(float(w @ r_pad),
+                                                rel=1e-4, abs=1e-6)
+    assert total(chk["mass_in"]) == pytest.approx(float(r_pad.sum()),
+                                                  rel=1e-5)
+    assert total(chk["mass_prev"]) == pytest.approx(float(r_pad.sum()),
+                                                    rel=1e-5)
+    r2 = np.asarray(jax.device_get(eng._r), np.float64)
+    assert total(chk["mass_out"]) == pytest.approx(float(r2.sum()),
+                                                   rel=1e-5)
+    assert total(chk["fp_out"]) == pytest.approx(float(w @ r2),
+                                                 rel=1e-4, abs=1e-6)
+    if chk["src_in"] is not None:
+        inv = np.asarray(jax.device_get(eng._inv_out), np.float64)
+        expect_src = float(r_pad[: inv.shape[0]][inv != 0].sum())
+        assert total(chk["src_in"]) == pytest.approx(expect_src,
+                                                     rel=1e-5)
+        # Link conservation in exact arithmetic: sum(contrib) ==
+        # sum(r[out_degree > 0]).
+        assert float(np.sum(chk["contrib"])) == pytest.approx(
+            expect_src, rel=1e-4)
+    verdict = _evaluate(eng, pre, chk)
+    assert verdict.ok, verdict.describe()
+    assert info["rank_mass"] == pytest.approx(float(r2.sum()), rel=1e-5)
+
+
+# -- every flip class detected + localized ----------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+@pytest.mark.parametrize("kind", ["mantissa", "exponent", "sign"])
+def test_flip_classes_detected_and_localized_replicated(kind):
+    """Each bit-flip class on one replicated copy breaches the
+    invariants at the next checked step, localized to the flipped
+    device position."""
+    g = _graph()
+    eng = JaxTpuEngine(_cfg(sdc_check_every=1)).build(g)
+    for _ in range(3):
+        eng.step()
+        eng.iteration += 1
+    pre = eng.sdc_state_values()
+    flip_rank_bit(eng, device_id=int(jax.devices()[3].id), kind=kind,
+                  frac=0.41)
+    _info, chk = eng.step_sdc()
+    verdict = _evaluate(eng, pre, chk)
+    assert not verdict.ok, kind
+    assert verdict.suspect == 3, (kind, verdict.describe())
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_mass_preserving_flip_detected():
+    """A corruption that PRESERVES total mass (+x here, -x there) is
+    invisible to the global --mass-tol scalar but not to the random
+    projection: the Rademacher fingerprint of the corrupted copy
+    diverges and localizes."""
+    g = _graph()
+    eng = JaxTpuEngine(_cfg(semantics="textbook",
+                            sdc_check_every=1)).build(g)
+    for _ in range(3):
+        eng.step()
+        eng.iteration += 1
+    pre = eng.sdc_state_values()
+
+    def mass_preserving(data, lo):
+        # Move mass between two lanes whose w signs differ so the
+        # projection must move; totals stay bit-comparable.
+        w = sdc_mod.fingerprint_vector(0, data.size)
+        i = int(np.argmax(w[:256]))
+        j = int(np.argmin(w[:256]))
+        x = np.float32(1e-3)
+        data[i] += x
+        data[j] -= x
+        return data
+
+    mutate_rank_shard(eng, int(jax.devices()[5].id), mass_preserving)
+    _info, chk = eng.step_sdc()
+    verdict = _evaluate(eng, pre, chk)
+    assert not verdict.ok
+    assert verdict.suspect == 5, verdict.describe()
+    # The mass vectors agree (the flip conserved mass) — the
+    # FINGERPRINT is what convicted.
+    kinds = {r["kind"] for r in verdict.reasons}
+    assert any(k.startswith(("copy:fp", "dual:fingerprint"))
+               for k in kinds), kinds
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_sharded_flip_detected_via_dual_fingerprint():
+    """On a vertex-sharded form there are no redundant copies — the
+    dual-computation invariant (boundary dispatch vs in-step tail)
+    catches an at-rest flip and the per-shard partial diff localizes
+    the owning device."""
+    g = _graph()
+    eng = JaxTpuEngine(_cfg(vertex_sharded=True,
+                            sdc_check_every=1)).build(g)
+    for _ in range(2):
+        eng.step()
+        eng.iteration += 1
+    pre = eng.sdc_state_values()
+    flip_rank_bit(eng, device_id=int(jax.devices()[4].id),
+                  kind="exponent", frac=0.5)
+    _info, chk = eng.step_sdc()
+    verdict = _evaluate(eng, pre, chk)
+    assert not verdict.ok
+    assert verdict.suspect == 4, verdict.describe()
+
+
+# -- transient vs sticky classification -------------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_transient_flip_healed_by_redo_and_oracle_parity():
+    """A one-shot flip: detected, the bounded redo reconciles clean,
+    the episode classifies TRANSIENT, the solve continues and the
+    final ranks match the f64 oracle — the corruption never reached
+    them."""
+    src, dst = _edges()
+    g = build_graph(src, dst, n=1024)
+    sdc_mod.reset()
+    eng = JaxTpuEngine(_cfg(sdc_check_every=1)).build(g)
+    sched = DeviceFaultSchedule(seed=13, flip={5: (3, "exponent")})
+    install_device_faults(eng, sched)
+    ranks = eng.run()
+    s = sdc_mod.report_section()
+    assert s["flips_detected"] == 1
+    assert s["transient"] == 1 and s["sticky"] == 0
+    assert s["last_breach"]["classified"] == "transient"
+    assert s["last_breach"]["device"] == 3
+    assert s["quarantined_devices"] == []
+    oracle = _oracle(src, dst, 1024, eng.config.num_iters)
+    assert _l1(ranks, oracle) <= F32_GATE
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_sticky_flip_raises_quarantine():
+    """A sticky flip re-fires on the redo's re-execution: the repeat
+    breach attributes to the same device and the guard raises
+    DeviceQuarantinedError carrying that device id."""
+    g = _graph()
+    sdc_mod.reset()
+    eng = JaxTpuEngine(_cfg(sdc_check_every=1)).build(g)
+    sched = DeviceFaultSchedule(seed=13, flip={4: (6, "mantissa")},
+                                sticky_flips=[4])
+    install_device_faults(eng, sched)
+    with pytest.raises(DeviceQuarantinedError) as ei:
+        eng.run()
+    assert ei.value.device_ids == (int(jax.devices()[6].id),)
+    s = sdc_mod.report_section()
+    assert s["sticky"] == 1
+    assert s["quarantined_devices"] == [int(jax.devices()[6].id)]
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_quarantine_finishes_on_degraded_mesh_at_oracle_gate():
+    """The full machine: sticky flip -> detect -> localize -> redo ->
+    sticky -> quarantine through the elastic rescue -> the solve
+    FINISHES on the degraded mesh and matches the f64 oracle."""
+    src, dst = _edges()
+    g = build_graph(src, dst, n=1024)
+    sdc_mod.reset()
+    obs_metrics.get_registry().reset()
+    cfg = _cfg(sdc_check_every=1)
+    eng = JaxTpuEngine(cfg).build(g)
+    sched = DeviceFaultSchedule(seed=11, flip={5: (2, "mantissa")},
+                                sticky_flips=[5])
+    install_device_faults(eng, sched)
+
+    def factory(devs):
+        return JaxTpuEngine(
+            cfg.replace(num_devices=len(devs)), devices=devs
+        ).build(g)
+
+    quarantined_seen = []
+    runner = ElasticRunner(
+        eng, factory, snapshotter=None, max_rescues=2,
+        liveness=sched.liveness_probe, monitor=DeviceHealthMonitor(),
+        on_rebuild=lambda e2: install_device_faults(e2, sched),
+        on_quarantine=lambda ids: quarantined_seen.append(list(ids)),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        ranks = runner.run()
+    assert runner.quarantined_device_ids == [2]
+    assert quarantined_seen == [[2]]
+    assert runner.rescues == 1
+    assert runner.engine.mesh.devices.size == NDEV - 1
+    assert 2 not in [int(d.id) for d in
+                     runner.engine.mesh.devices.reshape(-1)]
+    oracle = _oracle(src, dst, 1024, cfg.num_iters)
+    assert _l1(ranks, oracle) <= F32_GATE
+    counters = obs_metrics.get_registry().snapshot()["counters"]
+    assert counters["sdc.flips_detected"] >= 1
+    assert counters["sdc.quarantined_devices"] == 1
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_guard_token_never_restores_future_state():
+    """Regression (review finding): after an external rewind (the
+    health-check rollback), the guard's retained token can point PAST
+    the live iteration — a redo must re-base on the current state, not
+    jump the solve forward onto rejected state."""
+    g = _graph()
+    eng = JaxTpuEngine(_cfg(sdc_check_every=1)).build(g)
+    guard = sdc_mod.attach_guard(eng)
+    early = eng.retain_state()
+    for _ in range(4):
+        eng.step()
+        eng.iteration += 1
+    guard._token = eng.retain_state(iteration=eng.iteration)  # at 4
+    # External rewind behind the token (what a rollback does): the
+    # defensive re-base must keep the checked step AT the early
+    # boundary — never teleport the solve to the token's iteration.
+    eng.restore_state(early)
+    info = guard.checked_step()
+    assert eng.iteration == 0
+    assert info["sdc"] == {"ok": True}
+    assert guard._token[0] == 1
+
+    # The run loop's protocol: note_rollback re-bases the double
+    # buffer on the freshly RESTORED (clean) state, so a breach after
+    # the rollback still heals as transient from clean state.
+    eng.restore_state(early)
+    guard.note_rollback()
+    assert guard._token[0] == eng.iteration == 0
+    flip_rank_bit(eng, device_id=int(jax.devices()[1].id),
+                  kind="exponent", frac=0.3)
+    info = guard.checked_step()
+    assert eng.iteration == 0
+    assert info["sdc"]["transient"] is True
+    assert info["sdc"]["suspect_device"] == int(jax.devices()[1].id)
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_quarantine_persists_without_rescue_runner(tmp_path):
+    """Regression (review finding): a sticky conviction must land in
+    job.json AT conviction time — even when no elastic rescue is wired
+    to survive it — so the resumed job excludes the chip."""
+    g = _graph()
+    sdc_mod.reset()
+    job = jobs.JobSupervisor(str(tmp_path))
+    sdc_mod.set_quarantine_hook(job.quarantine_devices)
+    try:
+        eng = JaxTpuEngine(_cfg(sdc_check_every=1)).build(g)
+        sched = DeviceFaultSchedule(seed=7, flip={3: (4, "mantissa")},
+                                    sticky_flips=[3])
+        install_device_faults(eng, sched)
+        with pytest.raises(DeviceQuarantinedError):
+            eng.run()
+    finally:
+        sdc_mod.reset()
+    assert job.quarantined_devices() == [int(jax.devices()[4].id)]
+    assert jobs.JobSupervisor(str(tmp_path)).quarantined_devices() == \
+        [int(jax.devices()[4].id)]
+
+
+# -- exclusion list persistence ---------------------------------------------
+
+
+def test_job_manifest_persists_quarantine(tmp_path):
+    """The job.json exclusion list survives a supervisor restart
+    (idempotent merge) — the substrate a resumed job reads to never
+    re-adopt a known-bad chip."""
+    job = jobs.JobSupervisor(str(tmp_path))
+    assert job.quarantined_devices() == []
+    job.quarantine_devices([2])
+    job.quarantine_devices([5, 2])
+    assert job.quarantined_devices() == [2, 5]
+    job2 = jobs.JobSupervisor(str(tmp_path))
+    assert job2.quarantined_devices() == [2, 5]
+    assert job2.report_section()["quarantined_devices"] == [2, 5]
+
+
+@pytest.mark.skipif(NDEV < 3, reason="needs >= 3 devices")
+def test_rescue_honors_exclusion_list():
+    """Regression (ISSUE 15 satellite): a rescue after a prior-run
+    quarantine rebuilds on survivors MINUS the excluded ids — a
+    device kill on an 8-device mesh with device 2 pre-quarantined
+    lands on NDEV - 2 devices, neither of them the excluded chip."""
+    g = _graph(n=512, e=4096)
+    cfg = _cfg(num_iters=10)
+    eng = JaxTpuEngine(cfg).build(g)
+    sched = DeviceFaultSchedule(seed=5, kill={4: 1})
+    install_device_faults(eng, sched)
+
+    def factory(devs):
+        return JaxTpuEngine(
+            cfg.replace(num_devices=len(devs)), devices=devs
+        ).build(g)
+
+    runner = ElasticRunner(
+        eng, factory, snapshotter=None, max_rescues=2,
+        liveness=sched.liveness_probe,
+        on_rebuild=lambda e2: install_device_faults(e2, sched),
+        exclude_device_ids=[2],
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        runner.run()
+    ids = [int(d.id) for d in runner.engine.mesh.devices.reshape(-1)]
+    assert runner.engine.mesh.devices.size == NDEV - 2
+    assert 1 not in ids and 2 not in ids
+
+
+# -- --sdc-check-every 0: bit identity + zero computations ------------------
+
+
+def test_check_every_zero_is_bit_identical_and_computation_free(
+        monkeypatch):
+    """The disarmed run must take the EXACT unchecked code path:
+    bit-identical ranks, and ZERO check computations — every SDC entry
+    point is booby-trapped to raise."""
+    g = _graph()
+    baseline = JaxTpuEngine(_cfg()).build(g).run()
+
+    def boom(*a, **k):  # pragma: no cover - the trap must not spring
+        raise AssertionError("SDC machinery touched on a disarmed run")
+
+    monkeypatch.setattr(JaxTpuEngine, "_sdc_w", boom)
+    monkeypatch.setattr(JaxTpuEngine, "_get_sdc_step", boom)
+    monkeypatch.setattr(JaxTpuEngine, "_get_sdc_state_fn", boom)
+    monkeypatch.setattr(JaxTpuEngine, "step_sdc", boom)
+    monkeypatch.setattr(JaxTpuEngine, "retain_state", boom)
+    monkeypatch.setattr(sdc_mod.SdcGuard, "__init__", boom)
+    trapped = JaxTpuEngine(_cfg(sdc_check_every=0)).build(g).run()
+    np.testing.assert_array_equal(baseline, trapped)
+
+
+def test_checked_solve_matches_unchecked_on_clean_run():
+    """With no fault injected, a checked solve produces the SAME ranks
+    as the unchecked one (the checked step is the ledger core + local
+    reductions — the update math is untouched)."""
+    g = _graph()
+    plain = JaxTpuEngine(_cfg()).build(g).run()
+    sdc_mod.reset()
+    checked = JaxTpuEngine(_cfg(sdc_check_every=3)).build(g).run()
+    np.testing.assert_array_equal(plain, checked)
+    s = sdc_mod.report_section()
+    assert s["checks"] == 4 and s["flips_detected"] == 0
+
+
+# -- reproducibility --------------------------------------------------------
+
+
+@pytest.mark.skipif(NDEV < 2, reason="needs a multi-device mesh")
+def test_same_seed_reproduces_chaos_bit_for_bit():
+    """Two same-seed runs of the same scenario must produce identical
+    fault logs (the faults.py convention) AND identical final ranks —
+    detection, redo, and healing included."""
+    src, dst = _edges()
+    g = build_graph(src, dst, n=1024)
+
+    def once():
+        sdc_mod.reset()
+        eng = JaxTpuEngine(_cfg(sdc_check_every=1)).build(g)
+        sched = DeviceFaultSchedule(
+            seed=23, flip={3: (1, "sign"), 7: (5, "exponent")})
+        install_device_faults(eng, sched)
+        ranks = eng.run()
+        return list(sched.log), np.asarray(ranks)
+
+    log_a, ranks_a = once()
+    log_b, ranks_b = once()
+    assert log_a == log_b
+    assert any(entry[1] == "flip" for entry in log_a)
+    np.testing.assert_array_equal(ranks_a, ranks_b)
+
+
+# -- tolerances + fingerprint determinism -----------------------------------
+
+
+def test_fingerprint_vector_deterministic_and_rademacher():
+    w1 = sdc_mod.fingerprint_vector(3, 4096)
+    w2 = sdc_mod.fingerprint_vector(3, 4096)
+    np.testing.assert_array_equal(w1, w2)
+    assert set(np.unique(w1)) == {-1.0, 1.0}
+    w3 = sdc_mod.fingerprint_vector(4, 4096)
+    assert not np.array_equal(w1, w3)
+
+
+def test_tolerances_scale_with_dtype_and_count():
+    eps64 = float(np.finfo(np.float64).eps)
+    assert sdc_mod.sdc_tolerance(EPS32, 1024, 8192) > \
+        sdc_mod.sdc_tolerance(eps64, 1024, 8192)
+    assert sdc_mod.sdc_tolerance(EPS32, 1024, 1 << 20) > \
+        sdc_mod.sdc_tolerance(EPS32, 1024, 8192)
+    assert sdc_mod.copy_tolerance(EPS32, 4096) > \
+        sdc_mod.copy_tolerance(EPS32, 1024)
+
+
+def test_probe_and_sdc_cadences_compose():
+    """Overlapping --probe-every / --sdc-check-every boundaries: the
+    checked step takes the iteration and the probe commits via the
+    standalone boundary path — both records exist, neither cadence is
+    silently dropped."""
+    from pagerank_tpu.obs.probes import ConvergenceProbes
+
+    g = _graph()
+    sdc_mod.reset()
+    eng = JaxTpuEngine(_cfg(num_iters=8, sdc_check_every=4)).build(g)
+    probes = ConvergenceProbes(2, topk=8)
+    eng.run(probes=probes)
+    assert [r["iteration"] for r in probes.history] == [1, 3, 5, 7]
+    assert sdc_mod.report_section()["checks"] == 2
