@@ -104,10 +104,14 @@ def _device_graph(cfg, scale, edge_factor, stripe, seed=0, timings=None):
         jax.device_get((src[:1], dst[:1]))  # honest gen fence
         timings["gen_s"] = time.perf_counter() - t0
     pallas = cfg.kernel == "pallas"
+    # Pallas consumes plain group-1 slot ids. The LEGACY whole-range
+    # kernel additionally needs a single-stripe graph (stripe 0); the
+    # partitioned kernel needs the stripes — they ARE the partitions
+    # (plan_build returned stripe == partition_span for it).
     return db.build_ell_device(
         src, dst, n=1 << scale,
         group=1 if pallas else cfg.lane_group,
-        stripe_size=0 if pallas else stripe,
+        stripe_size=0 if pallas and not cfg.partition_span else stripe,
         with_weights=False,  # presentinel: no per-slot weight plane
         timings=timings,
     )
@@ -235,7 +239,8 @@ def _emit(out: dict, args) -> None:
 
 def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
              build_only: bool = False, partition_span: int = 0,
-             stream_dtype: str = "", force_span_fallback: bool = False):
+             stream_dtype: str = "", force_span_fallback: bool = False,
+             kernel: str = ""):
     """One throughput measurement: build (device by default) + timed
     stepwise loop with the honest scalar fence. Returns the result dict.
 
@@ -246,7 +251,11 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
     they always run and record what they ran, while single-config
     ``--partition-span -1`` honors the rule's "off" verdict.
     ``stream_dtype`` streams the gather table reduced-precision (the
-    ``fast_bf16`` leg).
+    ``fast_bf16`` leg). ``kernel`` overrides ``--kernel`` for this leg
+    (the couple mode's ``pallas_partitioned`` leg passes "pallas" so
+    the fused Mosaic kernel gets its own series without changing the
+    XLA legs; a probe downgrade is visible in the leg's recorded
+    layout via ``kernel_requested``).
 
     ``build_only`` (VERDICT r4 weak #4): build, time it, free, and
     return only ``build_s`` — couple mode calls this LAST with the
@@ -262,7 +271,7 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
 
     host_build = args.host_build
-    kernel = args.kernel
+    kernel = kernel or args.kernel
     if kernel == "coo" and not host_build:
         print("--kernel coo requires the host ingest path; using --host-build",
               file=sys.stderr)
@@ -337,6 +346,8 @@ def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
         label += f"+span{part}"
         if stream_dtype:
             label += f"+{stream_dtype}"
+    if kernel == "pallas":
+        label += "+pallas"
     if build_only:
         del engine
         print(f"build[{label}]: warm rebuild {t_build:.1f}s "
@@ -618,7 +629,8 @@ def run_accuracy(scale: int = 20, iters: int = 50, with_bf16: bool = False,
     return out
 
 
-def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None):
+def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None,
+            kernel="", partition_span=0):
     """One multichip rate leg: a vertex-sharded f32 solve over ``ndev``
     devices through the dense or sparse (halo) exchange. Returns the
     leg dict: edges/s/chip, cost + layout + comms blocks, the
@@ -628,16 +640,30 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None):
     comms-vs-compute ``attribution`` block (ISSUE 10): fenced
     exchange-only vs full-step sub-dispatch timing + achieved wire
     bytes/s against the model — the is-it-exchange-bound verdict the
-    next TPU session reads first."""
+    next TPU session reads first.
+
+    ``kernel``/``partition_span`` (ISSUE 16): the ``pallas_partitioned``
+    leg runs the fused Mosaic kernel's replicated-rank partitioned
+    layout over the same mesh instead — the hand kernel doesn't compose
+    with the vertex-sharded exchange (it consumes the whole rank vector
+    per source window), so its multichip series measures the
+    data-parallel form; the recorded layout says which one ran."""
     from pagerank_tpu import PageRankConfig
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
     from pagerank_tpu.obs import devices as obs_devices
     from pagerank_tpu.obs import metrics as obs_metrics
 
-    cfg = PageRankConfig(
-        num_iters=iters, dtype="float32", accum_dtype="float32",
-        num_devices=ndev, vertex_sharded=True, halo_exchange=halo,
-    ).validate()
+    if kernel:
+        cfg = PageRankConfig(
+            num_iters=iters, dtype="float32", accum_dtype="float32",
+            num_devices=ndev, kernel=kernel,
+            partition_span=partition_span,
+        ).validate()
+    else:
+        cfg = PageRankConfig(
+            num_iters=iters, dtype="float32", accum_dtype="float32",
+            num_devices=ndev, vertex_sharded=True, halo_exchange=halo,
+        ).validate()
     t0 = time.perf_counter()
     engine = JaxTpuEngine(cfg).build(graph)
     t_build = time.perf_counter() - t0
@@ -741,6 +767,17 @@ def run_multichip(args):
                     **kw)
     sparse = _mc_leg(graph, ndev=ndev, halo=True,
                      label="sparse_exchange", **kw)
+    # Fused Mosaic kernel leg (ISSUE 16): the partitioned pallas form
+    # over the same mesh (replicated ranks — see _mc_leg docstring),
+    # so the multichip cell carries the hand-kernel series too. Span:
+    # the engine's auto rule, with the couple legs' quarter-range
+    # fallback when the rule says "off" at this geometry.
+    n_padded = -(-graph.n // 128) * 128
+    pspan = JaxTpuEngine.partition_span(n_padded, graph.num_edges) \
+        or _fallback_span(graph.n)
+    pallas = _mc_leg(graph, ndev=ndev, halo=False,
+                     label="pallas_partitioned", kernel="pallas",
+                     partition_span=pspan, **kw)
     cm = sparse["comms"]
     # The sparse leg can legitimately DOWNGRADE to the dense exchange
     # (multi-dispatch layouts past SCAN_STRIPE_UNITS; layout_info's
@@ -757,6 +794,7 @@ def run_multichip(args):
         "single_chip": single,
         "dense_exchange": dense,
         "sparse_exchange": sparse,
+        "pallas_partitioned": pallas,
         # Per-chip rate retained at ndev chips vs 1 chip — the honest
         # scale-out figure (1.0 = linear scaling).
         "scaling_efficiency": sparse["value"] / single["value"],
@@ -1083,6 +1121,17 @@ def main(argv=None):
                          partition_span=leg_span,
                          stream_dtype="bfloat16",
                          force_span_fallback=True)
+    # Fused Mosaic kernel leg (ISSUE 16): the SAME partitioned f32
+    # workload through ops/pallas_spmv.ell_contrib_pallas_partitioned
+    # instead of the XLA gather pipeline — its own series so the
+    # hand-kernel-vs-XLA delta is attributable per round. The kernel
+    # override is leg-local; a probe downgrade records itself in the
+    # leg's layout (kernel_requested='pallas', form back to
+    # 'partitioned') rather than silently re-measuring the XLA leg.
+    pallas_rate = run_rate(args, "float32", "float32",
+                           partition_span=leg_span,
+                           force_span_fallback=True,
+                           kernel="pallas")
     out = {
         "metric": "edges_per_sec_per_chip",
         "value": pair_rate["value"],
@@ -1098,6 +1147,7 @@ def main(argv=None):
         "sdc_check_overhead_pct": pair_rate["sdc_check_overhead_pct"],
         "fast_f32": f32_rate,  # carries its own "costs" block
         "partitioned_f32": part_rate,
+        "pallas_partitioned": pallas_rate,
         "fast_bf16": bf16_rate,
         "scale": args.scale,
         "iters": args.iters,

@@ -63,9 +63,38 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated engine dispatch forms for the contract "
         "suite (default: all; see docs/ANALYSIS.md)",
     )
+    p.add_argument(
+        "--select", default=None,
+        help="comma-separated rule or family prefixes to run (e.g. "
+        "'PTK', 'PTL005,PTR'); passes whose families are not selected "
+        "are skipped entirely (so '--select PTK' is the fast "
+        "kernel-plane gate)",
+    )
+    p.add_argument(
+        "--kernel-fixture", nargs="?", const="all", default=None,
+        metavar="NAME",
+        help="run the kernel-plane pass over the seeded-defect "
+        "fixtures instead of the shipped registry ('all' or one of "
+        "vmem_overflow/misaligned_tile/index_gap/index_overlap/"
+        "f64_scratch/cost_mismatch) — each must exit nonzero; the "
+        "acceptance harness pins this",
+    )
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalogue and exit")
     return p
+
+
+def _selected(select, *families: str) -> bool:
+    """Whether any of a pass's rule families ('PTL', 'PTK', ...) is
+    covered by the --select prefixes (None selects everything). A
+    selector may be a family ('PTK') or a full rule id ('PTL005')."""
+    if select is None:
+        return True
+    sels = [s.strip().upper() for s in select.split(",") if s.strip()]
+    return any(
+        s.startswith(fam) or fam.startswith(s)
+        for s in sels for fam in families
+    )
 
 
 def main(argv=None) -> int:
@@ -98,8 +127,14 @@ def main(argv=None) -> int:
             ("PTH002", "optimized-HLO fusion count within budget"),
             ("PTH003", "no while-loop carrying gather-class traffic "
                        "as scalar dynamic-slices"),
+            ("PTH004", "pallas engine optimized HLO: the Mosaic custom "
+                       "call present AND the gathers gone"),
         ):
             print(f"{rid}  [hlo   ] {desc}")
+        from pagerank_tpu.analysis import kernels as kernels_mod
+
+        for rid, desc in sorted(kernels_mod.RULES.items()):
+            print(f"{rid}  [kernel] {desc}")
         return 0
 
     allowlist_path = args.allowlist
@@ -116,7 +151,7 @@ def main(argv=None) -> int:
             return 2
 
     findings = []
-    if not args.contracts_only:
+    if not args.contracts_only and _selected(args.select, "PTL", "PTR"):
         from pagerank_tpu.analysis import concurrency as conc_mod
 
         if args.paths:
@@ -165,7 +200,31 @@ def main(argv=None) -> int:
             findings.extend(lint_mod.lint_tree())
             findings.extend(conc_mod.analyze_package())
 
-    if not args.lint_only:
+    if not args.lint_only and _selected(args.select, "PTK"):
+        # Kernel plane BEFORE the contract pass: PTK traces the Pallas
+        # kernels at their shipped dtypes and must not run under the
+        # x64 flip the contract suite needs for PTC002.
+        _prepare_jax_env()
+        from pagerank_tpu.analysis import kernels as kernels_mod
+
+        cases = None
+        if args.kernel_fixture is not None:
+            cases = kernels_mod.defect_cases()
+            if args.kernel_fixture != "all":
+                cases = [
+                    c for c in cases
+                    if c.label == f"fixture:{args.kernel_fixture}"
+                ]
+                if not cases:
+                    print(
+                        f"analysis: unknown kernel fixture "
+                        f"'{args.kernel_fixture}'",
+                        file=sys.stderr,
+                    )
+                    return 2
+        findings.extend(kernels_mod.check_kernel_plane(cases))
+
+    if not args.lint_only and _selected(args.select, "PTC", "PTH"):
         _prepare_jax_env()
         import jax
 

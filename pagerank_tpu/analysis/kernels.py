@@ -1,0 +1,1040 @@
+"""Kernel-plane static analysis: PTK rules that prove a Pallas kernel
+safe BEFORE TPU time (ISSUE 16 tentpole).
+
+Hand-written kernels reintroduce the failure classes XLA used to
+absorb — VMEM overflow, tile/lane misalignment, index maps that skip or
+double-cover output rows — and the repo's standing rule (PR 11 for
+gather lowering, PR 14 for races) is that every TPU risk becomes a
+typed pre-mesh verdict first. This module walks every
+``pl.pallas_call`` site of a registered kernel *abstractly*: the kernel
+is traced with ``jax.make_jaxpr`` at the instantiated geometry (shapes
+only — nothing executes, no TPU, no Mosaic), the grid spec /
+BlockSpecs / index maps / scratch shapes are read off the jaxpr, and
+each index map is evaluated symbolically over the FULL grid (the
+state-discharged map jaxpr, vmapped over grid coordinates against the
+case's concrete scalar-prefetch arrays). Rules:
+
+  PTK001  VMEM budget: every VMEM-resident block (x2 when its index
+          map varies across the grid — the pipeline double-buffers it)
+          plus VMEM scratch, tile-padded, must fit the per-device-kind
+          VMEM capacity table with headroom
+          (obs/costs.VMEM_CAPACITY_BYTES / pallas_vmem_budget — the
+          HBM_CAPACITY_BYTES idiom). The legacy ell_contrib_pallas
+          whole-z_ext design FAILS this at the bench scales and
+          carries a geometry-bounded allowlist entry; the runtime
+          guard (engine pallas probe) enforces the same shared bound.
+  PTK002  Tile/lane geometry: a >=2-D VMEM block's trailing dims must
+          be divisible by the dtype's sublane x lane tile — 8x128 f32,
+          16x128 bf16, 32x128 int8 (the words24 planar-int8 slot
+          stream makes the int8 row a live hazard). A trailing dim of
+          exactly 1 is allowed (Mosaic pads; PTK001 charges the full
+          128 lanes).
+  PTK003  Index-map coverage: every blocked input read in bounds over
+          the full grid; every output element written exactly once —
+          blocked VMEM outputs must cover every block with no
+          non-consecutive revisit (gap AND overlapping-write races),
+          ANY-space RMW outputs must declare a write model (window
+          starts x width) whose union covers the full logical length
+          in bounds (a chunk whose rank span outgrew the static width
+          would silently drop rows — this is the rule that catches
+          it).
+  PTK004  Memory-space discipline: float VMEM scratch accumulators
+          must be f32, no f64 value anywhere in a kernel body, and
+          ANY-space (HBM-resident) refs may be touched ONLY by
+          explicit DMA (make_async_copy's dma_start/dma_wait) — never
+          direct get/swap.
+  PTK005  Grid/cost sanity: static per-sweep FLOPs (dot_generals over
+          the grid) and HBM bytes (streamed blocks x distinct-index
+          runs + RMW traffic) reconciled against the case's analytic
+          model within 25% (the PR 11 obs/costs reconciliation idiom).
+
+Verdicts are deterministic and CPU-only; the CLI front-end is
+``python -m pagerank_tpu.analysis --select PTK`` and the shipped-kernel
+registry pins the TPU campaign's scale 22-25 geometries so the next
+mesh session starts from a green exit code. Seeded-defect fixtures
+(``defect_cases``) each trip exactly their rule and are wired into
+scripts/acceptance.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pagerank_tpu.analysis.findings import Finding
+
+LANES = 128
+
+#: rule id -> one-line description (the CLI --list-rules catalogue).
+RULES: Dict[str, str] = {
+    "PTK001": "VMEM budget: resident blocks x buffering + scratch vs "
+              "per-device-kind capacity with headroom",
+    "PTK002": "tile/lane geometry: 8x128 f32 / 16x128 bf16 / 32x128 int8 "
+              "block divisibility",
+    "PTK003": "index-map coverage: reads in bounds; outputs written "
+              "exactly once (gaps AND overlaps)",
+    "PTK004": "memory-space discipline: f32 VMEM scratch, no f64 in "
+              "kernels, ANY refs only via explicit DMA",
+    "PTK005": "grid/cost sanity: static FLOPs+bytes vs the obs/costs "
+              "analytic model",
+}
+
+#: dtype itemsize -> required sublane multiple (lane is always 128).
+_SUBLANES = {8: 4, 4: 8, 2: 16, 1: 32}
+
+
+# ---------------------------------------------------------------------------
+# Case registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KernelCase:
+    """One kernel at one instantiated geometry.
+
+    ``fn(*args)`` must trace (jax.make_jaxpr) to a jaxpr containing
+    exactly one ``pallas_call``; ``scalar_args`` are the CONCRETE
+    scalar-prefetch operands (index maps and the write model evaluate
+    against them). ``write_model`` describes an ANY-space RMW output:
+    ``(starts, width, length)`` — per-grid-step window starts, static
+    window width, logical output length that must be covered.
+    ``cost_model`` is the analytic {"flops", "bytes"} expectation per
+    sweep (PTK005); None skips the reconciliation."""
+
+    label: str
+    fn: Callable
+    args: tuple
+    scalar_args: tuple = ()
+    write_model: Optional[Callable[[], Tuple[np.ndarray, int, int]]] = None
+    cost_model: Optional[Dict[str, float]] = None
+    rmw: bool = True
+    path: str = ""
+    line: int = 0
+
+
+def _package_root() -> str:
+    import pagerank_tpu
+
+    return os.path.dirname(os.path.abspath(pagerank_tpu.__file__))
+
+
+def _loc(obj) -> Tuple[str, int]:
+    """(package-relative path, 1-based line) of a kernel's def — the
+    finding anchor. Unwraps jit/partial wrappers; falls back to an
+    empty anchor rather than failing the analysis."""
+    try:
+        fn = obj
+        while isinstance(fn, functools.partial):
+            fn = fn.func
+        fn = inspect.unwrap(fn)
+        src = inspect.getsourcefile(fn)
+        line = inspect.getsourcelines(fn)[1]
+        rel = os.path.relpath(src, _package_root()).replace(os.sep, "/")
+        if rel.startswith(".."):
+            rel = os.path.basename(src)
+        return rel, line
+    except Exception:
+        return "", 0
+
+
+def _synth_ranks(rows: int, pairs: int) -> np.ndarray:
+    """Dense non-decreasing global pair ranks spread evenly over the
+    rows — the engine's dense_block_ranks invariant (increment <= 1
+    per row) at synthetic-geometry fidelity."""
+    return ((np.arange(rows, dtype=np.int64) * pairs) // rows).astype(
+        np.int32
+    )
+
+
+def _legacy_case(*, label: str, n_pad: int, rows: int, chunk: int = 256,
+                 gather: str = "take") -> KernelCase:
+    """ops/pallas_spmv.ell_contrib_pallas at a synthetic geometry:
+    whole z_ext resident, global block ids, per-chunk rb0 RMW."""
+    import jax
+    import jax.numpy as jnp
+
+    from pagerank_tpu.ops import pallas_spmv
+
+    nb = n_pad // LANES
+    nc = rows // chunk
+    rb = _synth_ranks(rows, nb)
+    rb0 = rb[::chunk].copy()
+    fn = functools.partial(
+        pallas_spmv.ell_contrib_pallas, num_blocks=nb, chunk=chunk,
+        gather=gather, interpret=False,
+    )
+    args = (
+        jax.ShapeDtypeStruct((n_pad + 8,), jnp.float32),
+        jax.ShapeDtypeStruct((rows, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((rows,), jnp.int32),
+        jnp.asarray(rb0),
+    )
+    z_bytes = (n_pad + 8) * 4
+    cost = {
+        # one (chunk, chunk) x (chunk, 128) one-hot segment matmul per
+        # grid step
+        "flops": nc * 2.0 * chunk * chunk * LANES,
+        # z resident once + streamed src/rb blocks + RMW window traffic
+        "bytes": (
+            z_bytes
+            + nc * (chunk * LANES * 4 + chunk * 4)
+            + 2.0 * nc * chunk * LANES * 4
+        ),
+    }
+    path, line = _loc(pallas_spmv.ell_contrib_pallas)
+    return KernelCase(
+        label=label, fn=fn, args=args, scalar_args=(rb0,),
+        write_model=lambda: (rb0, chunk, nb),
+        cost_model=cost, rmw=True, path=path, line=line,
+    )
+
+
+def _pallas_span(n_pad: int, edges: int, z_item: int) -> int:
+    """The partition span a pallas campaign pins at a given scale: the
+    engine's auto rule (JaxTpuEngine.partition_span) when its pick
+    also fits the kernel's DOUBLE-buffered z window in the VMEM budget
+    with ~2MB of stream/scratch headroom, else the largest
+    power-of-two span that does. The auto rule caps the window for
+    single-copy cache residency on the XLA ell path; the Pallas
+    pipeline keeps two copies in flight, so the big f32 scales pin one
+    notch finer (the same bound
+    jax_engine._setup_ell_partitioned_pallas enforces at runtime)."""
+    from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+    from pagerank_tpu.obs import costs
+
+    budget = costs.pallas_vmem_budget(None) - (2 << 20)
+
+    def fits(span: int) -> bool:
+        pspan = -(-(span + 8) // 2048) * 2048
+        return 2 * pspan * z_item <= budget
+
+    auto = JaxTpuEngine.partition_span(n_pad, edges, z_item)
+    if auto and fits(auto):
+        return auto
+    best, span = 0, 1 << 15
+    while span * 2 <= n_pad:
+        if fits(span):
+            best = span
+        span *= 2
+    return best
+
+
+def _partitioned_case(*, label: str, scale: int, stream: str = "float32",
+                      chunk: int = 1024, width: int = 128) -> KernelCase:
+    """ops/pallas_spmv.ell_contrib_pallas_partitioned at the geometry
+    the engine would instantiate for an R-MAT graph of ``scale`` with
+    the campaign's edge factor 16: partition span from ``_pallas_span``,
+    rows padded per partition, words24 slot words when the span fits
+    24 bits."""
+    import jax
+    import jax.numpy as jnp
+
+    from pagerank_tpu.engines.jax_engine import JaxTpuEngine
+    from pagerank_tpu.ops import pallas_spmv
+
+    n_pad = 1 << scale
+    edges = 16 * n_pad
+    z_dt = jnp.bfloat16 if stream == "bfloat16" else jnp.float32
+    z_item = jnp.dtype(z_dt).itemsize
+    psz = _pallas_span(n_pad, edges, z_item)
+    assert psz, (scale, stream)  # every campaign scale has a fitting span
+    K = -(-n_pad // psz)
+    pspan = -(-(psz + 8) // 2048) * 2048
+    w_rows = pspan // LANES
+    rows_per_part = max(chunk, -(-(edges // LANES) // K // 2048) * 2048)
+    rows = K * rows_per_part
+    nc = rows // chunk
+    pairs = nc * (width // 2)  # per-chunk span ~width/2: engine headroom
+    rk = _synth_ranks(rows, pairs)
+    rb0 = rk[::chunk].copy()
+    part_ids = np.repeat(
+        np.arange(K, dtype=np.int32), rows_per_part // chunk
+    )
+    bases = np.stack([part_ids, rb0], axis=1).astype(np.int32)
+    words24 = JaxTpuEngine.partition_words24(psz, 1)
+    src_lanes, src_dt, src_item = (
+        (3 * LANES, jnp.int8, 1) if words24 else (LANES, jnp.int32, 4)
+    )
+    fn = functools.partial(
+        pallas_spmv.ell_contrib_pallas_partitioned, num_pairs=pairs,
+        chunk=chunk, width=width, gather="take", interpret=False,
+    )
+    args = (
+        jax.ShapeDtypeStruct((K, w_rows, LANES), z_dt),
+        jax.ShapeDtypeStruct((rows, src_lanes), src_dt),
+        jax.ShapeDtypeStruct((rows // LANES, LANES), jnp.int32),
+        jnp.asarray(bases),
+    )
+    cost = {
+        # one (chunk, width) x (chunk, 128) segment matmul per step
+        "flops": nc * 2.0 * chunk * width * LANES,
+        # each partition window streams through VMEM exactly once +
+        # slot words + rank rows + RMW window traffic
+        "bytes": (
+            K * pspan * z_item
+            + nc * (chunk * src_lanes * src_item + chunk * 4)
+            + 2.0 * nc * width * LANES * 4
+        ),
+    }
+    path, line = _loc(pallas_spmv.ell_contrib_pallas_partitioned)
+    return KernelCase(
+        label=label, fn=fn, args=args, scalar_args=(bases,),
+        write_model=lambda: (rb0, width, pairs),
+        cost_model=cost, rmw=True, path=path, line=line,
+    )
+
+
+#: The TPU campaign's bench scales (perf_budgets.json env scopes).
+BENCH_SCALES = (22, 23, 24, 25)
+
+
+def shipped_cases() -> List[KernelCase]:
+    """Both shipped kernels: a sound toy geometry each, plus the bench
+    scales. The legacy kernel's scale cases FAIL PTK001 by design
+    (whole z_ext resident) and are waived in allowlist.txt with the
+    geometry bound; the partitioned kernel must be clean everywhere."""
+    cases = [
+        _legacy_case(label="ell_contrib_pallas@toy", n_pad=1 << 20,
+                     rows=1 << 16),
+    ]
+    for s in BENCH_SCALES:
+        cases.append(_legacy_case(
+            label=f"ell_contrib_pallas@scale{s}", n_pad=1 << s,
+            rows=max(256, (1 << s) // 8 // 256 * 256),
+        ))
+    cases.append(_partitioned_case(
+        label="ell_contrib_pallas_partitioned@toy-span", scale=18,
+    ))
+    for s in BENCH_SCALES:
+        cases.append(_partitioned_case(
+            label=f"ell_contrib_pallas_partitioned@scale{s}", scale=s,
+        ))
+    cases.append(_partitioned_case(
+        label="ell_contrib_pallas_partitioned@scale24-bf16", scale=24,
+        stream="bfloat16",
+    ))
+    return cases
+
+
+# ---------------------------------------------------------------------------
+# Seeded-defect fixtures: one per rule; each must trip exactly its rule
+# (scripts/acceptance.py + tests/test_kernel_analysis.py pin this).
+# ---------------------------------------------------------------------------
+
+
+def _fx_copy(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _fx_scratch(x_ref, o_ref, acc):
+    acc[...] = -acc[...]
+    o_ref[...] = x_ref[...]
+
+
+def _fx_matmul(x_ref, y_ref, o_ref):
+    import jax.numpy as jnp
+
+    o_ref[...] = jnp.dot(
+        x_ref[...], y_ref[...], preferred_element_type=jnp.float32
+    )
+
+
+def defect_cases() -> List[KernelCase]:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    here, _ = _loc(defect_cases)
+    cases = []
+
+    # PTK001: 32MB f32 whole-resident input (over every budget tier).
+    n = 8 << 20
+    fn = pl.pallas_call(
+        _fx_copy, grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+    )
+    cases.append(KernelCase(
+        label="fixture:vmem_overflow", fn=fn,
+        args=(jax.ShapeDtypeStruct((n,), jnp.float32),),
+        path=here, line=_loc(_fx_copy)[1],
+    ))
+
+    # PTK002: (100, 64) f32 blocks — sublane 100 % 8 != 0, lane 64.
+    fn = pl.pallas_call(
+        _fx_copy, grid=(2, 2),
+        in_specs=[pl.BlockSpec((100, 64), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((100, 64), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((200, 128), jnp.float32),
+    )
+    cases.append(KernelCase(
+        label="fixture:misaligned_tile", fn=fn,
+        args=(jax.ShapeDtypeStruct((200, 128), jnp.float32),),
+        path=here, line=_loc(_fx_copy)[1],
+    ))
+
+    # PTK003 (gap): output map i -> 2i skips every odd block.
+    fn = pl.pallas_call(
+        _fx_copy, grid=(2,),
+        in_specs=[pl.BlockSpec((8, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, LANES), lambda i: (2 * i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, LANES), jnp.float32),
+    )
+    cases.append(KernelCase(
+        label="fixture:index_gap", fn=fn,
+        args=(jax.ShapeDtypeStruct((16, LANES), jnp.float32),),
+        path=here, line=_loc(_fx_copy)[1],
+    ))
+
+    # PTK003 (overlap): output map i -> i % 2 revisits blocks 0/1
+    # non-consecutively (steps 0,1,2,3 -> blocks 0,1,0,1).
+    fn = pl.pallas_call(
+        _fx_copy, grid=(4,),
+        in_specs=[pl.BlockSpec((8, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, LANES), lambda i: (i % 2, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, LANES), jnp.float32),
+    )
+    cases.append(KernelCase(
+        label="fixture:index_overlap", fn=fn,
+        args=(jax.ShapeDtypeStruct((32, LANES), jnp.float32),),
+        path=here, line=_loc(_fx_copy)[1],
+    ))
+
+    # PTK004: float64 VMEM scratch accumulator.
+    fn = pl.pallas_call(
+        _fx_scratch, grid=(2,),
+        in_specs=[pl.BlockSpec((8, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, LANES), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, LANES), jnp.float64)],
+    )
+    cases.append(KernelCase(
+        label="fixture:f64_scratch", fn=fn,
+        args=(jax.ShapeDtypeStruct((16, LANES), jnp.float32),),
+        path=here, line=_loc(_fx_scratch)[1],
+    ))
+
+    # PTK005: a correct kernel with a deliberately wrong analytic model.
+    fn = pl.pallas_call(
+        _fx_matmul, grid=(2,),
+        in_specs=[
+            pl.BlockSpec((LANES, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((LANES, LANES), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((LANES, LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((2 * LANES, LANES), jnp.float32),
+    )
+    cases.append(KernelCase(
+        label="fixture:cost_mismatch", fn=fn,
+        args=(
+            jax.ShapeDtypeStruct((2 * LANES, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((LANES, LANES), jnp.float32),
+        ),
+        cost_model={"flops": 1.0, "bytes": 1.0},
+        path=here, line=_loc(_fx_matmul)[1],
+    ))
+    return cases
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+
+def _find_pallas_eqns(jaxpr, out):
+    for eq in jaxpr.eqns:
+        if eq.primitive.name == "pallas_call":
+            out.append(eq)
+        for v in eq.params.values():
+            if hasattr(v, "jaxpr"):
+                _find_pallas_eqns(v.jaxpr, out)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if hasattr(x, "jaxpr"):
+                        _find_pallas_eqns(x.jaxpr, out)
+    return out
+
+
+def _space(aval_or_bm) -> str:
+    return str(getattr(aval_or_bm, "memory_space", "")).lower()
+
+
+def _bm_space(bm) -> str:
+    """'vmem' or 'any' for a BlockMapping. An unspecified memory space
+    (``MemRef<None>``) is Pallas's default for blocked operands —
+    VMEM."""
+    ms = getattr(bm.transformed_block_aval, "memory_space", None)
+    if ms is None:
+        return "vmem"
+    s = str(ms).lower()
+    return "any" if "any" in s else ("vmem" if "vmem" in s else s)
+
+
+class _NpUnsupported(Exception):
+    """A map primitive outside the numpy fast path's vocabulary."""
+
+
+def _nonneg(*arrays) -> bool:
+    return all(np.all(np.asarray(a) >= 0) for a in arrays)
+
+
+#: Elementwise primitives the numpy index-map interpreter understands.
+#: div/rem guard to non-negative operands (numpy floors, lax
+#: truncates; index arithmetic is non-negative in practice — anything
+#: else falls back to the vmap path).
+def _np_div(a, b):
+    if not _nonneg(a, b):
+        raise _NpUnsupported("div on negative operands")
+    return np.floor_divide(a, b)
+
+
+def _np_rem(a, b):
+    if not _nonneg(a, b):
+        raise _NpUnsupported("rem on negative operands")
+    return np.remainder(a, b)
+
+
+_NP_ELEMENTWISE = {
+    "add": np.add, "sub": np.subtract, "mul": np.multiply,
+    "max": np.maximum, "min": np.minimum, "neg": np.negative,
+    "lt": np.less, "le": np.less_equal, "gt": np.greater,
+    "ge": np.greater_equal, "eq": np.equal, "ne": np.not_equal,
+    "and": np.bitwise_and, "or": np.bitwise_or, "xor": np.bitwise_xor,
+    "not": np.bitwise_not, "div": _np_div, "rem": _np_rem,
+    "shift_left": np.left_shift,
+    "shift_right_logical": np.right_shift,
+    "shift_right_arithmetic": np.right_shift,
+    "stop_gradient": lambda a: a,
+}
+
+
+def _np_eval_index_map(dj, dconsts, coords: np.ndarray, scalars,
+                       nd: int) -> np.ndarray:
+    """Numpy fast path for the (overwhelmingly common) scalar index
+    map: every value is a scalar — possibly batched over the grid
+    steps as a leading axis — except the scalar-prefetch arrays, which
+    appear only as all-1 dynamic_slice operands (batched fancy
+    indexing). Raises _NpUnsupported on anything richer; the caller
+    falls back to the jax vmap evaluator. This exists because eager
+    vmap re-compiles the batched scalar gather once per distinct grid
+    shape (~0.3s per kernel case — the difference between a <2s and a
+    ~3s acceptance smoke)."""
+    import jax
+
+    steps = len(coords)
+    env = {}
+
+    def read(v):
+        if isinstance(v, jax.core.Literal):
+            return np.asarray(v.val), False
+        return env[v]
+
+    ngrid = coords.shape[1]
+    for k in range(ngrid):
+        env[dj.invars[k]] = (coords[:, k].astype(np.int64), True)
+    for var, s in zip(dj.invars[ngrid:], scalars):
+        env[var] = (np.asarray(s), False)
+    for var, c in zip(dj.constvars, dconsts):
+        env[var] = (np.asarray(c), False)
+
+    for eqn in dj.eqns:
+        name = eqn.primitive.name
+        ins = [read(x) for x in eqn.invars]
+        batched = any(b for _, b in ins)
+        scalarish = all(
+            v.ndim == 0 or (b and v.ndim == 1) for v, b in ins
+        )
+        if name in _NP_ELEMENTWISE and scalarish:
+            out = _NP_ELEMENTWISE[name](*(v for v, _ in ins))
+        elif name == "select_n" and scalarish:
+            which, *cases = (v for v, _ in ins)
+            out = np.choose(which.astype(np.int64), cases)
+        elif name == "dynamic_slice" and all(
+            s == 1 for s in eqn.params["slice_sizes"]
+        ):
+            (op, opb), *starts = ins
+            if opb or not all(
+                v.ndim == 0 or (b and v.ndim == 1) for v, b in starts
+            ):
+                raise _NpUnsupported("batched dynamic_slice operand")
+            # lax clamps starts into [0, dim - 1] for size-1 slices.
+            sidx = tuple(
+                np.clip(v, 0, dim - 1)
+                for (v, _), dim in zip(starts, op.shape)
+            )
+            out = op[sidx]
+        elif name in ("squeeze", "reshape", "broadcast_in_dim") and (
+            int(np.prod(eqn.outvars[0].aval.shape)) == 1
+            or (batched and ins[0][0].ndim == 1)
+        ):
+            out = ins[0][0]
+        elif name == "convert_element_type" and scalarish:
+            out = ins[0][0].astype(
+                np.dtype(eqn.params["new_dtype"])
+                if np.dtype(eqn.params["new_dtype"]).kind in "iub"
+                else np.int64
+            )
+        else:
+            raise _NpUnsupported(name)
+        env[eqn.outvars[0]] = (np.asarray(out), batched)
+
+    cols = []
+    for v in dj.outvars[:nd]:
+        val, b = read(v)
+        col = val.astype(np.int64).reshape(-1)
+        cols.append(col if b else np.full(steps, int(col[0]) if col.size
+                                          else 0, np.int64))
+    return np.stack(cols, axis=1)
+
+
+def _eval_index_map(bm, grid: Tuple[int, ...], scalars) -> np.ndarray:
+    """Evaluate one BlockSpec index map over the full grid: the map
+    jaxpr reads scalar-prefetch REFS, so it is state-discharged to a
+    pure jaxpr first, then evaluated over all grid coordinates — by
+    the numpy interpreter when the map stays in its scalar vocabulary,
+    else vmapped through jax. Returns int64 [steps, ndim] block
+    indices (row-major grid order — the TPU's sequential execution
+    order)."""
+    import jax
+    import jax.numpy as jnp
+    from jax._src.state.discharge import discharge_state
+
+    cj = bm.index_map_jaxpr
+    dj, dconsts = discharge_state(cj.jaxpr, cj.consts)
+    nd = len(bm.block_shape)
+    steps = int(np.prod(grid)) if grid else 1
+    coords = np.indices(grid).reshape(len(grid), steps).T.astype(np.int32)
+    try:
+        return _np_eval_index_map(dj, dconsts, coords, scalars, nd)
+    except _NpUnsupported:
+        pass
+    scal = tuple(jnp.asarray(s) for s in scalars)
+
+    def one(c):
+        out = jax.core.eval_jaxpr(
+            dj, dconsts, *(c[k] for k in range(len(grid))), *scal
+        )
+        return tuple(jnp.asarray(o, jnp.int32) for o in out[:nd])
+
+    outs = jax.vmap(one)(jnp.asarray(coords))
+    return np.stack(
+        [np.asarray(o, np.int64) for o in outs], axis=1
+    )  # (steps, nd)
+
+
+@dataclasses.dataclass
+class _Site:
+    """One extracted pallas_call: the grid mapping, per-operand block
+    info, scratch avals, and the kernel jaxpr."""
+
+    grid: Tuple[int, ...]
+    in_blocks: list  # (bm, index array) for inputs
+    out_blocks: list  # (bm, index array) for outputs
+    scratch_avals: list
+    kernel_jaxpr: object
+
+
+def extract_site(case: KernelCase) -> _Site:
+    """Trace ``case.fn(*case.args)`` and read the single pallas_call's
+    grid/Block/scratch structure off the jaxpr — no execution."""
+    import jax
+
+    jx = jax.make_jaxpr(case.fn)(*case.args)
+    eqns = _find_pallas_eqns(jx.jaxpr, [])
+    if len(eqns) != 1:
+        raise ValueError(
+            f"{case.label}: expected exactly one pallas_call in the "
+            f"traced jaxpr, found {len(eqns)}"
+        )
+    eq = eqns[0]
+    gm = eq.params["grid_mapping"]
+    grid = tuple(int(g) for g in gm.grid)
+    bms = list(gm.block_mappings)
+    n_in = gm.num_inputs
+    in_blocks = [
+        (bm, _eval_index_map(bm, grid, case.scalar_args))
+        for bm in bms[:n_in]
+    ]
+    out_blocks = [
+        (bm, _eval_index_map(bm, grid, case.scalar_args))
+        for bm in bms[n_in:]
+    ]
+    kj = eq.params["jaxpr"]
+    n_lead = gm.num_index_operands + gm.num_inputs + gm.num_outputs
+    scratch_avals = [v.aval for v in kj.invars[n_lead:]]
+    return _Site(grid, in_blocks, out_blocks, scratch_avals, kj)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+def _tile_padded_bytes(shape, itemsize: int) -> int:
+    """VMEM footprint of one block: trailing dims padded to the
+    dtype's sublane x 128 tile (Mosaic's physical layout)."""
+    sub = _SUBLANES.get(itemsize, 8)
+    dims = list(shape)
+    if not dims:
+        return sub * LANES * itemsize
+    if len(dims) == 1:
+        return -(-dims[0] // (sub * LANES)) * sub * LANES * itemsize
+    dims[-1] = -(-dims[-1] // LANES) * LANES
+    dims[-2] = -(-dims[-2] // sub) * sub
+    return int(np.prod(dims)) * itemsize
+
+
+def _buffer_count(idx: np.ndarray) -> int:
+    """1 when the block index never changes over the grid (one
+    resident copy), else 2 (the Pallas pipeline double-buffers)."""
+    return 2 if len(idx) > 1 and np.any(np.diff(idx, axis=0) != 0) else 1
+
+
+def _block_runs(idx: np.ndarray) -> int:
+    """Number of DISTINCT-consecutive index runs over the grid — how
+    many times the pipeline actually fetches the block."""
+    if len(idx) == 0:
+        return 0
+    return 1 + int(np.count_nonzero(np.any(np.diff(idx, axis=0) != 0,
+                                           axis=1)))
+
+
+def _f(case: KernelCase, rule: str, msg: str) -> Finding:
+    return Finding(rule=rule, path=case.path, line=case.line,
+                   message=msg, snippet=f"kernel={case.label}")
+
+
+def check_vmem_budget(case: KernelCase, site: _Site,
+                      device_kind: Optional[str]) -> List[Finding]:
+    """PTK001."""
+    from pagerank_tpu.obs import costs
+
+    total = 0
+    parts = []
+    for bm, idx in site.in_blocks + site.out_blocks:
+        if _bm_space(bm) != "vmem":
+            continue
+        item = np.dtype(bm.array_shape_dtype.dtype).itemsize
+        b = _tile_padded_bytes(bm.block_shape, item)
+        bufs = _buffer_count(idx)
+        total += b * bufs
+        parts.append(f"{tuple(bm.block_shape)}x{bufs}={b * bufs}")
+    for av in site.scratch_avals:
+        if "vmem" not in _space(av) or not hasattr(av, "shape"):
+            continue
+        b = _tile_padded_bytes(av.shape, np.dtype(av.dtype).itemsize)
+        total += b
+        parts.append(f"scratch{tuple(av.shape)}={b}")
+    budget = costs.pallas_vmem_budget(device_kind)
+    if total > budget:
+        kind = device_kind or costs.DEFAULT_VMEM_TARGET_KIND
+        return [_f(
+            case, "PTK001",
+            f"VMEM residency {total / 1e6:.1f}MB exceeds the "
+            f"{budget / 1e6:.0f}MB budget for '{kind}' "
+            f"({costs.PALLAS_VMEM_HEADROOM:.0%} of capacity): "
+            + ", ".join(parts),
+        )]
+    return []
+
+
+def check_tile_geometry(case: KernelCase, site: _Site) -> List[Finding]:
+    """PTK002 (>=2-D VMEM blocks only: 1-D whole-array operands lay
+    out as (1, n) with Mosaic's own lane padding, charged by
+    PTK001)."""
+    out = []
+    for bm, _idx in site.in_blocks + site.out_blocks:
+        if _bm_space(bm) != "vmem":
+            continue
+        bs = tuple(bm.block_shape)
+        if len(bs) < 2:
+            continue
+        item = np.dtype(bm.array_shape_dtype.dtype).itemsize
+        sub = _SUBLANES.get(item, 8)
+        lane, subl = bs[-1], bs[-2]
+        if lane != 1 and lane % LANES:
+            out.append(_f(
+                case, "PTK002",
+                f"block {bs} ({bm.array_shape_dtype.dtype}) lane dim "
+                f"{lane} not a multiple of {LANES}",
+            ))
+        if subl != 1 and subl % sub:
+            out.append(_f(
+                case, "PTK002",
+                f"block {bs} ({bm.array_shape_dtype.dtype}) sublane "
+                f"dim {subl} not a multiple of {sub} "
+                f"({sub}x{LANES} tile for itemsize {item})",
+            ))
+    return out
+
+
+def check_index_coverage(case: KernelCase, site: _Site) -> List[Finding]:
+    """PTK003."""
+    out: List[Finding] = []
+    for bm, idx in site.in_blocks:
+        dims = bm.array_shape_dtype.shape
+        bs = bm.block_shape
+        for d in range(len(bs)):
+            lo = int(idx[:, d].min())
+            hi = int(idx[:, d].max())
+            if lo < 0 or hi * bs[d] >= max(1, dims[d]) + (bs[d] - 1):
+                # A block STARTING at or past the dim end reads fully
+                # out of bounds (partial trailing blocks are legal —
+                # Pallas masks them).
+                pass
+            if lo < 0 or hi * bs[d] >= dims[d]:
+                out.append(_f(
+                    case, "PTK003",
+                    f"input block map for {tuple(bs)} reaches index "
+                    f"{lo if lo < 0 else hi} on dim {d} "
+                    f"(array dim {dims[d]}, block {bs[d]}): read out "
+                    f"of bounds",
+                ))
+                break
+    for bm, idx in site.out_blocks:
+        if _bm_space(bm) == "vmem":
+            dims = bm.array_shape_dtype.shape
+            bs = bm.block_shape
+            nblocks = [
+                -(-dims[d] // bs[d]) for d in range(len(bs))
+            ]
+            # Collapse consecutive repeats (a block legally stays
+            # resident across adjacent steps — the accumulate
+            # pattern); any remaining duplicate is a non-consecutive
+            # revisit, i.e. an overwrite race with the earlier write.
+            keep = np.ones(len(idx), bool)
+            keep[1:] = np.any(np.diff(idx, axis=0) != 0, axis=1)
+            dedup = idx[keep]
+            seen = set()
+            for row in dedup:
+                t = tuple(int(x) for x in row)
+                if t in seen:
+                    out.append(_f(
+                        case, "PTK003",
+                        f"output block {t} written on non-consecutive "
+                        f"grid steps (overlapping writes: the later "
+                        f"visit overwrites the earlier result)",
+                    ))
+                seen.add(t)
+            expect = int(np.prod(nblocks))
+            if len(seen) < expect:
+                missing = expect - len(seen)
+                out.append(_f(
+                    case, "PTK003",
+                    f"output coverage gap: {missing} of {expect} "
+                    f"blocks never written (first missing: "
+                    f"{_first_missing(seen, nblocks)})",
+                ))
+        else:
+            # ANY-space output: writes happen via explicit DMA at
+            # data-dependent offsets — verify the registered write
+            # model instead.
+            if case.write_model is None:
+                out.append(_f(
+                    case, "PTK003",
+                    "ANY-space output has no registered write model: "
+                    "coverage of the DMA RMW windows cannot be proven",
+                ))
+                continue
+            starts, width, length = case.write_model()
+            starts = np.asarray(starts, np.int64)
+            dim0 = int(bm.array_shape_dtype.shape[0])
+            if starts.min(initial=0) < 0 or (
+                len(starts) and int(starts.max()) + width > dim0
+            ):
+                out.append(_f(
+                    case, "PTK003",
+                    f"RMW window out of bounds: starts in "
+                    f"[{int(starts.min())}, {int(starts.max())}] with "
+                    f"width {width} against output dim {dim0}",
+                ))
+            ss = np.sort(starts)
+            ends = np.maximum.accumulate(ss + width)
+            gaps = ss[1:] > ends[:-1]
+            covered_to = int(ends[-1]) if len(ends) else 0
+            if len(ss) and int(ss[0]) > 0:
+                out.append(_f(
+                    case, "PTK003",
+                    f"RMW coverage gap: first window starts at "
+                    f"{int(ss[0])}, elements [0, {int(ss[0])}) never "
+                    f"written",
+                ))
+            elif np.any(gaps & (ss[1:] < length)):
+                at = int(ss[1:][gaps & (ss[1:] < length)][0])
+                out.append(_f(
+                    case, "PTK003",
+                    f"RMW coverage gap before element {at}: a chunk's "
+                    f"rank span exceeds the static window width "
+                    f"{width} — rows silently dropped",
+                ))
+            elif covered_to < length:
+                out.append(_f(
+                    case, "PTK003",
+                    f"RMW coverage gap: windows end at {covered_to} "
+                    f"of {length} logical elements",
+                ))
+    return out
+
+
+def _first_missing(seen, nblocks):
+    it = np.ndindex(*nblocks)
+    for t in it:
+        if t not in seen:
+            return t
+    return None
+
+
+def _walk_eqns(jaxpr):
+    for eq in jaxpr.eqns:
+        yield eq
+        for v in eq.params.values():
+            if hasattr(v, "jaxpr"):
+                yield from _walk_eqns(v.jaxpr)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if hasattr(x, "jaxpr"):
+                        yield from _walk_eqns(x.jaxpr)
+
+
+def check_memory_discipline(case: KernelCase, site: _Site) -> List[Finding]:
+    """PTK004."""
+    import jax
+
+    out = []
+    kj = site.kernel_jaxpr
+    for av in site.scratch_avals:
+        dt = getattr(av, "dtype", None)
+        if dt is None or "vmem" not in _space(av):
+            continue
+        if np.issubdtype(dt, np.floating) and dt != np.float32:
+            out.append(_f(
+                case, "PTK004",
+                f"float VMEM scratch accumulator is {dt}, not "
+                f"float32 (the accumulation contract; f64 has no "
+                f"Mosaic tile, bf16 loses the accumulated bits)",
+            ))
+    f64_seen = False
+    for eq in _walk_eqns(kj):
+        for v in list(eq.invars) + list(eq.outvars):
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and dt == np.float64 and not f64_seen:
+                f64_seen = True
+                out.append(_f(
+                    case, "PTK004",
+                    f"float64 value inside the kernel body "
+                    f"(primitive '{eq.primitive.name}'): f64 is not a "
+                    f"TPU vector dtype",
+                ))
+    any_vars = {
+        v for v in kj.invars
+        if "any" in _space(getattr(v, "aval", None))
+    }
+    for eq in kj.eqns:
+        if not any(
+            isinstance(v, jax.core.Var) and v in any_vars
+            for v in eq.invars
+        ):
+            continue
+        if eq.primitive.name not in ("dma_start", "dma_wait"):
+            out.append(_f(
+                case, "PTK004",
+                f"ANY-space (HBM) ref touched by primitive "
+                f"'{eq.primitive.name}' — HBM operands may be "
+                f"accessed only via explicit DMA "
+                f"(make_async_copy)",
+            ))
+    return out
+
+
+def check_cost_sanity(case: KernelCase, site: _Site) -> List[Finding]:
+    """PTK005."""
+    if case.cost_model is None:
+        return []
+    steps = int(np.prod(site.grid)) if site.grid else 1
+    flops_step = 0.0
+    for eq in _walk_eqns(site.kernel_jaxpr):
+        if eq.primitive.name != "dot_general":
+            continue
+        (lc, _rc), _batch = eq.params["dimension_numbers"]
+        lhs = eq.invars[0].aval
+        contract = int(np.prod([lhs.shape[d] for d in lc])) or 1
+        out_elems = int(np.prod(eq.outvars[0].aval.shape)) or 1
+        flops_step += 2.0 * out_elems * contract
+    flops = flops_step * steps
+
+    bytes_total = 0.0
+    for bm, idx in site.in_blocks:
+        if _bm_space(bm) != "vmem":
+            continue
+        item = np.dtype(bm.array_shape_dtype.dtype).itemsize
+        bytes_total += (
+            _block_runs(idx) * int(np.prod(bm.block_shape)) * item
+        )
+    for bm, idx in site.out_blocks:
+        item = np.dtype(bm.array_shape_dtype.dtype).itemsize
+        if _bm_space(bm) == "vmem":
+            bytes_total += (
+                _block_runs(idx) * int(np.prod(bm.block_shape)) * item
+            )
+        elif case.write_model is not None:
+            _starts, width, _length = case.write_model()
+            row = int(np.prod(bm.array_shape_dtype.shape[1:])) or 1
+            bytes_total += 2.0 * steps * width * row * item  # RMW r+w
+
+    out = []
+    for name, got, want in (
+        ("flops", flops, float(case.cost_model.get("flops", flops))),
+        ("bytes", bytes_total,
+         float(case.cost_model.get("bytes", bytes_total))),
+    ):
+        ref = max(abs(want), 1.0)
+        if abs(got - want) / ref > 0.25:
+            out.append(_f(
+                case, "PTK005",
+                f"static {name} {got:.3g} vs analytic model "
+                f"{want:.3g} (>{25}% apart): the kernel's geometry "
+                f"and the obs/costs-style model have drifted",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def check_kernel_case(case: KernelCase,
+                      device_kind: Optional[str] = None) -> List[Finding]:
+    try:
+        site = extract_site(case)
+    except Exception as e:  # a kernel that cannot even trace
+        msg = str(e).splitlines()[0][:200] if str(e) else type(e).__name__
+        return [_f(case, "PTK003",
+                   f"kernel failed to trace abstractly: {msg}")]
+    out: List[Finding] = []
+    out += check_vmem_budget(case, site, device_kind)
+    out += check_tile_geometry(case, site)
+    out += check_index_coverage(case, site)
+    out += check_memory_discipline(case, site)
+    out += check_cost_sanity(case, site)
+    return out
+
+
+def check_kernel_plane(cases: Optional[Sequence[KernelCase]] = None,
+                       device_kind: Optional[str] = None) -> List[Finding]:
+    """Run PTK001-005 over the registered kernel cases (default: the
+    shipped registry at toy + bench geometries). Deterministic,
+    CPU-only, no execution."""
+    if cases is None:
+        cases = shipped_cases()
+    findings: List[Finding] = []
+    for case in cases:
+        findings.extend(check_kernel_case(case, device_kind))
+    return findings

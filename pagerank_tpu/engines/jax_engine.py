@@ -362,12 +362,19 @@ class JaxTpuEngine(PageRankEngine):
             raise ValueError("build_device supports the ell/pallas kernels only")
         group = getattr(dg, "group", 1)
         stripe_size = getattr(dg, "stripe_size", 0)
-        if cfg.kernel == "pallas" and (group > 1 or stripe_size):
+        if cfg.kernel == "pallas" and group > 1:
             raise ValueError(
-                "kernel='pallas' needs a group=1 single-stripe device "
-                "graph; pass group=1, stripe_size=0 to build_ell_device"
+                "kernel='pallas' needs a group=1 device graph; pass "
+                "group=1 to build_ell_device"
             )
-        part = int(cfg.partition_span) if cfg.kernel != "pallas" else 0
+        if cfg.kernel == "pallas" and stripe_size and not cfg.partition_span:
+            raise ValueError(
+                "kernel='pallas' without partition_span needs a "
+                "single-stripe device graph; pass stripe_size=0 to "
+                "build_ell_device (or set partition_span to run the "
+                "partitioned kernel)"
+            )
+        part = int(cfg.partition_span)
         if part:
             part = min(part, dg.n_padded) if dg.n_padded else part
             # The partition-centric layout consumes a device graph
@@ -472,15 +479,21 @@ class JaxTpuEngine(PageRankEngine):
         )
         zero_in = graph.zero_in_mask
 
-        if kernel == "ell" and cfg.partition_span:
+        if kernel in ("ell", "pallas") and cfg.partition_span:
             # Partition-centric layout (ISSUE 6): the packer's stripes
             # ARE the source partitions — the sub-binning permutation is
-            # absorbed into its one composite-key sort.
+            # absorbed into its one composite-key sort. kernel='pallas'
+            # runs the same layout through the hand kernel
+            # (ops/pallas_spmv.ell_contrib_pallas_partitioned, ISSUE 16)
+            # on plain group-1 slot ids.
             psz = int(cfg.partition_span)
             n_padded = -(-n // 128) * 128
-            group = self.clamp_group_for_span(
-                cfg.lane_group or cfg.effective_lane_group(False),
-                psz,
+            group = (
+                1 if kernel == "pallas"
+                else self.clamp_group_for_span(
+                    cfg.lane_group or cfg.effective_lane_group(False),
+                    psz,
+                )
             )
             pack = ell_lib.ell_pack_striped(
                 graph, stripe_size=min(psz, max(128, n_padded)),
@@ -1285,14 +1298,22 @@ class JaxTpuEngine(PageRankEngine):
         prescale = prescale_pair if pair else prescale_plain
 
         if want_pallas:
-            # The pallas kernel pins z_ext in VMEM; refuse graphs that
-            # cannot fit (the XLA path has no such limit).
+            # The legacy pallas kernel pins the WHOLE z_ext in VMEM;
+            # refuse graphs that cannot fit (the XLA path has no such
+            # limit) with the clean downgrade signal, not a runtime TPU
+            # crash — ISSUE 16 satellite. The bound is the shared
+            # PTK001 budget (obs/costs.pallas_vmem_budget), so the
+            # static analyzer and this probe can never disagree.
             z_bytes = (n_state + gw) * jnp.dtype(self._inv_out.dtype).itemsize
-            if z_bytes > 12 * 1024 * 1024:
-                raise ValueError(
-                    f"kernel='pallas' needs the rank vector resident in "
-                    f"VMEM ({z_bytes / 1e6:.0f}MB > 12MB budget at "
-                    f"n_padded={n_state}); use kernel='ell'"
+            budget = obs_costs.pallas_vmem_budget(
+                jax.devices()[0].device_kind
+            )
+            if z_bytes > budget:
+                raise PallasUnavailableError(
+                    f"rank vector does not fit the VMEM budget "
+                    f"({z_bytes / 1e6:.0f}MB > {budget / 1e6:.0f}MB at "
+                    f"n_padded={n_state}); set partition_span for the "
+                    f"windowed pallas kernel, or use kernel='ell'"
                 )
             # Probe-compile each gather strategy at build: Mosaic gather
             # support varies by TPU generation — try the direct take,
@@ -1524,6 +1545,24 @@ class JaxTpuEngine(PageRankEngine):
             inv_out_rel = inv_out_rel.astype(z_dtype)
         self._inv_out = jax.device_put(inv_out_rel, rep)
 
+        if cfg.kernel == "pallas":
+            # Same slot/rank layout, hand kernel (ISSUE 16): route to
+            # the partition-centric Pallas setup. Shares src_dev /
+            # ranks / ids / offs verbatim — a probe failure downgrades
+            # via PallasUnavailableError and the rebuild re-enters this
+            # function with kernel='ell' (group regains its native
+            # value there; the arrays here are group-1 by routing).
+            self._setup_ell_partitioned_pallas(
+                src_dev=src_dev, ranks_dev=ranks_dev, ids_cat=ids_cat,
+                offs=offs, prefix_flags=prefix_flags,
+                rows_per_part=rows_per_part, rows_total=rows_total,
+                pairs_total=pairs_total, K=K, psz=psz, words24=words24,
+                num_blocks=num_blocks, n=n, n_state=n_state,
+                mass_mask=mass_mask, zero_in=zero_in, valid=valid,
+                z_dtype=z_dtype, stream=stream, gw=gw, group=group,
+            )
+            return
+
         chosen = self._autotune_chunk(
             chunk_cands, [rows_total // ndev], table_len, z_item, gw,
             group, False, accum, [pairs_total], ndev,
@@ -1606,6 +1645,212 @@ class JaxTpuEngine(PageRankEngine):
             contrib_fn, (src_dev, rb_dev, bases_dev, ids_cat),
             mass_mask, zero_in, valid, n, n_state,
             prescale=prescale_part,
+        )
+
+    # Fixed row-chunk of the partitioned pallas kernel: 1024 rows keep
+    # the streamed src block at 384KB (words24 planar) with the one-hot
+    # segment matmul MXU-shaped. Divisibility is structural: the shared
+    # partitioned layout pads every partition to ndev * cand_max rows
+    # with cand_max >= 2048, so 1024 divides both partitions and device
+    # shards and a chunk can never straddle either boundary.
+    PALLAS_PART_CHUNK = 1024
+
+    def _setup_ell_partitioned_pallas(
+            self, *, src_dev, ranks_dev, ids_cat, offs, prefix_flags,
+            rows_per_part, rows_total, pairs_total, K, psz, words24,
+            num_blocks, n, n_state, mass_mask, zero_in, valid, z_dtype,
+            stream, gw, group):
+        """Partition-centric Pallas kernel setup (ISSUE 16 payload):
+        consumes the layout `_setup_ell_partitioned` already built
+        (partition-major group-1 rows, words24/int32 slot words, dense
+        global pair ranks) and binds
+        ops/pallas_spmv.ell_contrib_pallas_partitioned in place of the
+        XLA window sweep. Differences from the XLA path:
+
+          - z lays out as [K, W, 128] partition WINDOWS (W*128 lanes =
+            span rounded to 2048, zero tail = the sentinel target); the
+            kernel's window BlockSpec picks row ``bases[i, 0]``, so the
+            Pallas pipeline double-buffers each window through VMEM
+            exactly once per sweep instead of trusting the cache;
+          - the 3-byte planar slot words stream to the core VERBATIM
+            and unpack on-chip — the XLA path pays an HLO unpack pass;
+          - pair ranks ride CHUNK-local in [0, width); the one-hot
+            segment matmul is (chunk, width) x (chunk, 128) on the MXU
+            with f32 scratch accumulation whatever the stream dtype.
+
+        Probe/downgrade contract matches the legacy kernel: both gather
+        strategies are probe-compiled at build, failure raises
+        PallasUnavailableError and the entry points rebuild with
+        kernel='ell' on the native (grouped) partitioned layout,
+        recording ``kernel_requested`` in layout_info()."""
+        from pagerank_tpu.ops import pallas_spmv
+
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        assert group == 1, group  # routing forces plain slot ids
+        chunk = self.PALLAS_PART_CHUNK
+        table_dt = stream or z_dtype
+        z_item = jnp.dtype(table_dt).itemsize
+
+        # Partition window padded so (1, W, 128) z blocks tile cleanly
+        # in both f32 (8x128) and bf16 (16x128): 2048 lanes = 16 rows
+        # of 128. The +8 keeps the onehot8 strategy's width-8 row at
+        # the zero sentinel (index psz) in range.
+        pspan = -(-(psz + 8) // 2048) * 2048
+        w_rows = pspan // 128
+
+        # width: max CHUNK-local pair-rank span. Dense ranks increment
+        # <= 1 per row so it is bounded by chunk + 1, and in practice
+        # is a handful of pairs; rounded to 128 for a lane-clean f32
+        # scratch. A chunk whose span exceeded width would silently
+        # drop rows — exactly the hazard PTK003's write-coverage proof
+        # (analysis/kernels.py) rules out statically.
+        spans = ranks_dev[chunk - 1 :: chunk] - ranks_dev[::chunk] + 1
+        width = int(jax.device_get(jnp.max(spans)))
+        width = -(-width // 128) * 128
+
+        src_lanes, src_item = (3 * 128, 1) if words24 else (128, 4)
+        resident = (
+            2 * w_rows * 128 * z_item           # double-buffered z window
+            + 2 * chunk * src_lanes * src_item  # streamed src block
+            + 2 * (chunk // 128) * 128 * 4      # streamed rank block
+            + width * 128 * 4                   # f32 accumulator scratch
+        )
+        budget = obs_costs.pallas_vmem_budget(jax.devices()[0].device_kind)
+        if resident > budget:
+            # Same shared bound as PTK001; an explicit oversized span
+            # lands here and downgrades to the XLA window sweep.
+            raise PallasUnavailableError(
+                f"partitioned kernel VMEM residency "
+                f"{resident / 1e6:.1f}MB > {budget / 1e6:.0f}MB budget "
+                f"(span {psz}, chunk {chunk}, width {width})"
+            )
+
+        part_ids = np.repeat(
+            np.arange(K, dtype=np.int32),
+            [r // chunk for r in rows_per_part],
+        )
+        rb0 = ranks_dev[::chunk].astype(jnp.int32)
+        bases = jnp.stack([jnp.asarray(part_ids), rb0], axis=1)
+        rk_loc = (
+            ranks_dev
+            - jnp.repeat(rb0, chunk, total_repeat_length=rows_total)
+        ).astype(jnp.int32).reshape(rows_total // 128, 128)
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+        rk_dev = jax.device_put(rk_loc, shard2d)
+        bases_dev = jax.device_put(bases, shard2d)
+        del rk_loc, bases, spans
+
+        self._src = [src_dev]
+        self._row_block = [rk_dev]
+        self._layout = {
+            "form": "pallas_partitioned",
+            "partition_span": psz,
+            "partitions": K,
+            "group": group,
+            "gather_width": gw,
+            "window_rows": w_rows,
+            "words24": words24,
+            "stream_dtype": cfg.stream_dtype or None,
+            "chunk": chunk,
+            "width": width,
+            "pairs": pairs_total,
+            "slot_rows": rows_total,
+            "n_stripes": 1,
+            "stripe_span": n_state,
+            "pair": False,
+        }
+        self._pack_stats = {
+            "num_rows": rows_total,
+            "padding_ratio": None,
+            "n_stripes": 1,
+        }
+
+        nb = num_blocks
+        nz_pad = K * psz - n_state
+
+        def prescale_pallas_part(r, inv):
+            z = r.astype(z_dtype) * inv
+            if nz_pad:
+                z = jnp.concatenate([z, jnp.zeros(nz_pad, z.dtype)])
+            if stream is not None:
+                z = z.astype(stream)
+            z2 = z.reshape(K, psz)
+            z2 = jnp.concatenate(
+                [z2, jnp.zeros((K, pspan - psz), z2.dtype)], axis=1
+            )
+            return z2.reshape(K, w_rows, 128)
+
+        interp = jax.default_backend() != "tpu"
+
+        def make_contrib(mode):
+            def sharded_contrib(z3, src, rk, bases_a, ids_a):
+                part = pallas_spmv.ell_contrib_pallas_partitioned(
+                    z3, src, rk, bases_a, pairs_total, chunk=chunk,
+                    width=width, gather=mode, interpret=interp,
+                )
+                p2 = part.reshape(pairs_total, 128)
+                total = jnp.zeros((nb, 128), p2.dtype)
+                # Pair -> global block expansion, identical to the XLA
+                # partitioned path: one sorted-UNIQUE scatter per
+                # partition (static pair-axis slices).
+                for j in range(K):
+                    lo, hi = int(offs[j]), int(offs[j + 1])
+                    total = spmv.scatter_block_sums(
+                        total, p2[lo:hi], ids_a[lo:hi], prefix_flags[j]
+                    )
+                return jax.lax.psum(total.reshape(-1), axis)
+
+            return shard_map(
+                sharded_contrib,
+                mesh=mesh,
+                in_specs=(P(), P(axis, None), P(axis, None),
+                          P(axis, None), P()),
+                out_specs=P(),
+                # pallas_call's out_shape carries no varying-mesh-axes
+                # annotation (see make_contrib above).
+                check_vma=False,
+            )
+
+        contrib_fn = None
+        for mode in ("take", "onehot8"):
+            candidate = make_contrib(mode)
+            try:
+                probe = jax.jit(
+                    lambda src, rk, b, ids, inv, fn=candidate: fn(
+                        prescale_pallas_part(
+                            jnp.zeros(n_state, z_dtype), inv
+                        ),
+                        src, rk, b, ids,
+                    )
+                )
+                jax.block_until_ready(
+                    probe(src_dev, rk_dev, bases_dev, ids_cat,
+                          self._inv_out)
+                )
+                contrib_fn = candidate
+                self._kernel = f"pallas_part:{mode}"
+                break
+            except Exception as e:  # pragma: no cover - hw-dependent
+                msg = str(e).splitlines()[0][:160] if str(e) else ""
+                if ("RESOURCE_EXHAUSTED" in msg
+                        or "out of memory" in msg.lower()):
+                    raise  # OOM is not a lowering problem; surface it
+                obs_log.info(
+                    f"partitioned pallas gather '{mode}' unavailable "
+                    f"({type(e).__name__}: {msg})"
+                )
+        if contrib_fn is None:
+            raise PallasUnavailableError(
+                "both Mosaic gather strategies failed to lower the "
+                "partitioned kernel"
+            )
+
+        self._finalize(
+            contrib_fn, (src_dev, rk_dev, bases_dev, ids_cat),
+            mass_mask, zero_in, valid, n, n_state,
+            prescale=prescale_pallas_part,
         )
 
     def _setup_multi_dispatch(self, *, n_stripes, sz, gw, group, pair,

@@ -96,6 +96,65 @@ def hbm_capacity_bytes(device_kind: Optional[str]) -> Optional[int]:
     return None
 
 
+#: Per-core VMEM capacity, bytes, keyed like the tables above — the
+#: LIMIT side of the kernel-plane PTK001 budget (ISSUE 16;
+#: analysis/kernels.py) and of the engine's pallas-probe refusal. VMEM
+#: is the on-chip scratchpad a Pallas kernel's resident blocks +
+#: scratch must fit (the Mosaic compiler also carves its own
+#: temporaries out of it — see :data:`PALLAS_VMEM_HEADROOM`).
+VMEM_CAPACITY_BYTES = {
+    "tpu v6": 32 << 20,
+    "tpu v5p": 16 << 20,
+    "tpu v5": 16 << 20,  # v5e ("TPU v5 lite" / "TPU v5e")
+    "tpu v4": 16 << 20,
+    "tpu v3": 16 << 20,
+    "tpu v2": 16 << 20,
+}
+
+#: Fraction of VMEM a kernel's accounted residency may claim: Mosaic
+#: keeps compiler temporaries (vector spills, DMA staging) in the same
+#: space, so budgeting the full capacity OOMs at compile time. 0.75 of
+#: the 16MB v5e core is the 12MB bound the engine's pallas probe has
+#: enforced since the legacy kernel landed.
+PALLAS_VMEM_HEADROOM = 0.75
+
+#: Budget target when no TPU is attached (CPU test substrate, or
+#: sizing a kernel for a TPU that isn't attached yet): the repo's
+#: measured platform (v5e). A per-kind budget must never come from a
+#: guess at an UNKNOWN kind — but a missing device is different: the
+#: pre-mesh checker exists precisely to run off-TPU, so it sizes for
+#: the campaign's default target.
+DEFAULT_VMEM_TARGET_KIND = "tpu v5"
+
+
+def vmem_capacity_bytes(device_kind: Optional[str]) -> Optional[int]:
+    """Per-core VMEM capacity for a ``device_kind`` string (same
+    longest-substring match as the HBM tables), or None when the kind
+    is unknown."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key in sorted(VMEM_CAPACITY_BYTES, key=len, reverse=True):
+        if key in kind:
+            return VMEM_CAPACITY_BYTES[key]
+    return None
+
+
+def pallas_vmem_budget(device_kind: Optional[str] = None) -> int:
+    """The VMEM byte budget a Pallas kernel's accounted residency
+    (resident blocks x pipeline buffering + scratch) must stay under:
+    the device kind's capacity (falling back to
+    :data:`DEFAULT_VMEM_TARGET_KIND` when the kind is unknown or no
+    device is attached) times :data:`PALLAS_VMEM_HEADROOM`. Shared by
+    the PTK001 rule (analysis/kernels.py) and the engine's pallas
+    probe refusal, so the static verdict and the runtime downgrade
+    can never disagree on the bound."""
+    cap = vmem_capacity_bytes(device_kind)
+    if cap is None:
+        cap = VMEM_CAPACITY_BYTES[DEFAULT_VMEM_TARGET_KIND]
+    return int(cap * PALLAS_VMEM_HEADROOM)
+
+
 @dataclass
 class CostReport:
     """One compiled program's static cost model (+ optional measured

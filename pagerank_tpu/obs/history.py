@@ -278,6 +278,8 @@ def _leg_name_from_layout(layout: Optional[dict], default: str = "f32") -> str:
     so the documented default is ``f32``."""
     if not isinstance(layout, dict):
         return default
+    if layout.get("form") == "pallas_partitioned":
+        return "pallas_partitioned_f32"
     if layout.get("stream_dtype") == "bfloat16":
         return "fast_bf16"
     if (layout.get("partition_span") or 0) > 0:
@@ -303,6 +305,11 @@ def leg_name_for_config(cfg) -> str:
     if get("vertex_sharded"):
         return ("multichip_sparse" if get("halo_exchange")
                 else "multichip_dense")
+    if get("kernel") == "pallas" and get("partition_span"):
+        # The fused Mosaic kernel leg (ISSUE 16): its own series —
+        # comparing it against partitioned_f32's XLA pipeline is the
+        # whole point of the ledger entry.
+        return "pallas_partitioned_f32"
     if get("stream_dtype") == "bfloat16" and get("partition_span"):
         return "fast_bf16"
     if get("partition_span"):
@@ -329,6 +336,7 @@ def _normalize_bench_couple(doc: dict, rec: dict) -> None:
         legs["pair_f64"]["build_warm_s"] = warm
     for key, name in (("fast_f32", "fast_f32"),
                       ("partitioned_f32", "partitioned_f32"),
+                      ("pallas_partitioned", "pallas_partitioned_f32"),
                       ("fast_bf16", "fast_bf16")):
         if isinstance(doc.get(key), dict):
             legs[name] = _rate_leg(doc[key])
@@ -357,9 +365,14 @@ def _normalize_bench_single(doc: dict, rec: dict) -> None:
 def _normalize_multichip(doc: dict, rec: dict) -> None:
     rec["kind"] = "multichip"
     legs = rec["legs"]
+    # The pallas leg joins the SAME pallas_partitioned_f32 series the
+    # couple mode feeds (its replicated-rank form measures per-chip
+    # rate like every other series entry); the exchange legs keep
+    # their multichip_* names.
     for key, name in (("single_chip", "multichip_single"),
                       ("dense_exchange", "multichip_dense"),
-                      ("sparse_exchange", "multichip_sparse")):
+                      ("sparse_exchange", "multichip_sparse"),
+                      ("pallas_partitioned", "pallas_partitioned_f32")):
         if isinstance(doc.get(key), dict):
             legs[name] = _rate_leg(doc[key])
     acc = doc.get("accuracy") or {}

@@ -346,7 +346,7 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
         pair
         or np.dtype(cfg.accum_dtype).itemsize > 4
         or cfg.vertex_sharded
-        or cfg.kernel not in ("auto", "ell")
+        or cfg.kernel not in ("auto", "ell", "pallas")
     ):
         if part > 0:
             obs_log.info(
@@ -366,8 +366,14 @@ def plan_build(cfg, n: int, stripe_size: int = 0, lane_group: int = 0,
                 f"(must be a multiple of {LANES})"
             )
             part = rounded
-        grp = JaxTpuEngine.clamp_group_for_span(
-            lane_group or cfg.effective_lane_group(False), part
+        # The pallas partitioned kernel consumes plain partition-local
+        # slot ids (it unpacks/gathers on-core); grouped lanes are an
+        # XLA-path packing.
+        grp = (
+            1 if cfg.kernel == "pallas"
+            else JaxTpuEngine.clamp_group_for_span(
+                lane_group or cfg.effective_lane_group(False), part
+            )
         )
         return grp, part, part
 

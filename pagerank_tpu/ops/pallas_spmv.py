@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from pagerank_tpu.ops import LANES
+from pagerank_tpu.ops import spmv as spmv_ops
 from pagerank_tpu.utils import jax_compat
 
 
@@ -154,3 +155,164 @@ def ell_contrib_pallas(
         row_block.reshape(-1, 1), out_init,
     )
     return out[:num_blocks].reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Partition-centric kernel (ISSUE 16 payload).
+#
+# The legacy kernel above pins the WHOLE z_ext vector in VMEM — sound
+# only while n_pad * itemsize fits the PTK001 budget (~3M f32 vertices),
+# which is exactly the geometry band the bench campaign left behind at
+# scale 22+. The partitioned kernel keeps the partition-centric layout
+# the XLA path already builds (ISSUE 6: rows grouped by source
+# partition, slot indices partition-local, pair ranks dense per
+# partition) and holds only ONE partition's z-window in VMEM at a time:
+#
+#   - ``z_windows`` [K, W, 128]: the pre-scaled rank vector split into K
+#     partition windows of W*128 = partition_span (+ zero tail) lanes.
+#     The BlockSpec picks window ``bases[i, 0]`` per grid step — rows
+#     are partition-major, so the index-map output is constant across a
+#     partition's chunks and the Pallas pipeline DMAs each window into
+#     its double buffer exactly once per sweep.
+#   - ``src_slots``: the 3-byte planar slot words (int8 [rows, 384],
+#     ops/spmv.py:pack_words24 layout) streamed chunk-at-a-time and
+#     unpacked to int32 on-core — 3 bytes of HBM traffic per slot
+#     instead of 4 — or plain int32 [rows, 128] when the span exceeds
+#     the 24-bit window.
+#   - segment sum: pair ranks are dense per partition (increment <= 1
+#     per row), so a chunk's CHUNK-LOCAL ranks live in [0, width) for a
+#     host-measured ``width`` — one (chunk, width) one-hot matmul on
+#     the MXU reduces the whole chunk, f32 whatever the stream dtype.
+#   - the (width, 128) f32 partial RMWs into the donated-zeros pair
+#     output at the chunk's global first rank (bases[i, 1]), the same
+#     sequential-grid DMA accumulate as above.
+#
+# A chunk whose rank span exceeds ``width`` would silently drop rows
+# (its one-hot rows are all-zero); the engine derives width from the
+# measured max span, and analysis/kernels.py PTK003 independently
+# proves the written windows cover every pair rank — the static gate
+# this kernel ships under.
+# ---------------------------------------------------------------------------
+
+
+def _kernel_partitioned(bases_ref, z_ref, src_ref, rk_ref, out_in_ref,
+                        out_ref, acc, sem, *, chunk, width, gather):
+    del out_in_ref  # aliased with out_ref (donated zeros)
+    i = pl.program_id(0)
+    rb0 = bases_ref[i, 1]
+
+    if src_ref.dtype == jnp.int8:
+        src = spmv_ops.unpack_words24(src_ref[...])  # (chunk, 128) int32
+    else:
+        src = src_ref[...]
+    z = z_ref[...].reshape(-1)  # (1, W, 128) -> flat partition window
+    if gather == "take":
+        v = z[src]
+    elif gather == "onehot8":
+        zw = z.reshape(-1, 8)
+        rows = zw[src >> 3]  # (chunk, 128, 8)
+        sel = jax.nn.one_hot(src & 7, 8, dtype=z.dtype)
+        v = (rows * sel).sum(-1)
+    else:
+        raise ValueError(f"unknown gather strategy {gather!r}")
+    v = v.astype(jnp.float32)  # bf16 streams, f32 accumulation
+
+    # Chunk-local pair ranks -> (width, 128) segment partial on the MXU.
+    rk = rk_ref[...].reshape(chunk)
+    oh = jax.nn.one_hot(rk, width, dtype=jnp.float32)  # (chunk, width)
+    seg = jax.lax.dot_general(
+        oh, v, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (width, 128)
+
+    load = pltpu.make_async_copy(out_ref.at[pl.ds(rb0, width), :], acc, sem)
+    load.start()
+    load.wait()
+    acc[...] += seg
+    store = pltpu.make_async_copy(acc, out_ref.at[pl.ds(rb0, width), :], sem)
+    store.start()
+    store.wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_pairs", "chunk", "width", "gather", "interpret"),
+)
+def ell_contrib_pallas_partitioned(
+    z_windows, src_slots, rank_rows, chunk_bases, num_pairs, *,
+    chunk=1024, width=LANES, gather="take", interpret=False,
+):
+    """Partition-centric fused gather+contrib+segment-sum (see module
+    comment above; the slot/rank layout is the engine's ISSUE-6
+    partitioned form).
+
+    Args:
+      z_windows: [K, W, 128] pre-scaled rank vector, one row per source
+        partition (W*128 >= partition_span + 8, tail zeroed; f32 or
+        bf16 stream).
+      src_slots: partition-LOCAL slot indices; int8 [rows, 384] planar
+        3-byte words (words24) or int32 [rows, 128]. ``rows`` must be a
+        multiple of ``chunk``; inert slots point at the zero tail.
+      rank_rows: int32 [rows/128, 128] CHUNK-local dense pair rank of
+        each slot row (row-major: row r at [r // 128, r % 128]); values
+        in [0, width).
+      chunk_bases: int32 [rows/chunk, 2]; per chunk ``[partition index,
+        global first pair rank]`` (host-precomputed, scalar-prefetched).
+      num_pairs: static global count of (dst block, partition) pairs.
+
+    Returns:
+      [num_pairs * 128] f32 per-pair contribution sums.
+    """
+    n_rows = src_slots.shape[0]
+    if n_rows % chunk:
+        raise ValueError(f"rows {n_rows} not a multiple of chunk {chunk}")
+    if chunk % LANES:
+        raise ValueError(f"chunk {chunk} not a multiple of {LANES}")
+    if width % 8:
+        raise ValueError(f"width {width} not a multiple of 8 (f32 sublanes)")
+    if z_windows.ndim != 3 or z_windows.shape[2] != LANES:
+        raise ValueError(f"z_windows must be [K, W, {LANES}], "
+                         f"got {z_windows.shape}")
+    src_lanes = 3 * LANES if src_slots.dtype == jnp.int8 else LANES
+    if src_slots.shape[1] != src_lanes:
+        raise ValueError(f"src_slots {src_slots.shape} / {src_slots.dtype} "
+                         f"mismatch (want {src_lanes} lanes)")
+    nc = n_rows // chunk
+    w_rows = z_windows.shape[1]
+    num_pairs_pad = num_pairs + width  # slack so the last RMW stays in range
+    out_init = jnp.zeros((num_pairs_pad, LANES), jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, w_rows, LANES), lambda i, b: (b[i, 0], 0, 0),
+                         memory_space=pltpu.VMEM),  # one partition window
+            pl.BlockSpec((chunk, src_lanes), lambda i, b: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk // LANES, LANES), lambda i, b: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # out buffer stays in HBM
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((width, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(
+        _kernel_partitioned, chunk=chunk, width=width, gather=gather,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_pairs_pad, LANES), jnp.float32),
+        input_output_aliases={4: 0},  # donated zeros -> output (RMW target)
+        interpret=interpret,
+        compiler_params=jax_compat.pallas_tpu_compiler_params(
+            has_side_effects=True
+        ),
+    )(
+        chunk_bases, z_windows, src_slots, rank_rows, out_init,
+    )
+    return out[:num_pairs].reshape(-1)

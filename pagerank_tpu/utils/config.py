@@ -409,10 +409,10 @@ class PageRankConfig:
                     f"partition_span must be a positive multiple of 128 "
                     f"(0 disables), got {self.partition_span}"
                 )
-            if self.kernel not in ("auto", "ell"):
+            if self.kernel not in ("auto", "ell", "pallas"):
                 raise ValueError(
-                    f"partition_span requires the ell kernel, got "
-                    f"{self.kernel!r}"
+                    f"partition_span requires the ell or pallas kernel, "
+                    f"got {self.kernel!r}"
                 )
             if self.vertex_sharded:
                 raise ValueError(

@@ -267,9 +267,19 @@ CONFIGS = {
               seed=11,
               label="sdc smoke (sticky bit-flip -> detect/localize/"
                     "quarantine on 8 fake devices)"),
+    # Kernel-plane smoke (ISSUE 16; analysis/kernels.py): the PTK
+    # static pass over the shipped Pallas kernel registry (toy + bench
+    # scale 22-25 geometries) — zero unwaived findings against the
+    # checked-in allowlist (the legacy whole-z entries waive as
+    # documented), AND every seeded-defect fixture trips EXACTLY its
+    # rule (a fixture that stops tripping means the rule went blind).
+    # Pure tracing + numpy, no TPU, no execution.
+    "W": dict(kind="kernels",
+              label="kernel-plane smoke (PTK pass clean, every seeded "
+                    "defect trips its rule)"),
 }
 DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "S",
-                "U", "V", "F", "A", "B", "T", "P", "E", "BV", "BB",
+                "U", "V", "W", "F", "A", "B", "T", "P", "E", "BV", "BB",
                 "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
@@ -1641,6 +1651,81 @@ def run_concurrency_smoke(key: str):
     return rec
 
 
+# Budget for the kernel-plane smoke (seconds): abstract tracing of
+# both shipped Pallas kernels at the toy + bench geometries plus the
+# six defect fixtures is ~0.6s on the CPU test substrate (the numpy
+# index-map interpreter keeps the full-grid evaluation off the
+# compiler); 2s absorbs a loaded host while catching an
+# accidentally-compiling evaluation path.
+KERNELS_SMOKE_BUDGET_S = 2.0
+
+#: Seeded defect fixture -> the ONE PTK rule it must trip (and no
+#: other rule may fire on it).
+KERNELS_FIXTURE_RULES = {
+    "fixture:vmem_overflow": "PTK001",
+    "fixture:misaligned_tile": "PTK002",
+    "fixture:index_gap": "PTK003",
+    "fixture:index_overlap": "PTK003",
+    "fixture:f64_scratch": "PTK004",
+    "fixture:cost_mismatch": "PTK005",
+}
+
+
+def run_kernels_smoke(key: str):
+    """ISSUE-16 gate: the PTK kernel-plane static pass
+    (analysis/kernels.py). Gates: ZERO unwaived findings over the
+    shipped registry against the checked-in allowlist (the legacy
+    whole-z VMEM entries waive with their documented geometry bound,
+    and ONLY those), every seeded-defect fixture trips exactly its
+    rule, and the whole pass under KERNELS_SMOKE_BUDGET_S. Abstract
+    tracing only — no TPU, nothing executes."""
+    from pagerank_tpu.analysis import kernels as kernels_mod
+    from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+    from pagerank_tpu.analysis.lint import package_root
+
+    spec = CONFIGS[key]
+    t0 = time.perf_counter()
+    findings = kernels_mod.check_kernel_plane()
+    allow = os.path.join(package_root(), "analysis", "allowlist.txt")
+    active, waived = split_allowlisted(findings, load_allowlist(allow))
+    fixture_bad = {}
+    for case in kernels_mod.defect_cases():
+        rules = sorted({f.rule for f in
+                        kernels_mod.check_kernel_case(case)})
+        want = KERNELS_FIXTURE_RULES[case.label]
+        if rules != [want]:
+            fixture_bad[case.label] = rules
+    t_run = time.perf_counter() - t0
+
+    ptk_waived = sum(1 for f, _w in waived if f.rule.startswith("PTK"))
+    passed = bool(
+        not active and not fixture_bad
+        and ptk_waived == len(kernels_mod.BENCH_SCALES)
+        and t_run <= KERNELS_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "kernels",
+        "label": spec["label"],
+        "active_findings": [f.render() for f in active],
+        "ptk_waived": ptk_waived,
+        "fixtures_checked": len(KERNELS_FIXTURE_RULES),
+        "fixture_mismatches": fixture_bad,
+        "seconds": t_run,
+        "budget_s": KERNELS_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] PTK kernel pass in {t_run:.2f}s vs budget "
+        f"{KERNELS_SMOKE_BUDGET_S:g}s; {len(active)} unwaived / "
+        f"{ptk_waived} waived finding(s); fixtures "
+        f"{'all trip' if not fixture_bad else 'BAD ' + repr(fixture_bad)}"
+        f" -> {'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 # Budget for the preemption smoke (seconds, measured around the
 # SIGTERM'd run + the resumed run — NOT the f64 oracle pass): two
 # 1024-vertex cpu-engine solves, a drain, and artifact save/restore
@@ -2349,7 +2434,7 @@ def main(argv=None) -> int:
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,
                "jobs": run_jobs_smoke, "graph": run_graph_smoke,
                "concurrency": run_concurrency_smoke,
-               "sdc": run_sdc_smoke}
+               "sdc": run_sdc_smoke, "kernels": run_kernels_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

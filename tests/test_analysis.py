@@ -286,10 +286,14 @@ def test_checked_in_allowlist_parses_and_every_entry_is_used():
     path = os.path.join(lint_mod.package_root(), "analysis", "allowlist.txt")
     waivers = load_allowlist(path)
     assert waivers, "the checked-in allowlist must carry the f64 waivers"
-    # The full AST surface the allowlist waives against: the lint pass
-    # AND the concurrency (PTR) pass — a waiver either matches a live
-    # finding in one of them or the fix landed and the entry is debt.
-    findings = lint_mod.lint_tree() + conc_mod.analyze_package()
+    # The full waivable surface: the lint pass, the concurrency (PTR)
+    # pass, AND the kernel plane (PTK — its legacy-geometry waiver is
+    # load-bearing, ISSUE 16) — a waiver either matches a live finding
+    # in one of them or the fix landed and the entry is debt.
+    from pagerank_tpu.analysis import kernels as kernels_mod
+
+    findings = (lint_mod.lint_tree() + conc_mod.analyze_package()
+                + kernels_mod.check_kernel_plane())
     _active, waived = split_allowlisted(findings, waivers)
     used = {id(w) for _f, w in waived}
     stale = [w for w in waivers if id(w) not in used]

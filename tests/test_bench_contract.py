@@ -160,7 +160,8 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "build_s", "costs", "layout", "lowering",
                         "graph", "sdc_check_overhead_pct", "fast_f32",
-                        "partitioned_f32", "fast_bf16", "accuracy",
+                        "partitioned_f32", "pallas_partitioned",
+                        "fast_bf16", "accuracy",
                         "env", "scale", "iters", "edge_factor",
                         "schema_version"}
     # SDC overhead (ISSUE 15): None-tolerant when disarmed — the key
@@ -180,7 +181,7 @@ def test_bench_json_contract_couple_mode(tmp_path):
     assert len(lines) == 1
     legs = lines[0]["legs"]
     assert {"pair_f64", "fast_f32", "partitioned_f32",
-            "fast_bf16"} <= set(legs)
+            "pallas_partitioned_f32", "fast_bf16"} <= set(legs)
     assert legs["pair_f64"]["edges_per_sec_per_chip"] == rec["value"]
     assert rec["build_s"] > 0 and rec["fast_f32"]["build_s"] > 0
     # Every leg carries the XLA cost-model block (ISSUE 5) and the
@@ -194,7 +195,8 @@ def test_bench_json_contract_couple_mode(tmp_path):
     # Every leg carries the data-plane graph block (ISSUE 13) — and a
     # fresh host build must actually report a profile, not None.
     _assert_graph_block(rec["graph"], expect_profile=True, ndev=1)
-    for leg in ("fast_f32", "partitioned_f32", "fast_bf16"):
+    for leg in ("fast_f32", "partitioned_f32", "pallas_partitioned",
+                "fast_bf16"):
         _assert_costs_block(rec[leg]["costs"])
         _assert_lowering_block(rec[leg]["lowering"], expect_native=True)
         _assert_graph_block(rec[leg]["graph"], expect_profile=True,
@@ -223,6 +225,18 @@ def test_bench_json_contract_couple_mode(tmp_path):
         assert lay["partitions"] >= 1 and lay["chunk"] > 0
     assert rec["fast_bf16"]["layout"]["stream_dtype"] == "bfloat16"
     assert rec["partitioned_f32"]["layout"]["stream_dtype"] is None
+    # The fused-kernel leg (ISSUE 16) must have ACTUALLY run the hand
+    # kernel (interpret-mode off-TPU) — a probe downgrade would
+    # silently re-measure the XLA partitioned leg; kernel_requested in
+    # the layout is how a downgrade stays visible, form proves it
+    # didn't happen here.
+    pl_lay = rec["pallas_partitioned"]["layout"]
+    _assert_layout_block(pl_lay, form="pallas_partitioned")
+    assert str(pl_lay["kernel"]).startswith("pallas_part")
+    assert pl_lay["partition_span"] > 0 and pl_lay["window_rows"] > 0
+    assert pl_lay["chunk"] > 0 and pl_lay["group"] == 1
+    pl_prof = rec["pallas_partitioned"]["graph"]["profile"]
+    assert pl_prof["stripe_span"] == pl_lay["partition_span"]
     assert rec["metric"] == "edges_per_sec_per_chip"
     assert rec["unit"] == "edges/s/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
@@ -344,7 +358,8 @@ def test_multichip_json_contract(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "n_devices", "scale",
                         "iters", "single_chip", "dense_exchange",
-                        "sparse_exchange", "scaling_efficiency",
+                        "sparse_exchange", "pallas_partitioned",
+                        "scaling_efficiency",
                         "scaling_efficiency_dense", "exchanged_bytes",
                         "device_view", "accuracy", "env", "edge_factor",
                         "schema_version"}
@@ -369,6 +384,20 @@ def test_multichip_json_contract(tmp_path):
         _assert_attribution_block(rec_l["attribution"],
                                   multi_device=leg != "single_chip")
     assert rec["single_chip"]["n_devices"] == 1
+    # The fused-kernel multichip leg (ISSUE 16): replicated-rank
+    # partitioned pallas form over the same mesh (the hand kernel
+    # doesn't compose with the vertex-sharded exchange — _mc_leg
+    # docstring), so its comms/attribution blocks are honestly None
+    # and its bytes counter honestly zero.
+    pl = rec["pallas_partitioned"]
+    assert pl["value"] > 0 and pl["n_devices"] == 8
+    _assert_costs_block(pl["costs"])
+    _assert_layout_block(pl["layout"], form="pallas_partitioned")
+    assert str(pl["layout"]["kernel"]).startswith("pallas_part")
+    _assert_lowering_block(pl["lowering"], expect_native=True)
+    _assert_graph_block(pl["graph"], expect_profile=True, ndev=8)
+    assert pl["comms"] is None and pl["attribution"] is None
+    assert pl["bytes_exchanged"] == 0
     # The attribution must agree with the leg's own comms model.
     assert rec["sparse_exchange"]["attribution"]["mode"] == "sparse"
     assert rec["sparse_exchange"]["attribution"]["model_bytes_per_iter"] \

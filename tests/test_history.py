@@ -542,6 +542,18 @@ def test_leg_name_for_config_vocabulary():
         vertex_sharded=True)) == "multichip_dense"
     assert H.leg_name_for_config(PageRankConfig(
         vertex_sharded=True, halo_exchange=True)) == "multichip_sparse"
+    # The fused Mosaic kernel leg (ISSUE 16): kernel='pallas' on a
+    # partitioned span is its OWN series — comparing it against the
+    # XLA partitioned_f32 pipeline is the point of the ledger entry.
+    # Without a span the pallas request alone doesn't rename the leg
+    # (the engine runs the plain layout and may downgrade anyway).
+    assert H.leg_name_for_config(PageRankConfig(
+        kernel="pallas", partition_span=512)) == "pallas_partitioned_f32"
+    assert H.leg_name_for_config(PageRankConfig(
+        kernel="pallas")) == "fast_f32"
+    assert H._leg_name_from_layout(
+        {"form": "pallas_partitioned", "kernel": "pallas_part:take",
+         "partition_span": 512}) == "pallas_partitioned_f32"
     # f64 naming must agree with _leg_name_from_layout's vocabulary:
     # the CLI can't set wide_accum (stays "auto", pair on TPU), so its
     # f64 runs join the headline pair_f64 series; only explicit NATIVE

@@ -1,0 +1,201 @@
+"""Tier-1 pins for the kernel-plane static analyzer (ISSUE 16;
+pagerank_tpu/analysis/kernels.py).
+
+The PTK rules prove a ``pl.pallas_call`` geometry safe WITHOUT running
+it: VMEM budget (PTK001), tile/lane alignment (PTK002), index-map
+coverage (PTK003), memory-space discipline (PTK004), and grid/cost
+sanity (PTK005) — all from the traced jaxpr, so the pass runs on CPU
+in tier-1. Pinned here:
+
+- the shipped registry is clean after the checked-in allowlist, and
+  the ONLY waived findings are the legacy whole-z kernel's PTK001 at
+  the bench scales (the documented, runtime-downgraded geometry hole);
+- the partitioned kernel is clean at every bench-campaign geometry —
+  the "proved safe before TPU time" acceptance;
+- every seeded-defect fixture trips exactly its rule;
+- the numpy index-map interpreter agrees with the jax evaluator (the
+  fast path is an optimization, never a semantics change);
+- CLI: ``--select PTK`` exit codes and the strict ``--json`` schema.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+from pagerank_tpu.analysis.__main__ import main as analysis_main
+from pagerank_tpu.analysis import kernels as K
+from pagerank_tpu.analysis.findings import Finding
+
+ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(K.__file__)),
+                         "allowlist.txt")
+
+# fixture label -> the ONE rule it must trip (scripts/acceptance.py's
+# kernel smoke pins the same mapping).
+FIXTURE_RULES = {
+    "fixture:vmem_overflow": "PTK001",
+    "fixture:misaligned_tile": "PTK002",
+    "fixture:index_gap": "PTK003",
+    "fixture:index_overlap": "PTK003",
+    "fixture:f64_scratch": "PTK004",
+    "fixture:cost_mismatch": "PTK005",
+}
+
+
+@pytest.fixture(scope="module")
+def shipped_findings():
+    return K.check_kernel_plane()
+
+
+def test_shipped_pass_is_clean_after_allowlist(shipped_findings):
+    active, waived = split_allowlisted(
+        shipped_findings, load_allowlist(ALLOWLIST)
+    )
+    assert active == [], [f.render() for f in active]
+    # The only waived findings are the legacy kernel's PTK001 at the
+    # bench scales — the waiver is geometry-bounded, not a blanket.
+    assert len(waived) == len(K.BENCH_SCALES)
+    for f, w in waived:
+        assert f.rule == "PTK001"
+        assert f.snippet.startswith("kernel=ell_contrib_pallas@scale")
+        assert "partitioned" not in f.snippet
+
+
+def test_legacy_kernel_overflows_vmem_at_every_bench_scale(
+        shipped_findings):
+    """The silent-scaling hole the ISSUE names: ell_contrib_pallas
+    holds z_ext whole in VMEM, so PTK001 must FAIL it at every bench
+    scale (and at nothing else — the toy geometry fits)."""
+    for s in K.BENCH_SCALES:
+        label = f"kernel=ell_contrib_pallas@scale{s}"
+        rules = [f.rule for f in shipped_findings if f.snippet == label]
+        assert rules == ["PTK001"], (s, rules)
+    toy = [f for f in shipped_findings
+           if f.snippet == "kernel=ell_contrib_pallas@toy"]
+    assert toy == [], [f.render() for f in toy]
+
+
+def test_partitioned_kernel_clean_at_all_bench_geometries(
+        shipped_findings):
+    """The acceptance: the partition-centric kernel passes PTK001-005
+    at every scale-22..25 geometry (f32 and the bf16 stream) with NO
+    allowlist help."""
+    bad = [f for f in shipped_findings if "partitioned" in f.snippet]
+    assert bad == [], [f.render() for f in bad]
+
+
+def test_allowlist_anchor_cannot_waive_partitioned_labels():
+    """Round-trip the checked-in waiver: it matches the legacy labels
+    and ONLY them — a PTK001 regression in the partitioned kernel must
+    surface, not vanish into the legacy kernel's documented hole."""
+    waivers = [w for w in load_allowlist(ALLOWLIST)
+               if w.rule == "PTK001"]
+    assert waivers, "the legacy PTK001 waiver must exist"
+    legacy = Finding(
+        rule="PTK001", path="ops/pallas_spmv.py", line=1, message="m",
+        snippet="kernel=ell_contrib_pallas@scale24",
+    )
+    partitioned = Finding(
+        rule="PTK001", path="ops/pallas_spmv.py", line=1, message="m",
+        snippet="kernel=ell_contrib_pallas_partitioned@scale24",
+    )
+    assert any(w.matches(legacy) for w in waivers)
+    assert not any(w.matches(partitioned) for w in waivers)
+
+
+def test_every_defect_fixture_is_pinned():
+    assert {c.label for c in K.defect_cases()} == set(FIXTURE_RULES)
+
+
+@pytest.mark.parametrize("label,rule", sorted(FIXTURE_RULES.items()))
+def test_defect_fixture_trips_exactly_its_rule(label, rule):
+    (case,) = [c for c in K.defect_cases() if c.label == label]
+    rules = [f.rule for f in K.check_kernel_plane([case])]
+    assert rules and set(rules) == {rule}, (label, rules)
+
+
+def test_numpy_index_map_interpreter_matches_jax(monkeypatch):
+    """The numpy fast path is the oracle-checked optimization: for the
+    partitioned kernel's scalar-driven maps (the z-window dynamic
+    slice included) it must produce bit-identical block indices to the
+    jax evaluator — and it must actually ENGAGE (a silent fallback
+    would put the eager-vmap recompile back on the CLI's hot path)."""
+    case = next(c for c in K.shipped_cases()
+                if c.label == "ell_contrib_pallas_partitioned@toy-span")
+    calls = []
+    orig = K._np_eval_index_map
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(K, "_np_eval_index_map", spy)
+    site_np = K.extract_site(case)
+    assert calls, "numpy interpreter never engaged on the shipped maps"
+
+    def refuse(*a, **kw):
+        raise K._NpUnsupported("forced jax fallback")
+
+    monkeypatch.setattr(K, "_np_eval_index_map", refuse)
+    site_jax = K.extract_site(case)
+    pairs = list(zip(site_np.in_blocks + site_np.out_blocks,
+                     site_jax.in_blocks + site_jax.out_blocks))
+    assert pairs
+    for (_, idx_np), (_, idx_jax) in pairs:
+        np.testing.assert_array_equal(idx_np, idx_jax)
+
+
+def test_cli_select_ptk_is_clean_on_the_repo(capsys):
+    rc = analysis_main(["--select", "PTK", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"] is True
+    assert out["version"] == 1
+    assert out["counts"]["active"] == 0
+    assert out["counts"]["waived"] == len(K.BENCH_SCALES)
+    assert out["findings"] == []
+
+
+def test_cli_without_allowlist_reports_the_legacy_hole(capsys):
+    rc = analysis_main(["--select", "PTK", "--json",
+                        "--allowlist", "none"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["ok"] is False
+    assert [f["rule"] for f in out["findings"]] == \
+        ["PTK001"] * len(K.BENCH_SCALES)
+    # Strict finding schema: the fields history/CI consume, no extras.
+    for f in out["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "snippet"}
+        assert f["path"] == "ops/pallas_spmv.py" and f["line"] > 0
+
+
+@pytest.mark.parametrize(
+    "fixture", sorted(n.split(":", 1)[1] for n in FIXTURE_RULES)
+)
+def test_cli_fixture_exits_nonzero(capsys, fixture):
+    rc = analysis_main(["--select", "PTK", "--json",
+                        "--kernel-fixture", fixture])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["ok"] is False
+    rules = {f["rule"] for f in out["findings"]}
+    assert rules == {FIXTURE_RULES["fixture:" + fixture]}, out["findings"]
+    # Fixture findings anchor to THIS analysis module, so the shipped
+    # allowlist (scoped to ops/pallas_spmv.py) can never absorb them.
+    assert out["counts"]["waived"] == 0
+
+
+def test_cli_unknown_fixture_is_usage_error(capsys):
+    rc = analysis_main(["--select", "PTK", "--kernel-fixture", "nope"])
+    assert rc == 2
+    assert "unknown kernel fixture" in capsys.readouterr().err
+
+
+def test_list_rules_includes_the_kernel_plane(capsys):
+    rc = analysis_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rid in K.RULES:
+        assert rid in out, rid
+    assert "PTH004" in out

@@ -106,16 +106,150 @@ def test_engine_pallas_kernel_matches_oracle(ndev):
     np.testing.assert_allclose(r_p, r_cpu, rtol=0, atol=1e-12)
 
 
-def test_engine_pallas_vmem_budget_refused():
+def test_engine_pallas_vmem_budget_downgrades():
+    """ISSUE-16 satellite: when the rank vector exceeds the shared
+    PTK001 VMEM budget (obs/costs.pallas_vmem_budget) and no
+    partition_span is set, the legacy whole-z kernel must raise a
+    clean PallasUnavailableError INSIDE the build — and the engine
+    must downgrade to the native ell layout and finish building,
+    recording the request. (This replaced the old hard ValueError:
+    a refused build cost campaigns a crash where a slower leg was
+    available.)"""
     from pagerank_tpu import JaxTpuEngine, PageRankConfig
 
     rng = np.random.default_rng(2)
-    n = 1 << 21  # 2M vertices * f64 > 12MB budget
+    n = 1 << 21  # 2M vertices * f64 > the ~12MB default budget
     g = build_graph(rng.integers(0, n, 1000), rng.integers(0, n, 1000), n=n)
-    cfg = PageRankConfig(kernel="pallas", dtype="float64", accum_dtype="float64",
-                         num_devices=1)
-    with pytest.raises(ValueError, match="VMEM"):
-        JaxTpuEngine(cfg).build(g)
+    cfg = PageRankConfig(kernel="pallas", dtype="float64",
+                         accum_dtype="float64", num_devices=1)
+    eng = JaxTpuEngine(cfg).build(g)
+    assert not eng._kernel.startswith("pallas")
+    assert eng.layout_info()["kernel_requested"] == "pallas"
+
+
+def _partitioned_form(rng, *, K=2, psz=256, chunk=128, width=16,
+                      rows_per_part=256, gw=128):
+    """Random toy partition-centric layout in the engine's ISSUE-6
+    form: partition-LOCAL slot indices with sentinel ``psz``, dense
+    chunk-local pair ranks, per-chunk (partition, first-rank) bases,
+    plus the flat partition-padded z table the XLA window path
+    consumes and an f64 numpy oracle."""
+    rows = K * rows_per_part
+    nc = rows // chunk
+    pairs = nc * (width // 2)
+    src = rng.integers(0, psz + 1, (rows, 128)).astype(np.int32)
+    rk_g = ((np.arange(rows) * pairs) // rows).astype(np.int32)
+    rb0 = rk_g[::chunk].copy()
+    rk_loc = (rk_g - np.repeat(rb0, chunk)).astype(np.int32)
+    part_ids = np.repeat(np.arange(K, dtype=np.int32),
+                         rows_per_part // chunk)
+    bases = np.stack([part_ids, rb0], 1).astype(np.int32)
+    win_rows = (psz + gw) // 128
+    zt = np.zeros((K, win_rows * 128), np.float32)
+    zt[:, :psz] = rng.random((K, psz)).astype(np.float32)
+
+    y64 = np.zeros((pairs, 128))
+    for r in range(rows):
+        p = part_ids[r // chunk]
+        y64[rk_g[r]] += zt[p].astype(np.float64)[src[r]]
+    return dict(src=src, rk_loc=rk_loc, bases=bases, zt=zt,
+                win_rows=win_rows, pairs=pairs, chunk=chunk,
+                width=width, oracle=y64.reshape(-1), part_ids=part_ids)
+
+
+@pytest.mark.parametrize("gather", ["take", "onehot8"])
+@pytest.mark.parametrize("words24", [False, True])
+def test_pallas_partitioned_matches_oracle(gather, words24):
+    """ISSUE-16 payload: the partitioned kernel (interpret mode) vs
+    the f64 numpy oracle — both Mosaic gather strategies, both slot
+    word encodings (3-byte planar int8 and int32)."""
+    from pagerank_tpu.ops import spmv
+
+    rng = np.random.default_rng(5)
+    f = _partitioned_form(rng)
+    K, win_rows = f["zt"].shape[0], f["win_rows"]
+    zw = jnp.asarray(f["zt"].reshape(K, win_rows, 128))
+    src = jnp.asarray(f["src"])
+    if words24:
+        src = spmv.pack_words24(src, jnp)
+    y = np.asarray(pallas_spmv.ell_contrib_pallas_partitioned(
+        zw, src, jnp.asarray(f["rk_loc"].reshape(-1, 128)),
+        jnp.asarray(f["bases"]), f["pairs"], chunk=f["chunk"],
+        width=f["width"], gather=gather, interpret=True,
+    ))
+    np.testing.assert_allclose(y, f["oracle"], rtol=2e-6, atol=2e-7)
+
+
+@pytest.mark.parametrize("gather", ["take", "onehot8"])
+def test_pallas_partitioned_bitwise_matches_ell_contrib(gather):
+    """f32 BIT-FOR-BIT parity against the XLA window-mode ell_contrib
+    on identical inputs with MATCHED chunking (same one-hot dot
+    contraction order). This is the rot guard for a kernel Mosaic can
+    only compile on hardware: any change to the gather, the one-hot
+    segment matmul, or the RMW accumulation order shows up as a
+    single-ulp diff here."""
+    from pagerank_tpu.ops import spmv
+
+    rng = np.random.default_rng(9)
+    f = _partitioned_form(rng)
+    K, win_rows = f["zt"].shape[0], f["win_rows"]
+    y_pallas = np.asarray(pallas_spmv.ell_contrib_pallas_partitioned(
+        jnp.asarray(f["zt"].reshape(K, win_rows, 128)),
+        jnp.asarray(f["src"]),
+        jnp.asarray(f["rk_loc"].reshape(-1, 128)),
+        jnp.asarray(f["bases"]), f["pairs"], chunk=f["chunk"],
+        width=f["width"], gather=gather, interpret=True,
+    ))
+    cb = np.stack([f["part_ids"] * win_rows, f["bases"][:, 1]],
+                  1).astype(np.int32)
+    y_ell = np.asarray(spmv.ell_contrib(
+        jnp.asarray(f["zt"].reshape(-1)), jnp.asarray(f["src"]),
+        jnp.asarray(f["rk_loc"]), f["pairs"], gather_width=128,
+        chunk_rows=f["chunk"], group=1, num_present=f["pairs"],
+        window_rows=win_rows, chunk_bases=jnp.asarray(cb),
+    ))
+    assert np.array_equal(y_pallas, y_ell)
+
+
+def test_pallas_partitioned_bf16_stream_vs_f64_oracle():
+    """bf16 z window stream, f32 accumulation: the error against the
+    f64 oracle must stay within the bf16 mantissa bound (~2^-8
+    relative per gathered value; sums are f32-exact on top)."""
+    rng = np.random.default_rng(13)
+    f = _partitioned_form(rng)
+    K, win_rows = f["zt"].shape[0], f["win_rows"]
+    zw = jnp.asarray(f["zt"].reshape(K, win_rows, 128), jnp.bfloat16)
+    y = np.asarray(pallas_spmv.ell_contrib_pallas_partitioned(
+        zw, jnp.asarray(f["src"]),
+        jnp.asarray(f["rk_loc"].reshape(-1, 128)),
+        jnp.asarray(f["bases"]), f["pairs"], chunk=f["chunk"],
+        width=f["width"], gather="take", interpret=True,
+    ))
+    assert y.dtype == np.float32
+    scale = np.abs(f["oracle"]).max()
+    np.testing.assert_allclose(y, f["oracle"], rtol=2**-7,
+                               atol=2**-8 * scale)
+
+
+@pytest.mark.parametrize("ndev", [1, 2])
+def test_engine_pallas_partitioned_matches_oracle(ndev):
+    """Full engine on the ISSUE-16 payload path: kernel='pallas' WITH
+    partition_span routes to ell_contrib_pallas_partitioned (interpret
+    mode on CPU) — the windowed-stream kernel, not the legacy whole-z
+    one — and must match the CPU reference at f32 iteration noise."""
+    from pagerank_tpu import JaxTpuEngine, PageRankConfig, ReferenceCpuEngine
+
+    rng = np.random.default_rng(31)
+    n, e = 400, 3000
+    g = build_graph(rng.integers(0, n, e), rng.integers(0, n, e), n=n)
+    cfg = PageRankConfig(num_iters=8, kernel="pallas", partition_span=256,
+                         num_devices=ndev)
+    eng = JaxTpuEngine(cfg).build(g)
+    assert eng._kernel.startswith("pallas_part")
+    assert eng.layout_info()["form"] == "pallas_partitioned"
+    r_p = eng.run()
+    r_cpu = ReferenceCpuEngine(cfg).build(g).run()
+    np.testing.assert_allclose(r_p, r_cpu, rtol=1e-5, atol=1e-7)
 
 
 def test_pallas_block_boundary_accumulation():

@@ -325,7 +325,7 @@ def test_config_partition_validation():
                        accum_dtype="float64").validate()
     with pytest.raises(ValueError, match="vertex_sharded"):
         PageRankConfig(partition_span=256, vertex_sharded=True).validate()
-    with pytest.raises(ValueError, match="ell kernel"):
+    with pytest.raises(ValueError, match="ell or pallas kernel"):
         PageRankConfig(partition_span=256, kernel="coo").validate()
     with pytest.raises(ValueError, match="stream_dtype"):
         PageRankConfig(stream_dtype="float16",
