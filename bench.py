@@ -630,7 +630,8 @@ def run_accuracy(scale: int = 20, iters: int = 50, with_bf16: bool = False,
 
 
 def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None,
-            kernel="", partition_span=0):
+            kernel="", partition_span=0, halo_async=False,
+            pack_cache=None):
     """One multichip rate leg: a vertex-sharded f32 solve over ``ndev``
     devices through the dense or sparse (halo) exchange. Returns the
     leg dict: edges/s/chip, cost + layout + comms blocks, the
@@ -647,7 +648,15 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None,
     layout over the same mesh instead — the hand kernel doesn't compose
     with the vertex-sharded exchange (it consumes the whole rank vector
     per source window), so its multichip series measures the
-    data-parallel form; the recorded layout says which one ran."""
+    data-parallel form; the recorded layout says which one ran.
+
+    ``halo_async`` (ISSUE 17): the ``sparse_async`` leg runs the
+    stale-boundary double-buffered exchange (config.halo_async) with
+    the auto-gate threshold pinned to 0 so the leg measures the async
+    form even at geometries where the gate would normally refuse it.
+    ``pack_cache`` (ISSUE 17): a dict shared across legs so every leg
+    whose resolved layout plan matches reuses ONE host ELL pack
+    instead of re-packing the same graph per leg."""
     from pagerank_tpu import PageRankConfig
     from pagerank_tpu.engines.jax_engine import JaxTpuEngine
     from pagerank_tpu.obs import devices as obs_devices
@@ -660,12 +669,18 @@ def _mc_leg(graph, *, ndev, iters, warmup, halo, label, dump_hlo=None,
             partition_span=partition_span,
         ).validate()
     else:
+        # Gate pinned open for the async bench leg: the whole point is
+        # to MEASURE the async form; the auto-gate's prediction is a
+        # separate recorded fact (comms.predicted_overlap_gain).
+        async_kw = ({"halo_async": True, "halo_async_min_gain": 0.0}
+                    if halo_async else {})
         cfg = PageRankConfig(
             num_iters=iters, dtype="float32", accum_dtype="float32",
             num_devices=ndev, vertex_sharded=True, halo_exchange=halo,
+            **async_kw,
         ).validate()
     t0 = time.perf_counter()
-    engine = JaxTpuEngine(cfg).build(graph)
+    engine = JaxTpuEngine(cfg, pack_cache=pack_cache).build(graph)
     t_build = time.perf_counter() - t0
     for _ in range(warmup):
         engine._device_step()
@@ -760,13 +775,20 @@ def run_multichip(args):
         f"({time.perf_counter() - t0:.1f}s host build)",
         file=sys.stderr,
     )
+    # One host ELL pack shared across every leg whose resolved layout
+    # plan matches (ISSUE 17): single/dense/sparse/async all resolve
+    # the same packer plan for this graph, so the graph is packed ONCE;
+    # the pallas leg's partition-span plan differs and packs its own.
+    pack_cache = {}
     kw = dict(iters=args.iters, warmup=args.warmup,
-              dump_hlo=args.dump_hlo)
+              dump_hlo=args.dump_hlo, pack_cache=pack_cache)
     single = _mc_leg(graph, ndev=1, halo=False, label="single_chip", **kw)
     dense = _mc_leg(graph, ndev=ndev, halo=False, label="dense_exchange",
                     **kw)
     sparse = _mc_leg(graph, ndev=ndev, halo=True,
                      label="sparse_exchange", **kw)
+    sparse_async = _mc_leg(graph, ndev=ndev, halo=True, halo_async=True,
+                           label="sparse_async", **kw)
     # Fused Mosaic kernel leg (ISSUE 16): the partitioned pallas form
     # over the same mesh (replicated ranks — see _mc_leg docstring),
     # so the multichip cell carries the hand-kernel series too. Span:
@@ -778,6 +800,30 @@ def run_multichip(args):
     pallas = _mc_leg(graph, ndev=ndev, halo=False,
                      label="pallas_partitioned", kernel="pallas",
                      partition_span=pspan, **kw)
+    # Overlap verdict (ISSUE 17): is the async leg's measured full-step
+    # wall strictly below the sync leg's compute + exchange sum? That
+    # sum is what the synchronous schedule PAYS per step; the async
+    # schedule's ceiling is max(compute, comms). Both sides come from
+    # the fenced attribution blocks of THIS run.
+    overlap = None
+    a_sync, a_async = sparse.get("attribution"), \
+        sparse_async.get("attribution")
+    if a_sync and a_async:
+        sync_sum = a_sync["compute_s"] + a_sync["exchange_s"]
+        overlap = {
+            "sync_compute_plus_exchange_s": sync_sum,
+            "async_step_s": a_async["step_s"],
+            "async_below_sync_sum": bool(a_async["step_s"] < sync_sum),
+            "gain": (1.0 - a_async["step_s"] / sync_sum
+                     if sync_sum > 0 else None),
+        }
+        print(
+            f"multichip[overlap]: async step "
+            f"{a_async['step_s'] * 1e3:.2f} ms vs sync compute+exchange "
+            f"{sync_sum * 1e3:.2f} ms "
+            f"({'HIDDEN' if overlap['async_below_sync_sum'] else 'NOT hidden'})",
+            file=sys.stderr,
+        )
     cm = sparse["comms"]
     # The sparse leg can legitimately DOWNGRADE to the dense exchange
     # (multi-dispatch layouts past SCAN_STRIPE_UNITS; layout_info's
@@ -794,7 +840,11 @@ def run_multichip(args):
         "single_chip": single,
         "dense_exchange": dense,
         "sparse_exchange": sparse,
+        "sparse_async": sparse_async,
         "pallas_partitioned": pallas,
+        # Sync-sum vs async-step wall comparison (ISSUE 17); None when
+        # either leg lacks an attribution block.
+        "exchange_overlap": overlap,
         # Per-chip rate retained at ndev chips vs 1 chip — the honest
         # scale-out figure (1.0 = linear scaling).
         "scaling_efficiency": sparse["value"] / single["value"],
@@ -830,7 +880,7 @@ def run_multichip(args):
         num_iters=acc_iters, dtype="float32", accum_dtype="float32",
         num_devices=ndev, vertex_sharded=True, halo_exchange=True,
     )
-    eng = JaxTpuEngine(cfg_s).build(g_acc)
+    eng = JaxTpuEngine(cfg_s, pack_cache=pack_cache).build(g_acc)
     r_sparse = eng.run_fast()
     acc_cm = eng.comms_model()
     del eng
@@ -857,6 +907,46 @@ def run_multichip(args):
             if acc_sm is not None else None
         ),
     }
+    # Convergence-vs-staleness sweep (ISSUE 17): iterations-to-tol at
+    # boundary lag 0 (async plumbing, fresh reads — must match sync)
+    # vs lag 1 (the overlapped schedule) — what the one-iteration
+    # staleness COSTS in convergence, priced in iterations. Textbook
+    # semantics: the contraction guarantees a fixed point to converge
+    # TO (reference semantics legitimately diverges on graphs with
+    # zero-in-degree vertices, so "iterations to tol" is undefined
+    # there); tol 1e-6 sits above the f32 noise floor.
+    sweep_tol, sweep_cap = 1e-6, 400
+    sweep = {"tol": sweep_tol, "semantics": "textbook", "legs": {}}
+    for name, akw in (
+        ("sync", {}),
+        ("async_lag0", {"halo_async": True, "stale_max_lag": 0,
+                        "halo_async_min_gain": 0.0}),
+        ("async_lag1", {"halo_async": True, "stale_max_lag": 1,
+                        "halo_async_min_gain": 0.0}),
+    ):
+        cfg_w = PageRankConfig(
+            num_iters=sweep_cap, dtype="float32", accum_dtype="float32",
+            num_devices=ndev, vertex_sharded=True, halo_exchange=True,
+            semantics="textbook", **akw,
+        ).validate()
+        eng_w = JaxTpuEngine(cfg_w, pack_cache=pack_cache).build(g_acc)
+        eng_w.run_fused_tol(tol=sweep_tol, num_iters=sweep_cap)
+        sweep["legs"][name] = {
+            "iters_to_tol": int(eng_w.iteration),
+            "converged": bool(eng_w.iteration < sweep_cap),
+        }
+        del eng_w
+    print(
+        "multichip staleness sweep (textbook, tol "
+        f"{sweep_tol:g}): " + ", ".join(
+            f"{k}={v['iters_to_tol']}" for k, v in sweep["legs"].items()),
+        file=sys.stderr,
+    )
+    out["staleness_sweep"] = sweep
+    # The async leg carries its own iters-to-tol so the history
+    # normalizer (obs/history) can track it as a first-class leg metric.
+    sparse_async["iters_to_tol"] = \
+        sweep["legs"]["async_lag1"]["iters_to_tol"]
     out["edge_factor"] = args.edge_factor
     out["env"] = _env_fingerprint()
     _emit(out, args)

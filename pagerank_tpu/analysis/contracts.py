@@ -28,6 +28,11 @@ paths):
                             the budget reflects the SMALLER collectives
                             (one round per active offset, no dense
                             all_gather/reduce_scatter)
+  vs_halo_async           — asynchronous stale-boundary exchange
+                            (ISSUE 17): the same plan double-buffered
+                            through the step carry; budget pinned
+                            IDENTICAL to vs_halo (overlap reorders
+                            collectives, never adds one)
   vs_bounded (+ms)        — owner-computes, per-stripe z psums
 
 Rule ids: PTC001 collective budget, PTC002 f64 promotion, PTC003
@@ -351,6 +356,17 @@ def engine_forms(ndev: int) -> List[Form]:
         Form("vs_halo", lambda: Eng(cfg(
             vertex_sharded=True, halo_exchange=True, halo_head=128,
         )).build(g), True),
+        # Asynchronous stale-boundary exchange (ISSUE 17): the same
+        # plan double-buffered through the step carry. halo_head
+        # pinned (as above) so the head psum is in the budget;
+        # halo_async_min_gain=0 so the tiny graph's honest low
+        # predicted gain cannot downgrade the form out from under the
+        # sweep (the GATE has its own tests — here we must trace the
+        # async program itself).
+        Form("vs_halo_async", lambda: Eng(cfg(
+            vertex_sharded=True, halo_exchange=True, halo_head=128,
+            halo_async=True, halo_async_min_gain=0.0,
+        )).build(g), True),
         Form("vs_bounded", lambda: Eng(cfg(
             vertex_sharded=True, vs_bounded=True,
         )).build(g), True),
@@ -414,14 +430,18 @@ def expected_collectives(engine, form: str) -> Dict[str, int]:
     merge = {"reduce_scatter": 1} if use_rs else {"psum": 1}
     if form in ("vertex_sharded", "vs_multi_dispatch"):
         return {"all_gather": 1, **merge}
-    if form == "vs_halo":
+    if form in ("vs_halo", "vs_halo_async"):
         # The sparse boundary exchange (ISSUE 8): NO dense
         # all_gather/reduce_scatter — one ppermute per active
         # read/write round (static at build, from the halo plan this
         # exact engine carries) plus the head-replication psum. The
         # budget is read off the plan so a layout change that silently
         # reintroduces a dense collective (or doubles the rounds)
-        # fails here.
+        # fails here. The ASYNC form's budget is PINNED IDENTICAL
+        # (ISSUE 17): the stale-boundary overlap may only REORDER the
+        # collectives (ship-side vs read-side of the double buffer) —
+        # an extra or missing collective means the overlap changed the
+        # exchange itself, not just its schedule.
         plan = engine._halo_plan
         rounds = len(plan.read_rounds) + len(plan.write_rounds)
         out: Dict[str, int] = {}

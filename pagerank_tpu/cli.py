@@ -104,6 +104,37 @@ def build_parser() -> argparse.ArgumentParser:
              "(rounded up to a multiple of 128)",
     )
     p.add_argument(
+        "--halo-async", action="store_true",
+        help="with --halo-exchange: asynchronous stale-boundary "
+             "iteration (ISSUE 17) — double-buffer the boundary so "
+             "iteration k's segment-sum overlaps the exchange of "
+             "iteration k's boundary outputs (remote reads lag one "
+             "iteration; per-step cost drops from compute + comms "
+             "toward max(compute, comms)); auto-downgrades to the "
+             "synchronous exchange when the predicted overlap gain "
+             "(comms.predicted_overlap_gain) is below "
+             "--halo-async-min-gain or the mesh is single-device "
+             "(layout_info records the downgrade)",
+    )
+    p.add_argument(
+        "--stale-max-lag", type=int, default=1, choices=(0, 1),
+        help="staleness bound for --halo-async: 1 (default) runs the "
+             "double-buffered overlap with boundary reads one "
+             "iteration stale; 0 is the exact synchronous path (bit-"
+             "identical to the plain sparse exchange, zero extra "
+             "buffers) — the A/B lever the convergence-vs-staleness "
+             "bench sweep and the correctness tests pivot on",
+    )
+    p.add_argument(
+        "--halo-async-min-gain", type=float, default=0.02,
+        help="auto-gate threshold for --halo-async: the predicted "
+             "overlap gain (exchange fraction x overlappable byte "
+             "share) below which the build downgrades to the "
+             "synchronous exchange; 0 pins the gate open (useful on "
+             "toy graphs where the modeled exchange fraction is "
+             "negligible)",
+    )
+    p.add_argument(
         "--vs-bounded", action="store_true",
         help="with --vertex-sharded: bound per-chip STEP transients too "
              "(destination-partitioned slot rows + per-stripe z "
@@ -1583,6 +1614,9 @@ def _run(args, ctx, drain) -> int:
         vs_bounded=args.vs_bounded,
         halo_exchange=args.halo_exchange,
         halo_head=args.halo_head,
+        halo_async=args.halo_async,
+        stale_max_lag=args.stale_max_lag,
+        halo_async_min_gain=args.halo_async_min_gain,
         snapshot_dir=args.snapshot_dir,
         snapshot_every=args.snapshot_every,
         log_every=args.log_every,

@@ -143,6 +143,16 @@ class PageRankEngine(abc.ABC):
         were computed in (the dtype-tolerance axis of the ledger)."""
         return float(np.finfo(np.float64).eps)
 
+    def _stale_slack(self) -> float:
+        """Staleness bound (mass units) on the conservation identities
+        of the step just taken — 0.0 for every synchronous engine.
+        The asynchronous stale-boundary form (config.halo_async,
+        ISSUE 17) overrides with the PREVIOUS step's L1 delta: its
+        contribution total mixes fresh own-block mass with lag-1
+        boundary mass, so link/flow conservation hold only up to how
+        much the rank vector moved last iteration."""
+        return 0.0
+
     def _ledger_entry(self, info: Dict[str, float]):
         """Assemble one mass-ledger entry from a probed step's info
         (requires the ``ledger_*`` sums; obs/graph_profile.py owns the
@@ -159,6 +169,7 @@ class PageRankEngine(abc.ABC):
             dangling_mass=info["dangling_mass"],
             contrib_total=info["ledger_contrib_total"],
             retained_total=info["ledger_retained_total"],
+            flow_slack=self._stale_slack(),
         )
 
     def step_probed(self, probes):

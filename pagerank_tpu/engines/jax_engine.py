@@ -133,10 +133,21 @@ def _ledger_partials(contrib_l, r_l, zin_l, accum):
 class JaxTpuEngine(PageRankEngine):
     """Sharded power iteration over a 1-D device mesh."""
 
-    def __init__(self, config=None, devices=None):
+    def __init__(self, config=None, devices=None, pack_cache=None):
         super().__init__(config)
         self._devices = devices
         self._mesh = None
+        # Optional host-pack reuse across engine builds of the SAME
+        # graph (ISSUE 17 bench satellite): a caller-owned dict keyed
+        # on the RESOLVED packing plan (graph identity, packer form,
+        # span, lane group, block deal). Legs whose plans resolve
+        # identically (dense / sparse / async exchange differ only in
+        # the step program, never in the ELL layout) share one packed
+        # graph instead of re-sorting the edge list per leg; a plan
+        # mismatch (the pallas partitioned leg) is a clean miss. When
+        # set, the build leaves the pack's host arrays alive — the
+        # cache owns them and the caller frees by dropping the dict.
+        self._pack_cache = pack_cache
         self._pack: Optional[ell_lib.EllPack] = None
         self._perm: Optional[np.ndarray] = None  # relabeled -> original
         self._ms_stripe = None  # set by _setup_multi_dispatch
@@ -153,6 +164,15 @@ class JaxTpuEngine(PageRankEngine):
         self._comms_counter = None
         self._comms_bytes_per_iter = 0
         self._halo_plan = None
+        # Step-carried device state beyond the rank vector (ISSUE 17;
+        # config.halo_async): the async halo setup threads its two-slot
+        # boundary buffer here, at _device_args index 1, and every step
+        # form returns the refreshed carry right after the rank output.
+        # Empty on every synchronous form — the staleness-0 booby trap
+        # (tests/test_halo_async.py) asserts exactly that.
+        self._carry_args: tuple = ()
+        self._carry_prime = None  # () -> fresh carry tuple, or None
+        self._last_step_delta = 0.0  # see _begin_build / _stale_slack
         # Exchange-only sub-program for comms-vs-compute wall
         # attribution (ISSUE 10; obs/devices.attribute_exchange): the
         # vertex-sharded setups stash the un-jitted body here; it is
@@ -182,6 +202,17 @@ class JaxTpuEngine(PageRankEngine):
         self._exchange_core = None
         self._exchange_fn = None
         self._lowering_cache = None
+        # A rebuild into a synchronous form must not inherit the async
+        # boundary buffer (or its priming program): the carry rides the
+        # step signature, so a stale one would desynchronize
+        # _device_args from the compiled step.
+        self._carry_args = ()
+        self._carry_prime = None
+        # Previous stepwise iteration's L1 delta — the staleness bound
+        # _stale_slack feeds the SDC/ledger conservation checks under
+        # the async form. 0.0 after any (re)build or state replacement:
+        # the freshly primed buffer makes the next step lag-0 exact.
+        self._last_step_delta = 0.0
         # Rank-mass-ledger step variants (ISSUE 13): every setup path
         # that supports the ledger reassigns these; a rebuild into a
         # form that doesn't must not inherit the previous layout's.
@@ -452,6 +483,23 @@ class JaxTpuEngine(PageRankEngine):
                 self._pallas_fallback(e)
                 return self._build_impl(graph)
 
+    def _cached_pack(self, key, make):
+        """Resolve one host ELL pack through the caller-owned
+        ``pack_cache`` (see ``__init__``); pack fresh when no cache is
+        wired or the resolved-plan key misses."""
+        if self._pack_cache is None:
+            return make()
+        pack = self._pack_cache.get(key)
+        if pack is None:
+            pack = make()
+            self._pack_cache[key] = pack
+        else:
+            obs_log.info(
+                f"reusing cached ELL pack for resolved plan "
+                f"{key[0]}(span/group/deal={key[2:]})"
+            )
+        return pack
+
     def _build_impl(self, graph: Graph) -> "JaxTpuEngine":
         cfg = self.config
         self.graph = graph
@@ -495,9 +543,13 @@ class JaxTpuEngine(PageRankEngine):
                     psz,
                 )
             )
-            pack = ell_lib.ell_pack_striped(
-                graph, stripe_size=min(psz, max(128, n_padded)),
-                group=group,
+            pack = self._cached_pack(
+                ("striped", id(graph), min(psz, max(128, n_padded)),
+                 group, 0),
+                lambda: ell_lib.ell_pack_striped(
+                    graph, stripe_size=min(psz, max(128, n_padded)),
+                    group=group,
+                ),
             )
             self._pack = pack
             self._perm = pack.perm
@@ -519,7 +571,8 @@ class JaxTpuEngine(PageRankEngine):
                 inv_out_rel=inv_out_rel, group=group,
                 partition_span=min(psz, max(128, n_padded)),
             )
-            pack.src, pack.weight, pack.row_block = [], [], []
+            if self._pack_cache is None:
+                pack.src, pack.weight, pack.row_block = [], [], []
             return self
 
         if kernel in ("ell", "pallas"):
@@ -561,13 +614,21 @@ class JaxTpuEngine(PageRankEngine):
                         f"for stripe span {span}"
                     )
                     group = grp
-                pack = ell_lib.ell_pack_striped(
-                    graph, stripe_size=span, group=group, block_deal=deal,
+                pack = self._cached_pack(
+                    ("striped", id(graph), span, group, deal),
+                    lambda: ell_lib.ell_pack_striped(
+                        graph, stripe_size=span, group=group,
+                        block_deal=deal,
+                    ),
                 )
                 srcs, weights, rbs = pack.src, pack.weight, pack.row_block
                 stripe_size = pack.stripe_size
             else:
-                pack = ell_lib.ell_pack(graph, group=group, block_deal=deal)
+                pack = self._cached_pack(
+                    ("flat", id(graph), group, deal),
+                    lambda: ell_lib.ell_pack(graph, group=group,
+                                             block_deal=deal),
+                )
                 srcs, weights, rbs = [pack.src], [pack.weight], [pack.row_block]
                 stripe_size = None
             self._pack = pack
@@ -596,10 +657,11 @@ class JaxTpuEngine(PageRankEngine):
                 "padding_ratio": pack.padding_ratio,
                 "n_stripes": getattr(pack, "n_stripes", 1),
             }
-            if isinstance(pack, ell_lib.StripedEllPack):
-                pack.src, pack.weight, pack.row_block = [], [], []
-            else:
-                pack.src = pack.weight = pack.row_block = None
+            if self._pack_cache is None:
+                if isinstance(pack, ell_lib.StripedEllPack):
+                    pack.src, pack.weight, pack.row_block = [], [], []
+                else:
+                    pack.src = pack.weight = pack.row_block = None
             return self
         else:
             self._pack = None
@@ -2178,12 +2240,19 @@ class JaxTpuEngine(PageRankEngine):
                 f"({halo_note})"
             )
             self._layout = dict(self._layout, halo=f"off:{halo_note}")
+            if cfg.halo_async:
+                # The async overlap rides the sparse exchange; when
+                # that downgrades, the overlap goes with it — recorded
+                # so layout_info explains BOTH refusals.
+                self._layout = dict(self._layout,
+                                    halo_async=f"off:{halo_note}")
         if halo:
             self._setup_vs_halo(
                 n_stripes=n_stripes, sz=sz, group=group, pair=pair,
                 accum=accum, ids=ids, n_vs=n_vs, padv=padv, blk=blk,
                 total_z=total_z, use_rs=use_rs,
                 accumulate_stripes=accumulate_stripes, vs_tail=vs_tail,
+                want_async=bool(cfg.halo_async),
             )
             return
         from pagerank_tpu.parallel import comms as comms_lib
@@ -2288,7 +2357,7 @@ class JaxTpuEngine(PageRankEngine):
 
     def _setup_vs_halo(self, *, n_stripes, sz, group, pair, accum, ids,
                        n_vs, padv, blk, total_z, use_rs,
-                       accumulate_stripes, vs_tail):
+                       accumulate_stripes, vs_tail, want_async=False):
         """Sparse boundary exchange for the vertex-sharded step
         (ISSUE 8; config.halo_exchange; Zhao & Canny, arXiv:1312.3020).
 
@@ -2333,7 +2402,21 @@ class JaxTpuEngine(PageRankEngine):
         Latency caveat: the rounds serialize up to 2*(ndev-1) small
         collectives where the dense path issues 2 large ones — a
         bandwidth/latency trade that pays exactly when the boundary is
-        sparse (docs/PERF_NOTES.md "Sparse boundary exchange")."""
+        sparse (docs/PERF_NOTES.md "Sparse boundary exchange").
+
+        ``want_async`` (ISSUE 17; config.halo_async) additionally asks
+        for the ASYNCHRONOUS stale-boundary form (_setup built here
+        under form "vs_halo_async"): a two-slot boundary buffer rides
+        the step carry so iteration k's local segment-sum consumes
+        iteration k-1's boundary while iteration k's ships — boundary
+        reads lag one iteration, own blocks stay fresh, and the head +
+        read-round collectives leave the critical path. Auto-gated
+        right here, where the plan's byte split exists: refused
+        (logged; layout_info carries halo_async="off:<reason>") on
+        single-device meshes, boundary-free plans, a predicted overlap
+        gain below config.halo_async_min_gain, or stale_max_lag=0 (the
+        exactness demand — the synchronous body below IS the lag-0
+        form, zero extra buffers)."""
         cfg = self.config
         mesh = self._mesh
         axis = cfg.mesh_axis
@@ -2358,7 +2441,48 @@ class JaxTpuEngine(PageRankEngine):
                 accum_item=jnp.dtype(accum).itemsize, rs_merge=use_rs,
             )
         self._halo_plan = plan
-        self._set_comms_model(comms_lib.model_sparse(plan))
+
+        # Async auto-gate (ISSUE 17): decided HERE, where the plan's
+        # byte split exists — mirroring the pallas probe-downgrade
+        # idiom (logged, recorded, solve stays correct either way).
+        # The predicted payoff is published even on refusal, so `obs
+        # report` always shows the gate's evidence.
+        use_async = False
+        if want_async:
+            gain = comms_lib.predict_overlap_gain(plan)
+            comms_lib.publish_overlap_gain(gain)
+            async_note = None
+            if cfg.stale_max_lag == 0:
+                # Exactness demanded: the synchronous body IS the
+                # lag-0 form (bit-identical, zero extra buffers) — an
+                # expected path, not a payoff refusal.
+                async_note = "stale_max_lag=0"
+                obs_log.info(
+                    "halo_async with stale_max_lag=0: running the "
+                    "synchronous sparse exchange (exact, unbuffered)"
+                )
+            elif ndev < 2:
+                async_note = "single_device"
+            elif not plan.overlappable_bytes_per_iter():
+                async_note = "no_boundary"
+            elif gain < cfg.halo_async_min_gain:
+                async_note = (f"gain {gain:.4f} < "
+                              f"{cfg.halo_async_min_gain:g}")
+            if async_note and async_note != "stale_max_lag=0":
+                obs_log.warn(
+                    f"halo_async downgraded to the synchronous sparse "
+                    f"exchange ({async_note})"
+                )
+            if async_note:
+                self._layout = dict(self._layout,
+                                    halo_async=f"off:{async_note}")
+            else:
+                use_async = True
+
+        self._set_comms_model(
+            comms_lib.model_async(plan) if use_async
+            else comms_lib.model_sparse(plan)
+        )
         RR, WR = plan.read_rounds, plan.write_rounds
         nread = len(RR)
         K = plan.head_k
@@ -2438,6 +2562,17 @@ class JaxTpuEngine(PageRankEngine):
                 ].add(recv)
             return own + buf[:blk]
 
+        if use_async:
+            self._setup_vs_halo_async(
+                plan=plan, RR=RR, WR=WR, K=K, halo_args=halo_args,
+                halo_specs=halo_specs, n_halo=n_halo, ids=ids, zd=zd,
+                accum=accum, pair=pair, blk=blk, n_vs=n_vs, padv=padv,
+                total_z=total_z, n_stripes=n_stripes,
+                accumulate_stripes=accumulate_stripes, vs_tail=vs_tail,
+                merge_sparse=merge_sparse,
+            )
+            return
+
         def vs_body(r_l, inv_l, dang_l, zin_l, valid_l, *rest):
             halo, stripes = rest[:n_halo], rest[n_halo:]
             zs = gather_z_sparse(r_l, inv_l, halo)
@@ -2500,6 +2635,229 @@ class JaxTpuEngine(PageRankEngine):
             f"{plan.sparse_bytes_per_iter():,} vs dense "
             f"{plan.dense_bytes_per_iter():,} B/chip/iter"
         )
+
+    def _setup_vs_halo_async(self, *, plan, RR, WR, K, halo_args,
+                             halo_specs, n_halo, ids, zd, accum, pair,
+                             blk, n_vs, padv, total_z, n_stripes,
+                             accumulate_stripes, vs_tail, merge_sparse):
+        """Asynchronous stale-boundary halo step (ISSUE 17;
+        config.halo_async; Kollias et al., arXiv:cs/0606047; overlap
+        per arXiv:2009.10443): the PR 8 plan's exchange, double-
+        buffered so it leaves the critical path.
+
+        A per-device boundary buffer of width ``W = K + sum(read
+        widths)`` — the head-replica plane followed by each read
+        round's ppermute landing zone — rides the step carry
+        (``_device_args`` index 1, donated like the rank buffer).
+        Iteration k:
+
+          1. ships THIS iteration's boundary: the SAME head psum and
+             read-round ppermutes as the synchronous gather, landing
+             in the buffer returned as the next carry (``buf_new``) —
+             nothing waits on them;
+          2. builds the sparse z image from the STALE buffer
+             (iteration k-1's boundary): stale head at [0, K), stale
+             landings scatter-added at their global ids, then the OWN
+             block written LAST — a device's own partition is always
+             fresh, only remote boundary reads lag one iteration;
+          3. per-stripe gathers + the write-band contribution merge
+             run unchanged (merge stays synchronous: windows are
+             consumed by the same iteration's rank update).
+
+        XLA sees the shipped collectives feeding only the carry output
+        while the segment-sum consumes the stale buffer — no data
+        dependence between them, so the scheduler is free to overlap
+        wire and compute and the per-step cost drops from compute +
+        comms toward max(compute, comms). The collective MULTISET is
+        identical to vs_halo's (overlap reorders, never adds —
+        contract PTC001 pins it).
+
+        Staleness bookkeeping: the buffer is PRIMED from the current
+        rank vector at build end and after every state replacement
+        (set_ranks — which snapshot resume, elastic rescue and the SDC
+        redo all route through), so the first step after any (re)start
+        is exactly the synchronous step and the lag never exceeds
+        config.stale_max_lag = 1. Convergence under bounded staleness
+        is classical (async iterations contract under the same
+        spectral radius); the measured cost is a few extra iterations
+        to tol, bounded by the bench staleness sweep and the probe
+        residuals."""
+        cfg = self.config
+        mesh = self._mesh
+        axis = cfg.mesh_axis
+        ndev = mesh.devices.size
+        nread = len(RR)
+        W = K + sum(r.width for r in RR)
+        assert W > 0, "gate admits only plans with a boundary"
+        shard2d = jax.sharding.NamedSharding(mesh, P(axis, None))
+
+        def ship_boundary(z_le, halo):
+            """This iteration's boundary onto the wire: head psum +
+            one ppermute per read round — the synchronous gather's
+            exact collectives — concatenated into the [1, W] buffer
+            slot the NEXT iteration consumes."""
+            me = jax.lax.axis_index(axis)
+            parts = []
+            if K:
+                idx = jnp.arange(K, dtype=jnp.int32)
+                pos = idx - me * blk
+                vals = z_le[jnp.clip(pos, 0, blk)]
+                mask = (pos >= 0) & (pos < blk)
+                parts.append(jax.lax.psum(
+                    jnp.where(mask, vals, jnp.zeros((), zd)), axis
+                ))
+            for i, rnd in enumerate(RR):
+                si = halo[2 * i][0]  # [width] owner-local send indices
+                parts.append(
+                    jax.lax.ppermute(z_le[si], axis, perm=rnd.perm)
+                )
+            return jnp.concatenate(parts)[None, :]
+
+        def stale_z_image(z_l, buf_l, halo):
+            """The sparse z image from LAST iteration's boundary
+            buffer. Same landing geometry as the synchronous gather
+            (head window, then unique scatter landings); the own block
+            goes in LAST so it is always this iteration's fresh z —
+            head/landing ids never alias another device's block, so
+            the one overwrite the orders differ on ([0, K) cap own
+            block) resolves to the fresh owner copy, exactly like the
+            sync path's psum-overwrite no-op."""
+            me = jax.lax.axis_index(axis)
+            b = buf_l[0]
+            zf = jnp.zeros(n_vs + 1, zd)
+            if K:
+                zf = jax.lax.dynamic_update_slice(zf, b[:K], (0,))
+            off = K
+            for i, rnd in enumerate(RR):
+                gi = halo[2 * i + 1][0]  # [width] global landing ids
+                zf = zf.at[gi].add(b[off:off + rnd.width])
+                off += rnd.width
+            zf = jax.lax.dynamic_update_slice(zf, z_l, (me * blk,))
+            z = zf[:n_vs]
+            if total_z > n_vs:
+                z = jnp.concatenate(
+                    [z, jnp.zeros(total_z - n_vs, zd)]
+                )
+            return _split_pair(z) if pair else (z,)
+
+        def vs_body_async(r_l, buf_l, inv_l, dang_l, zin_l, valid_l,
+                          *rest):
+            halo, stripes = rest[:n_halo], rest[n_halo:]
+            z_l = r_l.astype(zd) * inv_l
+            z_le = jnp.concatenate([z_l, jnp.zeros(1, zd)])
+            buf_new = ship_boundary(z_le, halo)
+            zs = stale_z_image(z_l, buf_l, halo)
+            total = accumulate_stripes(zs, stripes)
+            contrib_l = merge_sparse(total, halo)
+            out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+            return (out[0], buf_new, out[1], out[2])
+
+        def vs_body_async_ledger(r_l, buf_l, inv_l, dang_l, zin_l,
+                                 valid_l, *rest):
+            halo, stripes = rest[:n_halo], rest[n_halo:]
+            z_l = r_l.astype(zd) * inv_l
+            z_le = jnp.concatenate([z_l, jnp.zeros(1, zd)])
+            buf_new = ship_boundary(z_le, halo)
+            zs = stale_z_image(z_l, buf_l, halo)
+            total = accumulate_stripes(zs, stripes)
+            contrib_l = merge_sparse(total, halo)
+            out = vs_tail(contrib_l, r_l, dang_l, zin_l, valid_l)
+            return (out[0], buf_new, out[1], out[2],
+                    *_ledger_partials(contrib_l, r_l, zin_l, accum))
+
+        async_in_specs = (P(axis), P(axis, None)) + (P(axis),) * 4 \
+            + tuple(halo_specs) \
+            + (P(axis, None), P(axis), P()) * n_stripes
+        step_core = shard_map(
+            vs_body_async,
+            mesh=mesh,
+            in_specs=async_in_specs,
+            out_specs=(P(axis), P(axis, None), P(), P()),
+        )
+        self._step_core_ledger = shard_map(
+            vs_body_async_ledger,
+            mesh=mesh,
+            in_specs=async_in_specs,
+            out_specs=(P(axis), P(axis, None), P(), P())
+            + (P(axis),) * 3,
+        )
+
+        self._contrib_args = tuple(halo_args) + tuple(
+            a for triple in zip(self._src, self._row_block, ids)
+            for a in triple
+        )
+        self._inv_in_args = True
+        self._step_core = step_core
+        # A zero pre-prime buffer so _device_args is well-formed while
+        # the step jits; the REAL boundary is primed below, before any
+        # caller can step.
+        self._carry_args = (jax.device_put(
+            np.zeros((ndev, W), zd), shard2d
+        ),)
+        self._step_fn = self._jit_step(step_core)
+
+        def exchange_body(r_l, buf_l, inv_l, dang_l, zin_l, valid_l,
+                          *rest):
+            halo = rest[:n_halo]
+            z_l = r_l.astype(zd) * inv_l
+            z_le = jnp.concatenate([z_l, jnp.zeros(1, zd)])
+            buf_new = ship_boundary(z_le, halo)
+            zs = stale_z_image(z_l, buf_l, halo)
+            # Dependency seed from BOTH exchange halves (ship + stale
+            # read) so neither DCEs out of the timing program.
+            flat = jnp.zeros(n_vs - padv, accum).at[0].add(
+                zs[0][0].astype(accum) + buf_new[0, 0].astype(accum)
+            )
+            contrib_l = merge_sparse(flat, halo)
+            return contrib_l[:1]
+
+        self._exchange_core = shard_map(
+            exchange_body, mesh=mesh, in_specs=async_in_specs,
+            out_specs=P(axis), check_vma=False,
+        )
+
+        read_args = tuple(halo_args[:2 * nread])
+        read_specs = tuple(halo_specs[:2 * nread])
+
+        def prime_body(r_l, inv_l, *halo):
+            z_l = r_l.astype(zd) * inv_l
+            z_le = jnp.concatenate([z_l, jnp.zeros(1, zd)])
+            return ship_boundary(z_le, halo)
+
+        prime_core = shard_map(
+            prime_body, mesh=mesh,
+            in_specs=(P(axis), P(axis)) + read_specs,
+            out_specs=P(axis, None), check_vma=False,
+        )
+
+        def prime_carry():
+            fn = self._fused_cache.get("carry_prime")
+            if fn is None:
+                with obs_trace.span("engine/compile",
+                                    form="halo_prime"):
+                    fn = jax.jit(prime_core)
+                self._fused_cache["carry_prime"] = fn
+            return (fn(self._r, self._inv_out, *read_args),)
+
+        self._carry_prime = prime_carry
+        self._fused_cache = {}
+        self.last_run_metrics = {
+            "l1_delta": np.zeros(0, self._accum_dtype),
+            "dangling_mass": np.zeros(0, self._accum_dtype),
+        }
+        self._layout = dict(
+            self._layout, form="vs_halo_async", halo=plan.summary(),
+            halo_async=f"on:lag{int(cfg.stale_max_lag)}",
+            halo_buffer_width=int(W),
+        )
+        obs_log.info(
+            f"async stale-boundary exchange: head K={K}, {nread} read "
+            f"+ {len(WR)} write round(s), buffer {W} x "
+            f"{jnp.dtype(zd).itemsize} B/device, overlappable "
+            f"{plan.overlappable_bytes_per_iter():,} of "
+            f"{plan.sparse_bytes_per_iter():,} B/chip/iter"
+        )
+        self._prime_carry()
 
     def _setup_multi_dispatch_vs(self, *, n_stripes, sz, gw, group, pair,
                                  accum, num_blocks, chunks, num_present,
@@ -3079,8 +3437,13 @@ class JaxTpuEngine(PageRankEngine):
         PTC003 (extended to the vertex-sharded forms)."""
         from pagerank_tpu.utils.compile_cache import usable_donations
 
-        donate = usable_donations(step_core, self._device_args(), (0,))
-        if donate != (0,):
+        # The rank buffer donates always; step-carried state (the async
+        # boundary buffer) donates right behind it — each slot's output
+        # aval matches its input, so the pre-filter keeps them all on
+        # every supported backend.
+        want = tuple(range(1 + len(self._carry_args)))
+        donate = usable_donations(step_core, self._device_args(), want)
+        if donate != want:
             obs_log.warn(
                 "rank-buffer donation is not consumable for this step "
                 "form; lowering without it"
@@ -3194,6 +3557,7 @@ class JaxTpuEngine(PageRankEngine):
         if iters < 1:
             raise ValueError(f"iters must be >= 1, got {iters}")
         r0, it0 = jnp.copy(self._r), self.iteration
+        c0 = tuple(jnp.copy(c) for c in self._carry_args)
         try:
             out = None
             for _ in range(max(0, warmup)):
@@ -3216,6 +3580,8 @@ class JaxTpuEngine(PageRankEngine):
             step_s = (time.perf_counter() - t0) / iters
         finally:
             self._r = r0
+            if c0:
+                self._carry_args = c0
             self.iteration = it0
         return exchange_s, step_s
 
@@ -3240,13 +3606,27 @@ class JaxTpuEngine(PageRankEngine):
             )
             self._note_comms(1)
             return delta, m
-        self._r, delta, m = self._step_fn(*self._device_args())
+        delta, m = self._adopt_step_out(
+            self._step_fn(*self._device_args())
+        )
         self._note_comms(1)
         return delta, m
 
     def step(self) -> Dict[str, float]:
         delta, m = self._device_step()
-        return {"l1_delta": float(delta), "dangling_mass": float(m)}
+        self._last_step_delta = float(delta)
+        return {"l1_delta": self._last_step_delta,
+                "dangling_mass": float(m)}
+
+    def _stale_slack(self) -> float:
+        """Previous stepwise iteration's L1 delta when the async
+        stale-boundary form is live (base-class docstring has the
+        bound); 0.0 on every synchronous form AND right after a
+        prime (build / set_ranks / restore), where the next step is
+        lag-0 exact."""
+        if str(self._layout.get("halo_async", "")).startswith("on:"):
+            return self._last_step_delta
+        return 0.0
 
     # -- convergence probes (obs/probes.py; ISSUE 5) -----------------------
 
@@ -3309,18 +3689,21 @@ class JaxTpuEngine(PageRankEngine):
         if fn is None:
             core = self._step_core_ledger if ledger else self._step_core
             tail = self._probe_tail(k)
+            nc = len(self._carry_args)
             # valid's position in the device-args tail (see
-            # _device_args: prescaled forms carry inv at index 1).
-            vi = 4 if self._inv_in_args else 3
+            # _device_args: prescaled forms carry inv right after the
+            # rank vector and any step-carried state).
+            vi = (4 if self._inv_in_args else 3) + nc
 
             def probed(*args):
                 prev_ids = args[-1]
                 core_args = args[:-1]
-                r2, delta, m, *led = core(*core_args)
+                r2, *rest = core(*core_args)
+                carry, (delta, m, *led) = rest[:nc], rest[nc:]
                 mass, ids, entered, topk_mass = tail(
                     r2, core_args[vi], prev_ids)
-                return (r2, delta, m, mass, ids, entered, topk_mass,
-                        *led)
+                return (r2, *carry, delta, m, mass, ids, entered,
+                        topk_mass, *led)
 
             from pagerank_tpu.utils.compile_cache import usable_donations
 
@@ -3328,7 +3711,7 @@ class JaxTpuEngine(PageRankEngine):
                 probed,
                 (*self._device_args(),
                  jax.ShapeDtypeStruct((k,), jnp.int32)),
-                (0,),
+                tuple(range(1 + nc)),
             )
             fn = jax.jit(probed, donate_argnums=donate)
             self._fused_cache[key] = fn
@@ -3402,14 +3785,15 @@ class JaxTpuEngine(PageRankEngine):
             )
         elif self._step_core_ledger is not None:
             fn = self._get_probed_step(k, ledger=True)
-            (self._r, delta, m, mass, ids, entered, topk_mass,
-             *led) = fn(*self._device_args(), prev_dev)
+            (delta, m, mass, ids, entered, topk_mass,
+             *led) = self._adopt_step_out(
+                fn(*self._device_args(), prev_dev))
             self._note_comms(1)
         else:
             fn = self._get_probed_step(k)
-            self._r, delta, m, mass, ids, entered, topk_mass = fn(
-                *self._device_args(), prev_dev
-            )
+            (delta, m, mass, ids, entered,
+             topk_mass) = self._adopt_step_out(
+                fn(*self._device_args(), prev_dev))
             self._note_comms(1)
         fetch = [delta, m, mass, entered, ids, topk_mass]
         if led:
@@ -3430,7 +3814,11 @@ class JaxTpuEngine(PageRankEngine):
             info["ledger_contrib_total"] = float(np.asarray(lk_h).sum())
             info["ledger_retained_total"] = float(np.asarray(rt_h).sum())
             info["ledger_mass_prev"] = float(np.asarray(pv_h).sum())
+            # Ledger first, delta update second: the flow-conservation
+            # slack must be the PREVIOUS step's delta (the staleness
+            # bound), not this one's.
             info["mass_ledger"] = self._ledger_entry(info)
+        self._last_step_delta = info["l1_delta"]
         ids_np = np.asarray(ids_np)
         ids_orig = self._perm[ids_np] if self._perm is not None else ids_np
         return info, (ids, np.asarray(ids_orig))
@@ -3449,16 +3837,38 @@ class JaxTpuEngine(PageRankEngine):
     def retain_state(self, iteration: Optional[int] = None):
         """Device-side double buffer for the SDC redo (and any caller
         that must rewind without a snapshot round-trip): an opaque
-        ``(iteration, rank copy)`` token. The copy stays on device —
-        no host transfer, no decode."""
+        ``(iteration, rank copy, carry copies, last delta)`` token.
+        The copies stay on device — no host transfer, no decode. The
+        carried state (the async boundary buffer) and the previous
+        step's L1 delta (the staleness slack the conservation checks
+        run under) are part of the token so a redo replays the SAME
+        staleness bits AND judges them by the same tolerance —
+        bit-determinism of the redo is what makes the SDC verdict
+        meaningful."""
         it = self.iteration if iteration is None else int(iteration)
-        return (it, jnp.copy(self._r))
+        return (it, jnp.copy(self._r),
+                tuple(jnp.copy(c) for c in self._carry_args),
+                float(self._last_step_delta))
 
     def restore_state(self, token) -> None:
         """Rewind to a :meth:`retain_state` token (the token itself
-        stays reusable — a second redo restores the same bits)."""
-        it, r = token
+        stays reusable — a second redo restores the same bits). Legacy
+        two-field tokens restore the rank vector and re-prime the
+        carry from it (lag-0, still correct — just not bit-identical
+        to the pre-token staleness)."""
+        it, r, *rest = token
         self._r = jnp.copy(r)
+        carry = rest[0] if rest else ()
+        if carry:
+            self._carry_args = tuple(jnp.copy(c) for c in carry)
+            self._last_step_delta = (float(rest[1]) if len(rest) > 1
+                                     else 0.0)
+        else:
+            if self._carry_args:
+                self._prime_carry()
+            # Primed (or synchronous) state: the next step is lag-0
+            # exact, so the conservation checks need no slack.
+            self._last_step_delta = 0.0
         self.iteration = int(it)
 
     def _sdc_w(self):
@@ -3565,17 +3975,23 @@ class JaxTpuEngine(PageRankEngine):
                 check_vma=False,
             )
 
+            nc = len(self._carry_args)
+
             def sdc_core(w, *args):
                 r = args[0]
-                r2, delta, m, ck, rt, pv = core(*args)
-                extra = (args[1],) if has_inv else ()
+                r2, *rest = core(*args)
+                carry, (delta, m, ck, rt, pv) = rest[:nc], rest[nc:]
+                # inv sits right behind the rank vector and any
+                # step-carried state (see _device_args).
+                extra = (args[1 + nc],) if has_inv else ()
                 checks = check(w, r, r2, *extra)
-                return (r2, delta, m, ck, rt, pv, *checks)
+                return (r2, *carry, delta, m, ck, rt, pv, *checks)
 
             from pagerank_tpu.utils.compile_cache import usable_donations
 
             donate = usable_donations(
-                sdc_core, (self._sdc_w(), *self._device_args()), (1,)
+                sdc_core, (self._sdc_w(), *self._device_args()),
+                tuple(range(1, 2 + nc)),
             )
             with obs_trace.span("engine/compile", form="sdc_step"):
                 fn = jax.jit(sdc_core, donate_argnums=donate)
@@ -3621,8 +4037,9 @@ class JaxTpuEngine(PageRankEngine):
                 (delta, m, lk, rt, pv, fin, min_, sin, fout, mout))
         else:
             fn = self._get_sdc_step()
-            (self._r, delta, m, lk, rt, pv, fin, min_, sin, fout,
-             mout) = fn(self._sdc_w(), *self._device_args())
+            (delta, m, lk, rt, pv, fin, min_, sin, fout,
+             mout) = self._adopt_step_out(
+                fn(self._sdc_w(), *self._device_args()))
             self._note_comms(1)
             host = jax.device_get(
                 (delta, m, lk, rt, pv, fin, min_, sin, fout, mout))
@@ -3640,6 +4057,10 @@ class JaxTpuEngine(PageRankEngine):
             "retained": np.asarray(rt_h),
             "mass_prev": np.asarray(pv_h),
             "dangling_mass": float(m_h),
+            # Stamped per attempt so the guard judges a redo by the
+            # slack its OWN input state warrants (delta before this
+            # step), not by whatever step ran since.
+            "stale_slack": self._stale_slack(),
         }
         info = {
             "l1_delta": float(d_h),
@@ -3647,6 +4068,7 @@ class JaxTpuEngine(PageRankEngine):
             "rank_mass": float(mout_np.astype(float).sum() if sharded
                                else np.median(mout_np)),
         }
+        self._last_step_delta = info["l1_delta"]
         return info, chk
 
     # -- cost accounting (obs/costs.py; ISSUE 5) ---------------------------
@@ -3826,7 +4248,9 @@ class JaxTpuEngine(PageRankEngine):
         if self._ms_stripe is not None:
             return self.run_fused_chunked(num_iters=total, every=0)
         fused = self._get_fused(k)
-        self._r, (deltas, masses) = fused(*self._device_args())
+        out = fused(*self._device_args())
+        deltas, masses = out[-1]
+        self._adopt_step_out(out[:-1])
         self.iteration = total
         self._note_comms(k)
         self.last_run_metrics = {"l1_delta": deltas, "dangling_mass": masses}
@@ -3869,7 +4293,9 @@ class JaxTpuEngine(PageRankEngine):
         if self._ms_stripe is not None:
             return self.run_fused_chunked(num_iters=total, every=1, tol=tol)
         fused = self._get_fused_tol(k, float(tol))
-        self._r, i_done, delta, mass = fused(*self._device_args())
+        i_done, delta, mass = self._adopt_step_out(
+            fused(*self._device_args())
+        )
         done = int(jax.device_get(i_done))
         self.iteration += done
         self._note_comms(done)
@@ -3938,7 +4364,9 @@ class JaxTpuEngine(PageRankEngine):
                 self.iteration += k  # _device_step does not count
             else:
                 fused = self._get_fused(k)
-                self._r, (deltas, masses) = fused(*self._device_args())
+                out = fused(*self._device_args())
+                deltas, masses = out[-1]
+                self._adopt_step_out(out[:-1])
                 self.iteration += k
                 self._note_comms(k)
             ds.append(deltas)
@@ -4013,24 +4441,29 @@ class JaxTpuEngine(PageRankEngine):
         if fused is None:
             core = self._step_core
             acc = self._accum_dtype
+            nc = len(self._carry_args)
 
             def fused_fn(r, *rest):
+                cs, tail = rest[:nc], rest[nc:]
+
                 def cond(carry):
-                    _, i, delta, _ = carry
+                    i, delta = carry[1 + nc], carry[2 + nc]
                     return jnp.logical_and(i < k, delta > tol)
 
                 def body(carry):
-                    rr, i, _, _ = carry
-                    r2, delta, m = core(rr, *rest)
-                    return r2, i + 1, delta, m
+                    r2, *out = core(carry[0], *carry[1:1 + nc], *tail)
+                    return (r2, *out[:nc], carry[1 + nc] + 1,
+                            out[nc], out[nc + 1])
 
-                init = (r, jnp.int32(0), jnp.array(jnp.inf, acc),
+                init = (r, *cs, jnp.int32(0), jnp.array(jnp.inf, acc),
                         jnp.zeros((), acc))
                 return jax.lax.while_loop(cond, body, init)
 
             with obs_trace.span("engine/compile", form="fused_tol",
                                 iters=k):
-                fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
+                fused = jax.jit(
+                    fused_fn, donate_argnums=tuple(range(1 + nc))
+                ).lower(
                     *self._device_args()
                 ).compile()
             # iters=k is the BUDGET (the while_loop may stop early):
@@ -4051,17 +4484,23 @@ class JaxTpuEngine(PageRankEngine):
         fused = self._fused_cache.get(k)
         if fused is None:
             core = self._step_core
+            nc = len(self._carry_args)
 
             def fused_fn(r, *rest):
-                def body(rr, _):
-                    r2, delta, m = core(rr, *rest)
-                    return r2, (delta, m)
+                cs, tail = rest[:nc], rest[nc:]
 
-                return jax.lax.scan(body, r, None, length=k)
+                def body(carry, _):
+                    r2, *out = core(carry[0], *carry[1:], *tail)
+                    return (r2, *out[:nc]), (out[nc], out[nc + 1])
+
+                fin, ys = jax.lax.scan(body, (r, *cs), None, length=k)
+                return (*fin, ys)
 
             with obs_trace.span("engine/compile", form="fused_scan",
                                 iters=k):
-                fused = jax.jit(fused_fn, donate_argnums=(0,)).lower(
+                fused = jax.jit(
+                    fused_fn, donate_argnums=tuple(range(1 + nc))
+                ).lower(
                     *self._device_args()
                 ).compile()
             # Cost ledger entry per compile; per-iteration fields
@@ -4081,12 +4520,38 @@ class JaxTpuEngine(PageRankEngine):
         """The step/fused argument tuple — ONE spelling so the
         AOT-lowered signature and the dispatch call cannot drift. The
         prescaled (ell/pallas) paths carry the 1/out-degree vector as a
-        runtime argument (never an embedded constant)."""
+        runtime argument (never an embedded constant); step-carried
+        state (the async boundary buffer, ISSUE 17) rides at index 1,
+        right behind the rank vector it is donated with."""
         if self._inv_in_args:
-            return (self._r, self._inv_out, self._dangling, self._zero_in,
-                    self._valid, *self._contrib_args)
-        return (self._r, self._dangling, self._zero_in, self._valid,
-                *self._contrib_args)
+            return (self._r, *self._carry_args, self._inv_out,
+                    self._dangling, self._zero_in, self._valid,
+                    *self._contrib_args)
+        return (self._r, *self._carry_args, self._dangling,
+                self._zero_in, self._valid, *self._contrib_args)
+
+    def _adopt_step_out(self, out):
+        """Split one step program's output tuple: the rank vector and
+        the carried state are adopted in place, the rest (delta, mass,
+        probe/ledger/check tails) returns to the caller. Every step
+        form returns ``(r2, *carry, ...)`` — ONE adoption spelling so
+        a form that forgets to thread the carry fails loudly in the
+        tests rather than silently running ever-staler boundaries."""
+        nc = len(self._carry_args)
+        self._r = out[0]
+        if nc:
+            self._carry_args = tuple(out[1:1 + nc])
+        return out[1 + nc:]
+
+    def _prime_carry(self) -> None:
+        """(Re)compute the carried state from the CURRENT rank vector
+        — a no-op on synchronous forms. Called at build end and after
+        every state replacement (set_ranks: snapshot resume, elastic
+        rescue, SDC redo via restore_state), so the first step after
+        any (re)start reads a lag-0 boundary and staleness never
+        exceeds one iteration."""
+        if self._carry_prime is not None:
+            self._carry_args = self._carry_prime()
 
     def fence(self) -> None:
         """Block until all queued steps actually finished on device."""
@@ -4130,6 +4595,12 @@ class JaxTpuEngine(PageRankEngine):
             rr[: self.graph.n] = r[self._perm]
             r = rr
         self._r = jax.device_put(r, self._state_sharding)
+        # Replaced state invalidates any carried boundary: re-prime so
+        # the next step reads a lag-0 boundary of the NEW ranks
+        # (snapshot resume, elastic rescue and warm starts all land
+        # here — ROBUSTNESS.md "Rescue x double buffer").
+        self._prime_carry()
+        self._last_step_delta = 0.0  # primed -> next step is lag-0
         self.iteration = iteration
 
     def layout_info(self) -> Dict[str, object]:

@@ -597,7 +597,8 @@ def mass_ledger_entry(*, damping: float, semantics: str, n: int,
                       eps: float, mass_prev: float, mass: float,
                       dangling_mass: float, contrib_total: float,
                       retained_total: float = 0.0,
-                      tol_factor: float = LEDGER_TOL_FACTOR
+                      tol_factor: float = LEDGER_TOL_FACTOR,
+                      flow_slack: float = 0.0
                       ) -> Dict[str, object]:
     """One probe iteration's exact mass decomposition + reconciliation.
 
@@ -631,6 +632,16 @@ def mass_ledger_entry(*, damping: float, semantics: str, n: int,
     All residuals are reported relative to the mode's expected total
     (1 textbook, n reference). ``leak`` is the worst offender's name,
     None when the ledger reconciles within :func:`ledger_tolerance`.
+
+    ``flow_slack`` (mass units, ISSUE 17) widens ONLY the flow-
+    conservation check: under the stale-boundary step
+    (config.halo_async) the measured contribution total mixes this
+    iteration's own-block mass with LAST iteration's boundary mass,
+    so flow conservation holds up to the previous step's L1 delta —
+    the caller passes that bound and the check stays sharp as the
+    solve converges (slack -> 0 with delta). The identity residual
+    needs no slack: the update consumed the same measured contrib the
+    ledger reports, stale or not.
     """
     reference = semantics == "reference"
     scale = float(n) if reference else 1.0
@@ -647,9 +658,10 @@ def mass_ledger_entry(*, damping: float, semantics: str, n: int,
     unaccounted = None
     if not reference:
         unaccounted = (mass_prev - dangling_mass - contrib_total) / scale
-        if unaccounted > tol:
+        flow_tol = tol + abs(flow_slack) / scale
+        if unaccounted > flow_tol:
             violations["dangling"] = abs(unaccounted)
-        elif unaccounted < -tol:
+        elif unaccounted < -flow_tol:
             violations["link"] = abs(unaccounted)
     leak = (max(violations, key=violations.get) if violations else None)
     return {

@@ -95,6 +95,11 @@ LEG_METRICS = (
     # with ``bench.py --sdc-check-every`` armed; None-tolerant like
     # every leg metric (disarmed legs simply lack the key).
     "sdc_check_overhead_pct",
+    # ISSUE 17: iterations-to-tol of the stale-boundary async solve —
+    # what the one-iteration boundary lag COSTS in convergence, priced
+    # in iterations (textbook semantics, bench --multichip staleness
+    # sweep). Present only on the sparse_async_f32 leg.
+    "iters_to_tol",
 )
 
 #: Profile scalars whose motion marks the DATA axis (classify_change
@@ -126,6 +131,8 @@ METRIC_BAD_DIRECTION = {
     "graph_partition_skew": "up",
     "graph_topk_concentration": "up",
     "sdc_check_overhead_pct": "up",
+    # More iterations to the same tolerance = the staleness cost grew.
+    "iters_to_tol": "up",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -227,6 +234,11 @@ def _rate_leg(d: dict) -> dict:
     so = _num(d.get("sdc_check_overhead_pct"))
     if so is not None:
         leg["sdc_check_overhead_pct"] = so
+    # Staleness convergence cost (ISSUE 17; the sparse_async multichip
+    # leg since r17) — absent on every synchronous leg.
+    itt = _num(d.get("iters_to_tol"))
+    if itt is not None:
+        leg["iters_to_tol"] = itt
     nd = d.get("n_devices")
     if isinstance(nd, int):
         leg["n_devices"] = nd
@@ -303,6 +315,12 @@ def leg_name_for_config(cfg) -> str:
         return getattr(cfg, key, default)
 
     if get("vertex_sharded"):
+        if get("halo_async"):
+            # The stale-boundary async exchange (ISSUE 17): its own
+            # series — one-iteration-lagged boundary reads change both
+            # the rate AND the convergence cost, so its numbers never
+            # baseline against the synchronous sparse series.
+            return "sparse_async_f32"
         return ("multichip_sparse" if get("halo_exchange")
                 else "multichip_dense")
     if get("kernel") == "pallas" and get("partition_span"):
@@ -372,6 +390,7 @@ def _normalize_multichip(doc: dict, rec: dict) -> None:
     for key, name in (("single_chip", "multichip_single"),
                       ("dense_exchange", "multichip_dense"),
                       ("sparse_exchange", "multichip_sparse"),
+                      ("sparse_async", "sparse_async_f32"),
                       ("pallas_partitioned", "pallas_partitioned_f32")):
         if isinstance(doc.get(key), dict):
             legs[name] = _rate_leg(doc[key])
@@ -979,6 +998,8 @@ _METRIC_SHORT = {
     "graph_dangling_fraction": "dangling frac",
     "graph_partition_skew": "part skew",
     "graph_topk_concentration": "topk conc",
+    "sdc_check_overhead_pct": "sdc ovh %",
+    "iters_to_tol": "iters to tol",
 }
 
 

@@ -79,6 +79,61 @@ def model_sparse(plan) -> dict:
     }
 
 
+def model_async(plan) -> dict:
+    """Comms model record for the ASYNCHRONOUS stale-boundary step
+    (ISSUE 17; config.halo_async). Same wire bytes as the synchronous
+    sparse exchange — overlap reorders the collectives, it never adds
+    or removes one (the vs_halo_async PTC001 contract) — plus the
+    overlap split the gate and the bench attribution read."""
+    m = model_sparse(plan)
+    m["mode"] = "sparse_async"
+    m["overlappable_bytes_per_iter"] = plan.overlappable_bytes_per_iter()
+    return m
+
+
+#: Standing exchange-fraction assumption when no measurement exists
+#: yet (a fresh build gates BEFORE its first attribution run). PR 10's
+#: TPU attributions put the sparse exchange at 20-40% of the step wall
+#: at headline scale; 0.3 is the midpoint — conservative enough that a
+#: boundary-light plan still gates off on its own overlappable share.
+DEFAULT_EXCHANGE_FRACTION = 0.3
+
+
+def predict_overlap_gain(plan, exchange_fraction: Optional[float] = None
+                         ) -> float:
+    """Predicted fractional step-wall saving of the stale-boundary
+    overlap (ISSUE 17): ``exchange_fraction x overlappable_share``,
+    where overlappable_share is the head + read-round portion of the
+    sparse exchange bytes (the write-band merge cannot be hidden —
+    parallel/partition.HaloPlan.overlappable_bytes_per_iter). The
+    exchange fraction comes from the caller (the engine passes the
+    live ``comms.exchange_fraction`` gauge when a prior attribution
+    measured one) or falls back to :data:`DEFAULT_EXCHANGE_FRACTION`.
+    Zero on single-device meshes and boundary-free plans — the
+    auto-gate's refusal signal."""
+    sparse = plan.sparse_bytes_per_iter()
+    if not sparse:
+        return 0.0
+    share = plan.overlappable_bytes_per_iter() / sparse
+    ef = exchange_fraction
+    if ef is None:
+        gauges = obs_metrics.get_registry().snapshot()["gauges"]
+        ef = gauges.get("comms.exchange_fraction")
+    if ef is None:
+        ef = DEFAULT_EXCHANGE_FRACTION
+    return float(max(0.0, min(1.0, ef)) * share)
+
+
+def publish_overlap_gain(gain: float) -> None:
+    """Publish the predicted payoff next to the measured exchange
+    fraction so `obs report` shows the gate's evidence."""
+    obs_metrics.gauge(
+        "comms.predicted_overlap_gain",
+        "predicted fractional step-wall saving of the stale-boundary "
+        "overlap (exchange fraction x overlappable byte share)",
+    ).set(float(gain))
+
+
 def register(model: dict) -> Optional[obs_metrics.Counter]:
     """Publish a comms model through the central registry (gauges) and
     return the ``comms.bytes_exchanged`` counter the solve loop feeds

@@ -159,6 +159,20 @@ class HaloPlan:
         writes = sum(r.width for r in self.write_rounds) * self.accum_item
         return int(head + reads + writes)
 
+    def overlappable_bytes_per_iter(self) -> int:
+        """The OVERLAPPABLE share of the sparse exchange (ISSUE 17;
+        config.halo_async): head all-reduce + read-round payloads —
+        the z-side traffic the stale-boundary double buffer moves off
+        the critical path (the reads consume LAST iteration's buffer
+        while this iteration's ships). The write-band merge stays
+        synchronous: contribution windows are consumed by the rank
+        update of the same iteration that produced them."""
+        if self.ndev <= 1:
+            return 0
+        head = 2 * (self.ndev - 1) * self.head_k * self.z_item // self.ndev
+        reads = sum(r.width for r in self.read_rounds) * self.z_item
+        return int(head + reads)
+
     def dense_bytes_per_iter(self) -> int:
         """Modeled bytes sent per chip per iteration by the DENSE
         exchange this plan replaces — THE one spelling lives in

@@ -223,7 +223,8 @@ def _spread_suspect(v: np.ndarray) -> int:
 
 def evaluate_check(pre: Dict[str, object], chk: Dict[str, object], *,
                    damping: float, semantics: str, n: int,
-                   num_edges: Optional[int], eps: float) -> SdcVerdict:
+                   num_edges: Optional[int], eps: float,
+                   stale_slack: float = 0.0) -> SdcVerdict:
     """Reconcile one checked step's ABFT values.
 
     ``pre`` is the standalone boundary-state dispatch over the INPUT
@@ -232,7 +233,18 @@ def evaluate_check(pre: Dict[str, object], chk: Dict[str, object], *,
     fp/mass/src over the input, fp/mass over the output, and the
     ledger sums). Both carry per-device arrays — full-copy values on
     replicated forms, per-shard partials on sharded ones
-    (``chk["sharded"]``)."""
+    (``chk["sharded"]``).
+
+    ``stale_slack`` (mass units; ISSUE 17): under the asynchronous
+    stale-boundary step (config.halo_async) the measured contribution
+    total mixes this iteration's own-block mass with LAST iteration's
+    boundary mass, so the link-conservation and flow-conservation
+    identities hold only up to the previous step's L1 delta. The
+    engine passes that delta as the slack; it decays to zero as the
+    solve converges, so detection power is recovered exactly where a
+    long solve spends its time. The fingerprint/copy duals and the
+    ledger identity residual are staleness-free and keep their sharp
+    tolerances — a flipped bit in the state still convicts."""
     sharded = bool(chk.get("sharded"))
     scale = float(n) if semantics == "reference" else 1.0
     tol_copy = copy_tolerance(eps, n)
@@ -295,13 +307,14 @@ def evaluate_check(pre: Dict[str, object], chk: Dict[str, object], *,
         src_total = (float(np.sum(_vec(src))) if sharded
                      else float(np.median(_vec(src))))
         dev = abs(contrib_total - src_total) / max(scale, 1e-30)
-        if dev > tol_link:
+        tol_link_eff = tol_link + abs(stale_slack) / max(scale, 1e-30)
+        if dev > tol_link_eff:
             suspect = None
             if sharded:
                 d = _vec(chk["contrib"]) - _vec(src)
                 suspect = (int(np.argmax(np.abs(d)))
                            if d.size > 1 else None)
-            breach("link_conservation", dev, tol_link, suspect)
+            breach("link_conservation", dev, tol_link_eff, suspect)
 
     # 4. mass-ledger identity (ISSUE 13 vocabulary): the decomposition
     # names the leaking term — the link/teleport/dangling corruption
@@ -320,6 +333,7 @@ def evaluate_check(pre: Dict[str, object], chk: Dict[str, object], *,
         retained_total=float(np.sum(_vec(chk["retained"]))),
         tol_factor=SDC_TOL_FACTOR * max(
             1.0, math.sqrt(max(1, num_edges or n) / max(1, n))),
+        flow_slack=stale_slack,
     )
     if not entry["ok"]:
         breach(f"mass_ledger:{entry['leak']}",
@@ -452,6 +466,11 @@ class SdcGuard:
             n=int(eng.graph.n),
             num_edges=ne,
             eps=eng._ledger_eps(),
+            # Prefer the per-attempt stamp (the delta bound of the
+            # state THIS chk was measured from); fall back to the
+            # engine's live value for engines that don't stamp.
+            stale_slack=float(
+                chk.get("stale_slack", eng._stale_slack()) or 0.0),
         )
 
     def _device_id(self, position: Optional[int]) -> Optional[int]:

@@ -277,10 +277,28 @@ CONFIGS = {
     "W": dict(kind="kernels",
               label="kernel-plane smoke (PTK pass clean, every seeded "
                     "defect trips its rule)"),
+    # Async stale-boundary smoke (ISSUE 17; config.halo_async): an
+    # 8-fake-device solve through the DOUBLE-BUFFERED halo exchange —
+    # the step must run the vs_halo_async form with the lag-1 buffer
+    # on, the vs_halo_async contract sweep must come back clean
+    # (PTC001 pins its collective multiset identical to vs_halo —
+    # overlap reorders, never adds), final ranks must match the f64
+    # CPU oracle at the standing f32 gate under TEXTBOOK semantics
+    # (the contraction guarantees the fixed point the lag-1 schedule
+    # converges to; reference semantics has none to compare at), the
+    # measured exchanged bytes must equal iters x the static model
+    # (staleness moves WHEN boundary bytes arrive, never HOW MANY),
+    # and the PTR race pass over the package must hold at zero
+    # unwaived findings with the buffer-rotation host state in the
+    # tree. Subprocess protocol as L/M/V when this backend can't host
+    # the mesh.
+    "X": dict(kind="halo_async", scale=12, iters=120,
+              label="async-exchange smoke (8-fake-device stale-"
+                    "boundary halo solve)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "N", "O", "Q", "R", "S",
-                "U", "V", "W", "F", "A", "B", "T", "P", "E", "BV", "BB",
-                "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "X", "N", "O", "Q", "R",
+                "S", "U", "V", "W", "F", "A", "B", "T", "P", "E", "BV",
+                "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1102,6 +1120,132 @@ def run_halo_smoke(key: str):
         f"measured {measured:,}; comms metrics "
         f"{'OK' if comms_visible else 'MISSING'}; {t_run:.2f}s vs "
         f"budget {HALO_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+# Budget for the async-exchange smoke (seconds, timed around the solve
+# loop itself — build/plan, the contract sweep, the PTR pass, and the
+# f64 oracle are excluded; the first step's compile is not): a
+# 120-iteration textbook f32 solve on 4096 vertices over 8 fake CPU
+# devices through the double-buffered exchange.
+HALO_ASYNC_SMOKE_BUDGET_S = 3.0
+
+
+def run_halo_async_smoke(key: str):
+    """ISSUE-17 gate: the asynchronous stale-boundary exchange end to
+    end on the 8-fake-device CPU mesh — vs_halo_async dispatch form
+    with the lag-1 double buffer ON, the form's contract sweep clean,
+    oracle L1 at the standing f32 gate (textbook semantics — the
+    lag-1 schedule must converge to the SAME fixed point), measured
+    exchanged bytes == iters x the static model, and the PTR
+    concurrency pass at zero unwaived findings. Re-invokes itself in
+    a subprocess with the fake-device flags when this backend can't
+    host the mesh (the smoke-L protocol)."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        return _fake_mesh_subprocess(key, "halo_async",
+                                     "PAGERANK_HALO_ASYNC_SMOKE_CHILD")
+
+    from pagerank_tpu import (JaxTpuEngine, PageRankConfig,
+                              ReferenceCpuEngine, build_graph, obs)
+    from pagerank_tpu.analysis import concurrency as conc_mod
+    from pagerank_tpu.analysis import load_allowlist, split_allowlisted
+    from pagerank_tpu.analysis.contracts import run_contracts
+    from pagerank_tpu.analysis.lint import package_root
+    from pagerank_tpu.obs import metrics as obs_metrics
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    scale, iters = spec["scale"], spec["iters"]
+    ndev = min(8, len(jax.devices()))
+    src, dst = rmat_edges(scale, 8, seed=4)
+    g = build_graph(src, dst, n=1 << scale)
+    obs.get_registry().reset()
+    cfg = PageRankConfig(num_iters=iters, dtype="float32",
+                         accum_dtype="float32", num_devices=ndev,
+                         vertex_sharded=True, halo_exchange=True,
+                         halo_async=True, halo_async_min_gain=0.0,
+                         semantics="textbook")
+    eng = JaxTpuEngine(cfg).build(g)
+    li = eng.layout_info()
+    form = li.get("form")
+    async_state = str(li.get("halo_async", ""))
+    cm = eng.comms_model() or {}
+    ctr = obs_metrics.counter("comms.bytes_exchanged")
+    c0 = ctr.value
+    t0 = time.perf_counter()
+    ranks = eng.run_fast()
+    t_run = time.perf_counter() - t0
+    measured = int(ctr.value - c0)
+    gauges = obs.get_registry().snapshot().get("gauges", {})
+    predicted_gain = gauges.get("comms.predicted_overlap_gain")
+
+    oracle = ReferenceCpuEngine(
+        PageRankConfig(num_iters=iters, dtype="float64",
+                       accum_dtype="float64", semantics="textbook")
+    ).build(g).run()
+    l1 = float(np.abs(ranks - oracle).sum()) / float(
+        np.abs(oracle).sum())
+
+    # The form's own jaxpr contract sweep (PTC001 collective multiset
+    # pinned identical to vs_halo, plus the probed/ledger/sdc variant
+    # rows) — empty findings = clean.
+    contract_findings = run_contracts(forms=["vs_halo_async"])
+
+    # PTR race pass with the buffer-rotation host state in the tree.
+    prog = conc_mod.build_package_program()
+    allow = os.path.join(package_root(), "analysis", "allowlist.txt")
+    active, _waived = split_allowlisted(
+        conc_mod.analyze_program(prog), load_allowlist(allow))
+
+    model = int(cm.get("bytes_per_iter") or 0)
+    overlappable = int(cm.get("overlappable_bytes_per_iter") or 0)
+    passed = bool(
+        form == "vs_halo_async"
+        and async_state.startswith("on:")
+        and not contract_findings
+        and l1 <= ELASTIC_F32_GATE
+        and model > 0
+        and measured == model * iters
+        and overlappable > 0
+        and not active
+        and t_run <= HALO_ASYNC_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "halo_async",
+        "label": spec["label"],
+        "scale": scale,
+        "iters": iters,
+        "devices": ndev,
+        "form": form,
+        "halo_async": async_state,
+        "contract_findings": [f.render() for f in contract_findings],
+        "normalized_l1": l1,
+        "gate": ELASTIC_F32_GATE,
+        "bytes_per_iter": model,
+        "overlappable_bytes_per_iter": overlappable,
+        "measured_bytes": measured,
+        "predicted_overlap_gain": predicted_gain,
+        "ptr_unwaived": len(active),
+        "seconds": t_run,
+        "budget_s": HALO_ASYNC_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] async stale-boundary exchange on {ndev} fake devices "
+        f"(scale {scale}, {iters} iters, textbook): form {form} "
+        f"({async_state}); contracts "
+        f"{'clean' if not contract_findings else 'DIRTY'}; oracle L1 "
+        f"{l1:.3e} vs gate {ELASTIC_F32_GATE:g}; measured "
+        f"{measured:,} == {iters} x {model:,} model "
+        f"({'OK' if measured == model * iters else 'BAD'}, "
+        f"{overlappable:,} overlappable); PTR {len(active)} unwaived; "
+        f"{t_run:.2f}s vs budget {HALO_ASYNC_SMOKE_BUDGET_S:g}s -> "
         f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
@@ -2430,6 +2574,7 @@ def main(argv=None) -> int:
                "faults": run_fault_smoke, "obs": run_obs_smoke,
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
                "elastic": run_elastic_smoke, "halo": run_halo_smoke,
+               "halo_async": run_halo_async_smoke,
                "history": run_history_smoke,
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,
                "jobs": run_jobs_smoke, "graph": run_graph_smoke,

@@ -122,7 +122,7 @@ def _assert_attribution_block(att, multi_device):
     if multi_device:
         assert att["model_bytes_per_iter"] > 0
         assert att["achieved_bytes_per_sec"] > 0
-        assert att["mode"] in ("dense", "sparse")
+        assert att["mode"] in ("dense", "sparse", "sparse_async")
 
 
 def _assert_layout_block(layout, form=None):
@@ -358,8 +358,9 @@ def test_multichip_json_contract(tmp_path):
     rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "n_devices", "scale",
                         "iters", "single_chip", "dense_exchange",
-                        "sparse_exchange", "pallas_partitioned",
-                        "scaling_efficiency",
+                        "sparse_exchange", "sparse_async",
+                        "pallas_partitioned", "exchange_overlap",
+                        "staleness_sweep", "scaling_efficiency",
                         "scaling_efficiency_dense", "exchanged_bytes",
                         "device_view", "accuracy", "env", "edge_factor",
                         "schema_version"}
@@ -367,7 +368,8 @@ def test_multichip_json_contract(tmp_path):
     assert len(rec["device_view"]) == 8
     assert rec["metric"] == "multichip_edges_per_sec_per_chip"
     assert rec["n_devices"] == 8
-    for leg in ("single_chip", "dense_exchange", "sparse_exchange"):
+    for leg in ("single_chip", "dense_exchange", "sparse_exchange",
+                "sparse_async"):
         rec_l = rec[leg]
         assert rec_l["value"] > 0 and rec_l["ms_per_iter"] > 0
         _assert_costs_block(rec_l["costs"])
@@ -420,6 +422,36 @@ def test_multichip_json_contract(tmp_path):
     assert rec["sparse_exchange"]["bytes_exchanged"] == \
         iters * cm["bytes_per_iter"]
     assert rec["dense_exchange"]["comms"]["mode"] == "dense"
+    # The async stale-boundary leg (ISSUE 17): same wire bytes as the
+    # sync sparse exchange (overlap reorders collectives, never adds
+    # one), the double-buffer layout recorded, and the leg's own
+    # iterations-to-tol from the staleness sweep.
+    sa = rec["sparse_async"]
+    assert sa["layout"]["form"] == "vs_halo_async"
+    assert str(sa["layout"]["halo_async"]).startswith("on:")
+    assert sa["comms"]["mode"] == "sparse_async"
+    assert sa["comms"]["bytes_per_iter"] == \
+        rec["sparse_exchange"]["comms"]["bytes_per_iter"]
+    assert sa["bytes_exchanged"] == iters * sa["comms"]["bytes_per_iter"]
+    assert sa["comms"]["overlappable_bytes_per_iter"] > 0
+    assert sa["attribution"]["mode"] == "sparse_async"
+    assert sa["iters_to_tol"] > 0
+    # Overlap verdict block: sync compute+exchange sum vs async step
+    # wall (the boolean is timing-dependent at toy scale — only the
+    # SHAPE is pinned here; the acceptance bench gates the value).
+    ov = rec["exchange_overlap"]
+    assert set(ov) == {"sync_compute_plus_exchange_s", "async_step_s",
+                       "async_below_sync_sum", "gain"}
+    assert ov["async_step_s"] > 0
+    # Staleness sweep: iterations-to-tol at lag 0 must match the sync
+    # schedule (lag-0 reads are fresh by construction).
+    sw = rec["staleness_sweep"]
+    assert sw["semantics"] == "textbook"
+    assert set(sw["legs"]) == {"sync", "async_lag0", "async_lag1"}
+    for v in sw["legs"].values():
+        assert v["iters_to_tol"] > 0
+    assert sw["legs"]["async_lag0"]["iters_to_tol"] == \
+        sw["legs"]["sync"]["iters_to_tol"]
     xb = rec["exchanged_bytes"]
     assert set(xb) == {"sparse_model_per_iter", "dense_model_per_iter",
                        "sparse_below_dense", "halo_fraction", "head_k"}

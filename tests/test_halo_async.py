@@ -1,0 +1,299 @@
+"""Asynchronous stale-boundary halo exchange tests (ISSUE 17;
+engines/jax_engine._setup_vs_halo_async; docs/PERF_NOTES.md "Hiding
+the exchange"): the lag-0 exactness demand (bit-identical to the
+synchronous vs_halo form, ZERO extra buffers — booby-trapped), the
+priming invariant (first step after build/set_ranks/restore is lag-0
+exact), lag-1 oracle parity at the f32 gate under textbook semantics,
+the auto-gate's downgrade paths, retain/restore rebuilding the double
+buffer (the elastic-rescue state path), SDC compatibility through the
+staleness slack, same-seed bit-for-bit chaos reproducibility, and the
+seed-deterministic rotation-protocol interleaving replay
+(testing/schedules.rotation_actors)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pagerank_tpu import JaxTpuEngine, PageRankConfig, build_graph
+from pagerank_tpu import sdc as sdc_mod
+from pagerank_tpu.engines.cpu import ReferenceCpuEngine
+from pagerank_tpu.testing import schedules
+from pagerank_tpu.testing.faults import (
+    DeviceFaultSchedule,
+    install_device_faults,
+)
+from pagerank_tpu.utils.metrics import oracle_l1
+from pagerank_tpu.utils.synth import rmat_edges
+
+NDEV = len(jax.devices())
+
+needs_mesh = pytest.mark.skipif(NDEV < 8, reason="needs 8 fake devices")
+
+F32_GATE = 1e-4
+
+
+def _rmat_graph(scale=10, ef=8, seed=1):
+    src, dst = rmat_edges(scale, edge_factor=ef, seed=seed)
+    return build_graph(src, dst, n=1 << scale)
+
+
+def _cfg(**kw):
+    base = dict(num_iters=8, dtype="float32", accum_dtype="float32",
+                num_devices=min(8, NDEV), vertex_sharded=True,
+                halo_exchange=True)
+    base.update(kw)
+    return PageRankConfig(**base)
+
+
+def _async_cfg(**kw):
+    """Async halo config with the auto-gate pinned open (the tests
+    measure the form itself; the gate's refusal paths get their own
+    tests below)."""
+    base = dict(halo_async=True, halo_async_min_gain=0.0)
+    base.update(kw)
+    return _cfg(**base)
+
+
+def _engine(graph, cfg):
+    return JaxTpuEngine(cfg).build(graph)
+
+
+# -- lag-0 exactness (booby-trapped) ----------------------------------------
+
+
+@needs_mesh
+def test_lag0_is_bit_identical_to_sync_with_zero_buffers():
+    """``--halo-async --stale-max-lag 0`` demands exactness: the engine
+    must run the SYNCHRONOUS sparse exchange — bit-identical ranks, the
+    vs_halo form, and (the booby trap) ZERO extra carry buffers. A
+    lag-0 "async" path that kept a buffer would be paying the memory
+    without the overlap AND hiding a second code path from the
+    contract sweep."""
+    g = _rmat_graph()
+    sync = _engine(g, _cfg())
+    lag0 = _engine(g, _async_cfg(stale_max_lag=0))
+    assert lag0.layout_info()["form"] == "vs_halo"
+    assert lag0.layout_info()["halo_async"] == "off:stale_max_lag=0"
+    assert tuple(lag0._carry_args) == ()  # the booby trap
+    np.testing.assert_array_equal(sync.run(), lag0.run())
+
+
+@needs_mesh
+def test_first_step_after_build_is_lag0_exact():
+    """Priming from the freshly-built rank plane makes the FIRST async
+    step consume a fresh boundary — bit-identical to the synchronous
+    step. Staleness begins at step two, by construction, never by
+    accident of initialization."""
+    g = _rmat_graph()
+    sync = _engine(g, _cfg())
+    a = _engine(g, _async_cfg())
+    assert a.layout_info()["form"] == "vs_halo_async"
+    assert a.layout_info()["halo_async"] == "on:lag1"
+    assert len(a._carry_args) > 0
+    sync.step()
+    a.step()
+    np.testing.assert_array_equal(np.asarray(sync.ranks()),
+                                  np.asarray(a.ranks()))
+
+
+# -- lag-1 convergence: oracle parity ---------------------------------------
+
+
+@needs_mesh
+def test_lag1_converges_to_oracle_fixed_point_textbook():
+    """The lag-1 schedule converges to the SAME fixed point as the f64
+    CPU oracle under textbook semantics (the contraction guarantees
+    one; reference semantics legitimately diverges on graphs with
+    zero-in-degree vertices, so there is no fixed point to compare
+    at). 120 iterations sits well past the tol-1e-6 convergence of
+    both schedules at this scale; the gate is the repo-wide f32
+    oracle-parity bound."""
+    g = _rmat_graph()
+    iters = 120
+    a = _engine(g, _async_cfg(num_iters=iters, semantics="textbook"))
+    r_async = a.run_fast()
+    cfg_o = PageRankConfig(num_iters=iters, dtype="float64",
+                           accum_dtype="float64", semantics="textbook")
+    r_oracle = ReferenceCpuEngine(cfg_o).build(g).run()
+    _l1, norm, _mass = oracle_l1(r_async, r_oracle)
+    assert norm <= F32_GATE
+
+
+# -- auto-gate downgrades ---------------------------------------------------
+
+
+@needs_mesh
+def test_gate_downgrades_on_low_predicted_gain():
+    """A predicted overlap gain below config.halo_async_min_gain
+    downgrades (logged, recorded) to the synchronous exchange: hiding
+    an exchange that is already cheap buys staleness for nothing."""
+    g = _rmat_graph()
+    eng = _engine(g, _cfg(halo_async=True, halo_async_min_gain=1.0))
+    li = eng.layout_info()
+    assert li["form"] == "vs_halo"
+    assert str(li["halo_async"]).startswith("off:gain ")
+    # The downgraded engine is the synchronous form, bit for bit.
+    np.testing.assert_array_equal(_engine(g, _cfg()).run(), eng.run())
+
+
+def test_gate_refuses_single_device_mesh():
+    """One device has no boundary to exchange, hence nothing to
+    overlap — the gate refuses rather than building dead buffers."""
+    g = _rmat_graph(scale=9)
+    eng = _engine(g, _cfg(num_devices=1, halo_async=True,
+                          halo_async_min_gain=0.0))
+    li = eng.layout_info()
+    assert str(li.get("halo_async", "")).startswith("off:")
+    assert not str(li.get("halo_async", "")).startswith("on:")
+
+
+# -- elastic/state path: the double buffer across state replacement ---------
+
+
+@needs_mesh
+def test_retain_restore_roundtrip_is_bitwise():
+    """retain_state/restore_state must carry the boundary double
+    buffer (and the staleness-slack scalar) so a restored solve
+    continues bit-identically — the state path every redo and rescue
+    rides."""
+    g = _rmat_graph()
+    eng = _engine(g, _async_cfg(num_iters=20))
+    eng.run_fast(num_iters=5)
+    token = eng.retain_state()
+    eng.run_fast(num_iters=10)
+    eng.restore_state(token)
+    assert eng.iteration == 5
+    r_resumed = eng.run_fast(num_iters=20)
+    r_fresh = _engine(g, _async_cfg(num_iters=20)).run_fast()
+    np.testing.assert_array_equal(np.asarray(r_resumed),
+                                  np.asarray(r_fresh))
+
+
+@needs_mesh
+def test_rescue_rebuild_restores_double_buffer():
+    """A rescue builds a FRESH engine and restores the retained token
+    into it: the rebuilt engine must adopt the double buffer from the
+    token and continue bit-identically with the uninterrupted solve."""
+    g = _rmat_graph()
+    eng = _engine(g, _async_cfg(num_iters=12))
+    eng.run_fast(num_iters=4)
+    token = eng.retain_state()
+    rebuilt = _engine(g, _async_cfg(num_iters=12))
+    rebuilt.restore_state(token)
+    assert len(rebuilt._carry_args) > 0
+    r_a = eng.run_fast()
+    r_b = rebuilt.run_fast()
+    np.testing.assert_array_equal(np.asarray(r_a), np.asarray(r_b))
+
+
+@needs_mesh
+def test_set_ranks_reprimes_to_lag0():
+    """set_ranks replaces the rank plane, so the engine must re-prime
+    the boundary buffer from the NEW ranks: the first step after is
+    lag-0 exact — bit-identical to the SYNCHRONOUS engine stepping
+    from the same ranks."""
+    g = _rmat_graph()
+    warm = _engine(g, _async_cfg())
+    warm.run_fast(num_iters=3)
+    v = np.asarray(warm.ranks())
+    a = _engine(g, _async_cfg())
+    s = _engine(g, _cfg())
+    a.set_ranks(v)
+    s.set_ranks(v)
+    a.step()
+    s.step()
+    np.testing.assert_array_equal(np.asarray(a.ranks()),
+                                  np.asarray(s.ranks()))
+
+
+# -- SDC compatibility: the staleness slack ---------------------------------
+
+
+@needs_mesh
+def test_sdc_checked_async_solve_matches_unchecked():
+    """The checked async solve produces the SAME ranks as the
+    unchecked one on a clean run: the flow-conservation invariants
+    absorb the bounded staleness through the slack term (the previous
+    step's L1 delta — sdc.evaluate_check), so a legitimate lag-1 step
+    is never misread as corruption."""
+    g = _rmat_graph()
+    plain = _engine(g, _async_cfg(num_iters=12)).run()
+    sdc_mod.reset()
+    checked = _engine(g, _async_cfg(num_iters=12,
+                                    sdc_check_every=3)).run()
+    np.testing.assert_array_equal(np.asarray(plain),
+                                  np.asarray(checked))
+    s = sdc_mod.report_section()
+    assert s["checks"] == 4 and s["flips_detected"] == 0
+
+
+@needs_mesh
+def test_same_seed_chaos_is_bit_for_bit_on_async():
+    """Two same-seed chaos runs over the async form must produce
+    identical fault logs AND identical final ranks — detection, the
+    slack-tolerant evaluation, redo, and healing included (the
+    testing/faults.py reproducibility convention)."""
+    g = _rmat_graph()
+
+    def once():
+        sdc_mod.reset()
+        eng = _engine(g, _async_cfg(num_iters=10, sdc_check_every=1))
+        sched = DeviceFaultSchedule(seed=23, flip={4: (2, "sign")})
+        install_device_faults(eng, sched)
+        ranks = eng.run()
+        return list(sched.log), np.asarray(ranks)
+
+    log_a, ranks_a = once()
+    log_b, ranks_b = once()
+    assert log_a == log_b
+    assert any(entry[1] == "flip" for entry in log_a)
+    np.testing.assert_array_equal(ranks_a, ranks_b)
+
+
+# -- rotation protocol: seed-deterministic interleaving replay --------------
+
+
+def test_rotation_protocol_clean_under_sampled_schedules():
+    """The honest rotation protocol (rank plane adopted first, buffer
+    second; prime on state replacement) holds its invariants —
+    consumed lag <= stale_max_lag, reader never observes a buffer
+    newer than the ranks — under every sampled schedule."""
+    for seed in range(25):
+        holder = {}
+        schedules.replay(
+            seed,
+            lambda s: holder.update(st=schedules.rotation_actors(
+                s, steps=6, rescue_after=3)),
+        )
+        st = holder["st"]
+        assert st["violations"] == [], (seed, st["violations"])
+        assert st["restores"] == 1
+
+
+def test_rotation_protocol_booby_trap_skipping_prime():
+    """The booby-trapped protocol (state replacement WITHOUT
+    re-priming the buffer) must record a consumed-lag violation under
+    the very same seeds the honest protocol survives — proving the
+    replay can actually see the bug class it certifies against."""
+    for seed in range(25):
+        holder = {}
+        schedules.replay(
+            seed,
+            lambda s: holder.update(st=schedules.rotation_actors(
+                s, steps=6, rescue_after=3, prime_on_restore=False)),
+        )
+        assert any(v[1] == "consumed-lag"
+                   for v in holder["st"]["violations"]), seed
+
+
+def test_rotation_protocol_replay_is_seed_deterministic():
+    """Same seed, same spawn sequence => identical schedule log,
+    bit for bit (the testing/faults.py convention)."""
+    runs = [
+        schedules.replay(
+            7, lambda s: schedules.rotation_actors(s, rescue_after=2)
+        ).log
+        for _ in range(2)
+    ]
+    assert runs[0] == runs[1]
