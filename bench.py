@@ -1003,6 +1003,93 @@ def _preflight(args) -> bool:
     return res.fits
 
 
+def run_ppr_serve(args):
+    """The ``ppr_serve`` leg (ISSUE 18): drive the resident PPR query
+    daemon (pagerank_tpu/serving/) open-loop at ``--serve-qps`` and
+    report the serving headline — sustained queries/s over accepted
+    queries, exact p50/p99 latency (percentiles over the per-query
+    walls, NOT the coarse power-of-two histogram buckets), the shed
+    fraction (typed Overloaded rejections / offered), and the rescue
+    count. One JSON line, ``metric: ppr_serve_queries_per_sec``;
+    --history normalizes it into the ``ppr_serve`` ledger leg."""
+    import numpy as np
+
+    from pagerank_tpu import PageRankConfig, build_graph
+    from pagerank_tpu.serving import PprServer, ServeConfig
+    from pagerank_tpu.testing.load import QueryLoadGenerator
+    from pagerank_tpu.utils.synth import rmat_edges
+
+    n = 1 << args.scale
+    src, dst = rmat_edges(args.scale, edge_factor=args.edge_factor,
+                          seed=args.seed)
+    graph = build_graph(src, dst, n=n)
+    cfg = PageRankConfig(num_iters=args.iters)
+    sc = ServeConfig(
+        max_batch=args.serve_max_batch,
+        queue_depth=args.serve_queue_depth,
+        deadline_ms=args.serve_deadline_ms,
+        topk=min(args.serve_topk, n),
+    )
+    server = PprServer(graph, config=cfg, serve_config=sc)
+    server.start()  # dispatcher thread; AOT warm happens here
+
+    gap = 1.0 / max(args.serve_qps, 1e-9)
+    plan = QueryLoadGenerator(
+        seed=args.seed, num_queries=args.serve_queries, n=n,
+        mean_gap_s=gap, k=sc.topk,
+        deadline_range_s=(sc.deadline_ms / 1e3, sc.deadline_ms / 1e3),
+    ).plan()
+
+    handles = []
+    t0 = time.perf_counter()
+    for gap_s, source, k, deadline_s in plan:
+        time.sleep(gap_s)
+        handles.append(server.submit(source, k=k, deadline_s=deadline_s))
+    # Settle: every handle resolves (answered or typed-rejected) —
+    # accounting identity, nothing silently dropped.
+    settle = sc.deadline_ms / 1e3 + sc.dispatch_timeout_s + 5.0
+    for q in handles:
+        q.wait(timeout=settle)
+    elapsed = time.perf_counter() - t0
+    rescues = server.rescues_done
+    server.drain()
+
+    outcomes = {}
+    lat_ms = []
+    for q in handles:
+        outcomes[q.outcome or "unsettled"] = (
+            outcomes.get(q.outcome or "unsettled", 0) + 1
+        )
+        if q.outcome.startswith("answered") and q.latency_s is not None:
+            lat_ms.append(q.latency_s * 1e3)
+    answered = sum(v for k_, v in outcomes.items()
+                   if k_.startswith("answered"))
+    shed = outcomes.get("shed_overload", 0)
+    out = {
+        "metric": "ppr_serve_queries_per_sec",
+        "value": answered / elapsed if elapsed > 0 else 0.0,
+        "unit": "queries/s",
+        "p50_ms": float(np.percentile(lat_ms, 50)) if lat_ms else None,
+        "p99_ms": float(np.percentile(lat_ms, 99)) if lat_ms else None,
+        "shed_fraction": shed / len(handles) if handles else 0.0,
+        "rescues": rescues,
+        "queries": len(handles),
+        "answered": answered,
+        "outcomes": outcomes,
+        "elapsed_s": elapsed,
+        "offered_qps": args.serve_qps,
+        "scale": args.scale,
+        "iters": args.iters,
+        "edge_factor": args.edge_factor,
+        "max_batch": sc.max_batch,
+        "deadline_ms": sc.deadline_ms,
+        "queue_depth": sc.queue_depth,
+        "topk": sc.topk,
+        "env": _env_fingerprint(),
+    }
+    _emit(out, args)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--scale", type=int, default=23,
@@ -1050,6 +1137,31 @@ def main(argv=None):
     p.add_argument("--multichip-devices", type=int, default=8,
                    help="device count for the --multichip legs "
                         "(clamped to the visible mesh)")
+    p.add_argument("--ppr-serve", action="store_true",
+                   help="the serving benchmark (ISSUE 18): drive the "
+                        "resident PPR query daemon "
+                        "(pagerank_tpu/serving/) open-loop at "
+                        "--serve-qps and report sustained queries/s, "
+                        "exact p50/p99 latency, shed fraction, and "
+                        "rescue count — one JSON line "
+                        "(ppr_serve_queries_per_sec)")
+    p.add_argument("--serve-queries", type=int, default=200,
+                   help="queries offered by the --ppr-serve leg")
+    p.add_argument("--serve-qps", type=float, default=100.0,
+                   help="offered open-loop rate for --ppr-serve "
+                        "(mean of the seeded exponential gaps)")
+    p.add_argument("--serve-max-batch", type=int, default=8,
+                   help="--ppr-serve daemon micro-batch size (the ONE "
+                        "AOT-warmed program's static batch)")
+    p.add_argument("--serve-deadline-ms", type=float, default=500.0,
+                   help="--ppr-serve per-query deadline")
+    p.add_argument("--serve-queue-depth", type=int, default=64,
+                   help="--ppr-serve admission queue bound")
+    p.add_argument("--serve-topk", type=int, default=64,
+                   help="--ppr-serve top-k returned per query "
+                        "(clamped to n)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="R-MAT + load-plan seed (--ppr-serve)")
     p.add_argument("--host-build", action="store_true",
                    help="build the graph on host + transfer (default: on-device)")
     p.add_argument("--build-only", action="store_true",
@@ -1113,6 +1225,10 @@ def main(argv=None):
         # taxonomy, pagerank_tpu/exitcodes.py; bench exited 2 here
         # before ISSUE 12 unified the two).
         sys.exit(int(ExitCode.PREFLIGHT_UNFIT))
+
+    if args.ppr_serve:
+        run_ppr_serve(args)
+        return
 
     if args.multichip:
         run_multichip(args)

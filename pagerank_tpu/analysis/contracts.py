@@ -34,6 +34,13 @@ paths):
                             IDENTICAL to vs_halo (overlap reorders
                             collectives, never adds one)
   vs_bounded (+ms)        — owner-computes, per-stripe z psums
+  ppr_batch               — the serving hot path (ISSUE 18): the
+                            batched-PPR chunk program
+                            (engines/ppr.py:PprJaxEngine._run_chunk;
+                            one psum per iteration, k-fold intensity)
+                            plus its on-device top-k, which must be
+                            collective- and callback-free so only
+                            [batch, k] leaves the chip
 
 Rule ids: PTC001 collective budget, PTC002 f64 promotion, PTC003
 donation consumed (warning capture per form + the structural
@@ -1331,6 +1338,101 @@ def check_build_donations() -> List[Finding]:
     return findings
 
 
+_PPR_PATH = "engines/ppr.py"
+
+
+def check_ppr_batch_form(ndev: int) -> List[Finding]:
+    """Contract coverage for the PPR serving hot path (ISSUE 18): the
+    batched dispatch program ``PprJaxEngine._run_chunk`` and its
+    on-device top-k, statically gated like every solver form.
+
+    - PTC001: exactly ONE bulk psum per iteration of the chunk body
+      (the [n, k] partial merge — SURVEY.md §3's shuffle collapse holds
+      at k-fold arithmetic intensity), zero scalar collectives;
+    - PTC002: no f64 under the all-f32 default config (the serving
+      path must not pay the TPU f64 emulation tax per query);
+    - PTC005: no host callbacks in either program;
+    - PTC007-adapted: the top-k program is collective- AND
+      callback-free — it runs replicated post-psum, so a collective
+      here means the layout regressed and more than ``[batch, k]``
+      would leave the chip.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pagerank_tpu import PageRankConfig
+    from pagerank_tpu.engines.ppr import PprJaxEngine
+    from pagerank_tpu.parallel.mesh import replicated
+
+    findings: List[Finding] = []
+    try:
+        g = _tiny_graph()
+        eng = PprJaxEngine(
+            PageRankConfig(num_iters=2, num_devices=ndev)
+        ).build(g)
+        batch = np.zeros(4, np.int64)
+        p = np.zeros((eng._n_state, len(batch)), eng._dtype)
+        p[eng._inv_perm[batch], np.arange(len(batch))] = 1.0
+        p_dev = jax.device_put(jnp.asarray(p), replicated(eng._mesh))
+        progs = [
+            ("chunk", jax.make_jaxpr(eng._run_chunk, static_argnums=(2,))(
+                p_dev.copy(), p_dev, 2, eng._inv_out, eng._dangling,
+                eng._valid, *eng._slot_args,
+            )),
+            ("topk", jax.make_jaxpr(eng._topk, static_argnums=(1,))(
+                p_dev, 4
+            )),
+        ]
+    except Exception as e:
+        return [_finding(
+            "PTC001",
+            f"ppr_batch form failed to build/trace: "
+            f"{type(e).__name__}: {str(e)[:160]}",
+            "ppr_batch", path=_PPR_PATH,
+        )]
+
+    got: Dict[str, int] = {}
+    scalars = 0
+    for _label, jx in progs[:1]:  # chunk program owns the budget
+        for prim, size in collectives(jx):
+            if size > 1:
+                got[prim] = got.get(prim, 0) + 1
+            else:
+                scalars += 1
+    if got != {"psum": 1} or scalars:
+        findings.append(_finding(
+            "PTC001",
+            f"ppr chunk bulk collective budget violated: expected "
+            f"{{'psum': 1}} and 0 scalar collectives, traced "
+            f"{got or 'none'} + {scalars} scalar(s)",
+            "ppr_batch", path=_PPR_PATH,
+        ))
+    for label, jx in progs:
+        hits = f64_avals(jx)
+        if hits:
+            findings.append(_finding(
+                "PTC002",
+                f"f64 under the f32 serving config in {label}: "
+                f"{hits[0]} (+{len(hits) - 1} more)",
+                "ppr_batch", path=_PPR_PATH,
+            ))
+        cbs = callback_prims(jx)
+        if cbs:
+            findings.append(_finding(
+                "PTC005",
+                f"host callback(s) {sorted(set(cbs))} in {label}",
+                "ppr_batch", path=_PPR_PATH,
+            ))
+    for prim, _size in collectives(progs[1][1]):
+        findings.append(_finding(
+            "PTC007",
+            f"top-k program contains collective {prim}: top-k must run "
+            f"replicated post-psum so only [batch, k] leaves the chip",
+            "ppr_batch", path=_PPR_PATH,
+        ))
+    return findings
+
+
 def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
     """Run the full contract suite; returns findings (empty = clean).
     ``forms`` filters the engine dispatch forms by name."""
@@ -1352,6 +1454,8 @@ def run_contracts(forms: Optional[List[str]] = None) -> List[Finding]:
             ))
     if forms is None or "pallas_partitioned" in forms:
         findings.extend(check_pallas_hlo(ndev))
+    if forms is None or "ppr_batch" in forms:
+        findings.extend(check_ppr_batch_form(ndev))
     if forms is None:
         findings.extend(check_step_key_stability(ndev))
         findings.extend(check_kernels())

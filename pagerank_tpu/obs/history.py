@@ -100,6 +100,14 @@ LEG_METRICS = (
     # in iterations (textbook semantics, bench --multichip staleness
     # sweep). Present only on the sparse_async_f32 leg.
     "iters_to_tol",
+    # ISSUE 18: the ppr_serve leg (bench.py --ppr-serve) — sustained
+    # serving throughput and tail latency of the deadline-honest query
+    # daemon, plus the shed fraction (admission honesty: what fraction
+    # of offered load the predictive shed refused).
+    "queries_per_sec",
+    "p50_ms",
+    "p99_ms",
+    "shed_fraction",
 )
 
 #: Profile scalars whose motion marks the DATA axis (classify_change
@@ -133,6 +141,13 @@ METRIC_BAD_DIRECTION = {
     "sdc_check_overhead_pct": "up",
     # More iterations to the same tolerance = the staleness cost grew.
     "iters_to_tol": "up",
+    # Serving (ISSUE 18): throughput down = regression; latency tails
+    # and shed fraction up = regression (shedding MORE at the same
+    # offered load means the modeled batch wall grew).
+    "queries_per_sec": "down",
+    "p50_ms": "up",
+    "p99_ms": "up",
+    "shed_fraction": "up",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -466,6 +481,23 @@ def _normalize_run_report(doc: dict, rec: dict) -> None:
         rec["workload"]["iters"] = iters
 
 
+def _normalize_ppr_serve(doc: dict, rec: dict) -> None:
+    rec["kind"] = "bench_ppr_serve"
+    leg: Dict[str, object] = {}
+    qps = _num(doc.get("value"))
+    if qps is not None:
+        leg["queries_per_sec"] = qps
+    for key in ("p50_ms", "p99_ms", "shed_fraction"):
+        v = _num(doc.get(key))
+        if v is not None:
+            leg[key] = v
+    if leg:
+        rec["legs"]["ppr_serve"] = leg
+    for key in ("queries", "rescues", "max_batch", "deadline_ms", "topk"):
+        if doc.get(key) is not None:
+            rec["extras"][key] = doc[key]
+
+
 def normalize_result(doc: dict, source: str = "") -> dict:
     """Any historical result artifact -> one canonical RunRecord dict.
 
@@ -475,6 +507,8 @@ def normalize_result(doc: dict, source: str = "") -> dict:
       - flat bench couple/single JSON (``metric ==
         edges_per_sec_per_chip``), versioned or not;
       - ``--build-only`` JSON (``metric == build_s``);
+      - ``--ppr-serve`` JSON (``metric == ppr_serve_queries_per_sec``,
+        ISSUE 18);
       - flat MULTICHIP JSON (``metric ==
         multichip_edges_per_sec_per_chip``) and the r01-r05 dryrun
         shape ``{n_devices, rc, ok, skipped, tail}``;
@@ -513,6 +547,8 @@ def normalize_result(doc: dict, source: str = "") -> dict:
         _normalize_multichip(inner, rec)
     elif metric == "build_s":
         _normalize_build_only(inner, rec)
+    elif metric == "ppr_serve_queries_per_sec":
+        _normalize_ppr_serve(inner, rec)
     elif "environment" in inner and "spans" in inner:
         _normalize_run_report(inner, rec)
     elif set(inner) >= {"n_devices", "rc", "ok"}:  # multichip dryrun

@@ -1,0 +1,20 @@
+"""``python -m pagerank_tpu.serve`` — the PPR query daemon entry point
+(ISSUE 18 satellite). The implementation lives in ``__main__.py`` (the
+lint PTL007 print-exempt surface); these lazy wrappers exist for
+in-process tests and avoid importing ``__main__`` at package-import
+time (runpy warns when ``-m`` finds it pre-imported)."""
+
+
+def build_parser():
+    from pagerank_tpu.serve.__main__ import build_parser as bp
+
+    return bp()
+
+
+def main(argv=None) -> int:
+    from pagerank_tpu.serve.__main__ import main as m
+
+    return m(argv)
+
+
+__all__ = ["build_parser", "main"]

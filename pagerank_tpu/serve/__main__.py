@@ -1,0 +1,175 @@
+"""CLI for the deadline-honest PPR query daemon (ISSUE 18).
+
+    python -m pagerank_tpu.serve --scale 14 --max-batch 8 \
+        --deadline-ms 500 --port 8080 --metrics-port 9100
+
+Builds a synthetic R-MAT graph (the repo's zero-egress workload
+stand-in), AOT-warms the one compiled batch program, and serves
+``GET /ppr?source=<id>`` over loopback HTTP until SIGTERM enters the
+PR 12 drain (admission closes with typed rejections, in-flight batches
+finish, exit 75). ``--serve-smoke N`` instead runs N seeded queries
+in-process against the started daemon and exits — the self-test mode
+the acceptance harness and a fresh checkout both use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from pagerank_tpu import PageRankConfig, build_graph, jobs
+from pagerank_tpu.exitcodes import ExitCode
+from pagerank_tpu.utils import synth
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m pagerank_tpu.serve",
+        description="Deadline-honest PPR query daemon over a resident "
+        "sharded graph (typed overload/drain/degraded outcomes).",
+    )
+    g = p.add_argument_group("graph / solver")
+    g.add_argument("--scale", type=int, default=12,
+                   help="R-MAT scale: 2**scale vertices (default 12)")
+    g.add_argument("--edge-factor", type=int, default=16,
+                   help="edges per vertex (default 16)")
+    g.add_argument("--seed", type=int, default=0,
+                   help="graph + smoke load seed (default 0)")
+    g.add_argument("--iters", type=int, default=10,
+                   help="PPR power iterations per query (default 10)")
+    g.add_argument("--damping", type=float, default=0.85)
+    g.add_argument("--num-devices", type=int, default=None,
+                   help="mesh width (default: all visible devices)")
+    s = p.add_argument_group("serving")
+    s.add_argument("--topk", type=int, default=100,
+                   help="on-device top-k width (default 100)")
+    s.add_argument("--max-batch", type=int, default=8,
+                   help="compiled batch width (default 8)")
+    s.add_argument("--deadline-ms", type=float, default=500.0,
+                   help="default per-query deadline (default 500)")
+    s.add_argument("--queue-depth", type=int, default=64,
+                   help="bounded admission depth (default 64)")
+    s.add_argument("--cache-capacity", type=int, default=1024,
+                   help="LRU result-cache entries; 0 disables")
+    s.add_argument("--port", type=int, default=8080,
+                   help="query ingress HTTP port (0 = ephemeral)")
+    s.add_argument("--metrics-port", type=int, default=None,
+                   help="serve /metrics on this port too")
+    s.add_argument("--drain-deadline", type=float, default=5.0,
+                   help="SIGTERM drain budget, seconds (default 5)")
+    s.add_argument("--serve-smoke", type=int, default=None, metavar="N",
+                   help="self-test: run N in-process queries, print a "
+                   "JSON summary, exit (no HTTP)")
+    return p
+
+
+def _build_server(args):
+    from pagerank_tpu.serving import PprServer, ServeConfig
+
+    src, dst = synth.rmat_edges(
+        args.scale, edge_factor=args.edge_factor, seed=args.seed
+    )
+    graph = build_graph(src, dst, n=1 << args.scale)
+    config = PageRankConfig(
+        num_iters=args.iters, damping=args.damping,
+        num_devices=args.num_devices,
+    )
+    serve_config = ServeConfig(
+        max_batch=args.max_batch,
+        queue_depth=args.queue_depth,
+        deadline_ms=args.deadline_ms,
+        topk=args.topk,
+        cache_capacity=args.cache_capacity,
+        drain_deadline_s=args.drain_deadline,
+    )
+    return PprServer(graph, config=config, serve_config=serve_config)
+
+
+def _run_smoke(server, args) -> int:
+    """N seeded in-process queries against the started daemon; prints
+    one JSON summary line. Exit 0 iff every query reached a typed
+    terminal state (answered or typed-rejected, zero unsettled)."""
+    import random
+
+    rng = random.Random(args.seed)
+    n = server.graph.n
+    handles = [
+        server.submit(rng.randrange(n), k=min(args.topk, 8))
+        for _ in range(args.serve_smoke)
+    ]
+    settle = args.deadline_ms / 1000.0 + 5.0
+    outcomes = {}
+    unsettled = 0
+    for q in handles:
+        q.wait(settle)
+        out = q.outcome or "<unsettled>"
+        unsettled += out == "<unsettled>"
+        outcomes[out] = outcomes.get(out, 0) + 1
+    server.stop()
+    print(json.dumps({
+        "smoke": "ppr_serve",
+        "queries": len(handles),
+        "outcomes": outcomes,
+        "unsettled": unsettled,
+        "devices": server.device_count,
+        "degraded": server.degraded,
+    }, sort_keys=True))
+    return int(ExitCode.OK) if unsettled == 0 else int(ExitCode.FAILURE)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        server = _build_server(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return int(ExitCode.USAGE)
+
+    # SIGTERM/SIGINT handlers live ONLY around entry points (PTL008);
+    # a drain request surfaces as DrainInterrupt at the poll below and
+    # the daemon exits ExitCode.INTERRUPTED after the bounded drain.
+    drain = jobs.GracefulDrain(deadline_s=args.drain_deadline)
+    with drain:
+        server.start()
+        try:
+            if args.serve_smoke is not None:
+                return _run_smoke(server, args)
+            from pagerank_tpu.serving.http import QueryIngress
+
+            exporter = None
+            if args.metrics_port is not None:
+                from pagerank_tpu.obs.live import MetricsExporter
+
+                exporter = MetricsExporter(port=args.metrics_port)
+            with QueryIngress(server, port=args.port) as ingress:
+                print(
+                    f"serving PPR on http://127.0.0.1:{ingress.port}/ppr "
+                    f"(graph 2**{args.scale} vertices, "
+                    f"{server.device_count} device(s), "
+                    f"batch {args.max_batch}, "
+                    f"deadline {args.deadline_ms:g}ms"
+                    + (f", metrics :{exporter.port}" if exporter else "")
+                    + ") — SIGTERM drains"
+                )
+                try:
+                    while True:
+                        drain.check("serve-loop")
+                        time.sleep(0.5)
+                finally:
+                    if exporter is not None:
+                        exporter.close()
+        except jobs.DrainInterrupt:
+            flushed = server.drain(deadline_s=drain.remaining())
+            spent = drain.finish()
+            print(
+                f"drained: admission closed, {flushed} queued "
+                f"query(ies) typed-rejected, {spent:.2f}s spent "
+                f"(exit {int(ExitCode.INTERRUPTED)})"
+            )
+            return int(ExitCode.INTERRUPTED)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Production PPR serving (ISSUE 18): a deadline-honest query daemon.
+
+The serving layer turns :class:`~pagerank_tpu.engines.ppr.PprJaxEngine`
+into a resident query path: one AOT-warmed compiled batch program over a
+sharded graph, a bounded admission queue with dynamic micro-batching,
+per-query deadlines with predictive load shedding, an LRU result cache,
+and on-device top-k so only ``[batch, k]`` ever leaves the chip.
+
+The robustness spine maps every failure mode the repo defends against
+offline to a *typed, bounded, observable* outcome for an in-flight
+query (docs/ROBUSTNESS.md "Serving"):
+
+- overload        -> typed :class:`Overloaded` rejection with a
+                     retry-after hint, decided AT ADMISSION (never
+                     accept work that cannot finish);
+- chip loss / SDC quarantine -> the PR 7/15 elastic rescue: re-shard
+                     onto the survivors and RE-RUN the in-flight batch
+                     (counted, never silently dropped);
+- SIGTERM         -> the PR 12 drain: admission closes with typed
+                     :class:`Draining` rejections, in-flight batches
+                     finish inside the drain deadline, exit 75;
+- stuck dispatch  -> bounded by ``mesh.run_with_deadline``; the batch
+                     fails typed (:class:`QueryDeadlineExceeded`)
+                     instead of hanging the queue.
+
+Telemetry rides the existing planes: ``serve.*`` counters/gauges and
+the ``serve.latency_ms`` histogram through the PR 5 exporter, and a
+``ppr_serve`` leg in the perf ledger (``bench.py --ppr-serve``).
+"""
+
+from pagerank_tpu.serving.admission import AdmissionQueue, BatchWallModel
+from pagerank_tpu.serving.cache import ResultCache
+from pagerank_tpu.serving.daemon import PprServer, ServeConfig
+from pagerank_tpu.serving.http import QueryIngress
+from pagerank_tpu.serving.query import (
+    Draining,
+    Overloaded,
+    PendingQuery,
+    QueryDeadlineExceeded,
+    ServeRejected,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "BatchWallModel",
+    "Draining",
+    "Overloaded",
+    "PendingQuery",
+    "PprServer",
+    "QueryDeadlineExceeded",
+    "QueryIngress",
+    "ResultCache",
+    "ServeConfig",
+    "ServeRejected",
+]

@@ -295,10 +295,15 @@ CONFIGS = {
     "X": dict(kind="halo_async", scale=12, iters=120,
               label="async-exchange smoke (8-fake-device stale-"
                     "boundary halo solve)"),
+    "Y": dict(kind="serve", seed=7, queries=40, iters=5,
+              kill_batch=3, kill_device=5, drain_at=34,
+              label="serving smoke (8-fake-device query daemon under "
+                    "chaos: kill + SIGTERM drain, bit-identical "
+                    "replay)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "X", "N", "O", "Q", "R",
-                "S", "U", "V", "W", "F", "A", "B", "T", "P", "E", "BV",
-                "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "X", "Y", "N", "O", "Q",
+                "R", "S", "U", "V", "W", "F", "A", "B", "T", "P", "E",
+                "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -705,6 +710,20 @@ def run_live_smoke(key: str):
 
 PARTITIONED_SMOKE_BUDGET_S = 120.0
 
+# Budget for the serving smoke (seconds, measured around ONE chaos
+# load run — NOT the engine compile in start() or the f64-free replay
+# run): 40 virtual-clock queries on 256 vertices with one device kill,
+# a rescue + batch re-run, and a mid-load drain inside it.
+SERVE_SMOKE_BUDGET_S = 3.0
+
+# Every terminal outcome the serving daemon is allowed to hand back
+# (pagerank_tpu/serving/query.py). Anything else — in particular "" /
+# "unsettled" — is a silent drop, the failure class ISSUE 18 bans.
+SERVE_OUTCOMES = frozenset({
+    "answered", "answered_cache", "answered_degraded",
+    "shed_overload", "rejected_draining", "rejected_deadline",
+})
+
 # Budget for the elastic-rescue smoke (seconds, measured around the
 # chaos run itself — NOT the initial engine compile, the f64 oracle
 # pass, or a subprocess fallback's interpreter/jax import): a
@@ -882,6 +901,152 @@ def run_elastic_smoke(key: str):
         f"{sorted(elastic_counters)}; {t_run:.2f}s vs budget "
         f"{ELASTIC_SMOKE_BUDGET_S:g}s -> "
         f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+def run_serve_smoke(key: str):
+    """ISSUE-18 gate: the PPR query daemon under chaos on the
+    8-fake-device CPU mesh. Seed-deterministic load (virtual clock)
+    with one device kill mid-serve -> rescue + in-flight batch re-run
+    -> mid-load drain; every offered query must end in a typed outcome
+    (zero silent drops, zero hangs), and a second same-seed run must
+    replay bit-identically (admission log AND result digest). Then a
+    REAL SIGTERM through the PR-12 GracefulDrain handler: the answered
+    query stays answered, post-drain submits get typed Draining. The
+    serve.* counter plane must surface in the run report, and the
+    chaos run itself lands under SERVE_SMOKE_BUDGET_S."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        return _fake_mesh_subprocess(key, "serve",
+                                     "PAGERANK_SERVE_SMOKE_CHILD")
+
+    from pagerank_tpu import PageRankConfig, build_graph, jobs, obs
+    from pagerank_tpu.serving import PprServer, ServeConfig
+    from pagerank_tpu.testing.faults import DeviceFaultSchedule
+    from pagerank_tpu.testing.load import (QueryLoadGenerator,
+                                           install_serve_faults,
+                                           run_serve_load)
+    from pagerank_tpu.testing.schedules import VirtualClock
+    from pagerank_tpu.utils import synth
+
+    seed = spec["seed"]
+    ndev = min(8, len(jax.devices()))
+    src, dst = synth.rmat_edges(8, edge_factor=8, seed=3)
+    g = build_graph(src, dst, n=256)
+    cfg = PageRankConfig(num_iters=spec["iters"])
+
+    def serve_config(cache_capacity=64):
+        # wall_alpha=0 freezes the batch-wall EWMA at wall_initial_s:
+        # with the virtual clock, every shed/close decision is then a
+        # pure function of the seed (the determinism contract).
+        return ServeConfig(max_batch=4, queue_depth=16, deadline_ms=400.0,
+                           topk=8, wall_alpha=0.0, wall_initial_s=0.05,
+                           cache_capacity=cache_capacity,
+                           batch_margin_s=0.01)
+
+    def one_run():
+        clock = VirtualClock()
+        sched = DeviceFaultSchedule(
+            seed=seed, kill={spec["kill_batch"]: spec["kill_device"]}
+        )
+        srv = PprServer(g, config=cfg, serve_config=serve_config(),
+                        liveness_probe=sched.liveness_probe, clock=clock)
+        srv.start(dispatcher=False)
+        install_serve_faults(srv, sched, clock=clock, service_s=0.05)
+        plan = QueryLoadGenerator(seed=seed, num_queries=spec["queries"],
+                                  n=256, mean_gap_s=0.02, k=8).plan()
+        # The budget times the CHAOS LOAD itself — admissions, kill,
+        # rescue, re-run, drain — not the compile inside start().
+        t0 = time.perf_counter()
+        rep = run_serve_load(srv, clock, plan, drain_at=spec["drain_at"],
+                             drain_deadline_s=1.0)
+        rep["seconds"] = time.perf_counter() - t0
+        return rep
+
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    tracer = obs.enable_tracing()
+    try:
+        r1 = one_run()
+        r2 = one_run()
+        report = obs.build_run_report(
+            config=cfg, tracer=tracer, registry=obs.get_registry(),
+        )
+    finally:
+        obs.disable_tracing()
+
+    # Real-SIGTERM drain: the production exit path, with an actual
+    # signal through the installed handler — not a direct drain() call.
+    clock3 = VirtualClock()
+    srv3 = PprServer(g, config=cfg, serve_config=serve_config(0),
+                     clock=clock3)
+    srv3.start(dispatcher=False)
+    drained = False
+    with jobs.GracefulDrain(deadline_s=5.0) as drain:
+        q_before = srv3.submit(5, k=4)
+        clock3.advance(0.36)  # inside the close margin, before expiry
+        srv3.pump()
+        os.kill(os.getpid(), signal.SIGTERM)
+        try:
+            drain.check("serve-smoke")
+        except jobs.DrainInterrupt:
+            srv3.drain(deadline_s=drain.remaining())
+            drained = True
+        q_after = srv3.submit(6, k=4)
+        drain.finish()
+    sigterm_ok = bool(drained and q_before.outcome == "answered"
+                      and q_after.outcome == "rejected_draining")
+
+    counters = (report.get("metrics") or {}).get("counters") or {}
+    serve_counters = {k: v for k, v in counters.items()
+                      if k.startswith("serve.")}
+    outcomes_seen = set(r1["outcomes"]) | set(r2["outcomes"])
+    accounted = (r1["unsettled"] == 0 and r2["unsettled"] == 0
+                 and outcomes_seen <= SERVE_OUTCOMES)
+    replay_ok = (r1["results_digest"] == r2["results_digest"]
+                 and r1["admission_log"] == r2["admission_log"])
+    passed = bool(
+        accounted
+        and replay_ok
+        and r1["degraded"] and r1["device_count"] == ndev - 1
+        and r1["outcomes"].get("rejected_draining", 0) >= 1
+        and serve_counters.get("serve.rescues") == 2  # one per run
+        and serve_counters.get("serve.batch_reruns", 0) >= 2
+        and sigterm_ok
+        and r1["seconds"] <= SERVE_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "serve",
+        "label": spec["label"],
+        "devices": ndev,
+        "queries": spec["queries"],
+        "kill": {"batch": spec["kill_batch"],
+                 "device": spec["kill_device"]},
+        "outcomes": dict(r1["outcomes"]),
+        "unsettled": r1["unsettled"] + r2["unsettled"],
+        "degraded": r1["degraded"],
+        "surviving_devices": r1["device_count"],
+        "replay_identical": replay_ok,
+        "sigterm_drain_ok": sigterm_ok,
+        "serve_counters": serve_counters,
+        "seconds": r1["seconds"],
+        "budget_s": SERVE_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] {spec['queries']} queries on {ndev} fake devices, "
+        f"kill dev {spec['kill_device']} @ batch {spec['kill_batch']}: "
+        f"outcomes {dict(sorted(r1['outcomes'].items()))}, finished on "
+        f"{r1['device_count']} device(s); replay "
+        f"{'bit-identical' if replay_ok else 'DIVERGED'}; SIGTERM drain "
+        f"{'OK' if sigterm_ok else 'BAD'}; counters "
+        f"{sorted(serve_counters)}; {r1['seconds']:.2f}s vs budget "
+        f"{SERVE_SMOKE_BUDGET_S:g}s -> {'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
     return rec
@@ -2573,7 +2738,8 @@ def main(argv=None) -> int:
     runners = {"ppr": run_ppr, "e2e": run_e2e, "build": run_build_smoke,
                "faults": run_fault_smoke, "obs": run_obs_smoke,
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
-               "elastic": run_elastic_smoke, "halo": run_halo_smoke,
+               "elastic": run_elastic_smoke, "serve": run_serve_smoke,
+               "halo": run_halo_smoke,
                "halo_async": run_halo_async_smoke,
                "history": run_history_smoke,
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,

@@ -82,6 +82,99 @@ def test_ppr_wide_accum_f32_storage():
         np.testing.assert_allclose(full, expected[:, j], rtol=0, atol=3e-7)
 
 
+def _oracle_full(g, srcs, num_iters=20):
+    """f64 oracle vectors, one column per source."""
+    return ppr_cpu(g, np.asarray(srcs), num_iters=num_iters)
+
+
+def _f64_engine(g, num_iters=20, **kw):
+    cfg = PageRankConfig(num_iters=num_iters, dtype="float64",
+                         accum_dtype="float64", **kw)
+    return PprJaxEngine(cfg).build(g)
+
+
+def test_ppr_topk_k_clamped_to_n():
+    # k >= n must clamp to n and still return every vertex exactly once
+    # with oracle scores (the serving layer clamps the same way).
+    g = graph(seed=20, n=60, e=500)
+    srcs = np.array([3])
+    expected = _oracle_full(g, srcs)
+    res = _f64_engine(g).run(srcs, topk=10 * g.n)
+    assert res.topk_ids.shape == (1, g.n)
+    assert sorted(res.topk_ids[0].tolist()) == list(range(g.n))
+    full = np.zeros(g.n)
+    full[res.topk_ids[0]] = res.topk_scores[0]
+    np.testing.assert_allclose(full, expected[:, 0], rtol=0, atol=1e-12)
+
+
+def test_ppr_topk_tied_scores():
+    # A bidirectional 4-cycle with restart at one vertex: the two
+    # neighbors of the source are exactly symmetric, so their scores tie
+    # EXACTLY in f64. The top-k must return both tied ids with bit-equal
+    # scores and keep the score ordering descending.
+    fwd_src, fwd_dst = np.array([0, 1, 2, 3]), np.array([1, 2, 3, 0])
+    src = np.concatenate([fwd_src, fwd_dst])
+    dst = np.concatenate([fwd_dst, fwd_src])
+    g = build_graph(src, dst, n=4)
+    expected = _oracle_full(g, [0], num_iters=30)[:, 0]
+    res = _f64_engine(g, num_iters=30).run(np.array([0]), topk=4)
+    ids, scores = res.topk_ids[0], res.topk_scores[0]
+    assert np.all(np.diff(scores) <= 0)
+    np.testing.assert_allclose(scores, expected[ids], rtol=0, atol=1e-12)
+    # vertices 1 and 3 are symmetric around the source: exact tie in
+    # the oracle AND bit-equal in the engine's own output.
+    assert expected[1] == expected[3]
+    assert {1, 3} <= set(ids.tolist())
+    by_id = dict(zip(ids.tolist(), scores.tolist()))
+    assert by_id[1] == by_id[3]
+
+
+def test_ppr_dangling_heavy_graph():
+    # Most vertices dangling (no out-edges): the dangling-mass term
+    # dominates the update, in BOTH dangling policies.
+    rng = np.random.default_rng(21)
+    n = 120
+    src = rng.integers(0, 10, 400)  # only vertices 0..9 have out-edges
+    dst = rng.integers(0, n, 400)
+    g = build_graph(src, dst, n=n)
+    assert (g.out_degree == 0).sum() >= n - 10
+    srcs = np.array([4, 57])
+    for mode in ("source", "uniform"):
+        expected = ppr_cpu(g, srcs, num_iters=25, dangling_to=mode)
+        cfg = PageRankConfig(num_iters=25, dtype="float64",
+                             accum_dtype="float64")
+        res = PprJaxEngine(cfg, dangling_to=mode).build(g).run(
+            srcs, topk=g.n
+        )
+        for j in range(len(srcs)):
+            full = np.zeros(g.n)
+            full[res.topk_ids[j]] = res.topk_scores[j]
+            np.testing.assert_allclose(
+                full, expected[:, j], rtol=0, atol=1e-12
+            )
+
+
+def test_ppr_batch_with_repeated_source():
+    # The same source twice in one batch (the serving batcher pads with
+    # repeats): both lanes must produce identical answers, equal to the
+    # lane of a batch where it appears once.
+    g = graph(seed=22)
+    res = _f64_engine(g).run(np.array([9, 9, 40]), topk=20)
+    np.testing.assert_array_equal(res.topk_ids[0], res.topk_ids[1])
+    np.testing.assert_allclose(
+        res.topk_scores[0], res.topk_scores[1], rtol=0, atol=0
+    )
+    solo = _f64_engine(g).run(np.array([9]), topk=20)
+    np.testing.assert_array_equal(res.topk_ids[0], solo.topk_ids[0])
+    np.testing.assert_allclose(
+        res.topk_scores[0], solo.topk_scores[0], rtol=0, atol=0
+    )
+    expected = _oracle_full(g, [9])[:, 0]
+    np.testing.assert_allclose(
+        res.topk_scores[0], expected[res.topk_ids[0]], rtol=0, atol=1e-12
+    )
+
+
 def test_ppr_multi_stripe():
     # Force >1 stripe by shrinking the stripe cap; results must match the
     # single-stripe/oracle answer exactly in f64.

@@ -1,0 +1,254 @@
+"""Serving-layer tests (ISSUE 18): admission, cache, daemon, chaos
+determinism, contract form, CLI smoke."""
+
+import numpy as np
+import pytest
+
+from pagerank_tpu import PageRankConfig, build_graph
+from pagerank_tpu.serving import (AdmissionQueue, BatchWallModel, Draining,
+                                  Overloaded, PendingQuery, PprServer,
+                                  QueryDeadlineExceeded, ResultCache,
+                                  ServeConfig)
+from pagerank_tpu.testing.faults import DeviceFaultSchedule
+from pagerank_tpu.testing.load import (QueryLoadGenerator,
+                                       install_serve_faults,
+                                       run_serve_load)
+from pagerank_tpu.testing.schedules import VirtualClock
+from pagerank_tpu.utils import synth
+
+
+@pytest.fixture(scope="module")
+def graph():
+    src, dst = synth.rmat_edges(8, edge_factor=8, seed=3)
+    return build_graph(src, dst, n=256)
+
+
+def frozen_wall(wall_s):
+    return BatchWallModel(initial_s=wall_s, alpha=0.0)
+
+
+def pending(clock, qid=0, source=0, k=4, deadline_s=10.0):
+    now = clock()
+    return PendingQuery(qid=qid, source=source, k=k,
+                        deadline=now + deadline_s, t_submit=now)
+
+
+def serve_config(**kw):
+    base = dict(max_batch=4, queue_depth=16, deadline_ms=400.0, topk=8,
+                wall_alpha=0.0, wall_initial_s=0.05, cache_capacity=64,
+                batch_margin_s=0.01)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def make_server(graph, clock, liveness_probe=None, **sc_kw):
+    srv = PprServer(graph, config=PageRankConfig(num_iters=5),
+                    serve_config=serve_config(**sc_kw),
+                    liveness_probe=liveness_probe, clock=clock)
+    srv.start(dispatcher=False)
+    return srv
+
+
+# -- admission / wall model -------------------------------------------------
+
+
+def test_wall_model_alpha_zero_freezes():
+    m = frozen_wall(0.1)
+    m.observe(5.0)
+    assert m.estimate() == 0.1
+    m2 = BatchWallModel(initial_s=0.1, alpha=0.5)
+    m2.observe(0.3)
+    assert m2.estimate() == pytest.approx(0.2)
+
+
+def test_admission_rejects_when_queue_full():
+    clock = VirtualClock()
+    q = AdmissionQueue(max_batch=2, queue_depth=2,
+                       wall_model=frozen_wall(0.01), clock=clock)
+    q.offer(pending(clock, qid=0))
+    q.offer(pending(clock, qid=1))
+    with pytest.raises(Overloaded) as e:
+        q.offer(pending(clock, qid=2))
+    assert "queue full" in str(e.value)
+    assert e.value.retry_after_s > 0
+    assert e.value.outcome == "shed_overload"
+
+
+def test_admission_predictive_shed():
+    # Frozen 0.2s batch wall, one-query batches: the second query has
+    # two batches ahead of it (0.4s modeled) but only 0.3s of deadline
+    # left -> shed AT ADMISSION, with a truthful retry-after.
+    clock = VirtualClock()
+    q = AdmissionQueue(max_batch=1, queue_depth=64,
+                       wall_model=frozen_wall(0.2), clock=clock)
+    q.offer(pending(clock, qid=0, deadline_s=10.0))
+    with pytest.raises(Overloaded) as e:
+        q.offer(pending(clock, qid=1, deadline_s=0.3))
+    assert e.value.retry_after_s >= 0.1 - 1e-9
+    # The same deadline with an empty queue admits fine.
+    q2 = AdmissionQueue(max_batch=1, queue_depth=64,
+                        wall_model=frozen_wall(0.2), clock=clock)
+    q2.offer(pending(clock, qid=0, deadline_s=0.3))
+
+
+def test_batch_closes_at_max_size_or_deadline_margin():
+    clock = VirtualClock()
+    q = AdmissionQueue(max_batch=2, queue_depth=16, batch_margin_s=0.01,
+                       wall_model=frozen_wall(0.05), clock=clock)
+    assert q.try_close_batch() is None  # empty
+    q.offer(pending(clock, qid=0))
+    q.offer(pending(clock, qid=1))
+    batch = q.try_close_batch()  # full
+    assert [p.qid for p in batch] == [0, 1]
+    q.batch_done()
+    # One query, far deadline: accumulates until the margin is reached.
+    q.offer(pending(clock, qid=2, deadline_s=1.0))
+    assert q.try_close_batch() is None
+    clock.advance(0.95)  # remaining 0.05 <= wall 0.05 + margin 0.01
+    batch = q.try_close_batch()
+    assert [p.qid for p in batch] == [2]
+
+
+def test_drain_closes_admission_and_flushes_typed():
+    clock = VirtualClock()
+    q = AdmissionQueue(max_batch=4, queue_depth=16,
+                       wall_model=frozen_wall(0.05), clock=clock)
+    p0, p1 = pending(clock, qid=0), pending(clock, qid=1)
+    q.offer(p0)
+    q.offer(p1)
+    q.close()
+    with pytest.raises(Draining):
+        q.offer(pending(clock, qid=2))
+    # Draining also closes a partial batch (no arrivals will top it up).
+    batch = q.try_close_batch()
+    assert [p.qid for p in batch] == [0, 1]
+    q.batch_done()
+    # Whatever the drain deadline strands gets typed-rejected, not dropped.
+    p3 = pending(clock, qid=3)
+    q._queue.append(p3)  # bypass closed admission to stage a stranded query
+    assert q.flush_rejected(lambda _q: Draining("drain deadline")) == 1
+    assert p3.outcome == "rejected_draining"
+
+
+# -- result cache -----------------------------------------------------------
+
+
+def test_result_cache_lru_eviction_and_disable():
+    c = ResultCache(capacity=2)
+    k1, k2, k3 = [ResultCache.key("fp", s, ("p",), 4) for s in (1, 2, 3)]
+    c.put(k1, np.arange(4), np.ones(4))
+    c.put(k2, np.arange(4), np.ones(4))
+    assert c.get(k1) is not None  # touch: k1 becomes most-recent
+    c.put(k3, np.arange(4), np.ones(4))
+    assert c.get(k2) is None  # k2 was LRU -> evicted
+    assert c.get(k1) is not None and c.get(k3) is not None
+    off = ResultCache(capacity=0)
+    off.put(k1, np.arange(4), np.ones(4))
+    assert off.get(k1) is None and len(off) == 0
+
+
+# -- daemon (pump mode, virtual clock) --------------------------------------
+
+
+def test_server_answers_and_serves_repeat_from_cache(graph):
+    clock = VirtualClock()
+    srv = make_server(graph, clock)
+    q1 = srv.submit(7, k=4)
+    clock.advance(0.36)  # into the close margin, before expiry
+    assert srv.pump() == 1
+    assert q1.outcome == "answered"
+    ids1, scores1 = q1.result(timeout=0)
+    assert ids1.shape == (4,) and scores1.shape == (4,)
+    # Same (source, k, params): LRU hit at admission, never queued.
+    q2 = srv.submit(7, k=4)
+    assert q2.outcome == "answered_cache"
+    ids2, scores2 = q2.result(timeout=0)
+    np.testing.assert_array_equal(ids1, ids2)
+    np.testing.assert_array_equal(scores1, scores2)
+    srv.drain()
+
+
+def test_server_rejects_expired_in_queue_typed(graph):
+    clock = VirtualClock()
+    srv = make_server(graph, clock, cache_capacity=0)
+    q = srv.submit(3, k=4, deadline_s=0.1)
+    clock.advance(0.2)  # expires IN QUEUE
+    srv.pump()
+    assert q.outcome == "rejected_deadline"
+    with pytest.raises(QueryDeadlineExceeded):
+        q.result(timeout=0)
+    srv.drain()
+
+
+def test_server_submit_requires_start(graph):
+    srv = PprServer(graph, config=PageRankConfig(num_iters=5),
+                    serve_config=serve_config())
+    with pytest.raises(RuntimeError):
+        srv.submit(0)
+
+
+def test_server_rescues_and_reruns_inflight_batch(graph):
+    import jax
+
+    ndev = len(jax.devices())
+    clock = VirtualClock()
+    sched = DeviceFaultSchedule(seed=11, kill={0: 1})
+    srv = make_server(graph, clock, liveness_probe=sched.liveness_probe,
+                      cache_capacity=0)
+    install_serve_faults(srv, sched, clock=clock, service_s=0.05)
+    q = srv.submit(9, k=4)
+    clock.advance(0.36)
+    srv.pump()  # batch 0: kill -> rescue -> RE-RUN -> answered
+    assert q.outcome == "answered_degraded"
+    assert srv.degraded and srv.device_count == ndev - 1
+    assert srv.rescues_done == 1
+    srv.drain()
+
+
+def test_chaos_load_replays_bit_identical(graph):
+    import jax
+
+    ndev = len(jax.devices())
+
+    def one_run():
+        clock = VirtualClock()
+        sched = DeviceFaultSchedule(seed=7, kill={2: 5})
+        srv = PprServer(graph, config=PageRankConfig(num_iters=5),
+                        serve_config=serve_config(),
+                        liveness_probe=sched.liveness_probe, clock=clock)
+        srv.start(dispatcher=False)
+        install_serve_faults(srv, sched, clock=clock, service_s=0.05)
+        plan = QueryLoadGenerator(seed=7, num_queries=24, n=256,
+                                  mean_gap_s=0.02, k=8).plan()
+        return run_serve_load(srv, clock, plan, drain_at=20,
+                              drain_deadline_s=1.0)
+
+    r1, r2 = one_run(), one_run()
+    assert r1["unsettled"] == 0 and r2["unsettled"] == 0
+    assert r1["results_digest"] == r2["results_digest"]
+    assert r1["admission_log"] == r2["admission_log"]
+    assert r1["degraded"] and r1["device_count"] == ndev - 1
+    assert r1["outcomes"].get("rejected_draining", 0) >= 1
+    answered = sum(v for k, v in r1["outcomes"].items()
+                   if k.startswith("answered"))
+    assert answered >= 1
+
+
+# -- contract form + CLI ----------------------------------------------------
+
+
+def test_ppr_batch_contract_form_clean():
+    from pagerank_tpu.analysis.contracts import run_contracts
+
+    assert run_contracts(["ppr_batch"]) == []
+
+
+def test_serve_cli_smoke_in_process(capsys):
+    from pagerank_tpu import serve
+
+    rc = serve.main(["--serve-smoke", "6", "--scale", "6",
+                     "--edge-factor", "4", "--iters", "3",
+                     "--topk", "8", "--max-batch", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"unsettled": 0' in out
