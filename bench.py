@@ -1016,6 +1016,7 @@ def run_ppr_serve(args):
 
     from pagerank_tpu import PageRankConfig, build_graph
     from pagerank_tpu.serving import PprServer, ServeConfig
+    from pagerank_tpu.serving import qtrace
     from pagerank_tpu.testing.load import QueryLoadGenerator
     from pagerank_tpu.utils.synth import rmat_edges
 
@@ -1040,19 +1041,27 @@ def run_ppr_serve(args):
         deadline_range_s=(sc.deadline_ms / 1e3, sc.deadline_ms / 1e3),
     ).plan()
 
-    handles = []
-    t0 = time.perf_counter()
-    for gap_s, source, k, deadline_s in plan:
-        time.sleep(gap_s)
-        handles.append(server.submit(source, k=k, deadline_s=deadline_s))
-    # Settle: every handle resolves (answered or typed-rejected) —
-    # accounting identity, nothing silently dropped.
-    settle = sc.deadline_ms / 1e3 + sc.dispatch_timeout_s + 5.0
-    for q in handles:
-        q.wait(timeout=settle)
-    elapsed = time.perf_counter() - t0
-    rescues = server.rescues_done
-    server.drain()
+    # Query plane (ISSUE 19): armed for the measured window so the
+    # leg carries WHERE the tail lives, not just how long it is.
+    plane = qtrace.arm_query_plane()
+    try:
+        handles = []
+        t0 = time.perf_counter()
+        for gap_s, source, k, deadline_s in plan:
+            time.sleep(gap_s)
+            handles.append(
+                server.submit(source, k=k, deadline_s=deadline_s))
+        # Settle: every handle resolves (answered or typed-rejected) —
+        # accounting identity, nothing silently dropped.
+        settle = sc.deadline_ms / 1e3 + sc.dispatch_timeout_s + 5.0
+        for q in handles:
+            q.wait(timeout=settle)
+        elapsed = time.perf_counter() - t0
+        rescues = server.rescues_done
+        server.drain()
+        phase_p99_ms = plane.phase_p99_ms()
+    finally:
+        qtrace.disarm_query_plane()
 
     outcomes = {}
     lat_ms = []
@@ -1071,6 +1080,9 @@ def run_ppr_serve(args):
         "unit": "queries/s",
         "p50_ms": float(np.percentile(lat_ms, 50)) if lat_ms else None,
         "p99_ms": float(np.percentile(lat_ms, 99)) if lat_ms else None,
+        # ISSUE 19: per-phase p99 decomposition of the tail (query
+        # plane) — --history lifts each leg into *_p99_ms columns.
+        "phase_p99_ms": phase_p99_ms,
         "shed_fraction": shed / len(handles) if handles else 0.0,
         "rescues": rescues,
         "queries": len(handles),

@@ -108,6 +108,14 @@ LEG_METRICS = (
     "p50_ms",
     "p99_ms",
     "shed_fraction",
+    # ISSUE 19: the query plane's p99 phase decomposition — WHERE the
+    # serving tail lives (admission decision / queue wait / device
+    # dispatch / top-k fetch), carried on the same ppr_serve leg the
+    # chip-time session already gates, so a p99 miss names its phase.
+    "admission_wait_p99_ms",
+    "batch_wait_p99_ms",
+    "dispatch_p99_ms",
+    "fetch_p99_ms",
 )
 
 #: Profile scalars whose motion marks the DATA axis (classify_change
@@ -148,6 +156,12 @@ METRIC_BAD_DIRECTION = {
     "p50_ms": "up",
     "p99_ms": "up",
     "shed_fraction": "up",
+    # Query plane (ISSUE 19): any phase's tail growing is a regression
+    # in that leg of the serving pipeline.
+    "admission_wait_p99_ms": "up",
+    "batch_wait_p99_ms": "up",
+    "dispatch_p99_ms": "up",
+    "fetch_p99_ms": "up",
 }
 
 #: Env-fingerprint keys that define the SERIES a record belongs to:
@@ -491,6 +505,15 @@ def _normalize_ppr_serve(doc: dict, rec: dict) -> None:
         v = _num(doc.get(key))
         if v is not None:
             leg[key] = v
+    # Query plane (ISSUE 19): the per-phase p99 decomposition, folded
+    # into the same leg so the trend/gate read WHERE the tail lives.
+    phase = doc.get("phase_p99_ms")
+    if isinstance(phase, dict):
+        for short in ("admission_wait", "batch_wait", "dispatch",
+                      "fetch"):
+            v = _num(phase.get(short))
+            if v is not None:
+                leg[short + "_p99_ms"] = v
     if leg:
         rec["legs"]["ppr_serve"] = leg
     for key in ("queries", "rescues", "max_batch", "deadline_ms", "topk"):
@@ -1036,6 +1059,14 @@ _METRIC_SHORT = {
     "graph_topk_concentration": "topk conc",
     "sdc_check_overhead_pct": "sdc ovh %",
     "iters_to_tol": "iters to tol",
+    "queries_per_sec": "queries/s",
+    "p50_ms": "p50 ms",
+    "p99_ms": "p99 ms",
+    "shed_fraction": "shed frac",
+    "admission_wait_p99_ms": "adm p99 ms",
+    "batch_wait_p99_ms": "bwait p99 ms",
+    "dispatch_p99_ms": "disp p99 ms",
+    "fetch_p99_ms": "fetch p99 ms",
 }
 
 

@@ -121,6 +121,64 @@ def render_prometheus(registry: Optional[obs_metrics.MetricsRegistry]
     return "\n".join(lines) + "\n"
 
 
+def _exemplar_suffix(ex: Optional[dict]) -> str:
+    """OpenMetrics exemplar clause for one bucket line: `` # {labels}
+    value``. Empty when the bucket has no exemplar (a series without
+    exemplars is valid OpenMetrics)."""
+    if not ex:
+        return ""
+    trace_id = str(ex.get("trace_id", ""))
+    safe = trace_id.replace("\\", "\\\\").replace('"', '\\"')
+    return f' # {{trace_id="{safe}"}} {_prom_value(ex.get("value"))}'
+
+
+def render_openmetrics(registry: Optional[obs_metrics.MetricsRegistry]
+                       = None) -> str:
+    """The registry as OpenMetrics 1.0 text — the exemplar-capable
+    sibling of :func:`render_prometheus` (ISSUE 19). Differences the
+    format mandates: counter samples carry the ``_total`` suffix,
+    histogram bucket lines may carry ``# {trace_id="..."} value``
+    exemplar clauses (the query plane's bucket->trace links), and the
+    exposition ends with ``# EOF``. Same deterministic ordering and
+    the same NaN/+Inf/-Inf value spellings; scrapers that only speak
+    plain Prometheus keep the 0.0.4 renderer (no exemplars) — the
+    fallback mode :class:`MetricsExporter` defaults to."""
+    registry = registry if registry is not None else obs_metrics.get_registry()
+    lines: List[str] = []
+    for name, kind, help_text, snap in registry.export_view():
+        pname = _prom_name(name)
+        lines.append(f"# HELP {pname} {_prom_help(help_text or name)}")
+        lines.append(f"# TYPE {pname} {kind}")
+        if kind == "counter":
+            lines.append(f"{pname}_total {_prom_value(snap)}")
+        elif kind == "gauge":
+            if snap is None:
+                continue  # unset gauge: publish nothing, not NaN
+            lines.append(f"{pname} {_prom_value(snap)}")
+        else:  # histogram -> cumulative le-buckets (+ exemplars)
+            buckets = snap["buckets"]
+            exemplars = snap.get("exemplars", {})
+
+            def bound(key: str) -> float:
+                return float("inf") if key == "+inf" else float(int(key))
+            cum = 0
+            finite = (k for k in buckets if k != "+inf")
+            for key in sorted(finite, key=bound):
+                cum += buckets[key]
+                lines.append(
+                    f'{pname}_bucket{{le="{key}"}} {cum}'
+                    + _exemplar_suffix(exemplars.get(key))
+                )
+            lines.append(
+                f'{pname}_bucket{{le="+Inf"}} {snap["count"]}'
+                + _exemplar_suffix(exemplars.get("+inf"))
+            )
+            lines.append(f"{pname}_sum {_prom_value(snap['sum'])}")
+            lines.append(f"{pname}_count {snap['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
 def update_solve_gauges(iteration: int, info: dict,
                         seconds: Optional[float] = None) -> None:
     """Publish one iteration's headline scalars as registry gauges (the
@@ -220,11 +278,23 @@ class MetricsExporter:
     dependencies (http.server); the HTTP thread renders on demand, so
     a scrape always sees the current registry."""
 
+    FORMATS = ("prometheus", "openmetrics")
+    _CONTENT_TYPES = {
+        "prometheus": "text/plain; version=0.0.4",
+        "openmetrics":
+            "application/openmetrics-text; version=1.0.0; charset=utf-8",
+    }
+
     def __init__(self, textfile: Optional[str] = None,
                  port: Optional[int] = None,
-                 registry: Optional[obs_metrics.MetricsRegistry] = None):
+                 registry: Optional[obs_metrics.MetricsRegistry] = None,
+                 format: str = "prometheus"):
+        if format not in self.FORMATS:
+            raise ValueError(
+                f"format must be one of {self.FORMATS}, got {format!r}")
         self.textfile = textfile
         self.registry = registry
+        self.format = format
         self._server = None
         self._thread = None
         self.port = None
@@ -232,6 +302,8 @@ class MetricsExporter:
             self._start_http(port)
 
     def render(self) -> str:
+        if self.format == "openmetrics":
+            return render_openmetrics(self.registry)
         return render_prometheus(self.registry)
 
     def write_textfile(self) -> None:
@@ -256,7 +328,8 @@ class MetricsExporter:
                 body = exporter.render().encode("utf-8")
                 self.send_response(200)
                 self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
+                    "Content-Type",
+                    exporter._CONTENT_TYPES[exporter.format],
                 )
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

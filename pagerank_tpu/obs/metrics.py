@@ -87,7 +87,7 @@ class Histogram:
     device dispatch."""
 
     __slots__ = ("name", "help", "count", "sum", "min", "max", "buckets",
-                 "_lock")
+                 "exemplars", "_lock")
 
     kind = "histogram"
 
@@ -101,9 +101,15 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.buckets: Dict[str, int] = {}
+        # bucket key -> {"value", "trace_id"}: the last exemplar filed
+        # per bucket (ISSUE 19 — the OpenMetrics renderer attaches them
+        # so a tail bucket names a concrete trace to pull). Populated
+        # ONLY by trace-id-carrying records: the plain record(v) path
+        # is unchanged, which is the disarmed-tracing zero-cost pin.
+        self.exemplars: Dict[str, dict] = {}
         self._lock = threading.Lock()
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, trace_id: Optional[str] = None) -> None:
         v = float(v)
         if v <= 0:
             key = "0"
@@ -118,6 +124,8 @@ class Histogram:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.buckets[key] = self.buckets.get(key, 0) + 1
+            if trace_id is not None:
+                self.exemplars[key] = {"value": v, "trace_id": trace_id}
 
     #: Fixed quantile summaries published by snapshot() — what the
     #: Prometheus exporter (obs/live.py) and the run report surface as
@@ -131,6 +139,13 @@ class Histogram:
         with self._lock:
             return (self.count, self.sum, self.min, self.max,
                     dict(self.buckets))
+
+    def exemplars_view(self) -> Dict[str, dict]:
+        """Consistent copy of the per-bucket exemplars (empty unless
+        trace-id-carrying records happened — i.e. the query plane was
+        armed)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self.exemplars.items()}
 
     @staticmethod
     def _estimate(count: int, mn: float, mx: float,
@@ -160,7 +175,7 @@ class Histogram:
 
     def snapshot(self):
         count, total, mn, mx, buckets = self._state()
-        return {
+        out = {
             "count": count,
             "sum": total,
             "min": mn,
@@ -174,6 +189,12 @@ class Histogram:
                for q in self.QUANTILES},
             "buckets": buckets,
         }
+        ex = self.exemplars_view()
+        if ex:
+            # Key present only when armed: the snapshot shape every
+            # existing consumer pins stays byte-identical otherwise.
+            out["exemplars"] = ex
+        return out
 
 
 Metric = Union[Counter, Gauge, Histogram]

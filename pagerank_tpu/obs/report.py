@@ -63,10 +63,14 @@ SCHEMA_VERSION = 1
 #: check/breach/transient/sticky counts, the quarantined device ids,
 #: and the last breach's invariant detail (pagerank_tpu/sdc.py) —
 #: empty unless ``--sdc-check-every`` armed the plane.
+#: ``serving`` (ISSUE 19) is the query-plane section: settled-query
+#: count, phase p99 decomposition, and the flight-recorder dumps
+#: (serving/qtrace.report_section) — ``{"enabled": false}`` unless the
+#: query plane was armed.
 REPORT_KEYS = (
     "schema_version", "created_unix", "environment", "config", "spans",
     "metrics", "iterations", "summary", "robustness", "costs",
-    "devices", "lowering", "job", "graph", "sdc",
+    "devices", "lowering", "job", "graph", "sdc", "serving",
 )
 
 
@@ -162,6 +166,7 @@ def build_run_report(
     devices: Optional[dict] = None,
     lowering: Optional[dict] = None,
     job: Optional[dict] = None,
+    serving: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Assemble the report dict. Every section is optional — a bench
@@ -190,6 +195,14 @@ def build_run_report(
         from pagerank_tpu.obs import hlo as hlo_mod
 
         lowering = hlo_mod.ledger_snapshot()
+    if serving is None:
+        # Query plane (ISSUE 19): whatever the armed plane's flight
+        # recorder holds — {"enabled": False} on a disarmed (default)
+        # run. Lazy import: qtrace is stdlib+obs only, never the
+        # daemon or jax.
+        from pagerank_tpu.serving import qtrace as qtrace_mod
+
+        serving = qtrace_mod.report_section()
     report = {
         "schema_version": SCHEMA_VERSION,
         "created_unix": time.time(),
@@ -214,6 +227,7 @@ def build_run_report(
         # ``extra["sdc"]`` (pagerank_tpu/sdc.report_section); always
         # present, empty on a disarmed run.
         "sdc": {},
+        "serving": _json_safe(serving or {"enabled": False}),
     }
     if extra:
         report.update(_json_safe(extra))
@@ -338,6 +352,22 @@ def render_report(report: dict) -> str:
                    if lb.get("classified") else "")
                 + (f" (device {lb.get('device')})"
                    if lb.get("device") is not None else "")
+            )
+    sv = report.get("serving") or {}
+    if sv.get("enabled"):
+        p99 = sv.get("phase_p99_ms") or {}
+        dumps = sv.get("flight_dumps") or []
+        lines.append(
+            f"serving (query plane): {sv.get('settled', 0)} settled, "
+            f"{sv.get('slow_queries', 0)} slow; p99 ms "
+            + ", ".join(f"{k}={v:g}" for k, v in p99.items())
+        )
+        if dumps:
+            lines.append(
+                "  flight dumps: "
+                + ", ".join(
+                    f"{d.get('reason')}({len(d.get('traces') or [])})"
+                    for d in dumps)
             )
     jb = report.get("job") or {}
     if jb.get("stages"):

@@ -47,13 +47,23 @@ from pagerank_tpu.utils import fsio
 class Span:
     """One finished (or live) span. ``start``/``duration`` are seconds
     on the owning tracer's perf_counter timebase (relative to its
-    epoch); ``attrs`` is a plain JSON-able dict."""
+    epoch); ``attrs`` is a plain JSON-able dict.
+
+    ``trace_id`` / ``links`` are the cross-thread half (ISSUE 19):
+    spans opened by handle (:meth:`Tracer.start_span`) can belong to a
+    logical trace that hops threads — one served query's causal
+    timeline — and link to spans of OTHER traces (batch membership).
+    Both stay None on the classic context-manager path, so the
+    existing export shapes are byte-identical for untouched callers.
+    """
 
     __slots__ = ("span_id", "name", "start", "duration", "parent_id",
-                 "tid", "attrs")
+                 "tid", "attrs", "trace_id", "links")
 
     def __init__(self, span_id: int, name: str, start: float,
-                 parent_id: Optional[int], tid: int, attrs: dict):
+                 parent_id: Optional[int], tid: int, attrs: dict,
+                 trace_id: Optional[str] = None,
+                 links: Optional[List[str]] = None):
         self.span_id = span_id
         self.name = name
         self.start = start
@@ -61,13 +71,15 @@ class Span:
         self.parent_id = parent_id
         self.tid = tid
         self.attrs = attrs
+        self.trace_id = trace_id
+        self.links = links
 
     @property
     def end(self) -> float:
         return self.start + self.duration
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "type": "span",
             "id": self.span_id,
             "name": self.name,
@@ -77,6 +89,11 @@ class Span:
             "tid": self.tid,
             "attrs": self.attrs,
         }
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        if self.links:
+            out["links"] = list(self.links)
+        return out
 
 
 class _SpanCm:
@@ -130,6 +147,19 @@ class NullTracer:
     def span(self, name: str, **attrs):
         return _NULL_CM
 
+    def start_span(self, name: str, parent=None,
+                   trace_id: Optional[str] = None,
+                   tid: Optional[int] = None,
+                   start_s: Optional[float] = None,
+                   links: Optional[List[str]] = None, **attrs):
+        return None
+
+    def finish_span(self, span, end_s: Optional[float] = None) -> None:
+        pass
+
+    def set_thread_label(self, tid: int, label: str) -> None:
+        pass
+
     def add_span(self, name: str, start_pc: float, duration: float,
                  **attrs) -> None:
         pass
@@ -173,6 +203,7 @@ class Tracer:
         self._events: List[dict] = []
         self._counters: List[dict] = []
         self._track_labels: Dict[int, str] = {}
+        self._thread_labels: Dict[int, str] = {}
         self._local = threading.local()
         self._next_id = 0
 
@@ -215,6 +246,55 @@ class Tracer:
                 pass
         with self._lock:
             self._spans.append(sp)
+
+    # -- explicit span handles (ISSUE 19: cross-thread traces) -------------
+
+    def start_span(self, name: str, parent=None,
+                   trace_id: Optional[str] = None,
+                   tid: Optional[int] = None,
+                   start_s: Optional[float] = None,
+                   links: Optional[List[str]] = None, **attrs) -> Span:
+        """Open a span BY HANDLE, parented explicitly instead of by the
+        thread-local stack — the primitive that lets one logical trace
+        cross the ingress -> admission -> dispatch -> response thread
+        hops (the serving query plane). ``parent`` is a Span or a span
+        id (None = root); ``tid`` pins the Chrome lane (default: the
+        calling thread); ``start_s`` is an explicit start on the
+        tracer's epoch timebase for pre-measured phases (default: now).
+        The handle is NOT pushed on any thread-local stack — nested
+        ``span()`` context managers on this thread are unaffected.
+        Finish with :meth:`finish_span`."""
+        if parent is not None and isinstance(parent, Span):
+            parent = parent.span_id
+        sp = Span(
+            self._new_id(), name,
+            (time.perf_counter() - self.epoch_pc
+             if start_s is None else float(start_s)),
+            parent,
+            threading.get_ident() if tid is None else int(tid),
+            dict(attrs),
+            trace_id=trace_id,
+            links=list(links) if links else None,
+        )
+        return sp
+
+    def finish_span(self, span: Span,
+                    end_s: Optional[float] = None) -> None:
+        """Record a handle opened by :meth:`start_span`; ``end_s`` is
+        an explicit end on the epoch timebase (default: now). Safe from
+        any thread — the handle carries its own parentage."""
+        end = (time.perf_counter() - self.epoch_pc
+               if end_s is None else float(end_s))
+        span.duration = max(0.0, end - span.start)
+        with self._lock:
+            self._spans.append(span)
+
+    def set_thread_label(self, tid: int, label: str) -> None:
+        """Name one tid's lane in the Chrome export (a ``thread_name``
+        metadata event) — the per-thread lanes of the serving trace
+        (ingress / dispatch / harness)."""
+        with self._lock:
+            self._thread_labels.setdefault(int(tid), label)
 
     def add_span(self, name: str, start_pc: float, duration: float,
                  **attrs) -> None:
@@ -341,6 +421,13 @@ class Tracer:
         pid = os.getpid()
         out = []
         for sp in self.spans():
+            args = sp.attrs
+            if sp.trace_id is not None or sp.links:
+                args = dict(sp.attrs)
+                if sp.trace_id is not None:
+                    args["trace_id"] = sp.trace_id
+                if sp.links:
+                    args["links"] = list(sp.links)
             out.append({
                 "name": sp.name,
                 "cat": sp.name.split("/", 1)[0],
@@ -349,7 +436,7 @@ class Tracer:
                 "dur": sp.duration * 1e6,
                 "pid": pid,
                 "tid": sp.tid,
-                "args": sp.attrs,
+                "args": args,
             })
         for ev in self.events():
             out.append({
@@ -368,11 +455,22 @@ class Tracer:
         # counters ride the process pid.
         with self._lock:
             labels = dict(self._track_labels)
+            thread_labels = dict(self._thread_labels)
         for track, label in sorted(labels.items()):
             out.append({
                 "name": "process_name",
                 "ph": "M",
                 "pid": track,
+                "args": {"name": label},
+            })
+        # Thread lanes: set_thread_label names a tid's row (the serving
+        # trace's ingress / dispatch / harness lanes).
+        for tid, label in sorted(thread_labels.items()):
+            out.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
                 "args": {"name": label},
             })
         for c in self.counters():

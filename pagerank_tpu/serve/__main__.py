@@ -62,6 +62,23 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--serve-smoke", type=int, default=None, metavar="N",
                    help="self-test: run N in-process queries, print a "
                    "JSON summary, exit (no HTTP)")
+    o = p.add_argument_group("query plane (observability)")
+    o.add_argument("--metrics-format", choices=("prometheus", "openmetrics"),
+                   default="prometheus",
+                   help="/metrics text format; openmetrics carries "
+                   "trace-id exemplars on latency buckets")
+    o.add_argument("--slow-query-ms", type=float, default=None,
+                   help="log queries slower than this as strict JSONL "
+                   "phase breakdowns (arms the query plane)")
+    o.add_argument("--slow-query-log", default=None, metavar="PATH",
+                   help="slow-query JSONL destination (default stderr "
+                   "is NOT used; requires a path when set)")
+    o.add_argument("--query-trace", default=None, metavar="PATH",
+                   help="export a Chrome trace of per-query spans "
+                   "(one lane per thread) at shutdown")
+    o.add_argument("--run-report", default=None, metavar="PATH",
+                   help="write the run report (with the serving flight "
+                   "recorder section) here on SIGTERM drain")
     return p
 
 
@@ -119,6 +136,16 @@ def _run_smoke(server, args) -> int:
     return int(ExitCode.OK) if unsettled == 0 else int(ExitCode.FAILURE)
 
 
+def _write_run_report(path: str) -> None:
+    """Dump the run report (serving flight-recorder section included —
+    ``build_run_report`` picks up the armed query plane by default)."""
+    from pagerank_tpu.obs.report import build_run_report
+
+    with open(path, "w") as f:
+        json.dump(build_run_report(), f, sort_keys=True)
+        f.write("\n")
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -126,6 +153,24 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return int(ExitCode.USAGE)
+
+    # Query plane (ISSUE 19): armed only on request — the disarmed
+    # daemon keeps its zero-tracer-call hot path (the booby-trap pin).
+    plane_armed = (args.slow_query_ms is not None or args.query_trace
+                   or args.run_report)
+    tracer = None
+    if plane_armed:
+        from pagerank_tpu.serving import qtrace
+
+        if args.query_trace:
+            import threading
+
+            from pagerank_tpu.obs import trace as obs_trace
+
+            tracer = obs_trace.enable_tracing()
+            tracer.set_thread_label(threading.get_ident(), "serve-main")
+        qtrace.arm_query_plane(slow_query_ms=args.slow_query_ms,
+                               slow_query_path=args.slow_query_log)
 
     # SIGTERM/SIGINT handlers live ONLY around entry points (PTL008);
     # a drain request surfaces as DrainInterrupt at the poll below and
@@ -142,7 +187,8 @@ def main(argv=None) -> int:
             if args.metrics_port is not None:
                 from pagerank_tpu.obs.live import MetricsExporter
 
-                exporter = MetricsExporter(port=args.metrics_port)
+                exporter = MetricsExporter(port=args.metrics_port,
+                                           format=args.metrics_format)
             with QueryIngress(server, port=args.port) as ingress:
                 print(
                     f"serving PPR on http://127.0.0.1:{ingress.port}/ppr "
@@ -163,12 +209,26 @@ def main(argv=None) -> int:
         except jobs.DrainInterrupt:
             flushed = server.drain(deadline_s=drain.remaining())
             spent = drain.finish()
+            if args.run_report:
+                # Black-box dump: the drain just pushed a flight-recorder
+                # snapshot; persist it before the process exits.
+                _write_run_report(args.run_report)
             print(
                 f"drained: admission closed, {flushed} queued "
                 f"query(ies) typed-rejected, {spent:.2f}s spent "
                 f"(exit {int(ExitCode.INTERRUPTED)})"
             )
             return int(ExitCode.INTERRUPTED)
+        finally:
+            if tracer is not None:
+                from pagerank_tpu.obs import trace as obs_trace
+
+                obs_trace.disable_tracing()
+                tracer.export_chrome(args.query_trace)
+            if plane_armed:
+                from pagerank_tpu.serving import qtrace
+
+                qtrace.disarm_query_plane()
 
 
 if __name__ == "__main__":

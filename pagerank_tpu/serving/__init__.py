@@ -26,12 +26,31 @@ query (docs/ROBUSTNESS.md "Serving"):
 Telemetry rides the existing planes: ``serve.*`` counters/gauges and
 the ``serve.latency_ms`` histogram through the PR 5 exporter, and a
 ``ppr_serve`` leg in the perf ledger (``bench.py --ppr-serve``).
+
+The **query plane** (ISSUE 19, :mod:`pagerank_tpu.serving.qtrace`) is
+the serving-side observability sibling: one cross-thread trace per
+query (W3C ``traceparent`` in/out over HTTP), exemplar trace ids on the
+latency histogram's tail buckets, a slow-query JSONL log, and a
+flight-recorder ring dumped into the run report on drain/rescue/crash.
+It is DISARMED by default — the hot admission/dispatch path then makes
+zero tracer or exemplar calls.
 """
 
-from pagerank_tpu.serving.admission import AdmissionQueue, BatchWallModel
+from pagerank_tpu.serving.admission import (
+    AdmissionQueue,
+    BatchWallModel,
+    ClosedBatch,
+)
 from pagerank_tpu.serving.cache import ResultCache
 from pagerank_tpu.serving.daemon import PprServer, ServeConfig
 from pagerank_tpu.serving.http import QueryIngress
+from pagerank_tpu.serving.qtrace import (
+    QueryPlane,
+    QueryTrace,
+    arm_query_plane,
+    disarm_query_plane,
+    get_query_plane,
+)
 from pagerank_tpu.serving.query import (
     Draining,
     Overloaded,
@@ -43,13 +62,19 @@ from pagerank_tpu.serving.query import (
 __all__ = [
     "AdmissionQueue",
     "BatchWallModel",
+    "ClosedBatch",
     "Draining",
     "Overloaded",
     "PendingQuery",
     "PprServer",
     "QueryDeadlineExceeded",
     "QueryIngress",
+    "QueryPlane",
+    "QueryTrace",
     "ResultCache",
     "ServeConfig",
     "ServeRejected",
+    "arm_query_plane",
+    "disarm_query_plane",
+    "get_query_plane",
 ]

@@ -24,7 +24,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from pagerank_tpu.obs import metrics as obs_metrics
 from pagerank_tpu.serving.query import (Draining, Overloaded,
@@ -56,6 +56,20 @@ class BatchWallModel:
     def estimate(self) -> float:
         with self._lock:
             return self._estimate
+
+
+class ClosedBatch(list):
+    """One closed batch of :class:`PendingQuery`. A plain list (every
+    existing consumer indexes/iterates it unchanged) that additionally
+    carries WHY it closed — 'full' / 'deadline' / 'drain' — so the
+    query plane can attribute batch-wait tails to the close policy
+    instead of discarding the reason at the pop."""
+
+    __slots__ = ("close_reason",)
+
+    def __init__(self, queries, close_reason: str):
+        super().__init__(queries)
+        self.close_reason = close_reason
 
 
 class AdmissionQueue:
@@ -153,24 +167,25 @@ class AdmissionQueue:
                 return "drain"
             return None
 
-    def _pop_batch(self) -> List[PendingQuery]:
+    def _pop_batch(self, reason: str) -> ClosedBatch:
         with self._cond:
             batch = []
             while self._queue and len(batch) < self.max_batch:
                 batch.append(self._queue.popleft())
             self._depth_gauge.set(len(self._queue))
             self._in_flight += 1
-            return batch
+            return ClosedBatch(batch, reason)
 
-    def try_close_batch(self) -> Optional[List[PendingQuery]]:
+    def try_close_batch(self) -> Optional[ClosedBatch]:
         """Non-blocking close check (the harness pump / drain loop)."""
         with self._cond:
-            if self._close_reason(self._clock()) is None:
+            reason = self._close_reason(self._clock())
+            if reason is None:
                 return None
-            return self._pop_batch()
+            return self._pop_batch(reason)
 
     def next_batch(self, poll_s: float = 0.05
-                   ) -> Optional[List[PendingQuery]]:
+                   ) -> Optional[ClosedBatch]:
         """Block until a batch closes (daemon dispatcher loop); None
         once :meth:`stop` was called and the queue is empty. The wait
         is bounded by the time to the oldest query's close point, so
@@ -178,8 +193,9 @@ class AdmissionQueue:
         with self._cond:
             while True:
                 now = self._clock()
-                if self._close_reason(now) is not None:
-                    return self._pop_batch()
+                reason = self._close_reason(now)
+                if reason is not None:
+                    return self._pop_batch(reason)
                 if self._stopped and not self._queue:
                     return None
                 timeout = poll_s

@@ -11,6 +11,13 @@ numbers, only slower, so hits stay valid across a rescue).
 Thread discipline (PTR001): a single lock guards the OrderedDict; the
 stored arrays are immutable by convention (the daemon stores the
 device-fetched numpy copies and hands the same objects back).
+
+Query plane (ISSUE 19): the daemon wraps every admission-time lookup
+in a ``query/cache`` phase (attr ``hit``) on the query's trace — a
+cache-hit settle is ``answered_cache`` with a one-phase timeline, so
+even never-queued queries carry a complete causal record. The cache
+itself stays observability-free beyond its aggregate hit/miss
+counters: it cannot see the querying context, only keys.
 """
 
 from __future__ import annotations

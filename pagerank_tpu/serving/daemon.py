@@ -51,6 +51,7 @@ from pagerank_tpu.parallel import mesh as mesh_lib
 from pagerank_tpu.parallel.elastic import (DeviceLostError,
                                            ElasticExhaustedError,
                                            looks_like_device_loss)
+from pagerank_tpu.serving import qtrace
 from pagerank_tpu.serving.admission import AdmissionQueue, BatchWallModel
 from pagerank_tpu.serving.cache import ResultCache
 from pagerank_tpu.serving.query import (Draining, PendingQuery,
@@ -273,11 +274,15 @@ class PprServer:
     # -- submit side --------------------------------------------------------
 
     def submit(self, source: int, k: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> PendingQuery:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> PendingQuery:
         """Admit one query. ALWAYS returns a :class:`PendingQuery` —
         rejections settle the handle with the typed error instead of
         raising here, so every submission has exactly one terminal
-        outcome to account for (the zero-silent-drops ledger)."""
+        outcome to account for (the zero-silent-drops ledger).
+        ``trace_id`` adopts an upstream W3C trace id (the HTTP
+        ``traceparent``); every outcome carries ``q.trace_id`` either
+        way, armed or not."""
         with self._state_lock:
             started = self._started
         if not started:
@@ -293,31 +298,76 @@ class PprServer:
             self._next_qid += 1
         q = PendingQuery(qid=qid, source=int(source), k=k,
                          deadline=now + deadline_s, t_submit=now)
+        if trace_id is not None:
+            q.set_trace_id(trace_id)
+        # Query plane (ISSUE 19): tr stays None while disarmed, and
+        # every tracing branch below gates on it — the disarmed hot
+        # path is byte-identical to the untraced one (booby-trap test).
+        plane = qtrace.get_query_plane()
+        tr = None
+        if plane is not None:
+            tr = q.trace = plane.new_trace(
+                q.qid, q.source, q.trace_id, start_s=now
+            )
 
         key = ResultCache.key(self._graph_fp, q.source, self._params_key, k)
+        if tr is not None:
+            t_c0 = self._clock()
         hit = self.cache.get(key)
         if hit is not None:
             self._c_accepted.inc()
             self._c_answered_cache.inc()
             q.resolve(hit[0], hit[1], "cache", self._clock())
-            self._h_latency.record(1000.0 * (q.latency_s or 0.0))
+            lat_ms = 1000.0 * (q.latency_s or 0.0)
+            if tr is not None:
+                tr.phase("query/cache", t_c0, self._clock() - t_c0,
+                         hit=True)
+                self._h_latency.record(lat_ms, trace_id=q.trace_id)
+                plane.settle(tr, "answered_cache", self._clock(), lat_ms)
+            else:
+                self._h_latency.record(lat_ms)
             return q
+        if tr is not None:
+            tr.phase("query/cache", t_c0, self._clock() - t_c0, hit=False)
+            t_a0 = self._clock()
         try:
             self.queue.offer(q)
         except Draining as e:
             self._c_rej_draining.inc()
-            q.reject(e, self._clock())
+            now2 = self._clock()
+            q.reject(e, now2)
+            if tr is not None:
+                tr.phase("query/admission", t_a0, now2 - t_a0,
+                         decision="rejected_draining")
+                plane.settle(tr, "rejected_draining", now2,
+                             1000.0 * (q.latency_s or 0.0))
             return q
         except ServeRejected as e:  # Overloaded
             self._c_shed.inc()
-            q.reject(e, self._clock())
+            now2 = self._clock()
+            q.reject(e, now2)
+            if tr is not None:
+                tr.phase("query/admission", t_a0, now2 - t_a0,
+                         decision="shed_overload")
+                plane.settle(tr, "shed_overload", now2,
+                             1000.0 * (q.latency_s or 0.0))
             return q
         self._c_accepted.inc()
+        if tr is not None:
+            now2 = self._clock()
+            tr.phase("query/admission", t_a0, now2 - t_a0,
+                     decision="admitted")
+            tr.t_admitted = now2
         return q
 
     # -- dispatch side ------------------------------------------------------
 
     def _dispatch_loop(self) -> None:
+        if qtrace.get_query_plane() is not None:
+            from pagerank_tpu.obs import trace as obs_trace
+            obs_trace.get_tracer().set_thread_label(
+                threading.get_ident(), "serve-dispatch"
+            )
         while True:
             batch = self.queue.next_batch()
             if batch is None:
@@ -387,6 +437,8 @@ class PprServer:
 
     def _serve_batch(self, batch: List[PendingQuery]) -> None:
         sc = self.serve_config
+        plane = qtrace.get_query_plane()
+        close_reason = getattr(batch, "close_reason", None)
         now = self._clock()
         live = []
         for q in batch:
@@ -395,18 +447,45 @@ class PprServer:
                 q.reject(QueryDeadlineExceeded(
                     f"deadline passed in-queue "
                     f"({now - q.deadline:.3f}s late)"), now)
+                tr = q.trace
+                if tr is not None:
+                    if tr.t_admitted is not None:
+                        tr.phase("query/batch_wait", tr.t_admitted,
+                                 now - tr.t_admitted,
+                                 close_reason=close_reason, expired=True)
+                    if plane is not None:
+                        plane.settle(tr, "rejected_deadline", now,
+                                     1000.0 * (q.latency_s or 0.0))
             else:
                 live.append(q)
         if not live:
             return
         self._g_occupancy.set(len(live) / sc.max_batch)
 
+        traced = [q for q in live if q.trace is not None]
+        if traced:
+            # Batch membership: every member's trace links to its
+            # batch-mates' trace ids (the span-link half of the plane).
+            members = [q.trace_id for q in live]
+            for q in traced:
+                tr = q.trace
+                if tr.t_admitted is not None:
+                    tr.phase("query/batch_wait", tr.t_admitted,
+                             now - tr.t_admitted,
+                             close_reason=close_reason,
+                             batch_size=len(live))
+                for m in members:
+                    if m != q.trace_id:
+                        tr.link(m)
+
         sources = np.full(sc.max_batch, live[0].source, np.int64)
         sources[: len(live)] = [q.source for q in live]
 
         rerun = False
+        attempts = 0
         while True:
             t0 = self._clock()
+            attempts += 1
             try:
                 ids, scores = mesh_lib.run_with_deadline(
                     lambda: self._execute(sources), sc.dispatch_timeout_s
@@ -420,6 +499,14 @@ class PprServer:
                     q.reject(QueryDeadlineExceeded(
                         f"device dispatch exceeded its "
                         f"{sc.dispatch_timeout_s}s bound: {e}"), now)
+                    tr = q.trace
+                    if tr is not None:
+                        tr.phase("query/dispatch", t0, now - t0,
+                                 error="DeadlineExpired",
+                                 attempts=attempts)
+                        if plane is not None:
+                            plane.settle(tr, "rejected_deadline", now,
+                                         1000.0 * (q.latency_s or 0.0))
                 return
             except Exception as e:  # noqa: BLE001 - classified below
                 if not (isinstance(e, DeviceLostError)
@@ -434,19 +521,38 @@ class PprServer:
                     for q in live:
                         q.reject(ServeRejected(
                             f"serving terminal: {term}"), now)
+                        tr = q.trace
+                        if tr is not None:
+                            tr.phase("query/dispatch", t0, now - t0,
+                                     error="ElasticExhausted",
+                                     attempts=attempts)
+                            if plane is not None:
+                                plane.settle(
+                                    tr, "rejected", now,
+                                    1000.0 * (q.latency_s or 0.0))
                     self.queue.stop()
+                    if plane is not None:
+                        plane.flight_dump("fatal")
                     return
                 rerun = True  # RE-RUN the same in-flight batch
+                if plane is not None:
+                    plane.flight_dump("rescue")
         wall = self._clock() - t0
         self.wall_model.observe(wall)
         self._c_batches.inc()
         if rerun:
             self._c_reruns.inc()
+        for q in traced:
+            q.trace.phase("query/dispatch", t0, wall, rerun=rerun,
+                          attempts=attempts)
 
         degraded = self.degraded
         served_from = "degraded" if degraded else "compute"
         now = self._clock()
         for i, q in enumerate(live):
+            tr = q.trace
+            if tr is not None:
+                t_f0 = self._clock()
             q_ids = np.array(ids[i, : q.k])
             q_scores = np.array(scores[i, : q.k])
             key = ResultCache.key(
@@ -457,7 +563,14 @@ class PprServer:
             self._c_answered.inc()
             if degraded:
                 self._c_answered_degraded.inc()
-            self._h_latency.record(1000.0 * (q.latency_s or 0.0))
+            lat_ms = 1000.0 * (q.latency_s or 0.0)
+            if tr is not None:
+                tr.phase("query/fetch", t_f0, self._clock() - t_f0)
+                self._h_latency.record(lat_ms, trace_id=q.trace_id)
+                if plane is not None:
+                    plane.settle(tr, q.outcome, now, lat_ms)
+            else:
+                self._h_latency.record(lat_ms)
 
     # -- drain side ---------------------------------------------------------
 
@@ -478,18 +591,26 @@ class PprServer:
             while self._clock() < t_end and len(self.queue) > 0:
                 if self.pump() == 0:
                     break
-        flushed = self.queue.flush_rejected(
-            lambda q: Draining(
+        plane = qtrace.get_query_plane()
+
+        def _drain_reject(q: PendingQuery) -> Draining:
+            if plane is not None and q.trace is not None:
+                plane.settle(q.trace, "rejected_draining",
+                             self._clock(), None)
+            return Draining(
                 "drain deadline reached before this query's batch "
                 "dispatched; retry against another replica"
             )
-        )
+
+        flushed = self.queue.flush_rejected(_drain_reject)
         self._c_rej_draining.inc(flushed)
         if self._dispatcher is not None:
             # Queue is now empty + stopped: the thread exits its wait
             # promptly; join for real (PTR005).
             self._dispatcher.join()
             self._dispatcher = None
+        if plane is not None:
+            plane.flight_dump("drain")
         return flushed
 
     def stop(self) -> None:

@@ -22,6 +22,7 @@ answered_degraded
 from __future__ import annotations
 
 import json
+import re
 import threading
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -38,6 +39,34 @@ _STATUS = {
     "rejected": 500,
 }
 
+# W3C trace-context level-1: version-traceid-parentid-flags, lowercase
+# hex, all-zero trace/parent ids invalid (the spec's "not a trace").
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[str]:
+    """The trace id of a valid ``traceparent`` header, else None
+    (malformed headers degrade to a server-assigned id, never a 4xx —
+    trace context is best-effort metadata)."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    trace_id, parent_id = m.group(1), m.group(2)
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return trace_id
+
+
+def format_traceparent(q) -> str:
+    """The response ``traceparent``: the query's trace id with the
+    server's span id (a deterministic function of the qid, matching
+    the trace-id fallback) and the sampled flag."""
+    return "00-%s-%016x-01" % (q.trace_id, (q.qid + 1) & (2 ** 64 - 1))
+
 
 def _query_payload(q, ids, scores) -> dict:
     return {
@@ -46,6 +75,7 @@ def _query_payload(q, ids, scores) -> dict:
         "k": q.k,
         "outcome": q.outcome,
         "served_from": q.served_from,
+        "trace_id": q.trace_id,
         "latency_ms": round(1000.0 * (q.latency_s or 0.0), 3),
         "ids": [int(i) for i in ids],
         "scores": [float(s) for s in scores],
@@ -67,7 +97,7 @@ class QueryIngress:
         self.port: Optional[int] = None
         self._start(port)
 
-    def _handle_ppr(self, params: dict):
+    def _handle_ppr(self, params: dict, traceparent: Optional[str] = None):
         try:
             source = int(params["source"][0])
         except (KeyError, ValueError, IndexError):
@@ -86,7 +116,13 @@ class QueryIngress:
                 return 400, {"error": "non-numeric 'deadline_ms'"}
 
         srv = self.server
-        q = srv.submit(source, k=k, deadline_s=deadline_s)
+        q = srv.submit(source, k=k, deadline_s=deadline_s,
+                       trace_id=parse_traceparent(traceparent))
+        if q.trace is not None:
+            from pagerank_tpu.obs import trace as obs_trace
+            obs_trace.get_tracer().set_thread_label(
+                threading.get_ident(), "serve-http"
+            )
         # Settlement is guaranteed typed; the bound below only trips if
         # that contract is broken (surfaced as a 500, not a hang).
         settle_bound = (
@@ -97,13 +133,22 @@ class QueryIngress:
             ids, scores = q.result(timeout=settle_bound)
         except Overloaded as e:
             return 429, {"error": str(e), "outcome": e.outcome,
+                         "qid": q.qid, "trace_id": q.trace_id,
                          "retry_after_s": e.retry_after_s}
         except ServeRejected as e:
             return (_STATUS.get(e.outcome, 500),
-                    {"error": str(e), "outcome": e.outcome})
+                    {"error": str(e), "outcome": e.outcome,
+                     "qid": q.qid, "trace_id": q.trace_id})
         except TimeoutError as e:
-            return 500, {"error": str(e), "outcome": "unsettled"}
-        return 200, _query_payload(q, ids, scores)
+            return 500, {"error": str(e), "outcome": "unsettled",
+                         "qid": q.qid, "trace_id": q.trace_id}
+        tr = q.trace
+        if tr is not None:
+            t0 = srv._clock()
+        payload = _query_payload(q, ids, scores)
+        if tr is not None:
+            tr.phase("query/serialize", t0, srv._clock() - t0)
+        return 200, payload
 
     def _handle_healthz(self):
         srv = self.server
@@ -129,7 +174,8 @@ class QueryIngress:
                 parsed = urlparse(self.path)
                 if parsed.path == "/ppr":
                     status, payload = ingress._handle_ppr(
-                        parse_qs(parsed.query)
+                        parse_qs(parsed.query),
+                        traceparent=self.headers.get("traceparent"),
                     )
                 elif parsed.path == "/healthz":
                     status, payload = ingress._handle_healthz()
@@ -144,6 +190,14 @@ class QueryIngress:
                     self.send_header(
                         "Retry-After",
                         str(max(1, int(round(payload["retry_after_s"]))))
+                    )
+                if "trace_id" in payload:
+                    self.send_header(
+                        "traceparent",
+                        "00-%s-%016x-01" % (
+                            payload["trace_id"],
+                            (payload.get("qid", 0) + 1) & (2 ** 64 - 1),
+                        ),
                     )
                 self.end_headers()
                 self.wfile.write(body)

@@ -75,7 +75,7 @@ class PendingQuery:
 
     __slots__ = ("qid", "source", "k", "deadline", "t_submit",
                  "_lock", "_done", "_ids", "_scores", "_error",
-                 "_served_from", "_latency_s")
+                 "_served_from", "_latency_s", "trace", "_trace_id")
 
     def __init__(self, qid: int, source: int, k: int, deadline: float,
                  t_submit: float):
@@ -84,6 +84,10 @@ class PendingQuery:
         self.k = int(k)
         self.deadline = float(deadline)  # absolute, on the server clock
         self.t_submit = float(t_submit)
+        # Query plane (ISSUE 19): None while disarmed — every tracing
+        # call site gates on it, so the hot path pays one attr read.
+        self.trace = None
+        self._trace_id: Optional[str] = None
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._ids = None
@@ -127,6 +131,22 @@ class PendingQuery:
             if self._error is not None:
                 raise self._error
             return self._ids, self._scores
+
+    @property
+    def trace_id(self) -> str:
+        """Stable W3C-shaped trace id: the caller's ``traceparent``
+        override when one arrived, else a deterministic function of
+        the qid (``qid+1`` as 32 hex digits — never the spec's
+        all-zero invalid value). A plain property read: no tracer or
+        plane call, so every typed outcome carries an id even with
+        the query plane disarmed."""
+        if self._trace_id is not None:
+            return self._trace_id
+        return format(self.qid + 1, "032x")
+
+    def set_trace_id(self, trace_id: str) -> None:
+        """Adopt an upstream trace id (the HTTP ``traceparent``)."""
+        self._trace_id = trace_id
 
     @property
     def outcome(self) -> str:

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from pagerank_tpu.parallel.elastic import DeviceLostError
+from pagerank_tpu.serving import qtrace
 from pagerank_tpu.serving.daemon import PprServer
 from pagerank_tpu.testing.faults import DeviceFaultSchedule
 from pagerank_tpu.testing.schedules import VirtualClock
@@ -175,7 +176,7 @@ def run_serve_load(
             digest.update(np.ascontiguousarray(ids).tobytes())
             digest.update(np.ascontiguousarray(scores).tobytes())
             latencies_ms.append(1000.0 * (q.latency_s or 0.0))
-    return {
+    rep = {
         "queries": len(handles),
         "outcomes": outcomes,
         "unsettled": unsettled,
@@ -185,3 +186,98 @@ def run_serve_load(
         "degraded": server.degraded,
         "device_count": server.device_count,
     }
+    plane = qtrace.get_query_plane()
+    if plane is not None:
+        # Query plane armed (ISSUE 19): the timestamp-free span-tree
+        # digest rides the determinism report — same seed must give
+        # the same trace structure, not just the same outcomes.
+        rep["trace_digest"] = plane.structure_digest()
+    return rep
+
+
+def chaos_run(seed: int = 7, queries: int = 40, iters: int = 5,
+              kill_batch: int = 3, kill_device: int = 5,
+              drain_at: Optional[int] = None,
+              service_s: float = 0.05) -> Dict:
+    """One canonical seed-deterministic chaos run (the acceptance
+    smoke's shape, reusable from the CLI): 256-vertex R-MAT graph,
+    pump-mode server on a virtual clock, frozen batch wall, one
+    injected device kill, optional mid-load drain. The caller's
+    environment must provide a (fake-)multi-device CPU mesh."""
+    from pagerank_tpu import PageRankConfig, build_graph
+    from pagerank_tpu.serving.daemon import ServeConfig
+    from pagerank_tpu.utils import synth
+
+    n = 256
+    src, dst = synth.rmat_edges(8, edge_factor=8, seed=3)
+    g = build_graph(src, dst, n=n)
+    cfg = PageRankConfig(num_iters=iters)
+    sc = ServeConfig(max_batch=4, queue_depth=16, deadline_ms=400.0,
+                     topk=8, wall_alpha=0.0, wall_initial_s=0.05,
+                     cache_capacity=64, batch_margin_s=0.01)
+    clock = VirtualClock()
+    sched = DeviceFaultSchedule(seed=seed, kill={kill_batch: kill_device})
+    srv = PprServer(g, config=cfg, serve_config=sc,
+                    liveness_probe=sched.liveness_probe, clock=clock)
+    srv.start(dispatcher=False)
+    install_serve_faults(srv, sched, clock=clock, service_s=service_s)
+    plan = QueryLoadGenerator(seed=seed, num_queries=queries, n=n,
+                              mean_gap_s=0.02, k=8).plan()
+    return run_serve_load(srv, clock, plan, drain_at=drain_at,
+                          drain_deadline_s=1.0)
+
+
+def main(argv=None) -> int:
+    """``python -m pagerank_tpu.testing.load``: run the canonical
+    chaos load with the query plane armed; ``--trace PATH`` exports a
+    Perfetto-loadable Chrome trace with per-thread lanes, and the
+    JSON determinism report (with ``trace_digest``) prints to stdout.
+    """
+    import argparse
+    import json
+    import threading
+
+    from pagerank_tpu.obs import trace as obs_trace
+
+    p = argparse.ArgumentParser(
+        description="seed-deterministic serving chaos harness")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--queries", type=int, default=40)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--kill-batch", type=int, default=3)
+    p.add_argument("--kill-device", type=int, default=5)
+    p.add_argument("--drain-at", type=int, default=None,
+                   help="trigger the SIGTERM drain path before query N")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="export a Chrome trace (Perfetto-loadable) of "
+                        "the run's query spans, one lane per thread")
+    p.add_argument("--slow-query-ms", type=float, default=None,
+                   help="log outliers >= this latency as strict JSONL")
+    p.add_argument("--slow-query-log", default=None, metavar="PATH",
+                   help="destination for the slow-query JSONL")
+    args = p.parse_args(argv)
+
+    tracer = None
+    if args.trace:
+        tracer = obs_trace.enable_tracing()
+        tracer.set_thread_label(threading.get_ident(), "serve-harness")
+    qtrace.arm_query_plane(slow_query_ms=args.slow_query_ms,
+                           slow_query_path=args.slow_query_log)
+    try:
+        rep = chaos_run(seed=args.seed, queries=args.queries,
+                        iters=args.iters, kill_batch=args.kill_batch,
+                        kill_device=args.kill_device,
+                        drain_at=args.drain_at)
+        plane = qtrace.get_query_plane()
+        rep["phase_p99_ms"] = plane.phase_p99_ms()
+    finally:
+        qtrace.disarm_query_plane()
+        if tracer is not None:
+            obs_trace.disable_tracing()
+            tracer.export_chrome(args.trace)
+    print(json.dumps(rep, allow_nan=False))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -300,10 +300,22 @@ CONFIGS = {
               label="serving smoke (8-fake-device query daemon under "
                     "chaos: kill + SIGTERM drain, bit-identical "
                     "replay)"),
+    # Query-plane smoke (ISSUE 19): the Y chaos load re-run with the
+    # query plane AND the tracer armed — determinism must survive
+    # instrumentation (same trace_digest), the slow-query log must
+    # schema-validate as strict JSONL, latency-bucket exemplars must
+    # strict-parse in the OpenMetrics rendering, and a real SIGTERM
+    # drain must leave a flight-recorder dump in the run report's
+    # serving section.
+    "Z": dict(kind="qtrace", seed=7, queries=40, iters=5,
+              kill_batch=3, kill_device=5, drain_at=34,
+              label="query-plane smoke (armed tracing under chaos: "
+                    "determinism, exemplars, slow-query log, flight "
+                    "recorder)"),
 }
-DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "X", "Y", "N", "O", "Q",
-                "R", "S", "U", "V", "W", "F", "A", "B", "T", "P", "E",
-                "BV", "BB", "TV"]
+DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "X", "Y", "Z", "N", "O",
+                "Q", "R", "S", "U", "V", "W", "F", "A", "B", "T", "P",
+                "E", "BV", "BB", "TV"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -1047,6 +1059,254 @@ def run_serve_smoke(key: str):
         f"{'OK' if sigterm_ok else 'BAD'}; counters "
         f"{sorted(serve_counters)}; {r1['seconds']:.2f}s vs budget "
         f"{SERVE_SMOKE_BUDGET_S:g}s -> {'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
+# Budget for the query-plane smoke (seconds, ISSUE 19, measured around
+# ONE armed chaos load — not the compile in start()): the same load as
+# the serving smoke plus per-query trace assembly, exemplar records,
+# and slow-query JSONL writes. Same 3 s bound as the unarmed smoke —
+# the plane is bounded work per settle, never a second pass.
+QTRACE_SMOKE_BUDGET_S = 3.0
+
+_OM_SAMPLE_RE = None
+
+
+def _parse_openmetrics_strict(text: str):
+    """Strict parse of the OpenMetrics rendering: every sample line
+    must match the grammar (counter samples ``_total``-suffixed,
+    optional `` # {trace_id="..."} value`` exemplar clause on histogram
+    bucket lines), and the body must end with the ``# EOF`` terminator.
+    Returns ``(samples, exemplars)``; raises AssertionError on any bad
+    line. tests/test_qtrace.py carries the same grammar."""
+    import re
+
+    global _OM_SAMPLE_RE
+    if _OM_SAMPLE_RE is None:
+        _v = r"(?:[-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|Inf)|NaN)"
+        _OM_SAMPLE_RE = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+            r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+            r" " + _v +
+            r'( # \{trace_id="[^"]+"\} ' + _v + r")?$"
+        )
+    lines = text.splitlines()
+    assert lines and lines[-1] == "# EOF", "missing # EOF terminator"
+    samples = 0
+    exemplars = 0
+    for line in lines[:-1]:
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _OM_SAMPLE_RE.match(line), f"bad openmetrics line: {line!r}"
+        samples += 1
+        exemplars += " # {" in line
+    return samples, exemplars
+
+
+def run_qtrace_smoke(key: str):
+    """ISSUE-19 gate: the serving chaos load with the query plane and
+    tracer ARMED. Gates: seed-determinism survives instrumentation
+    (admission log, result digest AND the timestamp-free trace-structure
+    digest all replay identically), the slow-query JSONL log
+    schema-validates line-by-line, the OpenMetrics rendering
+    strict-parses with >=1 trace-id exemplar on the serve latency
+    buckets, a REAL SIGTERM drain leaves a reason="drain" flight dump
+    (with trace-carrying timelines) in the run report's serving
+    section, and the armed chaos run still lands under
+    QTRACE_SMOKE_BUDGET_S."""
+    import jax
+
+    spec = CONFIGS[key]
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 2:
+        return _fake_mesh_subprocess(key, "qtrace",
+                                     "PAGERANK_QTRACE_SMOKE_CHILD")
+
+    import shutil
+    import tempfile
+
+    from pagerank_tpu import PageRankConfig, build_graph, jobs, obs
+    from pagerank_tpu.obs import live as obs_live
+    from pagerank_tpu.serving import PprServer, ServeConfig, qtrace
+    from pagerank_tpu.testing.faults import DeviceFaultSchedule
+    from pagerank_tpu.testing.load import (QueryLoadGenerator,
+                                           install_serve_faults,
+                                           run_serve_load)
+    from pagerank_tpu.testing.schedules import VirtualClock
+    from pagerank_tpu.utils import synth
+
+    seed = spec["seed"]
+    ndev = min(8, len(jax.devices()))
+    src, dst = synth.rmat_edges(8, edge_factor=8, seed=3)
+    g = build_graph(src, dst, n=256)
+    cfg = PageRankConfig(num_iters=spec["iters"])
+
+    def serve_config(cache_capacity=64):
+        return ServeConfig(max_batch=4, queue_depth=16, deadline_ms=400.0,
+                           topk=8, wall_alpha=0.0, wall_initial_s=0.05,
+                           cache_capacity=cache_capacity,
+                           batch_margin_s=0.01)
+
+    def one_run(slow_log):
+        # A FRESH plane per run: the structure digest then covers
+        # exactly one load, so equal digests mean equal span trees.
+        plane = qtrace.arm_query_plane(slow_query_ms=0.0,
+                                       slow_query_path=slow_log)
+        try:
+            clock = VirtualClock()
+            sched = DeviceFaultSchedule(
+                seed=seed, kill={spec["kill_batch"]: spec["kill_device"]}
+            )
+            srv = PprServer(g, config=cfg, serve_config=serve_config(),
+                            liveness_probe=sched.liveness_probe,
+                            clock=clock)
+            srv.start(dispatcher=False)
+            install_serve_faults(srv, sched, clock=clock, service_s=0.05)
+            plan = QueryLoadGenerator(seed=seed,
+                                      num_queries=spec["queries"],
+                                      n=256, mean_gap_s=0.02, k=8).plan()
+            t0 = time.perf_counter()
+            rep = run_serve_load(srv, clock, plan,
+                                 drain_at=spec["drain_at"],
+                                 drain_deadline_s=1.0)
+            rep["seconds"] = time.perf_counter() - t0
+            rep["slow_count"] = plane.slow_count
+            rep["phase_p99_ms"] = plane.phase_p99_ms()
+        finally:
+            qtrace.disarm_query_plane()
+        return rep
+
+    def reject_constant(s):
+        raise AssertionError(f"non-strict JSON constant {s!r}")
+
+    def slow_log_ok(path, expect):
+        """Strict-JSONL schema gate on one slow-query log."""
+        count = 0
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                rec = json.loads(line, parse_constant=reject_constant)
+                if set(rec) != set(qtrace.SLOW_QUERY_KEYS):
+                    return False
+                if rec["type"] != "slow_query":
+                    return False
+                if not (isinstance(rec["trace_id"], str)
+                        and len(rec["trace_id"]) == 32):
+                    return False
+                count += 1
+        return count == expect and count > 0
+
+    obs.disable_tracing()
+    obs.get_registry().reset()
+    tracer = obs.enable_tracing()
+    work = tempfile.mkdtemp(prefix="pagerank_qtrace_")
+    try:
+        log1 = os.path.join(work, "slow1.jsonl")
+        log2 = os.path.join(work, "slow2.jsonl")
+        r1 = one_run(log1)
+        r2 = one_run(log2)
+        slow_ok = bool(slow_log_ok(log1, r1["slow_count"])
+                       and slow_log_ok(log2, r2["slow_count"]))
+
+        # The armed runs recorded trace-id exemplars into the latency
+        # histogram; the OpenMetrics rendering must carry them and
+        # still strict-parse (plain-Prometheus stays exemplar-free).
+        om_text = obs_live.render_openmetrics()
+        try:
+            _, exemplars = _parse_openmetrics_strict(om_text)
+            exemplar_ok = exemplars >= 1
+        except AssertionError:
+            exemplar_ok = False
+
+        # Real SIGTERM through the PR-12 handler with the plane armed:
+        # the drain must leave a flight-recorder dump in the report.
+        plane3 = qtrace.arm_query_plane()
+        clock3 = VirtualClock()
+        srv3 = PprServer(g, config=cfg, serve_config=serve_config(0),
+                         clock=clock3)
+        srv3.start(dispatcher=False)
+        drained = False
+        with jobs.GracefulDrain(deadline_s=5.0) as drain:
+            q_before = srv3.submit(5, k=4)
+            clock3.advance(0.36)
+            srv3.pump()
+            os.kill(os.getpid(), signal.SIGTERM)
+            try:
+                drain.check("qtrace-smoke")
+            except jobs.DrainInterrupt:
+                srv3.drain(deadline_s=drain.remaining())
+                drained = True
+            drain.finish()
+        report = obs.build_run_report(
+            config=cfg, tracer=tracer, registry=obs.get_registry(),
+        )
+        serving = report.get("serving") or {}
+        dumps = serving.get("flight_dumps") or []
+        drain_dumps = [d for d in dumps if d.get("reason") == "drain"]
+        dump_traces_ok = bool(
+            drain_dumps
+            and drain_dumps[-1]["traces"]
+            and all(len(t.get("trace_id", "")) == 32
+                    for t in drain_dumps[-1]["traces"])
+        )
+        sigterm_ok = bool(drained and serving.get("enabled")
+                          and q_before.outcome == "answered"
+                          and dump_traces_ok)
+        qtrace.disarm_query_plane()
+    finally:
+        obs.disable_tracing()
+        qtrace.disarm_query_plane()
+        shutil.rmtree(work, ignore_errors=True)
+
+    trace_ok = bool(r1.get("trace_digest") and
+                    r1.get("trace_digest") == r2.get("trace_digest"))
+    replay_ok = (r1["results_digest"] == r2["results_digest"]
+                 and r1["admission_log"] == r2["admission_log"])
+    accounted = r1["unsettled"] == 0 and r2["unsettled"] == 0
+    decomposed = all(
+        leg in r1["phase_p99_ms"] for leg in qtrace.DECOMPOSITION_LEGS
+    ) and r1["phase_p99_ms"]["batch_wait"] > 0
+    passed = bool(
+        accounted
+        and replay_ok
+        and trace_ok
+        and decomposed
+        and slow_ok
+        and exemplar_ok
+        and sigterm_ok
+        and r1["seconds"] <= QTRACE_SMOKE_BUDGET_S
+    )
+    rec = {
+        "config": key,
+        "kind": "qtrace",
+        "label": spec["label"],
+        "devices": ndev,
+        "queries": spec["queries"],
+        "outcomes": dict(r1["outcomes"]),
+        "trace_digest_identical": trace_ok,
+        "replay_identical": replay_ok,
+        "phase_p99_ms": r1["phase_p99_ms"],
+        "slow_log_ok": slow_ok,
+        "slow_queries": r1["slow_count"],
+        "exemplars_ok": exemplar_ok,
+        "sigterm_flight_dump_ok": sigterm_ok,
+        "seconds": r1["seconds"],
+        "budget_s": QTRACE_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    print(
+        f"[{key}] armed chaos x2 on {ndev} fake devices: trace digest "
+        f"{'identical' if trace_ok else 'DIVERGED'}; replay "
+        f"{'bit-identical' if replay_ok else 'DIVERGED'}; "
+        f"{r1['slow_count']} slow-query line(s) "
+        f"{'schema OK' if slow_ok else 'SCHEMA BAD'}; exemplars "
+        f"{'parse OK' if exemplar_ok else 'PARSE BAD'}; SIGTERM flight "
+        f"dump {'OK' if sigterm_ok else 'BAD'}; {r1['seconds']:.2f}s vs "
+        f"budget {QTRACE_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
         file=sys.stderr,
     )
     return rec
@@ -2739,6 +2999,7 @@ def main(argv=None) -> int:
                "faults": run_fault_smoke, "obs": run_obs_smoke,
                "live": run_live_smoke, "partitioned": run_partitioned_smoke,
                "elastic": run_elastic_smoke, "serve": run_serve_smoke,
+               "qtrace": run_qtrace_smoke,
                "halo": run_halo_smoke,
                "halo_async": run_halo_async_smoke,
                "history": run_history_smoke,

@@ -462,6 +462,50 @@ def test_multichip_json_contract(tmp_path):
     assert rec["env"]["jax_version"] and rec["env"]["backend"] == "cpu"
 
 
+def test_bench_ppr_serve_contract(tmp_path):
+    """--ppr-serve (ISSUE 18/19): ONE JSON line with the serving
+    schema, now including the query plane's per-leg p99 decomposition
+    (phase_p99_ms), and --history folds those legs into *_p99_ms
+    columns on the ppr_serve ledger leg."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ppr-serve",
+         "--scale", "8", "--iters", "2", "--serve-queries", "24",
+         "--serve-qps", "500", "--serve-topk", "8",
+         "--history", ledger],
+        capture_output=True, text=True, env=_env(), timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    json_lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, r.stdout
+    rec = json.loads(json_lines[0])
+    assert set(rec) == {"metric", "value", "unit", "p50_ms", "p99_ms",
+                        "phase_p99_ms", "shed_fraction", "rescues",
+                        "queries", "answered", "outcomes", "elapsed_s",
+                        "offered_qps", "scale", "iters", "edge_factor",
+                        "max_batch", "deadline_ms", "queue_depth",
+                        "topk", "env", "schema_version"}
+    assert rec["metric"] == "ppr_serve_queries_per_sec"
+    assert rec["schema_version"] >= 2
+    assert rec["queries"] == 24 and rec["answered"] > 0
+    # The tail decomposition (ISSUE 19): every leg present, finite,
+    # non-negative — the columns the history ledger trends.
+    phase = rec["phase_p99_ms"]
+    assert set(phase) == {"admission_wait", "batch_wait", "dispatch",
+                          "fetch"}
+    assert all(isinstance(v, (int, float)) and v >= 0
+               for v in phase.values())
+    assert phase["dispatch"] > 0     # real dispatches happened
+    # --history lifted the decomposition into the ppr_serve leg.
+    with open(ledger) as f:
+        lines = [json.loads(l) for l in f.read().splitlines() if l]
+    assert len(lines) == 1
+    leg = lines[0]["legs"]["ppr_serve"]
+    for short in ("admission_wait", "batch_wait", "dispatch", "fetch"):
+        assert leg[short + "_p99_ms"] == phase[short]
+    assert leg["queries_per_sec"] == rec["value"]
+
+
 def test_graft_entry_contract():
     sys.path.insert(0, REPO)
     try:

@@ -420,6 +420,44 @@ def test_attribution_block_normalizes_into_leg_metrics():
     assert H.METRIC_BAD_DIRECTION["exchange_fraction"] == "up"
 
 
+def test_ppr_serve_phase_decomposition_normalizes_into_leg():
+    """A ppr_serve bench doc's phase_p99_ms decomposition (ISSUE 19
+    query plane) lands as *_p99_ms columns on the ppr_serve leg, and
+    every leg column is a known, direction-tagged LEG_METRICS entry."""
+    doc = {
+        "metric": "ppr_serve_queries_per_sec", "value": 123.4,
+        "unit": "queries/s", "p50_ms": 12.0, "p99_ms": 80.0,
+        "phase_p99_ms": {"admission_wait": 1.5, "batch_wait": 40.0,
+                         "dispatch": 30.0, "fetch": 2.0},
+        "shed_fraction": 0.05, "rescues": 1, "queries": 200,
+        "answered": 190, "outcomes": {"answered": 190, "shed": 10},
+        "elapsed_s": 1.6, "offered_qps": 125.0, "scale": 12,
+        "iters": 10, "edge_factor": 16, "max_batch": 8,
+        "deadline_ms": 500.0, "queue_depth": 64, "topk": 64,
+        "env": {"backend": "cpu"}, "schema_version": 2,
+    }
+    rec = H.normalize_result(doc, source="BENCH_SERVE.json")
+    assert rec["kind"] == "bench_ppr_serve"
+    leg = rec["legs"]["ppr_serve"]
+    assert leg["admission_wait_p99_ms"] == 1.5
+    assert leg["batch_wait_p99_ms"] == 40.0
+    assert leg["dispatch_p99_ms"] == 30.0
+    assert leg["fetch_p99_ms"] == 2.0
+    assert leg["queries_per_sec"] == 123.4
+    assert leg["p99_ms"] == 80.0
+    for col in leg:
+        assert col in H.LEG_METRICS
+        # Latency legs regress UP: a taller tail is the bad direction.
+        if col.endswith("_ms"):
+            assert H.METRIC_BAD_DIRECTION[col] == "up"
+    # Decomposition absent (pre-ISSUE-19 artifact): leg still forms,
+    # just without the phase columns — old ledgers keep ingesting.
+    old = {k: v for k, v in doc.items() if k != "phase_p99_ms"}
+    legacy = H.normalize_result(old, source="BENCH_SERVE_OLD.json")
+    assert "admission_wait_p99_ms" not in legacy["legs"]["ppr_serve"]
+    assert legacy["legs"]["ppr_serve"]["p99_ms"] == 80.0
+
+
 def test_checked_in_ledger_records_are_deduped_and_versioned():
     records = H.read_ledger(PERF_HISTORY)
     hashes = [r["content_hash"] for r in records]
