@@ -39,6 +39,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from pagerank_tpu.utils import fsio
@@ -191,15 +192,23 @@ NULL_TRACER = NullTracer()
 class Tracer:
     """Recording tracer: nested context-manager spans with thread-local
     stacks, instant events, aggregation, and JSONL / Chrome trace-event
-    export."""
+    export.
+
+    ``max_spans`` bounds retention: when set, finished spans live in a
+    ring (oldest dropped first) instead of an unbounded list — the mode
+    long-running captures (the serving daemon's ``--query-trace``) use
+    so an armed tracer cannot grow memory without bound with query
+    count. Solver runs are finite, so the default stays unbounded and
+    exports every span."""
 
     enabled = True
 
-    def __init__(self):
+    def __init__(self, max_spans: Optional[int] = None):
         self.epoch_pc = time.perf_counter()
         self.epoch_unix = time.time()
         self._lock = threading.Lock()
-        self._spans: List[Span] = []
+        self._spans = (deque(maxlen=int(max_spans))
+                       if max_spans else [])
         self._events: List[dict] = []
         self._counters: List[dict] = []
         self._track_labels: Dict[int, str] = {}

@@ -23,6 +23,12 @@ from pagerank_tpu import PageRankConfig, build_graph, jobs
 from pagerank_tpu.exitcodes import ExitCode
 from pagerank_tpu.utils import synth
 
+#: span-retention bound for --query-trace: the daemon may trace for its
+#: whole lifetime, so the Tracer keeps a ring of the most recent spans
+#: (~6 spans per query -> tens of thousands of queries of tail) instead
+#: of growing without bound the way a finite solver capture may.
+QUERY_TRACE_MAX_SPANS = 200_000
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -69,13 +75,17 @@ def build_parser() -> argparse.ArgumentParser:
                    "trace-id exemplars on latency buckets")
     o.add_argument("--slow-query-ms", type=float, default=None,
                    help="log queries slower than this as strict JSONL "
-                   "phase breakdowns (arms the query plane)")
+                   "phase breakdowns (arms the query plane; requires "
+                   "--slow-query-log)")
     o.add_argument("--slow-query-log", default=None, metavar="PATH",
-                   help="slow-query JSONL destination (default stderr "
-                   "is NOT used; requires a path when set)")
+                   help="slow-query JSONL destination (required with, "
+                   "and only meaningful with, --slow-query-ms)")
     o.add_argument("--query-trace", default=None, metavar="PATH",
-                   help="export a Chrome trace of per-query spans "
-                   "(one lane per thread) at shutdown")
+                   help="debug/short-capture: export a Chrome trace of "
+                   "per-query spans (one lane per thread) at shutdown; "
+                   f"retains only the most recent {QUERY_TRACE_MAX_SPANS} "
+                   "spans (a bounded ring), so long-lived daemons stay "
+                   "bounded but export only the tail of the run")
     o.add_argument("--run-report", default=None, metavar="PATH",
                    help="write the run report (with the serving flight "
                    "recorder section) here on SIGTERM drain")
@@ -147,7 +157,14 @@ def _write_run_report(path: str) -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if (args.slow_query_ms is None) != (args.slow_query_log is None):
+        # Half a pair is a silent no-op (counting without writing, or a
+        # path that never arms the plane) — refuse it at parse time.
+        parser.error(
+            "--slow-query-ms and --slow-query-log must be given together"
+        )
     try:
         server = _build_server(args)
     except ValueError as e:
@@ -167,7 +184,9 @@ def main(argv=None) -> int:
 
             from pagerank_tpu.obs import trace as obs_trace
 
-            tracer = obs_trace.enable_tracing()
+            tracer = obs_trace.enable_tracing(
+                obs_trace.Tracer(max_spans=QUERY_TRACE_MAX_SPANS)
+            )
             tracer.set_thread_label(threading.get_ident(), "serve-main")
         qtrace.arm_query_plane(slow_query_ms=args.slow_query_ms,
                                slow_query_path=args.slow_query_log)

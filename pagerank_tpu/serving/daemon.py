@@ -310,6 +310,10 @@ class PprServer:
                 q.qid, q.source, q.trace_id, start_s=now
             )
 
+        # Publish-last ordering (also below, in _serve_batch): settle
+        # the trace BEFORE resolve()/reject() set the done event, so
+        # the awakened caller thread can never observe — or touch — a
+        # trace mid-settle (qtrace's happens-before contract).
         key = ResultCache.key(self._graph_fp, q.source, self._params_key, k)
         if tr is not None:
             t_c0 = self._clock()
@@ -317,15 +321,15 @@ class PprServer:
         if hit is not None:
             self._c_accepted.inc()
             self._c_answered_cache.inc()
-            q.resolve(hit[0], hit[1], "cache", self._clock())
-            lat_ms = 1000.0 * (q.latency_s or 0.0)
+            now2 = self._clock()
+            lat_ms = 1000.0 * max(0.0, now2 - q.t_submit)
             if tr is not None:
-                tr.phase("query/cache", t_c0, self._clock() - t_c0,
-                         hit=True)
+                tr.phase("query/cache", t_c0, now2 - t_c0, hit=True)
                 self._h_latency.record(lat_ms, trace_id=q.trace_id)
-                plane.settle(tr, "answered_cache", self._clock(), lat_ms)
+                plane.settle(tr, "answered_cache", now2, lat_ms)
             else:
                 self._h_latency.record(lat_ms)
+            q.resolve(hit[0], hit[1], "cache", now2)
             return q
         if tr is not None:
             tr.phase("query/cache", t_c0, self._clock() - t_c0, hit=False)
@@ -335,22 +339,22 @@ class PprServer:
         except Draining as e:
             self._c_rej_draining.inc()
             now2 = self._clock()
-            q.reject(e, now2)
             if tr is not None:
                 tr.phase("query/admission", t_a0, now2 - t_a0,
                          decision="rejected_draining")
                 plane.settle(tr, "rejected_draining", now2,
-                             1000.0 * (q.latency_s or 0.0))
+                             1000.0 * max(0.0, now2 - q.t_submit))
+            q.reject(e, now2)
             return q
         except ServeRejected as e:  # Overloaded
             self._c_shed.inc()
             now2 = self._clock()
-            q.reject(e, now2)
             if tr is not None:
                 tr.phase("query/admission", t_a0, now2 - t_a0,
                          decision="shed_overload")
                 plane.settle(tr, "shed_overload", now2,
-                             1000.0 * (q.latency_s or 0.0))
+                             1000.0 * max(0.0, now2 - q.t_submit))
+            q.reject(e, now2)
             return q
         self._c_accepted.inc()
         if tr is not None:
@@ -444,9 +448,6 @@ class PprServer:
         for q in batch:
             if q.deadline <= now:
                 self._c_rej_deadline.inc()
-                q.reject(QueryDeadlineExceeded(
-                    f"deadline passed in-queue "
-                    f"({now - q.deadline:.3f}s late)"), now)
                 tr = q.trace
                 if tr is not None:
                     if tr.t_admitted is not None:
@@ -455,7 +456,10 @@ class PprServer:
                                  close_reason=close_reason, expired=True)
                     if plane is not None:
                         plane.settle(tr, "rejected_deadline", now,
-                                     1000.0 * (q.latency_s or 0.0))
+                                     1000.0 * max(0.0, now - q.t_submit))
+                q.reject(QueryDeadlineExceeded(
+                    f"deadline passed in-queue "
+                    f"({now - q.deadline:.3f}s late)"), now)
             else:
                 live.append(q)
         if not live:
@@ -496,9 +500,6 @@ class PprServer:
                 now = self._clock()
                 for q in live:
                     self._c_rej_deadline.inc()
-                    q.reject(QueryDeadlineExceeded(
-                        f"device dispatch exceeded its "
-                        f"{sc.dispatch_timeout_s}s bound: {e}"), now)
                     tr = q.trace
                     if tr is not None:
                         tr.phase("query/dispatch", t0, now - t0,
@@ -506,7 +507,11 @@ class PprServer:
                                  attempts=attempts)
                         if plane is not None:
                             plane.settle(tr, "rejected_deadline", now,
-                                         1000.0 * (q.latency_s or 0.0))
+                                         1000.0 * max(0.0,
+                                                      now - q.t_submit))
+                    q.reject(QueryDeadlineExceeded(
+                        f"device dispatch exceeded its "
+                        f"{sc.dispatch_timeout_s}s bound: {e}"), now)
                 return
             except Exception as e:  # noqa: BLE001 - classified below
                 if not (isinstance(e, DeviceLostError)
@@ -519,8 +524,6 @@ class PprServer:
                         self._fatal = term
                     now = self._clock()
                     for q in live:
-                        q.reject(ServeRejected(
-                            f"serving terminal: {term}"), now)
                         tr = q.trace
                         if tr is not None:
                             tr.phase("query/dispatch", t0, now - t0,
@@ -529,7 +532,9 @@ class PprServer:
                             if plane is not None:
                                 plane.settle(
                                     tr, "rejected", now,
-                                    1000.0 * (q.latency_s or 0.0))
+                                    1000.0 * max(0.0, now - q.t_submit))
+                        q.reject(ServeRejected(
+                            f"serving terminal: {term}"), now)
                     self.queue.stop()
                     if plane is not None:
                         plane.flight_dump("fatal")
@@ -548,6 +553,7 @@ class PprServer:
 
         degraded = self.degraded
         served_from = "degraded" if degraded else "compute"
+        outcome = "answered_degraded" if degraded else "answered"
         now = self._clock()
         for i, q in enumerate(live):
             tr = q.trace
@@ -559,18 +565,21 @@ class PprServer:
                 self._graph_fp, q.source, self._params_key, q.k
             )
             self.cache.put(key, q_ids, q_scores)
-            q.resolve(q_ids, q_scores, served_from, now)
             self._c_answered.inc()
             if degraded:
                 self._c_answered_degraded.inc()
-            lat_ms = 1000.0 * (q.latency_s or 0.0)
+            lat_ms = 1000.0 * max(0.0, now - q.t_submit)
             if tr is not None:
                 tr.phase("query/fetch", t_f0, self._clock() - t_f0)
                 self._h_latency.record(lat_ms, trace_id=q.trace_id)
                 if plane is not None:
-                    plane.settle(tr, q.outcome, now, lat_ms)
+                    plane.settle(tr, outcome, now, lat_ms)
             else:
                 self._h_latency.record(lat_ms)
+            # resolve LAST: the done event publishes the query to the
+            # blocked ingress thread, so the settled record is complete
+            # before any other thread can see this query again.
+            q.resolve(q_ids, q_scores, served_from, now)
 
     # -- drain side ---------------------------------------------------------
 

@@ -61,11 +61,13 @@ def parse_traceparent(value: Optional[str]) -> Optional[str]:
     return trace_id
 
 
-def format_traceparent(q) -> str:
+def format_traceparent(trace_id: str, qid: int) -> str:
     """The response ``traceparent``: the query's trace id with the
     server's span id (a deterministic function of the qid, matching
-    the trace-id fallback) and the sampled flag."""
-    return "00-%s-%016x-01" % (q.trace_id, (q.qid + 1) & (2 ** 64 - 1))
+    the trace-id fallback) and the sampled flag. The ONE encoder for
+    both helper and header paths, so the span-id scheme cannot
+    drift."""
+    return "00-%s-%016x-01" % (trace_id, (int(qid) + 1) & (2 ** 64 - 1))
 
 
 def _query_payload(q, ids, scores) -> dict:
@@ -147,6 +149,10 @@ class QueryIngress:
             t0 = srv._clock()
         payload = _query_payload(q, ids, scores)
         if tr is not None:
+            # The query settled before resolve() woke this thread, so
+            # the trace is sealed: this phase mirrors into the live
+            # tracer (the serve-http Chrome lane) but stays out of the
+            # settled record — slow-log, flight dumps, digest.
             tr.phase("query/serialize", t0, srv._clock() - t0)
         return 200, payload
 
@@ -192,12 +198,13 @@ class QueryIngress:
                         str(max(1, int(round(payload["retry_after_s"]))))
                     )
                 if "trace_id" in payload:
+                    # Every payload that carries trace_id carries qid;
+                    # a missing qid is a bug and should fail loudly,
+                    # never encode span id 0x1 for the wrong query.
                     self.send_header(
                         "traceparent",
-                        "00-%s-%016x-01" % (
-                            payload["trace_id"],
-                            (payload.get("qid", 0) + 1) & (2 ** 64 - 1),
-                        ),
+                        format_traceparent(payload["trace_id"],
+                                           payload["qid"]),
                     )
                 self.end_headers()
                 self.wfile.write(body)

@@ -20,6 +20,15 @@ Three consumers hang off :class:`QueryPlane`:
   snapshotted on drain / rescue / fatal into the run report's
   ``serving`` section (:func:`report_section`).
 
+Memory discipline: the plane retains NOTHING per settled query beyond
+the fixed-size structures above — bounded per-leg sample deques, the
+flight ring, counters, and a rolling order-independent structure
+digest (each settle folds ``sha256(trace.structure())`` into one
+accumulator). A daemon armed for its whole process lifetime
+(``python -m pagerank_tpu.serve --slow-query-ms``) stays O(1) in
+query count; degrading instead of dying includes not OOMing on
+observability state.
+
 Zero-cost discipline (the booby-trap contract): the plane is DISARMED
 by default (:func:`get_query_plane` returns None) and every serving
 call site gates on ``q.trace is not None`` — a disarmed admitted query
@@ -41,8 +50,11 @@ query/batch_wait    admitted -> batch close (attrs ``close_reason``,
                     ``batch_size``; links = batch-mates' trace ids)
 query/dispatch      compiled-batch device run (attrs ``rerun``,
                     ``attempts``; covers elastic-rescue re-runs)
-query/fetch         on-device top-k -> host copy + cache put + resolve
-query/serialize     HTTP response body build (ingress only)
+query/fetch         on-device top-k -> host copy + cache put
+query/serialize     HTTP response body build (ingress only; recorded
+                    AFTER the query settles, so it appears in the live
+                    Chrome trace but never in the settled record —
+                    slow-query log, flight dumps, structure digest)
 ==================  =====================================================
 """
 
@@ -85,9 +97,14 @@ class QueryTrace:
     """One query's causal timeline — the handle that crosses threads.
 
     Phases are PRE-MEASURED on the server's injected clock and appended
-    in lifecycle order (submit thread, then dispatcher, then ingress),
-    so no lock is needed: every hand-off happens-before via the
-    admission queue's condition / the query's done event. When the
+    in lifecycle order (submit thread, then dispatcher), so no lock is
+    needed: every hand-off happens-before via the admission queue's
+    condition, and the daemon publishes the query (``resolve``/
+    ``reject``, which set the done event) only AFTER :meth:`finish`
+    sealed the trace. A phase recorded after the seal — the ingress
+    thread's ``query/serialize`` — mirrors into the tracer (its own
+    lock) but does NOT touch ``phases``, so the settled record is
+    immutable and flight-dump readers never race an append. When the
     process tracer is armed, each phase mirrors immediately into a
     handle-parented span (:meth:`Tracer.start_span`) so the Chrome
     export shows the query as one tree spanning thread lanes.
@@ -95,7 +112,7 @@ class QueryTrace:
 
     __slots__ = ("trace_id", "qid", "source", "phases", "links",
                  "outcome", "attrs", "t_start", "t_admitted",
-                 "_tracer", "_root")
+                 "_tracer", "_root", "_sealed")
 
     def __init__(self, qid: int, source: int, trace_id: str,
                  start_s: float, tracer=None):
@@ -108,6 +125,7 @@ class QueryTrace:
         self.attrs: Dict = {}
         self.t_start = float(start_s)
         self.t_admitted: Optional[float] = None
+        self._sealed = False
         self._tracer = tracer if tracer is not None else obs_trace.NULL_TRACER
         self._root = self._tracer.start_span(
             "query", trace_id=trace_id, start_s=start_s,
@@ -116,7 +134,12 @@ class QueryTrace:
 
     def phase(self, name: str, start_s: float, duration_s: float,
               **attrs) -> None:
-        """Record one pre-measured phase (server-clock seconds)."""
+        """Record one pre-measured phase (server-clock seconds). After
+        :meth:`finish` sealed the trace, the phase still lands in the
+        live tracer (Chrome lanes) but NOT in ``phases`` — the settled
+        record is immutable, so post-settle ingress work
+        (``query/serialize``) can never race a flight-dump reader or
+        perturb the structure digest."""
         rec = {
             "name": name,
             "start_s": float(start_s),
@@ -125,7 +148,8 @@ class QueryTrace:
         }
         if attrs:
             rec["attrs"] = attrs
-        self.phases.append(rec)
+        if not self._sealed:
+            self.phases.append(rec)
         sp = self._tracer.start_span(
             name, parent=self._root, trace_id=self.trace_id,
             start_s=rec["start_s"], **attrs
@@ -140,8 +164,10 @@ class QueryTrace:
         self.links.append(other_trace_id)
 
     def finish(self, outcome: str, end_s: float) -> None:
-        """Seal the trace (called once, by :meth:`QueryPlane.settle`)."""
+        """Seal the trace (called once, by :meth:`QueryPlane.settle`):
+        ``phases`` is immutable from here on."""
         self.outcome = outcome
+        self._sealed = True
         if self._root is not None:
             self._root.attrs["outcome"] = outcome
             if self.links:
@@ -181,7 +207,12 @@ class QueryTrace:
 
 class QueryPlane:
     """The armed query plane: trace factory, settle ledger, tail
-    samplers, slow-query log, and the flight-recorder ring."""
+    samplers, slow-query log, and the flight-recorder ring.
+
+    Every retained structure is bounded (deques with maxlen, counters,
+    one digest accumulator) — an armed plane's memory is O(1) in the
+    number of settled queries, so arming it for a daemon's whole
+    process lifetime is safe."""
 
     def __init__(self, ring_size: int = 64,
                  slow_query_ms: Optional[float] = None,
@@ -192,7 +223,10 @@ class QueryPlane:
         self.slow_query_path = slow_query_path
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, int(ring_size)))
-        self._settled: List[QueryTrace] = []
+        # Rolling structure digest: per-trace sha256 values summed mod
+        # 2**256 — order-independent (settle order may differ across
+        # threads) and O(1) memory, unlike retaining every trace.
+        self._digest_sum = 0
         self._samples: Dict[str, deque] = {
             leg: deque(maxlen=max_samples) for leg in DECOMPOSITION_LEGS
         }
@@ -226,10 +260,15 @@ class QueryPlane:
         slow = (self.slow_query_ms is not None
                 and latency_ms is not None
                 and latency_ms >= self.slow_query_ms)
+        shape = hashlib.sha256(
+            json.dumps(trace.structure(), sort_keys=True).encode("utf-8")
+        ).digest()
         with self._lock:
             self._settled_count += 1
             self._ring.append(trace)
-            self._settled.append(trace)
+            self._digest_sum = (
+                self._digest_sum + int.from_bytes(shape, "big")
+            ) % (1 << 256)
             for p in trace.phases:
                 leg = PHASE_TO_LEG.get(p["name"])
                 if leg is not None:
@@ -287,15 +326,13 @@ class QueryPlane:
         return out
 
     def structure_digest(self) -> str:
-        """sha256 over every settled trace's timestamp-free structure,
-        ordered by trace id — equal across same-seed chaos runs."""
+        """Rolling digest over every settled trace's timestamp-free
+        structure: the sum (mod 2**256) of per-trace sha256 values,
+        folded in at settle time — order-independent, so it is equal
+        across same-seed chaos runs regardless of settle interleaving,
+        and O(1) memory regardless of query count."""
         with self._lock:
-            shapes = sorted(
-                (t.structure() for t in self._settled),
-                key=lambda s: (s["trace_id"], s["qid"]),
-            )
-        blob = json.dumps(shapes, sort_keys=True).encode("utf-8")
-        return hashlib.sha256(blob).hexdigest()
+            return format(self._digest_sum, "064x")
 
     @property
     def settled_count(self) -> int:

@@ -252,10 +252,18 @@ def main(argv=None) -> int:
                    help="export a Chrome trace (Perfetto-loadable) of "
                         "the run's query spans, one lane per thread")
     p.add_argument("--slow-query-ms", type=float, default=None,
-                   help="log outliers >= this latency as strict JSONL")
+                   help="log outliers >= this latency as strict JSONL "
+                        "(requires --slow-query-log)")
     p.add_argument("--slow-query-log", default=None, metavar="PATH",
-                   help="destination for the slow-query JSONL")
+                   help="destination for the slow-query JSONL "
+                        "(requires --slow-query-ms)")
     args = p.parse_args(argv)
+    if (args.slow_query_ms is None) != (args.slow_query_log is None):
+        # Half the pair silently counts-without-writing (or never arms
+        # the threshold) — refuse it at parse time, like serve's CLI.
+        p.error(
+            "--slow-query-ms and --slow-query-log must be given together"
+        )
 
     tracer = None
     if args.trace:

@@ -306,7 +306,7 @@ def test_format_traceparent_roundtrips():
     clock = VirtualClock()
     q = PendingQuery(qid=41, source=0, k=4, deadline=10.0,
                      t_submit=clock())
-    tp = format_traceparent(q)
+    tp = format_traceparent(q.trace_id, q.qid)
     assert re.fullmatch(r"00-[0-9a-f]{32}-[0-9a-f]{16}-01", tp)
     assert parse_traceparent(tp) == q.trace_id
 
@@ -646,3 +646,124 @@ def test_structure_digest_ignores_timestamps_and_tids():
 def test_default_trace_id_never_all_zero():
     assert qtrace.default_trace_id(0) == "0" * 31 + "1"
     assert all(qtrace.default_trace_id(i) != "0" * 32 for i in range(64))
+
+
+# -- bounded-memory + publish-last regression pins (review fixes) ------------
+
+
+def test_structure_digest_order_independent_and_o1_memory():
+    """The digest is a rolling per-trace-hash sum folded in at settle:
+    settle order must not move it (threads interleave settles), and the
+    plane must retain NO per-query list — a lifetime-armed daemon stays
+    O(1) in query count."""
+    def build(order):
+        plane = qtrace.QueryPlane()
+        trs = []
+        for qid in (0, 1, 2):
+            tr = plane.new_trace(qid, qid, qtrace.default_trace_id(qid),
+                                 start_s=0.0)
+            tr.phases.append({"name": "query/dispatch", "start_s": 0.0,
+                              "duration_s": 0.1 * (qid + 1), "tid": 7})
+            trs.append(tr)
+        for i in order:
+            plane.settle(trs[i], "answered", 1.0, 5.0)
+        return plane, plane.structure_digest()
+
+    p1, d1 = build([0, 1, 2])
+    p2, d2 = build([2, 0, 1])
+    assert d1 == d2
+    assert not hasattr(p1, "_settled")   # the unbounded ledger is gone
+    # Bounded state only: ring + samples are deques with maxlen.
+    assert p1._ring.maxlen is not None
+    assert all(dq.maxlen is not None for dq in p1._samples.values())
+
+
+def test_sealed_trace_ignores_post_settle_phase_appends():
+    """After settle seals a trace, a late phase (the ingress thread's
+    query/serialize) must not mutate the settled record or move the
+    digest — it mirrors only into the live tracer."""
+    tracer = obs_trace.enable_tracing()
+    qtrace.arm_query_plane()
+    plane = qtrace.get_query_plane()
+    tr = plane.new_trace(0, 1, qtrace.default_trace_id(0), start_s=0.0)
+    tr.phase("query/fetch", 0.0, 0.1)
+    plane.settle(tr, "answered", 1.0, 100.0)
+    digest = plane.structure_digest()
+    tr.phase("query/serialize", 1.0, 0.01)
+    assert [p["name"] for p in tr.phases] == ["query/fetch"]
+    assert plane.structure_digest() == digest
+    # ... but the live span tree still shows the serialize lane.
+    assert "query/serialize" in {s.name for s in tracer.spans()}
+
+
+def test_settle_happens_before_resolve_publishes(graph):
+    """resolve() is the LAST step of every settle path: when the
+    waiting thread wakes, the trace is already sealed and counted, so
+    post-wake work can never race the dispatcher on the timeline."""
+    qtrace.arm_query_plane()
+    plane = qtrace.get_query_plane()
+    srv = PprServer(graph, config=PageRankConfig(num_iters=5),
+                    serve_config=serve_config())
+    srv.start()          # REAL dispatcher thread
+    try:
+        q = srv.submit(17, k=4, deadline_s=5.0)
+        q.result(timeout=10.0)
+        assert plane.settled_count == 1
+        assert q.trace._sealed is True
+    finally:
+        srv.drain()
+
+
+def test_serialize_phase_stays_out_of_settled_record(graph):
+    """End-to-end over HTTP: query/serialize shows in the live Chrome
+    lanes but never in the flight-recorder ring (the settled record)."""
+    tracer = obs_trace.enable_tracing()
+    qtrace.arm_query_plane()
+    plane = qtrace.get_query_plane()
+    srv = PprServer(graph, config=PageRankConfig(num_iters=5),
+                    serve_config=serve_config())
+    srv.start()
+    try:
+        with QueryIngress(srv, port=0) as ing:
+            url = f"http://127.0.0.1:{ing.port}/ppr?source=6&k=4"
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                assert resp.status == 200
+    finally:
+        srv.drain()
+    obs_trace.disable_tracing()
+    ring_names = {p["name"] for t in plane._ring for p in t.phases}
+    assert "query/fetch" in ring_names
+    assert "query/serialize" not in ring_names
+    assert "query/serialize" in {s.name for s in tracer.spans()}
+
+
+def test_tracer_max_spans_ring():
+    """Tracer(max_spans=N) keeps the most recent N finished spans — the
+    bounded mode the daemon's --query-trace capture runs in."""
+    tr = obs_trace.Tracer(max_spans=10)
+    for i in range(25):
+        sp = tr.start_span(f"s{i}")
+        tr.finish_span(sp)
+    spans = tr.spans()
+    assert len(spans) == 10
+    assert spans[0].name == "s15" and spans[-1].name == "s24"
+    # Default stays unbounded (finite solver captures export it all).
+    tr2 = obs_trace.Tracer()
+    for i in range(25):
+        tr2.finish_span(tr2.start_span(f"t{i}"))
+    assert len(tr2.spans()) == 25
+
+
+def test_serve_cli_rejects_half_slow_query_pair(tmp_path, capsys):
+    """--slow-query-ms and --slow-query-log are a pair: half of it is a
+    silent no-op, so the CLI refuses it at parse time (exit 2)."""
+    from pagerank_tpu.serve.__main__ import main
+
+    with pytest.raises(SystemExit) as e1:
+        main(["--slow-query-ms", "5"])
+    assert e1.value.code == 2
+    with pytest.raises(SystemExit) as e2:
+        main(["--slow-query-log", str(tmp_path / "slow.jsonl")])
+    assert e2.value.code == 2
+    err = capsys.readouterr().err
+    assert "must be given together" in err
