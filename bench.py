@@ -206,7 +206,7 @@ def _enable_compile_cache():
     )
 
 
-def _emit(out: dict, args) -> None:
+def _emit(out: dict, args) -> dict:
     """THE one bench output path (ISSUE 9): stamp the schema version,
     print the ONE JSON line the driver contract requires, and write
     the canonical artifacts directly — ``--out`` saves the record
@@ -235,6 +235,7 @@ def _emit(out: dict, args) -> None:
         print(("appended record to" if added
                else "record already in (content-hash dedupe)")
               + f" perf ledger {args.history}", file=sys.stderr)
+    return out
 
 
 def run_rate(args, dtype: str, accum_dtype: str, wide_accum: str = "auto",
@@ -949,7 +950,7 @@ def run_multichip(args):
         sweep["legs"]["async_lag1"]["iters_to_tol"]
     out["edge_factor"] = args.edge_factor
     out["env"] = _env_fingerprint()
-    _emit(out, args)
+    return _emit(out, args)
 
 
 def _preflight(args) -> bool:
@@ -1099,7 +1100,7 @@ def run_ppr_serve(args):
         "topk": sc.topk,
         "env": _env_fingerprint(),
     }
-    _emit(out, args)
+    return _emit(out, args)
 
 
 def main(argv=None):
@@ -1239,12 +1240,10 @@ def main(argv=None):
         sys.exit(int(ExitCode.PREFLIGHT_UNFIT))
 
     if args.ppr_serve:
-        run_ppr_serve(args)
-        return
+        return run_ppr_serve(args)
 
     if args.multichip:
-        run_multichip(args)
-        return
+        return run_multichip(args)
 
     if args.build_only:
         if args.host_build:
@@ -1286,8 +1285,7 @@ def main(argv=None):
                    "pair_warm_over_f32":
                        pair_warm["build_s"] / f32["build_s"]}
         out["env"] = _env_fingerprint()
-        _emit(out, args)
-        return
+        return _emit(out, args)
 
     if args.dtype is not None:
         # Single-config mode (the original schema).
@@ -1311,8 +1309,7 @@ def main(argv=None):
         if not args.no_accuracy:
             out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters)
         out["env"] = _env_fingerprint()
-        _emit(out, args)
-        return
+        return _emit(out, args)
 
     # Couple mode: the headline is the ACCURACY-GRADE config's rate
     # (pair-f64: f64 storage + pair accumulation — f32 storage loses
@@ -1386,7 +1383,7 @@ def main(argv=None):
         out["accuracy"] = run_accuracy(args.accuracy_scale, args.iters,
                                        with_bf16=True)
     out["env"] = _env_fingerprint()
-    _emit(out, args)
+    return _emit(out, args)
 
 
 if __name__ == "__main__":

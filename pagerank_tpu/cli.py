@@ -36,7 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native PageRank (reference or textbook semantics).",
         epilog="Developer tooling: `python -m pagerank_tpu.analysis` "
         "runs the repo's AST lint + jaxpr contract checker "
-        "(docs/ANALYSIS.md).",
+        "(docs/ANALYSIS.md); `python -m pagerank_tpu.obs campaign "
+        "run` executes the full measurement campaign with resumable "
+        "legs and a typed decision ledger (docs/OBSERVABILITY.md).",
     )
     src = p.add_mutually_exclusive_group(required=True)
     src.add_argument(

@@ -60,8 +60,31 @@ artifacts, the perf-history ledger, and the OOM-preflight fit check.
                                   signature); --dump-hlo DIR writes
                                   the raw modules for offline diffing
 
+  campaign run --campaign-dir D   the measurement-campaign
+                                  orchestrator (ISSUE 20;
+                                  obs/campaign.py): execute the
+                                  checked-in ROADMAP campaign spec
+                                  (hlo -> fit -> graph -> bench couple
+                                  -> bench --multichip -> bench
+                                  --ppr-serve -> history gate) as ONE
+                                  resumable command — checksummed
+                                  per-leg artifacts, atomic manifest,
+                                  SIGTERM drains to exit 75, resume
+                                  skips validated legs. With
+                                  --fake-devices N every leg runs
+                                  end-to-end on CPU fake devices and
+                                  all verdicts are non-binding
+  campaign status --campaign-dir D    per-leg progress from the
+                                      manifest
+  campaign report --campaign-dir D    the strict-JSON campaign report:
+                                      five typed verdicts + the human
+                                      decision ledger (--full adds
+                                      measured evidence)
+
 Exit codes: 0 ok, 1 gate violation / does not fit / defeated gather /
-mass-ledger violation, 2 usage/unreadable input.
+mass-ledger violation / failed or incomplete campaign, 2 usage/
+unreadable input, 75 campaign drained on SIGTERM (resume with the
+same command).
 """
 
 from __future__ import annotations
@@ -140,6 +163,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "(the artifact is normalized, not appended)")
     ga.add_argument("--json", action="store_true",
                     help="emit the GateResult as JSON")
+    ga.add_argument("--propose-budgets", default=None,
+                    metavar="OUT.json",
+                    help="also derive refreshed floors/ceilings from "
+                    "the measured medians of each budget entry's "
+                    "matching env-class rows (requires --budgets), "
+                    "write the full proposal doc to OUT.json, and "
+                    "print the diff vs the input budgets — the "
+                    "ROADMAP's 'refresh floors from real numbers' "
+                    "step, mechanized")
     fp = sub.add_parser(
         "fit",
         help="OOM-preflight fit check (ISSUE 10; obs/devices.py): "
@@ -242,6 +274,64 @@ def build_parser() -> argparse.ArgumentParser:
     hp2.add_argument("--dump-hlo", default=None, metavar="DIR",
                      help="also write every inspected program's raw "
                      "optimized HLO to DIR as <form>.<program>.hlo")
+    cp = sub.add_parser(
+        "campaign",
+        help="the measurement-campaign orchestrator (ISSUE 20; "
+        "obs/campaign.py): run/resume the checked-in ROADMAP "
+        "campaign as one command with checksummed per-leg artifacts, "
+        "typed verdicts, and a decision ledger",
+    )
+    csub = cp.add_subparsers(dest="campaign_command", required=True)
+    cr = csub.add_parser(
+        "run", help="run (or resume) the campaign; completed legs "
+        "with validated artifacts are skipped, SIGTERM drains to "
+        "exit 75 at the next leg boundary")
+    cr.add_argument("--campaign-dir", required=True, metavar="DIR",
+                    help="artifact + manifest directory (the resume "
+                    "key: rerun with the same DIR to resume)")
+    cr.add_argument("--fake-devices", type=int, default=0, metavar="N",
+                    help="non-binding dry run: force JAX_PLATFORMS="
+                    "cpu with N fake host devices (set BEFORE backend "
+                    "init), run the smoke-scale profile, and mark "
+                    "every verdict 'defer' — the tier-1-testable "
+                    "rehearsal of the TPU session")
+    cr.add_argument("--profile", choices=["auto", "roadmap", "smoke"],
+                    default="auto",
+                    help="campaign geometry (default auto: smoke when "
+                    "--fake-devices is set, roadmap otherwise)")
+    cr.add_argument("--ndev", type=int, default=8,
+                    help="target device count for the fit/graph/"
+                    "multichip legs (default 8)")
+    cr.add_argument("--budgets", default=None, metavar="BUDGETS.json",
+                    help="perf_budgets file the gate leg and verdict "
+                    "floors read (default: the checked-in "
+                    "perf_budgets.json)")
+    cr.add_argument("--drain-deadline", type=float, default=8.0,
+                    metavar="S",
+                    help="seconds after SIGTERM before the hard exit "
+                    "(default 8.0)")
+    cr.add_argument("--json", action="store_true",
+                    help="emit the stable campaign report as JSON "
+                    "instead of the human rendering")
+    cst = csub.add_parser(
+        "status", help="per-leg progress from the campaign manifest")
+    cst.add_argument("--campaign-dir", required=True, metavar="DIR")
+    cst.add_argument("--json", action="store_true",
+                     help="emit the manifest as JSON")
+    crp = csub.add_parser(
+        "report", help="rebuild the campaign report from the on-disk "
+        "artifacts (never re-runs anything): typed verdicts + the "
+        "decision ledger; exit 1 while the campaign is incomplete")
+    crp.add_argument("--campaign-dir", required=True, metavar="DIR")
+    crp.add_argument("--budgets", default=None, metavar="BUDGETS.json",
+                     help="perf_budgets file the verdict floors read "
+                     "(default: the checked-in perf_budgets.json)")
+    crp.add_argument("--json", action="store_true",
+                     help="emit the report as canonical strict JSON")
+    crp.add_argument("--full", action="store_true",
+                     help="include the volatile evidence: per-verdict "
+                     "measurements, per-leg walls, raw leg docs "
+                     "(NOT byte-stable across runs)")
     return p
 
 
@@ -614,8 +704,26 @@ def _cmd_history(args) -> int:
                 _load_json(args.record), source=args.record)
             records = list(records) + [rec]
         res = history_mod.evaluate_gate(records, budgets)
+        prop = None
+        if args.propose_budgets:
+            if budgets is None:
+                print("obs history: --propose-budgets needs --budgets "
+                      "(there is nothing to refresh without the "
+                      "checked-in floors)", file=sys.stderr)
+                return int(ExitCode.USAGE)
+            prop = history_mod.propose_budgets(records, budgets)
+            with open(args.propose_budgets, "w") as f:
+                f.write(json.dumps(
+                    report_mod._json_safe(prop["proposal"]),
+                    indent=2, allow_nan=False) + "\n")
         if args.json:
-            print(json.dumps(res.to_dict(), indent=2, allow_nan=False))
+            doc = res.to_dict()
+            if prop is not None:
+                doc = {"gate": doc,
+                       "proposal": {"changes": prop["changes"],
+                                    "skipped": prop["skipped"],
+                                    "out": args.propose_budgets}}
+            print(json.dumps(doc, indent=2, allow_nan=False))
         else:
             for line in res.notes:
                 print(f"gate: {line}")
@@ -628,12 +736,104 @@ def _cmd_history(args) -> int:
             print("gate: " + ("PASS" if res.ok else "FAIL")
                   + (f" ({len(res.drift_warnings)} drift warning(s))"
                      if res.drift_warnings else ""))
+            if prop is not None:
+                for c in prop["changes"]:
+                    print(f"propose: {c['leg']}.{c['metric']} "
+                          f"{c['bound']} {c['old']:.4g} -> "
+                          f"{c['new']:.4g} (median {c['median']:.4g} "
+                          f"over {c['n']} row(s))")
+                for s in prop["skipped"]:
+                    print(f"propose: {s['leg']}.{s['metric']} "
+                          f"unchanged — {s['rows']} matching row(s), "
+                          f"need {s['needed']}")
+                print(f"propose: wrote {args.propose_budgets}")
         # The exit-code taxonomy (pagerank_tpu/exitcodes.py): FAILURE
         # is a judged-bad gate, USAGE a bad/missing invocation.
         return int(ExitCode.OK if res.ok else ExitCode.FAILURE)
     except (OSError, json.JSONDecodeError, ValueError) as e:
         print(f"obs history: {e}", file=sys.stderr)
         return int(ExitCode.USAGE)
+
+
+def _cmd_campaign(args) -> int:
+    """The campaign orchestrator CLI (ISSUE 20; obs/campaign.py)."""
+    from pagerank_tpu.obs import campaign as campaign_mod
+
+    if args.campaign_command == "status":
+        try:
+            _spec, manifest, _docs, _metas = \
+                campaign_mod.load_campaign(args.campaign_dir)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"obs campaign: no campaign in "
+                  f"{args.campaign_dir}: {e}", file=sys.stderr)
+            return int(ExitCode.USAGE)
+        if args.json:
+            print(json.dumps(report_mod._json_safe(manifest),
+                             indent=2, allow_nan=False))
+        else:
+            print(campaign_mod.render_status(manifest))
+        return int(ExitCode.OK)
+
+    if args.campaign_command == "report":
+        try:
+            spec, manifest, docs, metas = \
+                campaign_mod.load_campaign(args.campaign_dir)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"obs campaign: no campaign in "
+                  f"{args.campaign_dir}: {e}", file=sys.stderr)
+            return int(ExitCode.USAGE)
+        budgets = campaign_mod._load_budgets_quiet(
+            args.budgets or campaign_mod.default_budgets_path())
+        rep = campaign_mod.build_report(spec, manifest, docs, metas,
+                                        budgets, full=args.full)
+        if args.json:
+            sys.stdout.write(report_mod.canonical_json(rep))
+        else:
+            print(campaign_mod.render_report(rep))
+        return int(ExitCode.OK if rep.get("complete")
+                   else ExitCode.FAILURE)
+
+    # run
+    if args.fake_devices:
+        # BEFORE any backend init: XLA reads these at first client
+        # creation, so setting them here (not at import time) is safe
+        # as long as nothing upstream touched jax.devices() yet.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                + str(args.fake_devices)).strip()
+    from pagerank_tpu import jobs
+
+    profile = args.profile
+    if profile == "auto":
+        profile = "smoke" if args.fake_devices else "roadmap"
+    spec = campaign_mod.build_spec(profile=profile, ndev=args.ndev)
+    runner = campaign_mod.CampaignRunner(
+        args.campaign_dir, spec, fake_devices=args.fake_devices,
+        budgets_path=args.budgets)
+    drain = jobs.GracefulDrain(deadline_s=args.drain_deadline)
+    with drain:
+        try:
+            runner.run(drain=drain,
+                       progress=lambda line: print(line,
+                                                   file=sys.stderr))
+        except jobs.DrainInterrupt as e:
+            runner.interrupt(str(e))
+            print(f"obs campaign: drained on signal ({e}); completed "
+                  "legs are durable — resume with the same command",
+                  file=sys.stderr)
+            return int(ExitCode.INTERRUPTED)
+    rep = runner.write_report()
+    if args.json:
+        sys.stdout.write(report_mod.canonical_json(rep))
+    else:
+        print(campaign_mod.render_report(rep))
+        print(f"report written to {runner.report_path}",
+              file=sys.stderr)
+    return int(ExitCode.OK if rep.get("complete")
+               else ExitCode.FAILURE)
 
 
 def main(argv=None) -> int:
@@ -646,6 +846,8 @@ def main(argv=None) -> int:
         return _cmd_hlo(args)
     if args.command == "graph":
         return _cmd_graph(args)
+    if args.command == "campaign":
+        return _cmd_campaign(args)
     return _cmd_history(args)
 
 

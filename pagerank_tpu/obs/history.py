@@ -967,6 +967,71 @@ def check_budgets(rec: dict, budgets: dict) -> List[str]:
     return violations
 
 
+def _round_sig(v: float, digits: int = 3) -> float:
+    """3-significant-figure rounding for proposed bounds — a floor of
+    5.4e8 is a statement a human can defend; 543217890.3 is noise."""
+    return float(f"{float(v):.{digits}g}")
+
+
+def propose_budgets(records: Sequence[dict], budgets: dict,
+                    safety: float = 0.9) -> dict:
+    """The ROADMAP's "refresh floors from real numbers" step,
+    mechanized (ISSUE 20): for every budget entry whose env/min_scale
+    scope matches enough ledger rows (the entry's OWN scoping rule —
+    :func:`_budget_applies` — so a TPU floor is only ever derived
+    from TPU rows), derive the refreshed bound from the trailing
+    window's median: ``min`` -> safety * median (a floor the measured
+    plateau clears with 1/safety headroom), ``max`` -> median / safety.
+    Entries with fewer than ``min_samples`` matching measurements are
+    skipped, never guessed. Returns::
+
+        {"proposal": <a valid perf_budgets doc with updated bounds,
+                      each changed entry annotated with its
+                      derivation>,
+         "changes": [{leg, metric, bound, old, new, median, n}, ...],
+         "skipped": [{leg, metric, rows, needed}, ...]}
+
+    The proposal is diffed against the checked-in file by
+    ``obs history gate --propose-budgets`` and rendered as the
+    campaign decision ledger's perf_budgets diff (obs/campaign.py).
+    """
+    if not 0 < safety <= 1:
+        raise ValueError(f"safety must be in (0, 1], got {safety}")
+    det = dict(DEFAULT_DETECTION)
+    det.update(budgets.get("detection") or {})
+    window = int(det.get("window", 8))
+    min_samples = int(det.get("min_samples", 3))
+    proposal = json.loads(json.dumps(_json_safe(budgets)))
+    changes: List[dict] = []
+    skipped: List[dict] = []
+    for b in proposal.get("budgets", []):
+        leg, metric = b.get("leg"), b.get("metric")
+        vals = [metric_value(r, leg, metric) for r in records
+                if _budget_applies(b, r)]
+        vals = [v for v in vals if v is not None][-window:]
+        if len(vals) < min_samples:
+            skipped.append({"leg": leg, "metric": metric,
+                            "rows": len(vals), "needed": min_samples})
+            continue
+        med, mad = median_mad(vals)
+        derived = False
+        for bound, new in (("min", _round_sig(med * safety)),
+                           ("max", _round_sig(med / safety))):
+            old = _num(b.get(bound))
+            if old is None or new == old:
+                continue
+            b[bound] = new
+            derived = True
+            changes.append({"leg": leg, "metric": metric,
+                            "bound": bound, "old": old, "new": new,
+                            "median": med, "n": len(vals)})
+        if derived:
+            b["derived"] = {"median": med, "mad": mad,
+                            "n": len(vals), "safety": safety}
+    return {"proposal": proposal, "changes": changes,
+            "skipped": skipped}
+
+
 @dataclass
 class GateResult:
     """One gate evaluation: violations fail CI; drift warnings and

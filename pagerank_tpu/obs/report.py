@@ -93,6 +93,16 @@ def _json_safe(obj):
         return repr(obj)
 
 
+def canonical_json(doc) -> str:
+    """THE canonical serialization: strict JSON, sorted keys, fixed
+    2-space indent, trailing newline. Two runs that produced the same
+    document produce the same BYTES — the campaign plane's
+    byte-identical resumed-report contract (obs/campaign.py) hangs off
+    this, so change it only with a schema bump."""
+    return json.dumps(_json_safe(doc), sort_keys=True, indent=2,
+                      allow_nan=False) + "\n"
+
+
 def git_rev(repo_dir: Optional[str] = None) -> Optional[str]:
     """Short git revision of the checkout (None outside a repo / without
     git) — pins the code axis of a report."""

@@ -399,14 +399,16 @@ def run_job_subprocess(argv: Sequence[str],
                        kill: Optional[ProcessKillPlan] = None,
                        env: Optional[Dict[str, str]] = None,
                        kill_log: Optional[str] = None,
-                       timeout: float = 600.0):
-    """Chaos harness: run ``python -m pagerank_tpu.cli <argv>`` as a
-    REAL subprocess, optionally carrying a seeded :class:`ProcessKillPlan`
-    that makes the child kill itself (SIGTERM -> graceful drain path,
-    SIGKILL -> nothing survives but the durable artifacts). Returns the
-    CompletedProcess; a SIGKILL'd child's returncode is ``-9`` and a
-    hard-exited SIGTERM child's is per the exit-code taxonomy
-    (pagerank_tpu/exitcodes.py)."""
+                       timeout: float = 600.0,
+                       module: str = "pagerank_tpu.cli"):
+    """Chaos harness: run ``python -m <module> <argv>`` as a REAL
+    subprocess (default module: ``pagerank_tpu.cli``; the campaign
+    chaos tests target ``pagerank_tpu.obs``), optionally carrying a
+    seeded :class:`ProcessKillPlan` that makes the child kill itself
+    (SIGTERM -> graceful drain path, SIGKILL -> nothing survives but
+    the durable artifacts). Returns the CompletedProcess; a SIGKILL'd
+    child's returncode is ``-9`` and a hard-exited SIGTERM child's is
+    per the exit-code taxonomy (pagerank_tpu/exitcodes.py)."""
     import subprocess
 
     child_env = dict(os.environ)
@@ -420,7 +422,7 @@ def run_job_subprocess(argv: Sequence[str],
     else:
         child_env.pop(ProcessKillPlan.ENV, None)
     return subprocess.run(
-        [sys.executable, "-m", "pagerank_tpu.cli", *argv],
+        [sys.executable, "-m", module, *argv],
         env=child_env, capture_output=True, text=True, timeout=timeout,
     )
 

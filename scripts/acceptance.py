@@ -312,10 +312,23 @@ CONFIGS = {
               label="query-plane smoke (armed tracing under chaos: "
                     "determinism, exemplars, slow-query log, flight "
                     "recorder)"),
+    # Campaign-plane smoke (ISSUE 20; obs/campaign.py): the whole
+    # measurement campaign, dry — one `campaign run --fake-devices 8`
+    # subprocess at smoke scale must complete every leg inside its
+    # per-leg wall budget, report.json must strict-parse as canonical
+    # JSON, all five typed verdicts must be present and NON-binding
+    # with decision "defer" (a CPU dry run never flips a TPU
+    # decision), the decision ledger must render one entry per
+    # verdict, and `campaign report` must re-render the identical
+    # bytes — under CAMPAIGN_SMOKE_BUDGET_S.
+    "AA": dict(kind="campaign",
+               label="campaign-plane smoke (dry-run campaign on 8 "
+                     "fake devices: all legs, 5 non-binding "
+                     "verdicts, decision ledger)"),
 }
 DEFAULT_KEYS = ["D", "G", "H", "K", "L", "M", "X", "Y", "Z", "N", "O",
                 "Q", "R", "S", "U", "V", "W", "F", "A", "B", "T", "P",
-                "E", "BV", "BB", "TV"]
+                "E", "BV", "BB", "TV", "AA"]
 
 # Recorded budget for the scale-18 build smoke (seconds): the restaged
 # single-sort pipeline builds this geometry in low single digits warm
@@ -337,6 +350,13 @@ OBS_SMOKE_BUDGET_S = 2.0
 # zero-extra-host-syncs contract's wall-clock shadow (PTC007 checks
 # the structural half).
 LIVE_SMOKE_BUDGET_S = 2.0
+
+# Budget for the campaign-plane smoke (seconds): the dry-run campaign
+# executes all seven legs in one subprocess — measured ~48s warm /
+# ~167s with a cold XLA compile cache on the CPU test substrate (the
+# bench legs dominate). 240s absorbs the cold-cache case while still
+# catching a campaign that hangs or re-runs legs it should resume.
+CAMPAIGN_SMOKE_BUDGET_S = 240.0
 
 # PPR gates. Top-k membership is judged against ORACLE SCORES, not id
 # sets: vertices tied at the k-th score legitimately swap in/out of an
@@ -2409,6 +2429,102 @@ def run_jobs_smoke(key: str):
     return rec
 
 
+def run_campaign_smoke(key: str):
+    """ISSUE-20 gate: the whole measurement campaign, dry. One
+    `python -m pagerank_tpu.obs campaign run --fake-devices 8`
+    subprocess (real child, so the fake-device XLA flags never touch
+    this process's backend) must complete every leg of the smoke
+    profile inside its per-leg wall budget. Gates: exit 0 with a
+    complete strict-JSON report.json (canonical form, constants
+    rejected), all five typed verdicts present and NON-binding with
+    decision "defer", one decision-ledger entry per verdict, every
+    leg done within budget, `campaign report --json` re-rendering
+    byte-identical to the durable report.json, and the wall under
+    CAMPAIGN_SMOKE_BUDGET_S."""
+    import shutil
+    import tempfile
+
+    from pagerank_tpu.obs import campaign as campaign_mod
+    from pagerank_tpu.testing.faults import run_job_subprocess
+
+    spec = CONFIGS[key]
+    work = tempfile.mkdtemp(prefix="pagerank_campaign_")
+    reject = lambda c: (_ for _ in ()).throw(  # noqa: E731
+        ValueError(f"non-finite constant {c} in campaign report"))
+    t0 = time.perf_counter()
+    try:
+        proc = run_job_subprocess(
+            ["campaign", "run", "--campaign-dir", work,
+             "--fake-devices", "8", "--json"],
+            module="pagerank_tpu.obs",
+            timeout=CAMPAIGN_SMOKE_BUDGET_S + 120.0)
+        t_run = time.perf_counter() - t0
+        report_raw = b""
+        report = {}
+        report_path = os.path.join(work, "report.json")
+        if os.path.exists(report_path):
+            with open(report_path, "rb") as f:
+                report_raw = f.read()
+            report = json.loads(report_raw, parse_constant=reject)
+        rerender = run_job_subprocess(
+            ["campaign", "report", "--campaign-dir", work, "--json"],
+            module="pagerank_tpu.obs", timeout=120.0)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    expected = set(campaign_mod.VERDICTS)
+    verdicts = report.get("verdicts") or {}
+    legs = report.get("legs") or []
+    nonbinding = (report.get("binding") is False
+                  and report.get("fake_devices") == 8
+                  and all(v.get("binding") is False
+                          and v.get("decision") == "defer"
+                          for v in verdicts.values()))
+    legs_ok = bool(legs) and all(
+        leg.get("status") == "done" and leg.get("within_budget")
+        for leg in legs)
+    ledger = report.get("decision_ledger") or []
+    rerender_ok = (rerender.returncode == 0
+                   and rerender.stdout.encode() == report_raw)
+    passed = bool(proc.returncode == 0 and report.get("complete")
+                  and set(verdicts) == expected and nonbinding
+                  and legs_ok and len(ledger) == len(expected)
+                  and rerender_ok
+                  and t_run <= CAMPAIGN_SMOKE_BUDGET_S)
+    rec = {
+        "config": key,
+        "kind": "campaign",
+        "label": spec["label"],
+        "exit_code": proc.returncode,
+        "complete": bool(report.get("complete")),
+        "legs_done": sum(1 for leg in legs
+                         if leg.get("status") == "done"),
+        "legs_total": len(legs),
+        "verdicts": sorted(verdicts),
+        "all_nonbinding_defer": nonbinding,
+        "ledger_entries": len(ledger),
+        "report_rerender_identical": rerender_ok,
+        "seconds": t_run,
+        "budget_s": CAMPAIGN_SMOKE_BUDGET_S,
+        "passed": passed,
+    }
+    if not passed and proc.stderr:
+        rec["stderr_tail"] = proc.stderr[-2000:]
+    verdict_note = ("all defer/non-binding" if nonbinding
+                    else "BINDING OR NON-DEFER")
+    print(
+        f"[{key}] campaign dry run: exit {proc.returncode}, "
+        f"{rec['legs_done']}/{rec['legs_total']} legs done, "
+        f"{len(verdicts)}/{len(expected)} verdicts ({verdict_note}), "
+        f"ledger {len(ledger)} entries, re-render "
+        f"{'identical' if rerender_ok else 'DIVERGED'}; "
+        f"{t_run:.1f}s vs budget {CAMPAIGN_SMOKE_BUDGET_S:g}s -> "
+        f"{'PASS' if passed else 'FAIL'}",
+        file=sys.stderr,
+    )
+    return rec
+
+
 def run_partitioned_smoke(key: str):
     """ISSUE-6 gate: a short solve on the partition-centric layout —
     the jax engine through the CLI with an explicit --partition-span
@@ -3006,7 +3122,8 @@ def main(argv=None) -> int:
                "devices": run_devices_smoke, "hlo": run_hlo_smoke,
                "jobs": run_jobs_smoke, "graph": run_graph_smoke,
                "concurrency": run_concurrency_smoke,
-               "sdc": run_sdc_smoke, "kernels": run_kernels_smoke}
+               "sdc": run_sdc_smoke, "kernels": run_kernels_smoke,
+               "campaign": run_campaign_smoke}
     recs = [
         runners.get(CONFIGS[k].get("kind"), run_one)(k) for k in keys
     ]

@@ -25,3 +25,11 @@ import jax  # noqa: E402
 # beats env); re-pin to CPU before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); covered "
+        "by the full suite and scripts/acceptance.py",
+    )

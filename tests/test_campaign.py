@@ -1,0 +1,634 @@
+"""Campaign-plane tests (ISSUE 20; pagerank_tpu/obs/campaign.py).
+
+Fast tier: golden-artifact verdict fixtures (including degraded
+inputs — missing lowering blocks, None cost fields, a leg that blew
+its wall budget), the budget-proposal derivation, and the full
+runner orchestration (resume-skip, drain, failure, byte-identical
+stable report) driven through STUB entrypoints so no jax work runs.
+
+Slow tier (excluded from tier-1 by ``-m 'not slow'``): the real
+``python -m pagerank_tpu.obs campaign run --fake-devices 8`` dry run
+as a subprocess, plus the SIGKILL-mid-leg chaos resume whose final
+report must be byte-identical to an uninterrupted run — the ISSUE 20
+acceptance criterion verbatim. The acceptance smoke AA
+(scripts/acceptance.py) runs the same flow in the default order.
+"""
+
+import json
+import os
+import signal
+
+import pytest
+
+from pagerank_tpu import jobs
+from pagerank_tpu.obs import campaign
+from pagerank_tpu.obs import history
+from pagerank_tpu.obs import report as report_mod
+from pagerank_tpu.obs.__main__ import main as obs_main
+from pagerank_tpu.testing.faults import ProcessKillPlan, \
+    run_job_subprocess
+
+
+# -- golden leg documents ----------------------------------------------------
+
+
+def couple_output(part=4.2e8, f32=3.5e8, pallas=3.4e8,
+                  kernel="pallas", requested=None,
+                  with_lowering=True):
+    out = {
+        "metric": "edges_per_sec_per_chip",
+        "value": 2.6e8,
+        "fast_f32": {"value": f32},
+        "partitioned_f32": {"value": part},
+        "pallas_partitioned": {"value": pallas,
+                               "layout": {"kernel": kernel}},
+    }
+    if requested is not None:
+        out["pallas_partitioned"]["layout"]["kernel_requested"] = \
+            requested
+    if with_lowering:
+        out["partitioned_f32"]["lowering"] = {
+            "step": {"hlo_bytes_per_edge": 171.2}}
+    return out
+
+
+def multichip_output(sparse=2.0e8, dense=1.6e8, gain=0.12,
+                     below=True, sync_iters=10, async_iters=12,
+                     converged=True):
+    return {
+        "metric": "multichip_edges_per_sec_per_chip",
+        "sparse_exchange": {
+            "value": sparse,
+            "attribution": {"exchange_fraction": 0.31,
+                            "achieved_bytes_per_sec": 1.1e9},
+        },
+        "dense_exchange": {"value": dense},
+        "exchange_overlap": {
+            "sync_compute_plus_exchange_s": 0.010,
+            "async_step_s": 0.010 * (1.0 - gain),
+            "async_below_sync_sum": below,
+            "gain": gain,
+        },
+        "exchanged_bytes": {"sparse_below_dense": True,
+                            "halo_fraction": 0.07, "head_k": 128},
+        "staleness_sweep": {"legs": {
+            "sync": {"iters_to_tol": sync_iters, "converged": True},
+            "async_lag1": {"iters_to_tol": async_iters,
+                           "converged": converged},
+        }},
+    }
+
+
+def serve_output(qps=150.0, p99=120.0, shed=0.05):
+    return {"metric": "ppr_serve_queries_per_sec", "value": qps,
+            "p99_ms": p99, "shed_fraction": shed}
+
+
+@pytest.fixture(scope="module")
+def budgets():
+    return history.load_budgets(campaign.default_budgets_path())
+
+
+# -- verdict extraction: typed decisions, degraded inputs --------------------
+
+
+def test_partitioned_flip_and_keep(budgets):
+    d, reason, ev = campaign.VERDICTS["partitioned_vs_default"](
+        couple_output(part=4.2e8, f32=3.5e8), budgets)
+    assert d == "flip_partitioned_to_default"
+    assert ev["measured_ratio"] == pytest.approx(1.2)
+    assert ev["model_ratio"] == pytest.approx(588.6 / 165.7)
+    d, _, _ = campaign.VERDICTS["partitioned_vs_default"](
+        couple_output(part=3.6e8, f32=3.5e8), budgets)
+    assert d == "keep_step_default"
+
+
+def test_partitioned_missing_lowering_block_still_decides(budgets):
+    """Degraded input: no lowering block (backend reported no HLO) —
+    the rate evidence still adjudicates; the HLO field is just None."""
+    d, _, ev = campaign.VERDICTS["partitioned_vs_default"](
+        couple_output(with_lowering=False), budgets)
+    assert d == "flip_partitioned_to_default"
+    assert ev["partitioned_hlo_bytes_per_edge"] is None
+
+
+def test_partitioned_none_values_inconclusive(budgets):
+    out = couple_output()
+    out["fast_f32"]["value"] = None
+    d, reason, _ = campaign.VERDICTS["partitioned_vs_default"](
+        out, budgets)
+    assert d == "inconclusive"
+    assert "rate values" in reason
+    d, _, _ = campaign.VERDICTS["partitioned_vs_default"](None, budgets)
+    assert d == "inconclusive"
+
+
+def test_pallas_keep_delete_and_downgrade(budgets):
+    # Clears the 3.0e8 floor and holds >= 0.95x of the XLA leg.
+    d, _, _ = campaign.VERDICTS["pallas_keep_or_delete"](
+        couple_output(pallas=4.1e8, part=4.2e8), budgets)
+    assert d == "keep_pallas_kernel"
+    # Below the checked-in perf_budgets floor -> delete (PTH004).
+    d, reason, _ = campaign.VERDICTS["pallas_keep_or_delete"](
+        couple_output(pallas=2.0e8, part=4.2e8), budgets)
+    assert d == "delete_pallas_kernel"
+    assert "floor" in reason
+    # Above the floor but losing >5% to XLA -> delete.
+    d, _, _ = campaign.VERDICTS["pallas_keep_or_delete"](
+        couple_output(pallas=3.2e8, part=4.2e8), budgets)
+    assert d == "delete_pallas_kernel"
+    # Probe downgrade: the kernel never ran -> inconclusive.
+    d, reason, ev = campaign.VERDICTS["pallas_keep_or_delete"](
+        couple_output(kernel="partitioned", requested="pallas"),
+        budgets)
+    assert d == "inconclusive"
+    assert "downgraded" in reason
+    assert ev["kernel_requested"] == "pallas"
+
+
+def test_halo_vs_dense(budgets):
+    d, _, ev = campaign.VERDICTS["halo_vs_dense"](
+        multichip_output(sparse=2.0e8, dense=1.6e8), budgets)
+    assert d == "keep_sparse_halo_default"
+    assert ev["head_k"] == 128
+    d, _, _ = campaign.VERDICTS["halo_vs_dense"](
+        multichip_output(sparse=1.4e8, dense=1.6e8), budgets)
+    assert d == "prefer_dense_exchange"
+    d, _, _ = campaign.VERDICTS["halo_vs_dense"]({}, budgets)
+    assert d == "inconclusive"
+
+
+def test_async_overlap(budgets):
+    d, _, _ = campaign.VERDICTS["async_overlap"](
+        multichip_output(gain=0.12, below=True), budgets)
+    assert d == "flip_halo_async_default"
+    d, _, _ = campaign.VERDICTS["async_overlap"](
+        multichip_output(gain=0.02, below=True), budgets)
+    assert d == "keep_synchronous_exchange"
+    # Convergence penalty eats the wall gain.
+    d, reason, _ = campaign.VERDICTS["async_overlap"](
+        multichip_output(gain=0.2, sync_iters=10, async_iters=40),
+        budgets)
+    assert d == "keep_synchronous_exchange"
+    assert "penalty" in reason
+    d, _, _ = campaign.VERDICTS["async_overlap"](
+        multichip_output(gain=0.2, converged=False), budgets)
+    assert d == "keep_synchronous_exchange"
+    # Degraded: attribution block missing entirely.
+    out = multichip_output()
+    del out["exchange_overlap"]
+    d, reason, _ = campaign.VERDICTS["async_overlap"](out, budgets)
+    assert d == "inconclusive"
+    assert "exchange_overlap" in reason
+
+
+def test_ppr_serve_floors(budgets):
+    d, _, _ = campaign.VERDICTS["ppr_serve_floors"](
+        serve_output(qps=150.0), budgets)
+    assert d == "tighten_serve_floors"  # >= 1.2x the 100 q/s floor
+    d, _, _ = campaign.VERDICTS["ppr_serve_floors"](
+        serve_output(qps=105.0), budgets)
+    assert d == "keep_serve_floors"
+    d, reason, ev = campaign.VERDICTS["ppr_serve_floors"](
+        serve_output(qps=50.0, p99=700.0), budgets)
+    assert d == "investigate_serve_regression"
+    assert len(ev["violations"]) == 2
+    d, _, _ = campaign.VERDICTS["ppr_serve_floors"](
+        serve_output(qps=None), budgets)
+    assert d == "inconclusive"
+    d, reason, _ = campaign.VERDICTS["ppr_serve_floors"](
+        serve_output(), {"budgets": []})
+    assert d == "inconclusive"
+    assert "no ppr_serve floors" in reason
+
+
+def test_extract_verdict_overrides(budgets):
+    doc = {"output": couple_output()}
+    # Binding + within budget: the measured decision binds.
+    v = campaign.extract_verdict("partitioned_vs_default",
+                                 "bench_couple", doc, budgets,
+                                 binding=True, over_budget=False)
+    assert v["decision"] == "flip_partitioned_to_default"
+    assert v["binding"] is True
+    # Binding + over budget: measurements are suspect -> inconclusive.
+    v = campaign.extract_verdict("partitioned_vs_default",
+                                 "bench_couple", doc, budgets,
+                                 binding=True, over_budget=True)
+    assert v["decision"] == "inconclusive"
+    assert "wall budget" in v["reason"]
+    # Non-binding: defer, with the would-be decision in the evidence.
+    v = campaign.extract_verdict("partitioned_vs_default",
+                                 "bench_couple", doc, budgets,
+                                 binding=False, over_budget=False)
+    assert v["decision"] == "defer"
+    assert v["reason"] == campaign.NONBINDING_REASON
+    assert v["evidence"]["would_decide"] == \
+        "flip_partitioned_to_default"
+    # Missing artifact: inconclusive whatever the mode.
+    v = campaign.extract_verdict("partitioned_vs_default",
+                                 "bench_couple", None, budgets,
+                                 binding=True, over_budget=False)
+    assert v["decision"] == "inconclusive"
+    assert "no artifact" in v["reason"]
+    # Every decision the extractors can return has ledger text.
+    assert v["decision"] in campaign.ACTION_TEXT
+
+
+# -- budget proposal (obs history gate --propose-budgets) --------------------
+
+
+def _serve_record(qps, backend="tpu"):
+    return {"legs": {"ppr_serve": {"queries_per_sec": qps}},
+            "env": {"backend": backend}, "workload": {"scale": 22}}
+
+
+PROPOSE_BUDGETS = {
+    "schema_version": 1,
+    "detection": {"window": 8, "min_samples": 3},
+    "budgets": [
+        {"leg": "ppr_serve", "metric": "queries_per_sec",
+         "min": 100.0, "env": {"backend": "tpu"}},
+        {"leg": "ppr_serve", "metric": "p99_ms", "max": 500.0,
+         "env": {"backend": "tpu"}},
+        {"leg": "fast_f32", "metric": "edges_per_sec_per_chip",
+         "min": 3.0e8, "env": {"backend": "tpu"}},
+    ],
+}
+
+
+def test_propose_budgets_derivation():
+    records = [_serve_record(q) for q in (190.0, 200.0, 210.0, 205.0)]
+    # CPU rows must NOT contribute to a tpu-scoped floor.
+    records += [_serve_record(20.0, backend="cpu")]
+    out = history.propose_budgets(records, PROPOSE_BUDGETS)
+    changes = {(c["leg"], c["metric"], c["bound"]): c
+               for c in out["changes"]}
+    c = changes[("ppr_serve", "queries_per_sec", "min")]
+    assert c["old"] == 100.0
+    # safety * median(190, 200, 205, 210) = 0.9 * 202.5, to 3 sig figs
+    assert c["new"] == 182.0
+    assert c["n"] == 4
+    # Entries with too few matching rows are skipped, never guessed.
+    skipped = {(s["leg"], s["metric"]) for s in out["skipped"]}
+    assert ("ppr_serve", "p99_ms") in skipped
+    assert ("fast_f32", "edges_per_sec_per_chip") in skipped
+    # The proposal doc is still a valid budgets file, with the
+    # derivation recorded on the changed entry.
+    prop = out["proposal"]
+    entry = next(b for b in prop["budgets"]
+                 if b["metric"] == "queries_per_sec")
+    assert entry["min"] == c["new"]
+    assert entry["derived"]["n"] == 4
+    # The input doc is untouched.
+    assert PROPOSE_BUDGETS["budgets"][0]["min"] == 100.0
+
+
+def test_propose_budgets_cli(tmp_path, capsys):
+    ledger = tmp_path / "ledger.jsonl"
+    for q in (190.0, 200.0, 210.0):
+        history.append_record(str(ledger), _serve_record(q))
+    bpath = tmp_path / "budgets.json"
+    bpath.write_text(json.dumps(PROPOSE_BUDGETS))
+    out = tmp_path / "proposal.json"
+    rc = obs_main(["history", "gate", str(ledger),
+                   "--budgets", str(bpath),
+                   "--propose-budgets", str(out), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["gate"]["ok"] is True
+    assert doc["proposal"]["changes"][0]["bound"] == "min"
+    written = json.loads(out.read_text())
+    assert history.load_budgets(str(out))  # valid budgets file
+    assert written["budgets"][0]["min"] == pytest.approx(0.9 * 200.0)
+    # Without --budgets the flag is a usage error.
+    rc = obs_main(["history", "gate", str(ledger),
+                   "--propose-budgets", str(out)])
+    assert rc == 2
+
+
+# -- runner orchestration (stub entrypoints; no jax work) --------------------
+
+
+STUB_HLO = {"command": ["obs", "hlo"], "exit_code": 0,
+            "output": {"partitioned": {"step": {
+                "gather": {"strategy": "native"}}}}}
+STUB_HLO_DEFEATED = {"command": ["obs", "hlo"], "exit_code": 1,
+                     "output": {"partitioned": {"step": {
+                         "gather": {"strategy": "expanded"}}}}}
+
+
+def stub_spec():
+    return campaign.CampaignSpec(name="stub", legs=(
+        campaign.LegSpec("hlo", "stub", {"doc": STUB_HLO},
+                         budget_s=60.0),
+        campaign.LegSpec("bench_couple", "stub",
+                         {"doc": {"command": ["bench"], "exit_code": 0,
+                                  "output": couple_output()}},
+                         budget_s=60.0,
+                         preconditions=("gather_native",),
+                         verdicts=("partitioned_vs_default",)),
+        campaign.LegSpec("ppr_serve", "stub",
+                         {"doc": {"command": ["bench"], "exit_code": 0,
+                                  "output": serve_output()}},
+                         budget_s=60.0,
+                         verdicts=("ppr_serve_floors",)),
+    ))
+
+
+@pytest.fixture
+def stub_entry(monkeypatch):
+    calls = []
+
+    def _stub(params, ctx):
+        calls.append(params)
+        if params.get("raise"):
+            raise RuntimeError("leg exploded")
+        return params["doc"]
+
+    monkeypatch.setitem(campaign.ENTRYPOINTS, "stub", _stub)
+    return calls
+
+
+def test_runner_complete_and_resume_byte_identical(tmp_path,
+                                                   stub_entry):
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    # Uninterrupted run.
+    r1 = campaign.CampaignRunner(d1, stub_spec(), fake_devices=8)
+    r1.run()
+    assert r1.manifest["status"] == "complete"
+    r1.write_report()
+    # Interrupted run: bench_couple explodes mid-campaign. Only the
+    # exploding leg's params change — the intact legs keep their
+    # artifact keys so the fixed spec can validate-and-skip them.
+    broken = campaign.CampaignSpec(name="stub", legs=tuple(
+        leg if leg.name != "bench_couple" else campaign.LegSpec(
+            leg.name, leg.entrypoint,
+            dict(leg.params, **{"raise": True}),
+            budget_s=leg.budget_s, preconditions=leg.preconditions,
+            verdicts=leg.verdicts)
+        for leg in stub_spec().legs))
+    r2 = campaign.CampaignRunner(d2, broken, fake_devices=8)
+    r2.run()
+    assert r2.manifest["status"] == "failed"
+    assert r2.manifest["legs"]["bench_couple"]["status"] == "failed"
+    assert "leg exploded" in r2.manifest["legs"]["bench_couple"]["error"]
+    # ...then the fixed spec resumes: completed legs are SKIPPED
+    # (validated artifacts), only the failed leg re-runs.
+    del stub_entry[:]
+    r3 = campaign.CampaignRunner(d2, stub_spec(), fake_devices=8)
+    r3.run()
+    assert r3.manifest["status"] == "complete"
+    assert r3.manifest["legs"]["hlo"]["skipped"] is True
+    assert r3.manifest["legs"]["bench_couple"]["skipped"] is False
+    assert [p["doc"]["command"] for p in stub_entry] == [["bench"]]
+    r3.write_report()
+    # The stable report is byte-identical to the uninterrupted run's.
+    with open(os.path.join(d1, campaign.REPORT_NAME), "rb") as f:
+        a = f.read()
+    with open(os.path.join(d2, campaign.REPORT_NAME), "rb") as f:
+        b = f.read()
+    assert a == b
+    rep = json.loads(a)
+    assert rep["complete"] is True
+    assert rep["binding"] is False
+    assert set(rep["verdicts"]) == {"partitioned_vs_default",
+                                    "ppr_serve_floors"}
+    assert all(v["decision"] == "defer"
+               for v in rep["verdicts"].values())
+    assert len(rep["decision_ledger"]) == 2
+    # Volatile fields stay out of the stable report.
+    assert "resumes" not in rep and "evidence" not in rep
+
+
+def test_runner_drain_interrupt_and_resume(tmp_path, stub_entry):
+    d = str(tmp_path / "c")
+
+    class FakeDrain:
+        def check(self, where=""):
+            if where == "campaign/ppr_serve":
+                raise jobs.DrainInterrupt(f"drain at {where}")
+
+    r = campaign.CampaignRunner(d, stub_spec(), fake_devices=8)
+    with pytest.raises(jobs.DrainInterrupt):
+        r.run(drain=FakeDrain())
+    r.interrupt("campaign/ppr_serve")
+    m = json.load(open(os.path.join(d, campaign.MANIFEST_NAME)))
+    assert m["status"] == "interrupted"
+    assert m["legs"]["bench_couple"]["status"] == "done"
+    assert "ppr_serve" not in m["legs"]
+    # Resume completes only the un-run leg.
+    del stub_entry[:]
+    r2 = campaign.CampaignRunner(d, stub_spec(), fake_devices=8)
+    r2.run()
+    assert r2.manifest["status"] == "complete"
+    assert r2.manifest["resumes"] == 1
+    assert len(stub_entry) == 1
+
+
+def test_runner_binding_precondition_blocks(tmp_path, monkeypatch):
+    """Binding run: a defeated gather BLOCKS the bench leg; the
+    dry run only warns (pinned via manifest warnings)."""
+    def _stub(params, ctx):
+        return params["doc"]
+
+    monkeypatch.setitem(campaign.ENTRYPOINTS, "stub", _stub)
+    spec = campaign.CampaignSpec(name="stub", legs=(
+        campaign.LegSpec("hlo", "stub", {"doc": STUB_HLO_DEFEATED},
+                         budget_s=60.0),
+        campaign.LegSpec("bench_couple", "stub",
+                         {"doc": {"command": ["bench"], "exit_code": 0,
+                                  "output": couple_output()}},
+                         budget_s=60.0,
+                         preconditions=("gather_native",),
+                         verdicts=("partitioned_vs_default",)),
+    ))
+    rb = campaign.CampaignRunner(str(tmp_path / "bind"), spec,
+                                 fake_devices=0)
+    rb.run()
+    assert rb.manifest["status"] == "failed"
+    assert rb.manifest["legs"]["bench_couple"]["status"] == "blocked"
+    assert "DEFEATED" in rb.manifest["legs"]["bench_couple"]["error"]
+    rep = rb.write_report()
+    assert rep["verdicts"]["partitioned_vs_default"]["decision"] == \
+        "inconclusive"
+    # Dry run: same spec runs the leg anyway, with a recorded warning.
+    rf = campaign.CampaignRunner(str(tmp_path / "fake"), spec,
+                                 fake_devices=8)
+    rf.run()
+    assert rf.manifest["status"] == "complete"
+    warnings = rf.manifest["legs"]["bench_couple"]["warnings"]
+    assert any("non-binding dry run" in w for w in warnings)
+
+
+def test_runner_over_budget_leg_poisons_binding_verdict(tmp_path,
+                                                        stub_entry):
+    spec = campaign.CampaignSpec(name="stub", legs=(
+        campaign.LegSpec("ppr_serve", "stub",
+                         {"doc": {"command": ["bench"], "exit_code": 0,
+                                  "output": serve_output()}},
+                         budget_s=0.0,  # any wall overruns
+                         verdicts=("ppr_serve_floors",)),
+    ))
+    r = campaign.CampaignRunner(str(tmp_path / "ob"), spec,
+                                fake_devices=0,
+                                clock=iter([0.0, 5.0]).__next__)
+    r.run()
+    assert r.manifest["legs"]["ppr_serve"]["over_budget"] is True
+    rep = r.write_report()
+    assert rep["legs"][0]["within_budget"] is False
+    v = rep["verdicts"]["ppr_serve_floors"]
+    assert v["decision"] == "inconclusive"
+    assert "wall budget" in v["reason"]
+
+
+def test_corrupt_artifact_recomputes(tmp_path, stub_entry):
+    d = str(tmp_path / "corrupt")
+    r = campaign.CampaignRunner(d, stub_spec(), fake_devices=8)
+    r.run()
+    path = r.artifact_path(0, stub_spec().legs[0])
+    with open(path, "r+b") as f:
+        f.seek(60)
+        f.write(b"\xff\xff\xff\xff")
+    del stub_entry[:]
+    r2 = campaign.CampaignRunner(d, stub_spec(), fake_devices=8)
+    r2.run()
+    # The corrupt leg recomputed; the intact ones resumed.
+    assert r2.manifest["legs"]["hlo"]["skipped"] is False
+    assert r2.manifest["legs"]["bench_couple"]["skipped"] is True
+    assert any(p["doc"] is STUB_HLO for p in stub_entry)
+
+
+def test_campaign_cli_status_report_exit_codes(tmp_path, stub_entry,
+                                               capsys):
+    # Missing campaign dir: usage error.
+    assert obs_main(["campaign", "status", "--campaign-dir",
+                     str(tmp_path / "nope")]) == 2
+    assert obs_main(["campaign", "report", "--campaign-dir",
+                     str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+    # Incomplete campaign: report renders but exits 1.
+    d = str(tmp_path / "partial")
+
+    class FakeDrain:
+        def check(self, where=""):
+            if where == "campaign/ppr_serve":
+                raise jobs.DrainInterrupt("drain")
+
+    r = campaign.CampaignRunner(d, stub_spec(), fake_devices=8)
+    with pytest.raises(jobs.DrainInterrupt):
+        r.run(drain=FakeDrain())
+    r.interrupt("campaign/ppr_serve")
+    assert obs_main(["campaign", "status", "--campaign-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "interrupted" in out
+    assert obs_main(["campaign", "report", "--campaign-dir", d,
+                     "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["complete"] is False
+    # status of the not-yet-run leg shows pending in the leg table.
+    assert [e["status"] for e in rep["legs"]] == \
+        ["done", "done", "pending"]
+    # --full adds the volatile evidence block.
+    assert obs_main(["campaign", "report", "--campaign-dir", d,
+                     "--json", "--full"]) == 1
+    full = json.loads(capsys.readouterr().out)
+    assert "evidence" in full and "leg_docs" in full
+    assert full["verdicts"].keys() == rep["verdicts"].keys()
+
+
+def test_stable_report_is_canonical_and_pure(tmp_path, stub_entry):
+    d = str(tmp_path / "canon")
+    r = campaign.CampaignRunner(d, stub_spec(), fake_devices=8)
+    r.run()
+    rep1 = r.write_report()
+    spec, manifest, docs, metas = campaign.load_campaign(d)
+    rep2 = campaign.build_report(spec, manifest, docs, metas,
+                                 budgets=None)
+    # build_report is pure over (spec, statuses, docs): re-deriving
+    # from disk canonicalizes to the same bytes the runner wrote —
+    # modulo budgets, which only shape evidence, not dry-run
+    # decisions.
+    assert report_mod.canonical_json(rep2) == \
+        report_mod.canonical_json(rep1)
+    with open(r.report_path) as f:
+        assert f.read() == report_mod.canonical_json(rep1)
+
+
+def test_build_spec_profiles():
+    smoke = campaign.build_spec("smoke", ndev=8)
+    road = campaign.build_spec("roadmap", ndev=8)
+    assert [l.name for l in smoke.legs] == [l.name for l in road.legs]
+    assert [l.name for l in smoke.legs] == [
+        "hlo", "fit", "graph", "bench_couple", "bench_multichip",
+        "ppr_serve", "history_gate"]
+    # All verdict/precondition/entrypoint names resolve.
+    for leg in smoke.legs:
+        assert leg.entrypoint in campaign.ENTRYPOINTS
+        for v in leg.verdicts:
+            assert v in campaign.VERDICTS
+        for p in leg.preconditions:
+            assert p in campaign.PRECONDITIONS
+    assert {v for l in smoke.legs for v in l.verdicts} == \
+        set(campaign.VERDICTS)
+    # Spec round-trips through its manifest encoding.
+    assert campaign.CampaignSpec.from_doc(smoke.to_doc()) == smoke
+
+
+# -- the real thing (slow tier; also acceptance smoke AA) --------------------
+
+
+def _campaign_child_args(d):
+    return ["campaign", "run", "--campaign-dir", str(d),
+            "--fake-devices", "8"]
+
+
+@pytest.mark.slow
+def test_campaign_dry_run_sigkill_chaos_byte_identical(tmp_path):
+    """ISSUE 20 acceptance criterion verbatim: the dry run completes
+    end-to-end on CPU as a real subprocess; SIGKILL mid-leg + re-run
+    resumes by skipping completed legs; the final report is
+    byte-identical to the uninterrupted run's."""
+    d1, d2 = tmp_path / "clean", tmp_path / "chaos"
+    r = run_job_subprocess(_campaign_child_args(d1),
+                           module="pagerank_tpu.obs", timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    clean = (d1 / campaign.REPORT_NAME).read_bytes()
+    rep = json.loads(clean)
+    assert rep["complete"] and not rep["binding"]
+    assert set(rep["verdicts"]) == set(campaign.VERDICTS)
+    assert all(v["decision"] == "defer"
+               for v in rep["verdicts"].values())
+    assert len(rep["decision_ledger"]) == len(campaign.VERDICTS)
+    # SIGKILL lands mid-campaign, at the bench_couple leg.
+    kill = ProcessKillPlan(stage="bench_couple",
+                           signum=signal.SIGKILL)
+    r = run_job_subprocess(_campaign_child_args(d2), kill=kill,
+                           module="pagerank_tpu.obs", timeout=900,
+                           kill_log=str(tmp_path / "kill.log"))
+    assert r.returncode == -signal.SIGKILL
+    m = json.load(open(d2 / campaign.MANIFEST_NAME))
+    assert m["legs"]["hlo"]["status"] == "done"
+    assert m["legs"]["bench_couple"]["status"] == "running"
+    assert not (d2 / campaign.REPORT_NAME).exists()
+    # Resume: completed legs skip, only the killed leg onward re-runs.
+    r = run_job_subprocess(_campaign_child_args(d2),
+                           module="pagerank_tpu.obs", timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert r.stderr.count("validated artifact, skipping") == 3
+    m = json.load(open(d2 / campaign.MANIFEST_NAME))
+    assert m["resumes"] == 1
+    assert m["legs"]["hlo"]["skipped"] is True
+    assert m["legs"]["bench_couple"]["skipped"] is False
+    assert (d2 / campaign.REPORT_NAME).read_bytes() == clean
+
+
+@pytest.mark.slow
+def test_campaign_sigterm_drains_to_75(tmp_path):
+    d = tmp_path / "drain"
+    kill = ProcessKillPlan(stage="fit", signum=signal.SIGTERM)
+    r = run_job_subprocess(_campaign_child_args(d), kill=kill,
+                           module="pagerank_tpu.obs", timeout=900)
+    assert r.returncode == 75, (r.returncode, r.stderr[-2000:])
+    m = json.load(open(d / campaign.MANIFEST_NAME))
+    assert m["status"] == "interrupted"
+    assert m["legs"]["hlo"]["status"] == "done"
